@@ -1,0 +1,29 @@
+"""nos_tpu — a TPU-native dynamic accelerator partitioning + elastic quota framework.
+
+Built from scratch with the capabilities of the reference (nebuly-ai/nos, a Go
+Kubernetes operator suite — see SURVEY.md): a geometry planner that watches pending
+Pods requesting device *fractions*, simulates scheduling with an embedded scheduler
+framework, and actuates new partitionings through node agents; plus
+ElasticQuota/CompositeElasticQuota with min/max, namespace borrowing and
+preemption-based fair sharing.
+
+The first-class partitioning mode here is **TPU**: Cloud TPU pods are carved into
+ICI-contiguous sub-slices (2x2, 4x4, ...) exposed as fractional `google.com/tpu`
+resources, with a topology-aware scheduler that bin-packs JAX workloads onto
+connected meshes. NVIDIA MIG and MPS modes are kept for parity with the reference.
+
+Package map (reference layer in parentheses — SURVEY.md §1):
+  - ``nos_tpu.api``          CRDs, annotation protocol, resource math   (pkg/api, pkg/resource)
+  - ``nos_tpu.cluster``      in-memory cluster API with watch streams   (k8s API server / envtest seam)
+  - ``nos_tpu.tpu``          TPU topology / sub-slice domain model      (pkg/gpu + pkg/gpu/mig analog)
+  - ``nos_tpu.gpu``          MIG + MPS device domain models             (pkg/gpu/mig, pkg/gpu/slicing)
+  - ``nos_tpu.partitioning`` mode-agnostic planner/actuator engine      (internal/partitioning)
+  - ``nos_tpu.scheduler``    plugin framework + CapacityScheduling      (pkg/scheduler/plugins)
+  - ``nos_tpu.controllers``  reconcilers: partitioner, agents, quotas   (internal/controllers)
+  - ``nos_tpu.tpulib``       native C++ slice shim + ctypes bindings    (pkg/gpu/nvml analog)
+  - ``nos_tpu.parallel``     JAX mesh/sharding/collectives for workloads (TPU-native, no ref analog)
+  - ``nos_tpu.ops``          Pallas TPU kernels for workload hot ops
+  - ``nos_tpu.models``       flagship JAX workloads (bench + graft entry)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""API layer: object model, CRDs, annotation protocol, resource arithmetic."""
+
+from nos_tpu.api.objects import (  # noqa: F401
+    ConfigMap,
+    Container,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.api.resources import (  # noqa: F401
+    ResourceList,
+    compute_pod_request,
+    parse_quantity,
+)

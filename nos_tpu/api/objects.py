@@ -1,0 +1,130 @@
+"""Core cluster object model: ObjectMeta, Pod, Node, ConfigMap.
+
+A deliberately small, typed mirror of the k8s objects the reference manipulates
+(it consumes them via client-go; we model just the fields the planner, scheduler
+and controllers touch). Value semantics: the in-memory cluster deep-copies on
+store/read, like an API server.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nos_tpu.api.resources import ResourceList
+
+_uid_counter = itertools.count(1)
+
+
+def _next_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    uid: str = field(default_factory=_next_uid)
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+
+    @property
+    def namespaced_name(self) -> str:
+        return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    resources: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    priority: int = 0
+    overhead: ResourceList = field(default_factory=ResourceList)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodStatus:
+    phase: str = PodPhase.PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+    KIND = "Pod"
+
+    def deepcopy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+    def condition(self, ctype: str) -> Optional[PodCondition]:
+        for c in self.status.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=ResourceList)
+    allocatable: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    KIND = "Node"
+
+    def deepcopy(self) -> "Node":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    KIND = "ConfigMap"
+
+    def deepcopy(self) -> "ConfigMap":
+        return copy.deepcopy(self)

@@ -1,0 +1,3 @@
+"""In-memory cluster API: the control bus standing in for the k8s API server."""
+
+from nos_tpu.cluster.client import Cluster, Event, EventType  # noqa: F401

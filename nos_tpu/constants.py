@@ -1,0 +1,122 @@
+"""Wire-protocol constants: resource names, labels, annotations, defaults.
+
+Analog of the reference's pkg/constant/constants.go:26-112 and
+pkg/api/nos.nebuly.com/v1alpha1/{labels.go:19-24, annotations.go:21-58}, with TPU
+as the first-class device family. The label/annotation names below ARE the public
+protocol between the central partitioner and node agents — everything else is
+implementation detail.
+"""
+
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------------------
+# Domain prefix for all labels/annotations owned by this framework.
+# ---------------------------------------------------------------------------
+DOMAIN = "tpu.nos"
+
+# ---------------------------------------------------------------------------
+# Resource names.
+# ---------------------------------------------------------------------------
+# Whole-chip TPU resource exposed by the TPU device plugin.
+RESOURCE_TPU = "google.com/tpu"
+# Fractional TPU sub-slice resources carved by the tpuagent, e.g.
+# "google.com/tpu-2x2" (a 4-chip ICI-contiguous sub-slice of a larger mesh).
+RESOURCE_TPU_SLICE_PREFIX = "google.com/tpu-"
+RESOURCE_TPU_SLICE_REGEX = re.compile(r"^google\.com/tpu-(\d+x\d+(?:x\d+)?)$")
+
+# NVIDIA parity modes (reference pkg/constant/constants.go resource regexes).
+RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
+RESOURCE_MIG_PREFIX = "nvidia.com/mig-"
+RESOURCE_MIG_REGEX = re.compile(r"^nvidia\.com/mig-(\d+)g\.(\d+)gb$")
+RESOURCE_MPS_REGEX = re.compile(r"^nvidia\.com/gpu-(\d+)gb$")
+
+# Synthetic resource injected into pod requests so Elastic Quotas can meter
+# heterogeneous accelerator requests in a single unit. The reference used
+# "nos.nebuly.com/gpu-memory" (pkg/gpu/util/resource.go:28-86); here the common
+# unit is accelerator *memory GB* as well, covering TPU slices (HBM GB) and GPUs.
+RESOURCE_ACCELERATOR_MEMORY = f"{DOMAIN}/accelerator-memory"
+
+# Non-accelerator resources.
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+
+# ---------------------------------------------------------------------------
+# Labels (reference labels.go:19-24).
+# ---------------------------------------------------------------------------
+# Which partitioning mode a node participates in: "tpu" | "mig" | "mps".
+LABEL_PARTITIONING = f"{DOMAIN}/partitioning"
+# Quota capacity status stamped on running pods by the quota reconciler.
+LABEL_CAPACITY = f"{DOMAIN}/capacity"
+CAPACITY_IN_QUOTA = "in-quota"
+CAPACITY_OVER_QUOTA = "over-quota"
+
+# TPU node discovery labels (the GKE TPU analog of NVIDIA GFD labels,
+# reference pkg/gpu/util.go:30-73).
+LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"  # e.g. "tpu-v5-lite-podslice"
+LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"        # e.g. "4x4"
+
+# NVIDIA GFD labels (kept verbatim for MIG/MPS parity modes).
+LABEL_GPU_PRODUCT = "nvidia.com/gpu.product"
+LABEL_GPU_COUNT = "nvidia.com/gpu.count"
+LABEL_GPU_MEMORY = "nvidia.com/gpu.memory"
+# NVIDIA device-plugin config selector label (MPS actuation channel,
+# reference mps/partitioner.go:104-113).
+LABEL_DEVICE_PLUGIN_CONFIG = "nvidia.com/device-plugin.config"
+
+# ---------------------------------------------------------------------------
+# Annotations — the spec/status protocol between planner and node agents
+# (reference annotations.go:21-58). `dev` stands for any partitionable device:
+# a TPU board's chip group index, or a GPU index.
+#
+#   spec:    tpu.nos/spec-dev-<index>-<profile> = <quantity>
+#   status:  tpu.nos/status-dev-<index>-<profile>-<free|used> = <quantity>
+#   plan id: tpu.nos/spec-partitioning-plan / tpu.nos/status-partitioning-plan
+# ---------------------------------------------------------------------------
+ANNOTATION_SPEC_PREFIX = f"{DOMAIN}/spec-dev-"
+ANNOTATION_STATUS_PREFIX = f"{DOMAIN}/status-dev-"
+ANNOTATION_SPEC_PLAN = f"{DOMAIN}/spec-partitioning-plan"
+ANNOTATION_STATUS_PLAN = f"{DOMAIN}/status-partitioning-plan"
+
+ANNOTATION_SPEC_REGEX = re.compile(
+    rf"^{re.escape(ANNOTATION_SPEC_PREFIX)}(\d+)-(.+)$"
+)
+ANNOTATION_STATUS_REGEX = re.compile(
+    rf"^{re.escape(ANNOTATION_STATUS_PREFIX)}(\d+)-(.+)-(free|used)$"
+)
+
+# ---------------------------------------------------------------------------
+# Defaults (reference constants.go + config/v1alpha1 defaults).
+# ---------------------------------------------------------------------------
+# Default GPU memory (GB) assumed for a whole GPU when GFD labels are missing.
+DEFAULT_GPU_MEMORY_GB = 16
+# Default HBM per TPU chip generation, GB (v5e = 16, v4 = 32, v5p = 95).
+TPU_CHIP_MEMORY_GB = {"v4": 32, "v5e": 16, "v5p": 95, "v6e": 32}
+DEFAULT_TPU_CHIP_MEMORY_GB = 16
+
+# Pod batching windows for the partitioner controller
+# (reference gpu_partitioner_config.go:33-34 defaults).
+DEFAULT_BATCH_WINDOW_TIMEOUT_S = 60.0
+DEFAULT_BATCH_WINDOW_IDLE_S = 10.0
+# Requeue delay while waiting for nodes to report the last plan
+# (reference partitioner_controller.go:118-122).
+PLAN_REPORT_REQUEUE_S = 10.0
+
+# Device-plugin ConfigMap defaults (MPS mode; reference constants.go).
+DEFAULT_DEVICE_PLUGIN_CM_NAME = "nvidia-device-plugin-configs"
+DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE = "kube-system"
+DEFAULT_DEVICE_PLUGIN_DELAY_S = 5.0
+
+# Scheduler name used by pods that want quota-aware scheduling.
+SCHEDULER_NAME = "nos-tpu-scheduler"
+
+# Env var node agents use to learn their node (reference constant.EnvVarNodeName).
+ENV_NODE_NAME = "NODE_NAME"
+
+# Partitioning kinds.
+KIND_TPU = "tpu"
+KIND_MIG = "mig"
+KIND_MPS = "mps"
+PARTITIONING_KINDS = (KIND_TPU, KIND_MIG, KIND_MPS)

@@ -1,0 +1,1 @@
+"""Generic utilities: batching, pod predicates, small generics."""

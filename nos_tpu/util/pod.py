@@ -1,0 +1,60 @@
+"""Pod predicates (reference pkg/util/pod/pod.go:31-48)."""
+
+from __future__ import annotations
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Pod, PodPhase
+
+# k8s PodScheduled condition constants.
+COND_POD_SCHEDULED = "PodScheduled"
+REASON_UNSCHEDULABLE = "Unschedulable"
+
+
+def is_pending(pod: Pod) -> bool:
+    return pod.status.phase == PodPhase.PENDING
+
+
+def is_unschedulable(pod: Pod) -> bool:
+    cond = pod.condition(COND_POD_SCHEDULED)
+    return (
+        cond is not None
+        and cond.status == "False"
+        and cond.reason == REASON_UNSCHEDULABLE
+    )
+
+
+def is_preempting(pod: Pod) -> bool:
+    return bool(pod.status.nominated_node_name)
+
+
+def is_owned_by_daemonset_or_node(pod: Pod) -> bool:
+    return any(o.kind in ("DaemonSet", "Node") for o in pod.owner_references)
+
+
+def extra_resources_could_help_scheduling(pod: Pod) -> bool:
+    """The gate for feeding a pod to the partitioner batch (pod.go:41-48):
+    pending AND marked unschedulable AND not already preempting AND not owned by
+    a DaemonSet/Node (those are pinned and new capacity can't help)."""
+    return (
+        is_pending(pod)
+        and is_unschedulable(pod)
+        and not is_preempting(pod)
+        and not is_owned_by_daemonset_or_node(pod)
+    )
+
+
+def is_over_quota(pod: Pod) -> bool:
+    """Over-quota pods are preemption victims first (pod.go:31-36)."""
+    return pod.metadata.labels.get(constants.LABEL_CAPACITY) == constants.CAPACITY_OVER_QUOTA
+
+
+def is_scheduled(pod: Pod) -> bool:
+    return bool(pod.spec.node_name)
+
+
+def is_active(pod: Pod) -> bool:
+    """Consumes resources on its node: scheduled and not finished."""
+    return is_scheduled(pod) and pod.status.phase not in (
+        PodPhase.SUCCEEDED,
+        PodPhase.FAILED,
+    )

@@ -1,0 +1,13 @@
+"""Test harness setup.
+
+JAX runs on a virtual 8-device CPU mesh during tests (multi-chip sharding
+paths compile and execute without TPU hardware); this must be configured
+before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,81 @@
+"""Batcher tests with injected time (reference pkg/util/batcher_test.go analog)."""
+
+from nos_tpu.util.batcher import Batcher
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_empty_batcher_never_ready():
+    clk = FakeClock()
+    b = Batcher(timeout_s=60, idle_s=10, now=clk)
+    assert not b.ready()
+    clk.advance(1000)
+    assert not b.ready()
+    assert b.drain_if_ready() == []
+
+
+def test_idle_window_closes_batch():
+    clk = FakeClock()
+    b = Batcher(timeout_s=60, idle_s=10, now=clk)
+    b.add("a")
+    clk.advance(5)
+    b.add("b")
+    clk.advance(9)
+    assert not b.ready()  # only 9s idle
+    clk.advance(1.5)
+    assert b.ready()
+    assert b.drain_if_ready() == ["a", "b"]
+    assert len(b) == 0
+
+
+def test_timeout_window_closes_batch_despite_activity():
+    clk = FakeClock()
+    b = Batcher(timeout_s=30, idle_s=10, now=clk)
+    b.add(0)
+    for i in range(1, 7):
+        clk.advance(5)  # keep idle window open
+        b.add(i)
+    assert b.ready()  # 30s since first item
+    assert b.drain_if_ready() == list(range(7))
+
+
+def test_new_batch_after_drain_restarts_windows():
+    clk = FakeClock()
+    b = Batcher(timeout_s=30, idle_s=10, now=clk)
+    b.add("x")
+    clk.advance(10)
+    assert b.drain_if_ready() == ["x"]
+    b.add("y")
+    assert not b.ready()
+    clk.advance(10)
+    assert b.drain_if_ready() == ["y"]
+
+
+def test_idle_defaults_to_timeout_when_invalid():
+    clk = FakeClock()
+    b = Batcher(timeout_s=10, idle_s=0, now=clk)
+    b.add(1)
+    clk.advance(9.9)
+    assert not b.ready()
+    clk.advance(0.2)
+    assert b.ready()
+
+
+def test_seconds_until_ready():
+    clk = FakeClock()
+    b = Batcher(timeout_s=30, idle_s=10, now=clk)
+    assert b.seconds_until_ready() is None
+    b.add(1)
+    assert b.seconds_until_ready() == 10
+    clk.advance(25)
+    b.add(2)
+    assert b.seconds_until_ready() == 5  # timeout closer than idle now

@@ -1,0 +1,121 @@
+"""In-memory cluster API tests: CRUD, value semantics, watch, webhooks."""
+
+import pytest
+
+from nos_tpu.api.objects import Node, ObjectMeta, Pod, PodPhase
+from nos_tpu.cluster.client import (
+    AdmissionError,
+    AlreadyExistsError,
+    Cluster,
+    ConflictError,
+    EventType,
+    NotFoundError,
+)
+
+
+def make_pod(name, ns="default", phase=PodPhase.PENDING):
+    p = Pod(metadata=ObjectMeta(name=name, namespace=ns))
+    p.status.phase = phase
+    return p
+
+
+def test_create_get_roundtrip_with_value_semantics():
+    c = Cluster()
+    pod = make_pod("a")
+    c.create(pod)
+    pod.metadata.labels["mutated-after-create"] = "yes"  # must not leak into store
+    got = c.get("Pod", "default", "a")
+    assert got.metadata.name == "a"
+    assert "mutated-after-create" not in got.metadata.labels
+    got.metadata.labels["mutated-after-read"] = "yes"  # must not leak either
+    assert "mutated-after-read" not in c.get("Pod", "default", "a").metadata.labels
+
+
+def test_create_duplicate_and_get_missing():
+    c = Cluster()
+    c.create(make_pod("a"))
+    with pytest.raises(AlreadyExistsError):
+        c.create(make_pod("a"))
+    with pytest.raises(NotFoundError):
+        c.get("Pod", "default", "nope")
+    assert c.try_get("Pod", "default", "nope") is None
+
+
+def test_update_optimistic_concurrency():
+    c = Cluster()
+    stored = c.create(make_pod("a"))
+    stale = c.get("Pod", "default", "a")
+    stored.status.phase = PodPhase.RUNNING
+    c.update(stored)
+    stale.status.phase = PodPhase.FAILED
+    with pytest.raises(ConflictError):
+        c.update(stale)
+    assert c.get("Pod", "default", "a").status.phase == PodPhase.RUNNING
+
+
+def test_patch_read_modify_write():
+    c = Cluster()
+    c.create(make_pod("a"))
+
+    def set_label(p):
+        p.metadata.labels["k"] = "v"
+
+    c.patch("Pod", "default", "a", set_label)
+    assert c.get("Pod", "default", "a").metadata.labels["k"] == "v"
+
+
+def test_list_filters():
+    c = Cluster()
+    c.create(make_pod("a", ns="ns1"))
+    c.create(make_pod("b", ns="ns2"))
+    running = make_pod("c", ns="ns1", phase=PodPhase.RUNNING)
+    running.metadata.labels["app"] = "x"
+    c.create(running)
+    c.create(Node(metadata=ObjectMeta(name="n1")))
+
+    assert [p.metadata.name for p in c.list("Pod")] == ["a", "c", "b"]
+    assert [p.metadata.name for p in c.list("Pod", namespace="ns1")] == ["a", "c"]
+    assert [p.metadata.name for p in c.list("Pod", label_selector={"app": "x"})] == ["c"]
+    assert [
+        p.metadata.name
+        for p in c.list("Pod", predicate=lambda p: p.status.phase == PodPhase.PENDING)
+    ] == ["a", "b"]
+    assert [n.metadata.name for n in c.list("Node")] == ["n1"]
+
+
+def test_watch_replay_and_live_events():
+    c = Cluster()
+    c.create(make_pod("pre"))
+    events = []
+    unsub = c.watch("Pod", events.append)
+    assert [(e.type, e.obj.metadata.name) for e in events] == [(EventType.ADDED, "pre")]
+
+    c.create(make_pod("live"))
+    c.patch("Pod", "default", "live", lambda p: p.metadata.labels.update(x="1"))
+    c.delete("Pod", "default", "live")
+    types = [(e.type, e.obj.metadata.name) for e in events[1:]]
+    assert types == [
+        (EventType.ADDED, "live"),
+        (EventType.MODIFIED, "live"),
+        (EventType.DELETED, "live"),
+    ]
+    # MODIFIED events carry the old object for predicate diffing.
+    assert events[2].old_obj is not None and "x" not in events[2].old_obj.metadata.labels
+
+    unsub()
+    c.create(make_pod("after-unsub"))
+    assert len(events) == 4
+
+
+def test_admission_webhook_rejects():
+    c = Cluster()
+
+    def deny_ns(op, obj, old):
+        if obj.metadata.namespace == "forbidden":
+            raise AdmissionError("nope")
+
+    c.register_webhook("Pod", deny_ns)
+    c.create(make_pod("ok"))
+    with pytest.raises(AdmissionError):
+        c.create(make_pod("bad", ns="forbidden"))
+    assert c.try_get("Pod", "forbidden", "bad") is None

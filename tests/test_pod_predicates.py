@@ -1,0 +1,63 @@
+"""Pod predicate tests (reference pkg/util/pod/pod_test.go analog)."""
+
+from nos_tpu import constants
+from nos_tpu.api.objects import ObjectMeta, OwnerReference, Pod, PodCondition, PodPhase
+from nos_tpu.util import pod as podutil
+
+
+def unschedulable_pod(**kw):
+    p = Pod(metadata=ObjectMeta(name="p", namespace="ns"))
+    p.status.phase = PodPhase.PENDING
+    p.status.conditions.append(
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    for k, v in kw.items():
+        setattr(p, k, v)
+    return p
+
+
+def test_extra_resources_could_help_scheduling_happy_path():
+    assert podutil.extra_resources_could_help_scheduling(unschedulable_pod())
+
+
+def test_running_pod_not_eligible():
+    p = unschedulable_pod()
+    p.status.phase = PodPhase.RUNNING
+    assert not podutil.extra_resources_could_help_scheduling(p)
+
+
+def test_pending_but_not_marked_unschedulable_not_eligible():
+    p = Pod()
+    p.status.phase = PodPhase.PENDING
+    assert not podutil.extra_resources_could_help_scheduling(p)
+
+
+def test_preempting_pod_not_eligible():
+    p = unschedulable_pod()
+    p.status.nominated_node_name = "node-1"
+    assert not podutil.extra_resources_could_help_scheduling(p)
+
+
+def test_daemonset_owned_pod_not_eligible():
+    p = unschedulable_pod()
+    p.owner_references.append(OwnerReference(kind="DaemonSet", name="ds"))
+    assert not podutil.extra_resources_could_help_scheduling(p)
+
+
+def test_is_over_quota_label():
+    p = Pod()
+    assert not podutil.is_over_quota(p)
+    p.metadata.labels[constants.LABEL_CAPACITY] = constants.CAPACITY_OVER_QUOTA
+    assert podutil.is_over_quota(p)
+    p.metadata.labels[constants.LABEL_CAPACITY] = constants.CAPACITY_IN_QUOTA
+    assert not podutil.is_over_quota(p)
+
+
+def test_is_active():
+    p = Pod()
+    assert not podutil.is_active(p)  # unscheduled
+    p.spec.node_name = "n1"
+    p.status.phase = PodPhase.RUNNING
+    assert podutil.is_active(p)
+    p.status.phase = PodPhase.SUCCEEDED
+    assert not podutil.is_active(p)

@@ -1,0 +1,64 @@
+"""Resource math tests (reference pkg/resource/resource_test.go analog)."""
+
+import pytest
+
+from nos_tpu.api.objects import Container, Pod, PodSpec
+from nos_tpu.api.resources import ResourceList, compute_pod_request, parse_quantity
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("500m", 0.5),
+        ("2", 2.0),
+        (3, 3.0),
+        ("1Gi", 2**30),
+        ("10G", 10e9),
+        ("1.5", 1.5),
+        ("250m", 0.25),
+        ("2Ki", 2048.0),
+    ],
+)
+def test_parse_quantity(raw, expected):
+    assert parse_quantity(raw) == pytest.approx(expected)
+
+
+def test_resource_list_arithmetic():
+    a = ResourceList.of({"cpu": "1", "google.com/tpu": 4})
+    b = ResourceList.of({"cpu": "500m", "google.com/tpu": 6})
+    assert a.add(b) == {"cpu": 1.5, "google.com/tpu": 10}
+    assert a.subtract(b) == {"cpu": 0.5, "google.com/tpu": -2}
+    assert a.subtract_non_negative(b) == {"cpu": 0.5}
+    assert a.subtract(b).negatives() == {"google.com/tpu": -2}
+    assert a.subtract(b).abs() == {"cpu": 0.5, "google.com/tpu": 2}
+
+
+def test_resource_list_equality_ignores_zero_entries():
+    assert ResourceList.of({"cpu": 1, "x": 0}) == ResourceList.of({"cpu": 1})
+    assert ResourceList.of({"cpu": 1}) != ResourceList.of({"cpu": 2})
+
+
+def test_fits_in():
+    cap = ResourceList.of({"cpu": 4, "google.com/tpu-2x2": 2})
+    assert ResourceList.of({"cpu": 2, "google.com/tpu-2x2": 2}).fits_in(cap)
+    assert not ResourceList.of({"google.com/tpu-2x2": 3}).fits_in(cap)
+    assert not ResourceList.of({"nvidia.com/gpu": 1}).fits_in(cap)
+
+
+def test_compute_pod_request_max_of_init_and_sum_of_containers():
+    pod = Pod(
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceList.of({"cpu": 1, "memory": "1Gi"})),
+                Container(resources=ResourceList.of({"cpu": 2})),
+            ],
+            init_containers=[
+                Container(resources=ResourceList.of({"cpu": 5})),
+                Container(resources=ResourceList.of({"memory": "4Gi"})),
+            ],
+            overhead=ResourceList.of({"cpu": "100m"}),
+        )
+    )
+    req = compute_pod_request(pod)
+    assert req["cpu"] == pytest.approx(5.1)  # max(init 5, sum 3) + overhead
+    assert req["memory"] == pytest.approx(4 * 2**30)
