@@ -1,0 +1,165 @@
+"""The spec/status node-annotation protocol.
+
+This is the RPC protocol between the central partitioner and node agents
+(reference pkg/api/nos.nebuly.com/v1alpha1/annotations.go:21-58 and the
+parser/formatter in pkg/gpu/annotation.go:29-224):
+
+  spec   (written by planner):  tpu.nos/spec-dev-<index>-<profile> = <qty>
+  status (written by agent):    tpu.nos/status-dev-<index>-<profile>-<free|used> = <qty>
+  plan handshake:               tpu.nos/spec-partitioning-plan / status-partitioning-plan
+
+`index` identifies a partitionable device on the node (a GPU index, or 0 for
+the node's whole TPU mesh); `profile` is mode-specific ("2x2", "1g.10gb",
+"10gb"). The planner won't re-plan until every node's status plan id matches
+its spec plan id (reference partitioner_controller.go:212-232).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+from nos_tpu import constants
+
+
+@dataclass(frozen=True)
+class SpecAnnotation:
+    device_index: int
+    profile: str
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return f"{constants.ANNOTATION_SPEC_PREFIX}{self.device_index}-{self.profile}"
+
+
+@dataclass(frozen=True)
+class StatusAnnotation:
+    device_index: int
+    profile: str
+    status: str  # "free" | "used"
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return (
+            f"{constants.ANNOTATION_STATUS_PREFIX}{self.device_index}-"
+            f"{self.profile}-{self.status}"
+        )
+
+
+def parse_spec(annotations: Mapping[str, str]) -> List[SpecAnnotation]:
+    out = []
+    for k, v in annotations.items():
+        m = constants.ANNOTATION_SPEC_REGEX.match(k)
+        if m:
+            out.append(SpecAnnotation(int(m.group(1)), m.group(2), int(v)))
+    out.sort(key=lambda a: (a.device_index, a.profile))
+    return out
+
+
+def parse_status(annotations: Mapping[str, str]) -> List[StatusAnnotation]:
+    out = []
+    for k, v in annotations.items():
+        m = constants.ANNOTATION_STATUS_REGEX.match(k)
+        if m:
+            out.append(StatusAnnotation(int(m.group(1)), m.group(2), m.group(3), int(v)))
+    out.sort(key=lambda a: (a.device_index, a.profile, a.status))
+    return out
+
+
+def format_spec(specs: Iterable[SpecAnnotation]) -> Dict[str, str]:
+    return {s.key: str(s.quantity) for s in specs if s.quantity > 0}
+
+
+def format_status(statuses: Iterable[StatusAnnotation]) -> Dict[str, str]:
+    return {s.key: str(s.quantity) for s in statuses}
+
+
+def spec_from_geometry(device_index: int, geometry: Mapping) -> List[SpecAnnotation]:
+    """Geometry (profile -> count; profile str()s to its name) -> spec annotations."""
+    return [
+        SpecAnnotation(device_index, str(p), int(n))
+        for p, n in sorted(geometry.items(), key=lambda kv: str(kv[0]))
+        if n > 0
+    ]
+
+
+def status_from_geometry(
+    device_index: int, geometry: Mapping, used: Mapping
+) -> List[StatusAnnotation]:
+    out = []
+    for p, n in sorted(geometry.items(), key=lambda kv: str(kv[0])):
+        u = min(int(used.get(p, 0)), int(n))
+        out.append(StatusAnnotation(device_index, str(p), "used", u))
+        out.append(StatusAnnotation(device_index, str(p), "free", int(n) - u))
+    return out
+
+
+def geometry_counts_from_spec(
+    specs: Iterable[SpecAnnotation],
+) -> Dict[int, Dict[str, int]]:
+    """device_index -> {profile name -> quantity}."""
+    out: Dict[int, Dict[str, int]] = {}
+    for s in specs:
+        out.setdefault(s.device_index, {})[s.profile] = s.quantity
+    return out
+
+
+def geometry_counts_from_status(
+    statuses: Iterable[StatusAnnotation],
+) -> Dict[int, Dict[str, Tuple[int, int]]]:
+    """device_index -> {profile name -> (free, used)}."""
+    out: Dict[int, Dict[str, Tuple[int, int]]] = {}
+    for s in statuses:
+        free, used = out.setdefault(s.device_index, {}).get(s.profile, (0, 0))
+        if s.status == "free":
+            free = s.quantity
+        else:
+            used = s.quantity
+        out[s.device_index][s.profile] = (free, used)
+    return out
+
+
+def spec_matches_status(
+    specs: Iterable[SpecAnnotation], statuses: Iterable[StatusAnnotation]
+) -> bool:
+    """True when the reported geometry equals the desired one (per device &
+    profile: spec quantity == free+used) — mig/annotation.go SpecMatchesStatus."""
+    want = geometry_counts_from_spec(specs)
+    got = {
+        idx: {prof: free + used for prof, (free, used) in profs.items() if free + used > 0}
+        for idx, profs in geometry_counts_from_status(statuses).items()
+    }
+    got = {idx: profs for idx, profs in got.items() if profs}
+    want = {
+        idx: {prof: q for prof, q in profs.items() if q > 0}
+        for idx, profs in want.items()
+    }
+    want = {idx: profs for idx, profs in want.items() if profs}
+    return want == got
+
+
+# -- plan-id handshake ------------------------------------------------------
+def get_spec_plan(annotations: Mapping[str, str]) -> Optional[str]:
+    return annotations.get(constants.ANNOTATION_SPEC_PLAN)
+
+
+def get_status_plan(annotations: Mapping[str, str]) -> Optional[str]:
+    return annotations.get(constants.ANNOTATION_STATUS_PLAN)
+
+
+def node_reported_last_plan(annotations: Mapping[str, str]) -> bool:
+    spec = get_spec_plan(annotations)
+    return spec is None or spec == get_status_plan(annotations)
+
+
+def strip_spec_annotations(annotations: Dict[str, str]) -> None:
+    """Remove all spec partitioning annotations in place (planner rewrite)."""
+    for k in [k for k in annotations if constants.ANNOTATION_SPEC_REGEX.match(k)]:
+        del annotations[k]
+
+
+def strip_status_annotations(annotations: Dict[str, str]) -> None:
+    for k in [k for k in annotations if constants.ANNOTATION_STATUS_REGEX.match(k)]:
+        del annotations[k]

@@ -1,0 +1,16 @@
+"""TPU device domain model.
+
+The analog of the reference's pkg/gpu + pkg/gpu/mig layer (Slice/Geometry
+abstractions, known-geometry tables, greedy UpdateGeometryFor), rebuilt for TPU
+ICI meshes: a *profile* is an ICI-contiguous sub-slice shape (``2x2``,
+``2x2x4``, ...), a *geometry* is a multiset of profiles carved out of one
+node's chip mesh, and *placement* is a canonical deterministic function of the
+geometry (buddy allocation over the mesh) — so the central planner and the
+node agent agree on chip assignment without ever transmitting coordinates.
+"""
+
+from nos_tpu.tpu.shape import Shape  # noqa: F401
+from nos_tpu.tpu.profile import Profile  # noqa: F401
+from nos_tpu.tpu.topology import Topology, accelerator_generation  # noqa: F401
+from nos_tpu.tpu.packing import Placement, pack  # noqa: F401
+from nos_tpu.tpu.mesh import TpuMesh  # noqa: F401

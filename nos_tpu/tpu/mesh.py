@@ -1,0 +1,156 @@
+"""TpuMesh: one node's partitionable chip mesh.
+
+The analog of the reference's mig.GPU (pkg/gpu/mig/gpu.go:97-195): tracks the
+current geometry (carved sub-slices) and which slices are in use, enforces the
+never-delete-used invariant (gpu.go:103-107), and implements the greedy
+UpdateGeometryFor search (gpu.go:141-195) under the ICI packability constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+from nos_tpu.tpu.packing import pack, packable
+from nos_tpu.tpu.profile import Profile
+from nos_tpu.tpu.topology import Topology
+
+Geometry = Dict[Profile, int]
+
+
+def _clean(g: Mapping[Profile, int]) -> Geometry:
+    return {p: int(n) for p, n in g.items() if n > 0}
+
+
+class TpuMesh:
+    def __init__(
+        self,
+        topology: Topology,
+        geometry: Optional[Mapping[Profile, int]] = None,
+        used: Optional[Mapping[Profile, int]] = None,
+    ):
+        self.topology = topology
+        self.geometry: Geometry = _clean(geometry or {})
+        self.used: Geometry = _clean(used or {})
+        for p, n in self.used.items():
+            if n > self.geometry.get(p, 0):
+                raise ValueError(
+                    f"used {n}x{p} exceeds geometry {self.geometry.get(p, 0)}x{p}"
+                )
+        if not packable(self.topology.shape, self.geometry):
+            raise ValueError(
+                f"geometry {self._fmt(self.geometry)} does not pack onto {topology}"
+            )
+
+    @staticmethod
+    def _fmt(g: Mapping[Profile, int]) -> str:
+        return "{" + ", ".join(f"{p}:{n}" for p, n in sorted(g.items())) + "}"
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def free(self) -> Geometry:
+        return _clean(
+            {p: n - self.used.get(p, 0) for p, n in self.geometry.items()}
+        )
+
+    @property
+    def free_chips(self) -> int:
+        return self.topology.chips - sum(p.chips * n for p, n in self.geometry.items())
+
+    def has_free_capacity(self) -> bool:
+        return self.free_chips > 0 or bool(self.free)
+
+    def clone(self) -> "TpuMesh":
+        return TpuMesh(self.topology, dict(self.geometry), dict(self.used))
+
+    # -- geometry transitions ---------------------------------------------
+    def can_apply_geometry(self, new: Mapping[Profile, int]) -> bool:
+        """A new geometry is applicable iff it keeps every in-use slice
+        (never-delete-used, mig/gpu.go:103-107), uses only allowed profiles,
+        and packs onto the ICI mesh."""
+        new = _clean(new)
+        for p, n in self.used.items():
+            if new.get(p, 0) < n:
+                return False
+        if any(not self.topology.is_profile_allowed(p) for p in new):
+            return False
+        return packable(self.topology.shape, new)
+
+    def apply_geometry(self, new: Mapping[Profile, int]) -> None:
+        if not self.can_apply_geometry(new):
+            raise ValueError(
+                f"cannot apply geometry {self._fmt(new)} on {self.topology} "
+                f"(used={self._fmt(self.used)})"
+            )
+        self.geometry = _clean(new)
+
+    def update_geometry_for(self, required: Mapping[Profile, int]) -> bool:
+        """Greedily re-carve free capacity to satisfy as much of `required` as
+        possible, never touching used slices. Returns True iff the geometry
+        changed. Mirrors mig/gpu.go UpdateGeometryFor:141-195 + the MPS
+        delete-free-then-recreate heuristic (slicing/gpu.go:162-232), with
+        packability standing in for the allowed-geometry table lookup.
+        """
+        required = {
+            p: n for p, n in required.items() if n > 0 and self.topology.is_profile_allowed(p)
+        }
+        if not required:
+            return False
+
+        # Start from the immutable floor: slices currently in use.
+        base: Geometry = dict(self.used)
+        satisfied_any = False
+        # Add required profiles largest-first so big contiguous blocks are
+        # reserved before fragmentation.
+        for profile in sorted(required, key=lambda p: (-p.chips, p.name)):
+            for _ in range(required[profile]):
+                trial = dict(base)
+                trial[profile] = trial.get(profile, 0) + 1
+                if packable(self.topology.shape, trial):
+                    base = trial
+                    satisfied_any = True
+
+        if not satisfied_any:
+            return False
+
+        # Preserve existing free slices where they still fit (avoid churn).
+        for profile, n in sorted(self.free.items(), key=lambda kv: (-kv[0].chips, kv[0].name)):
+            for _ in range(n):
+                trial = dict(base)
+                trial[profile] = trial.get(profile, 0) + 1
+                if packable(self.topology.shape, trial):
+                    base = trial
+
+        new_geometry = _clean(base)
+        if new_geometry == self.geometry:
+            return False
+        self.geometry = new_geometry
+        return True
+
+    # -- usage -------------------------------------------------------------
+    def mark_used(self, profile: Profile, count: int = 1) -> None:
+        free = self.geometry.get(profile, 0) - self.used.get(profile, 0)
+        if count > free:
+            raise ValueError(f"cannot use {count}x{profile}: only {free} free")
+        self.used[profile] = self.used.get(profile, 0) + count
+
+    def mark_unused(self, profile: Profile, count: int = 1) -> None:
+        if self.used.get(profile, 0) < count:
+            raise ValueError(f"cannot release {count}x{profile}")
+        self.used[profile] -= count
+        if self.used[profile] == 0:
+            del self.used[profile]
+
+    # -- resource views ----------------------------------------------------
+    def as_resources(self) -> Dict[str, int]:
+        """Extended resources this geometry exposes (allocatable scalars,
+        the analog of mig/node.go:172-195 recompute)."""
+        return {p.resource: n for p, n in self.geometry.items()}
+
+    def placements(self):
+        return pack(self.topology.shape, self.geometry)
+
+    def __repr__(self) -> str:
+        return (
+            f"TpuMesh({self.topology}, geometry={self._fmt(self.geometry)}, "
+            f"used={self._fmt(self.used)})"
+        )

@@ -1,0 +1,126 @@
+"""Canonical placement of a geometry onto an ICI mesh.
+
+ICI contiguity is a *graph* constraint the reference never had (NVML owned MIG
+placement — SURVEY.md §7 "hard parts"). We solve it with a deterministic
+guillotine packer: profiles are placed largest-first, best-fit, splitting free
+cuboids along fixed dimension order. Because the algorithm is a pure function
+of the geometry multiset, the central planner and every node agent compute the
+*same* chip assignment independently — the annotation protocol only ever
+carries profile counts, exactly like the reference's (annotations.go:21-58).
+
+Every placement is a contiguous cuboid of the mesh, so each sub-slice gets a
+fully connected ICI block (its own torus/mesh for XLA collectives).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Mapping, Optional, Tuple
+
+from nos_tpu.tpu.profile import Profile
+from nos_tpu.tpu.shape import Shape
+
+Coord = Tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class Block:
+    origin: Coord
+    dims: Coord
+
+    @property
+    def chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One carved sub-slice: which profile, where, and in which orientation."""
+
+    profile: Profile
+    origin: Coord
+    dims: Coord  # oriented dims actually placed (a permutation of profile.shape.dims)
+
+    @property
+    def chips(self) -> int:
+        return self.profile.chips
+
+
+def _fits(block: Block, want: Coord) -> bool:
+    return all(w <= b for w, b in zip(want, block.dims))
+
+
+def _split(block: Block, want: Coord) -> Tuple[Block, List[Block]]:
+    """Guillotine split: carve a `want`-sized corner block at `block.origin`,
+    returning it plus the remainder cuboids (split in fixed dim order)."""
+    remainders: List[Block] = []
+    origin, dims = block.origin, block.dims
+    for d in range(len(dims)):
+        if dims[d] > want[d]:
+            rem_origin = tuple(
+                o + (want[d] if i == d else 0) for i, o in enumerate(origin)
+            )
+            # Along the split dim the remainder is dims[d]-want[d]; dims before
+            # d are already reduced to want, dims after d are untouched.
+            rem_dims = tuple(
+                dims[i] - want[i] if i == d else (want[i] if i < d else dims[i])
+                for i in range(len(dims))
+            )
+            remainders.append(Block(rem_origin, rem_dims))
+    return Block(origin, want), remainders
+
+
+def _place_one(free: List[Block], profile: Profile) -> Optional[Placement]:
+    """Best-fit: smallest free block (ties: lexicographic origin) and the first
+    orientation (canonical order) that fits."""
+    best: Optional[Tuple[int, Coord, int, Coord]] = None  # (chips, origin, idx, want)
+    for idx, block in enumerate(free):
+        for orient in profile.shape.orientations():
+            want = orient.dims
+            if _fits(block, want):
+                key = (block.chips, block.origin, idx, want)
+                if best is None or key < best:
+                    best = key
+                break  # orientations are tried in a fixed order; first fit per block
+    if best is None:
+        return None
+    _, _, idx, want = best
+    block = free.pop(idx)
+    placed, remainders = _split(block, want)
+    free.extend(remainders)
+    free.sort(key=lambda b: (b.chips, b.origin))
+    return Placement(profile, placed.origin, placed.dims)
+
+
+def pack(mesh: Shape, geometry: Mapping[Profile, int]) -> Optional[List[Placement]]:
+    """Place `geometry` (profile -> count) onto `mesh`; None if it doesn't fit.
+
+    Deterministic: profiles largest-first (ties by name), best-fit free block,
+    fixed split order — the canonical placement contract shared by planner and
+    agents.
+    """
+    total = sum(p.chips * n for p, n in geometry.items())
+    if total > mesh.chips:
+        return None
+    free: List[Block] = [Block((0,) * mesh.rank, mesh.dims)]
+    placements: List[Placement] = []
+    for profile in sorted(geometry, key=lambda p: (-p.chips, p.name)):
+        if profile.shape.rank != mesh.rank:
+            return None
+        for _ in range(geometry[profile]):
+            placed = _place_one(free, profile)
+            if placed is None:
+                return None
+            placements.append(placed)
+    return placements
+
+
+def packable(mesh: Shape, geometry: Mapping[Profile, int]) -> bool:
+    return pack(mesh, geometry) is not None
+
+
+def free_chips(mesh: Shape, geometry: Mapping[Profile, int]) -> int:
+    return mesh.chips - sum(p.chips * n for p, n in geometry.items())

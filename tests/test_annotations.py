@@ -1,0 +1,87 @@
+"""Annotation protocol tests (reference pkg/gpu/annotation_test.go analog)."""
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.tpu import Profile
+
+
+def P(name):
+    return Profile.parse(name)
+
+
+def test_spec_roundtrip():
+    specs = ann.spec_from_geometry(0, {P("2x2"): 2, P("1x1"): 3})
+    d = ann.format_spec(specs)
+    assert d == {
+        "tpu.nos/spec-dev-0-1x1": "3",
+        "tpu.nos/spec-dev-0-2x2": "2",
+    }
+    parsed = ann.parse_spec(d)
+    assert parsed == specs
+
+
+def test_parse_ignores_foreign_annotations():
+    d = {
+        "tpu.nos/spec-dev-0-2x2": "1",
+        "tpu.nos/status-dev-0-2x2-free": "1",
+        "tpu.nos/status-dev-0-2x2-used": "0",
+        "kubernetes.io/something": "x",
+        "tpu.nos/spec-partitioning-plan": "42",
+    }
+    assert len(ann.parse_spec(d)) == 1
+    assert len(ann.parse_status(d)) == 2
+
+
+def test_status_roundtrip_and_geometry_counts():
+    statuses = ann.status_from_geometry(0, {P("2x2"): 3}, {P("2x2"): 1})
+    d = ann.format_status(statuses)
+    assert d == {
+        "tpu.nos/status-dev-0-2x2-used": "1",
+        "tpu.nos/status-dev-0-2x2-free": "2",
+    }
+    counts = ann.geometry_counts_from_status(ann.parse_status(d))
+    assert counts == {0: {"2x2": (2, 1)}}
+
+
+def test_spec_matches_status():
+    spec = ann.spec_from_geometry(0, {P("2x2"): 2})
+    status_ok = ann.status_from_geometry(0, {P("2x2"): 2}, {P("2x2"): 2})
+    status_short = ann.status_from_geometry(0, {P("2x2"): 1}, {})
+    assert ann.spec_matches_status(spec, status_ok)
+    assert not ann.spec_matches_status(spec, status_short)
+    # Extra zero-quantity status entries don't break equality.
+    status_extra = status_ok + ann.status_from_geometry(1, {}, {})
+    assert ann.spec_matches_status(spec, status_extra)
+    # Empty spec matches empty/zero status.
+    assert ann.spec_matches_status([], [])
+
+
+def test_multi_device_indexes():
+    spec = ann.spec_from_geometry(0, {P("2x2"): 1}) + ann.spec_from_geometry(
+        1, {P("1x1"): 2}
+    )
+    counts = ann.geometry_counts_from_spec(spec)
+    assert counts == {0: {"2x2": 1}, 1: {"1x1": 2}}
+
+
+def test_plan_handshake():
+    annotations = {}
+    assert ann.node_reported_last_plan(annotations)  # no spec -> nothing owed
+    annotations[constants.ANNOTATION_SPEC_PLAN] = "plan-7"
+    assert not ann.node_reported_last_plan(annotations)
+    annotations[constants.ANNOTATION_STATUS_PLAN] = "plan-6"
+    assert not ann.node_reported_last_plan(annotations)
+    annotations[constants.ANNOTATION_STATUS_PLAN] = "plan-7"
+    assert ann.node_reported_last_plan(annotations)
+
+
+def test_strip_annotations():
+    d = {
+        "tpu.nos/spec-dev-0-2x2": "1",
+        "tpu.nos/status-dev-0-2x2-free": "1",
+        "other": "keep",
+    }
+    ann.strip_spec_annotations(d)
+    assert "tpu.nos/spec-dev-0-2x2" not in d and "other" in d
+    ann.strip_status_annotations(d)
+    assert d == {"other": "keep"}

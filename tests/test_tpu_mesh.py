@@ -1,0 +1,108 @@
+"""TpuMesh tests (reference pkg/gpu/mig/gpu_test.go analog, table-driven)."""
+
+import pytest
+
+from nos_tpu.tpu import Profile, Topology, TpuMesh
+
+
+def P(name):
+    return Profile.parse(name)
+
+
+def v5e_4x4(geometry=None, used=None):
+    return TpuMesh(Topology.parse("v5e", "4x4"), geometry, used)
+
+
+def test_invalid_construction():
+    with pytest.raises(ValueError):
+        v5e_4x4({P("2x2"): 1}, used={P("2x2"): 2})  # used > geometry
+    with pytest.raises(ValueError):
+        v5e_4x4({P("2x2"): 5})  # doesn't pack
+
+
+def test_free_accounting():
+    m = v5e_4x4({P("2x2"): 3}, used={P("2x2"): 1})
+    assert m.free == {P("2x2"): 2}
+    assert m.free_chips == 4
+    assert m.has_free_capacity()
+
+
+def test_can_apply_geometry_never_deletes_used():
+    m = v5e_4x4({P("2x2"): 2}, used={P("2x2"): 1})
+    assert m.can_apply_geometry({P("2x2"): 1, P("1x1"): 4})  # keeps the used one
+    assert not m.can_apply_geometry({P("1x1"): 8})  # would delete the used 2x2
+    assert not m.can_apply_geometry({P("2x2"): 8})  # doesn't pack
+    with pytest.raises(ValueError):
+        m.apply_geometry({P("1x1"): 1})
+
+
+def test_can_apply_geometry_rejects_disallowed_profile():
+    m = v5e_4x4()
+    assert not m.can_apply_geometry({P("4x8"): 1})  # bigger than the mesh
+    assert not m.can_apply_geometry({P("3x3"): 1})  # not in the v5e menu
+
+
+def test_update_geometry_for_carves_free_space():
+    m = v5e_4x4()
+    changed = m.update_geometry_for({P("2x2"): 2})
+    assert changed
+    assert m.geometry == {P("2x2"): 2}
+    assert m.free == {P("2x2"): 2}
+
+
+def test_update_geometry_for_partial_satisfaction():
+    # 16 chips: can host at most 4 2x2 slices; ask for 6, get 4.
+    m = v5e_4x4()
+    assert m.update_geometry_for({P("2x2"): 6})
+    assert m.geometry == {P("2x2"): 4}
+
+
+def test_update_geometry_for_keeps_used_and_repacks_free():
+    m = v5e_4x4({P("2x2"): 2, P("1x1"): 2}, used={P("2x2"): 1})
+    # Wants a 2x4 (8 chips). Used 2x2 (4 chips) is immutable; free 2x2 and the
+    # 1x1s can be sacrificed. 4+8=12 chips; the free 2x2 and both 1x1s still
+    # fit in the remaining 4 chips.
+    assert m.update_geometry_for({P("2x4"): 1})
+    assert m.geometry[P("2x4")] == 1
+    assert m.geometry[P("2x2")] >= 1  # the used one survived
+    assert m.used == {P("2x2"): 1}
+
+
+def test_update_geometry_for_no_change_when_impossible():
+    m = v5e_4x4({P("2x2"): 4}, used={P("2x2"): 4})  # mesh full, all used
+    assert not m.update_geometry_for({P("2x4"): 1})
+    assert m.geometry == {P("2x2"): 4}
+
+
+def test_update_geometry_for_ignores_disallowed_or_empty():
+    m = v5e_4x4()
+    assert not m.update_geometry_for({})
+    assert not m.update_geometry_for({P("8x8"): 1})  # larger than mesh
+    assert not m.update_geometry_for({P("2x2"): 0})
+
+
+def test_mark_used_and_unused():
+    m = v5e_4x4({P("2x2"): 2})
+    m.mark_used(P("2x2"))
+    assert m.used == {P("2x2"): 1}
+    with pytest.raises(ValueError):
+        m.mark_used(P("2x2"), 2)
+    m.mark_unused(P("2x2"))
+    assert m.used == {}
+    with pytest.raises(ValueError):
+        m.mark_unused(P("2x2"))
+
+
+def test_as_resources_and_clone_independent():
+    m = v5e_4x4({P("2x2"): 2, P("1x1"): 1})
+    assert m.as_resources() == {"google.com/tpu-2x2": 2, "google.com/tpu-1x1": 1}
+    c = m.clone()
+    c.mark_used(P("2x2"))
+    c.update_geometry_for({P("2x4"): 1})
+    assert m.used == {} and m.geometry == {P("2x2"): 2, P("1x1"): 1}
+
+
+def test_placements_cover_geometry():
+    m = v5e_4x4({P("2x2"): 2, P("1x2"): 1})
+    pls = m.placements()
+    assert pls is not None and len(pls) == 3

@@ -1,0 +1,83 @@
+"""Shape / Profile / Topology tests (reference mig profile_test + known_config_test analog)."""
+
+import pytest
+
+from nos_tpu.tpu import Profile, Shape, Topology, accelerator_generation
+
+
+def test_shape_parse_and_name():
+    s = Shape.parse("4x4")
+    assert s.dims == (4, 4) and s.chips == 16 and s.rank == 2
+    assert Shape.parse("2x2x4").chips == 16
+    assert str(Shape((8, 16))) == "8x16"
+
+
+@pytest.mark.parametrize("bad", ["", "x", "4x", "0x2", "-1x2", "axb"])
+def test_shape_parse_invalid(bad):
+    with pytest.raises(ValueError):
+        Shape.parse(bad)
+
+
+def test_shape_divides_and_orientations():
+    assert Shape.parse("2x2").divides(Shape.parse("4x4"))
+    assert not Shape.parse("3x3").divides(Shape.parse("4x4"))
+    assert not Shape.parse("2x2").divides(Shape.parse("2x2x2"))  # rank mismatch
+    # 2x4 doesn't divide 4x4 elementwise, but its 4x2 orientation... also not
+    # (4 % 4 == 0, 4 % 2 == 0) -> 4x2 divides 4x4.
+    orientations = {s.name for s in Shape.parse("2x4").orientations()}
+    assert orientations == {"2x4", "4x2"}
+    assert any(o.divides(Shape.parse("4x4")) for o in Shape.parse("2x4").orientations())
+
+
+def test_profile_parse_and_resource_roundtrip():
+    p = Profile.parse("google.com/tpu-2x2")
+    assert p.name == "2x2" and p.chips == 4
+    assert p.resource == "google.com/tpu-2x2"
+    assert Profile.from_resource("google.com/tpu-2x4").chips == 8
+    assert Profile.from_resource("google.com/tpu") is None
+    assert Profile.from_resource("nvidia.com/mig-1g.10gb") is None
+
+
+def test_profile_ordering_smaller_chips_first():
+    profiles = [Profile.parse(n) for n in ("4x4", "1x1", "2x2", "2x4")]
+    assert [p.name for p in sorted(profiles)] == ["1x1", "2x2", "2x4", "4x4"]
+
+
+def test_profile_memory_gb():
+    assert Profile.parse("2x2").memory_gb("v5e") == 64  # 4 chips * 16 GB
+    assert Profile.parse("1x1x1").memory_gb("v4") == 32
+
+
+def test_accelerator_generation():
+    assert accelerator_generation("tpu-v5-lite-podslice") == "v5e"
+    assert accelerator_generation("tpu-v4-podslice") == "v4"
+    assert accelerator_generation("nvidia-a100") is None
+
+
+def test_topology_allowed_profiles_v5e_4x4():
+    t = Topology.parse("v5e", "4x4")
+    names = [p.name for p in t.allowed_profiles]
+    # Whole-mesh 4x4 excluded (that's the plain google.com/tpu resource).
+    assert names == ["1x1", "1x2", "2x2", "2x4"]
+    assert t.chips == 16 and t.chip_memory_gb == 16
+
+
+def test_topology_allowed_profiles_v5e_8x8():
+    t = Topology.parse("v5e", "8x8")
+    assert [p.name for p in t.allowed_profiles] == ["1x1", "1x2", "2x2", "2x4", "4x4", "4x8"]
+
+
+def test_topology_allowed_profiles_v4_cube():
+    t = Topology.parse("v4", "2x2x4")
+    assert [p.name for p in t.allowed_profiles] == ["1x1x1", "1x2x2", "2x2x2"]
+
+
+def test_topology_from_node_labels():
+    t = Topology.from_node_labels(
+        {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+    )
+    assert t is not None and t.generation == "v5e" and t.shape.name == "4x4"
+    assert Topology.from_node_labels({}) is None
