@@ -1,0 +1,13 @@
+from nos_tpu.partitioning.core.interface import (  # noqa: F401
+    NodeInfo,
+    PartitionableNode,
+    Partitioner,
+    SimScheduler,
+    SliceSpec,
+    SnapshotTaker,
+)
+from nos_tpu.partitioning.core.snapshot import Snapshot  # noqa: F401
+from nos_tpu.partitioning.core.tracker import SliceTracker  # noqa: F401
+from nos_tpu.partitioning.core.sorter import sort_candidate_pods  # noqa: F401
+from nos_tpu.partitioning.core.planner import Planner, PartitioningPlan  # noqa: F401
+from nos_tpu.partitioning.core.actuator import Actuator  # noqa: F401

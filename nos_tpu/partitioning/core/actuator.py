@@ -1,0 +1,45 @@
+"""Actuator: diff desired vs current partitioning and drive the mode
+partitioner (core/actuator.go:39-66 analog)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict
+
+from nos_tpu.partitioning.core.interface import (
+    NodePartitioning,
+    Partitioner,
+    PartitioningState,
+    partitioning_equal,
+)
+from nos_tpu.partitioning.core.planner import PartitioningPlan
+
+logger = logging.getLogger(__name__)
+
+
+class Actuator:
+    def __init__(
+        self,
+        partitioner: Partitioner,
+        get_current: Callable[[str], NodePartitioning],
+    ):
+        self._partitioner = partitioner
+        self._get_current = get_current
+
+    def apply(self, plan: PartitioningPlan) -> Dict[str, bool]:
+        """Apply the plan node by node, skipping nodes whose current
+        partitioning already equals the desired one. Returns
+        node -> whether it was (re)partitioned."""
+        applied: Dict[str, bool] = {}
+        for node_name in sorted(plan.state):
+            desired = plan.state[node_name]
+            current = self._get_current(node_name)
+            if partitioning_equal(current, desired):
+                applied[node_name] = False
+                continue
+            logger.info(
+                "actuator: applying plan %s to node %s", plan.id, node_name
+            )
+            self._partitioner.apply_partitioning(node_name, plan.id, desired)
+            applied[node_name] = True
+        return applied

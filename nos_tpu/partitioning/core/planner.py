@@ -1,0 +1,103 @@
+"""The geometry planner — the engine's hot loop.
+
+Analog of core/planner.go:63-203. For each candidate node (name-sorted), fork
+the snapshot, let the node re-carve its free devices toward the batch's lacking
+slices, then simulate scheduling each still-pending pod (PreFilter + Filter)
+against the updated node; commit the fork iff at least one pod became
+schedulable, else revert. The result is a desired PartitioningState for the
+actuator to diff & apply.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from nos_tpu.api.objects import Pod
+from nos_tpu.api.resources import compute_pod_request
+from nos_tpu.partitioning.core.interface import (
+    PartitionableNode,
+    PartitioningState,
+    SimScheduler,
+)
+from nos_tpu.partitioning.core.snapshot import Snapshot
+from nos_tpu.partitioning.core.sorter import sort_candidate_pods
+from nos_tpu.partitioning.core.tracker import SliceTracker
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PartitioningPlan:
+    """Desired state + unique plan id (reference uses a unix timestamp,
+    planner.go:31-45; we add entropy so two plans in one second differ)."""
+
+    state: PartitioningState
+    id: str = field(
+        default_factory=lambda: f"{int(time.time())}-{uuid.uuid4().hex[:8]}"
+    )
+
+
+class Planner:
+    def __init__(self, sim_scheduler: SimScheduler):
+        self._sim = sim_scheduler
+
+    def plan(self, snapshot: Snapshot, candidate_pods: List[Pod]) -> PartitioningPlan:
+        tracker = SliceTracker(snapshot, candidate_pods, snapshot.slice_spec)
+        pods = sort_candidate_pods(candidate_pods, snapshot.slice_spec)
+        placed_keys: set = set()
+
+        for node in snapshot.get_candidate_nodes():
+            if tracker.is_empty:
+                break
+            snapshot.fork()
+            # Re-fetch the node from the snapshot: get_candidate_nodes() was
+            # computed pre-fork; mutations must land on the current view.
+            node = snapshot.get_node(node.name)
+            changed = node.update_geometry_for(dict(tracker.get_lacking()))
+            if not changed:
+                snapshot.revert()
+                continue
+            placed_any = False
+            for pod in pods:
+                key = pod.metadata.namespaced_name
+                if key in placed_keys:
+                    continue
+                if self._try_add_pod(snapshot, pod, node):
+                    tracker.remove(pod)
+                    placed_keys.add(key)
+                    placed_any = True
+            if placed_any:
+                logger.debug("planner: committing new geometry on %s", node.name)
+                snapshot.commit()
+            else:
+                snapshot.revert()
+
+        state: PartitioningState = {
+            name: n.partitioning() for name, n in snapshot.nodes.items()
+        }
+        return PartitioningPlan(state=state)
+
+    # -- internals (planner.go:151-203) -------------------------------------
+    def _try_add_pod(self, snapshot: Snapshot, pod: Pod, node: PartitionableNode) -> bool:
+        # Early exit: if even after the geometry change the cluster still lacks
+        # slices for this pod, don't burn a scheduling cycle (planner.go:155).
+        if snapshot.get_lacking_slices(pod):
+            return False
+        if not self._can_schedule(pod, node):
+            return False
+        node.add_pod(pod)
+        return True
+
+    def _can_schedule(self, pod: Pod, node: PartitionableNode) -> bool:
+        if not self._sim.pre_filter(pod):
+            return False
+        info = node.node_info()
+        if not self._sim.filter(pod, info):
+            return False
+        # The simulated scheduler may be permissive; enforce plain resource fit
+        # so add_pod never overcommits a node.
+        return compute_pod_request(pod).fits_in(info.free)

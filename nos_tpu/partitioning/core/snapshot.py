@@ -1,0 +1,83 @@
+"""Cluster snapshot with fork/commit/revert (core/snapshot.go:85-165 analog)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from nos_tpu.api.objects import Pod
+from nos_tpu.api.resources import ResourceList, compute_pod_request
+from nos_tpu.partitioning.core.interface import PartitionableNode, SliceSpec
+
+
+class Snapshot:
+    """A what-if view of the partitionable nodes. `fork` begins a speculative
+    edit; `commit` keeps it; `revert` rolls back. The planner forks once per
+    candidate node (planner.go:139-145)."""
+
+    def __init__(self, nodes: Dict[str, PartitionableNode], slice_spec: SliceSpec):
+        self._nodes = dict(nodes)
+        self._forked: Optional[Dict[str, PartitionableNode]] = None
+        self.slice_spec = slice_spec
+
+    # -- fork/commit/revert ------------------------------------------------
+    def fork(self) -> None:
+        if self._forked is not None:
+            raise RuntimeError("snapshot already forked")
+        self._forked = {name: n.clone() for name, n in self._nodes.items()}
+
+    def commit(self) -> None:
+        self._forked = None
+
+    def revert(self) -> None:
+        if self._forked is None:
+            raise RuntimeError("no fork to revert")
+        self._nodes = self._forked
+        self._forked = None
+
+    # -- views -------------------------------------------------------------
+    @property
+    def nodes(self) -> Dict[str, PartitionableNode]:
+        return self._nodes
+
+    def get_node(self, name: str) -> PartitionableNode:
+        return self._nodes[name]
+
+    def get_candidate_nodes(self) -> List[PartitionableNode]:
+        """Nodes with free capacity worth re-carving, name-sorted for
+        determinism (snapshot.go:119-130)."""
+        return [
+            self._nodes[name]
+            for name in sorted(self._nodes)
+            if self._nodes[name].has_free_capacity()
+        ]
+
+    def cluster_free(self) -> ResourceList:
+        """Cluster-wide free = Σ allocatable − Σ requested, floored at 0."""
+        free = ResourceList()
+        for n in self._nodes.values():
+            info = n.node_info()
+            free = free.add(info.allocatable.subtract(info.requested))
+        for k in list(free):
+            if free[k] < 0:
+                free[k] = 0.0
+        return free
+
+    def get_lacking_slices(self, pod: Pod) -> ResourceList:
+        """Slice resources the cluster is missing to host `pod`: request minus
+        cluster-wide free, positives only, slice resources only
+        (snapshot.go:132-165 getLackingResources)."""
+        request = compute_pod_request(pod)
+        slice_request = ResourceList(
+            {
+                k: v
+                for k, v in request.items()
+                if v > 0 and self.slice_spec.is_slice_resource(k)
+            }
+        )
+        if not slice_request:
+            return ResourceList()
+        free = self.cluster_free()
+        lacking = slice_request.subtract(
+            ResourceList({k: free.get(k, 0.0) for k in slice_request})
+        )
+        return ResourceList({k: v for k, v in lacking.items() if v > 0})

@@ -1,0 +1,110 @@
+"""ClusterState: a watch-fed, mutex-guarded mirror of nodes and pods.
+
+Analog of internal/partitioning/state/state.go:49-222. Controllers feed it
+from cluster watch events; the snapshot takers read it. Pure cache — it can
+always be rebuilt by re-listing (the "annotations are the database" design,
+SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Node, Pod
+from nos_tpu.api.resources import ResourceList, compute_pod_request
+from nos_tpu.cluster.client import Cluster, Event, EventType
+from nos_tpu.util import pod as podutil
+
+
+class ClusterState:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, Node] = {}
+        self._pods: Dict[str, Pod] = {}  # key: ns/name, only scheduled+active pods
+
+    # -- feeding -----------------------------------------------------------
+    def update_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.metadata.name] = node
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            self._nodes.pop(name, None)
+            for key in [k for k, p in self._pods.items() if p.spec.node_name == name]:
+                del self._pods[key]
+
+    def update_pod(self, pod: Pod) -> None:
+        """Track pods that consume node resources (state.go UpdateUsage:153-180)."""
+        with self._lock:
+            key = pod.metadata.namespaced_name
+            if podutil.is_active(pod):
+                self._pods[key] = pod
+            else:
+                self._pods.pop(key, None)
+
+    def delete_pod(self, namespaced_name: str) -> None:
+        with self._lock:
+            self._pods.pop(namespaced_name, None)
+
+    def start_watching(self, cluster: Cluster) -> None:
+        """Wire watch streams (NodeController/PodController analog,
+        node_controller.go:50-95, pod_controller.go:47-104)."""
+
+        def on_node(ev: Event) -> None:
+            if ev.type == EventType.DELETED:
+                self.delete_node(ev.obj.metadata.name)
+            else:
+                self.update_node(ev.obj)
+
+        def on_pod(ev: Event) -> None:
+            if ev.type == EventType.DELETED:
+                self.delete_pod(ev.obj.metadata.namespaced_name)
+            else:
+                self.update_pod(ev.obj)
+
+        cluster.watch("Node", on_node)
+        cluster.watch("Pod", on_pod)
+
+    # -- reading -----------------------------------------------------------
+    def get_node(self, name: str) -> Optional[Node]:
+        with self._lock:
+            n = self._nodes.get(name)
+            return n.deepcopy() if n is not None else None
+
+    def nodes(self, label_selector: Optional[Dict[str, str]] = None) -> List[Node]:
+        with self._lock:
+            out = []
+            for n in self._nodes.values():
+                if label_selector and any(
+                    n.metadata.labels.get(k) != v for k, v in label_selector.items()
+                ):
+                    continue
+                out.append(n.deepcopy())
+            out.sort(key=lambda n: n.metadata.name)
+            return out
+
+    def node_pods(self, node_name: str) -> List[Pod]:
+        with self._lock:
+            return sorted(
+                (p.deepcopy() for p in self._pods.values() if p.spec.node_name == node_name),
+                key=lambda p: p.metadata.namespaced_name,
+            )
+
+    def node_requested(self, node_name: str) -> ResourceList:
+        with self._lock:
+            out = ResourceList()
+            for p in self._pods.values():
+                if p.spec.node_name == node_name:
+                    out = out.add(compute_pod_request(p))
+            return out
+
+    def partitioning_enabled(self, kind: str) -> bool:
+        """Any node labeled for this partitioning mode
+        (state.go IsPartitioningEnabled:216-222)."""
+        with self._lock:
+            return any(
+                n.metadata.labels.get(constants.LABEL_PARTITIONING) == kind
+                for n in self._nodes.values()
+            )

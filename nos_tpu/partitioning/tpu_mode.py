@@ -1,0 +1,188 @@
+"""TPU partitioning mode: slice spec, partitionable node, snapshot taker.
+
+The TPU analog of internal/partitioning/mig/{slice_calculator.go, slice_filter.go,
+snapshot_taker.go} + pkg/gpu/mig/node.go. One k8s node owns one ICI chip mesh
+(device index 0); its geometry is the multiset of carved sub-slices, reported
+via the status annotations and re-carved by the planner through TpuMesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.api.objects import Node, Pod
+from nos_tpu.api.resources import ResourceList, compute_pod_request
+from nos_tpu.partitioning.core.interface import NodeInfo, NodePartitioning
+from nos_tpu.tpu import Profile, Topology, TpuMesh
+
+TPU_DEVICE_INDEX = 0  # one mesh per node
+
+
+class TpuSliceSpec:
+    """SliceSpec for google.com/tpu-<shape> resources."""
+
+    def is_slice_resource(self, resource_name: str) -> bool:
+        return bool(constants.RESOURCE_TPU_SLICE_REGEX.match(resource_name))
+
+    def slice_weight(self, resource_name: str) -> float:
+        profile = Profile.from_resource(resource_name)
+        return float(profile.chips) if profile else 0.0
+
+    def pod_slice_request(self, pod: Pod) -> ResourceList:
+        req = compute_pod_request(pod)
+        return ResourceList(
+            {k: v for k, v in req.items() if v > 0 and self.is_slice_resource(k)}
+        )
+
+
+class TpuNode:
+    """PartitionableNode over one node's TpuMesh (pkg/gpu/mig/node.go analog)."""
+
+    def __init__(
+        self,
+        name: str,
+        mesh: TpuMesh,
+        labels: Optional[Dict[str, str]] = None,
+        base_allocatable: Optional[ResourceList] = None,
+        requested: Optional[ResourceList] = None,
+        pods: Optional[List[Pod]] = None,
+    ):
+        self._name = name
+        self.mesh = mesh
+        self.labels = dict(labels or {})
+        # Non-TPU resources (cpu, memory, ...) from node.status.allocatable.
+        self.base_allocatable = ResourceList(
+            {
+                k: v
+                for k, v in (base_allocatable or ResourceList()).items()
+                if k != constants.RESOURCE_TPU
+                and not constants.RESOURCE_TPU_SLICE_REGEX.match(k)
+            }
+        )
+        self.requested = ResourceList(requested or {})
+        self.pods: List[Pod] = list(pods or [])
+
+    # -- construction from cluster objects ---------------------------------
+    @classmethod
+    def from_node(
+        cls,
+        node: Node,
+        pods: Optional[List[Pod]] = None,
+        requested: Optional[ResourceList] = None,
+    ) -> "TpuNode":
+        """Build from GKE discovery labels + status annotations
+        (mig/node.go:40-104 analog: status annotations are the source of truth
+        for the current geometry)."""
+        topology = Topology.from_node_labels(node.metadata.labels)
+        if topology is None:
+            raise ValueError(f"node {node.metadata.name} has no TPU topology labels")
+        statuses = ann.parse_status(node.metadata.annotations)
+        geometry: Dict[Profile, int] = {}
+        used: Dict[Profile, int] = {}
+        for idx, profs in ann.geometry_counts_from_status(statuses).items():
+            if idx != TPU_DEVICE_INDEX:
+                continue
+            for prof_name, (free, in_use) in profs.items():
+                profile = Profile.parse(prof_name)
+                total = free + in_use
+                if total > 0:
+                    geometry[profile] = total
+                if in_use > 0:
+                    used[profile] = in_use
+        mesh = TpuMesh(topology, geometry, used)
+        if requested is None:
+            requested = ResourceList()
+            for p in pods or []:
+                requested = requested.add(compute_pod_request(p))
+        return cls(
+            name=node.metadata.name,
+            mesh=mesh,
+            labels=node.metadata.labels,
+            base_allocatable=node.status.allocatable,
+            requested=requested,
+            pods=pods,
+        )
+
+    # -- PartitionableNode protocol -----------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def update_geometry_for(self, lacking: Mapping[str, float]) -> bool:
+        required: Dict[Profile, int] = {}
+        for resource_name, qty in lacking.items():
+            profile = Profile.from_resource(resource_name)
+            if profile is not None and qty > 0:
+                required[profile] = required.get(profile, 0) + int(round(qty))
+        # Chips held by whole-chip pods must survive the re-carve.
+        reserved = int(round(self.requested.get(constants.RESOURCE_TPU, 0.0)))
+        return self.mesh.update_geometry_for(required, reserved_chips=reserved)
+
+    def partitioning(self) -> NodePartitioning:
+        return {
+            TPU_DEVICE_INDEX: {p.name: n for p, n in sorted(self.mesh.geometry.items())}
+        }
+
+    def node_info(self) -> NodeInfo:
+        allocatable = ResourceList(self.base_allocatable)
+        # Uncarved chips stay whole-chip schedulable; carved capacity is
+        # exposed as slice resources (mig/node.go:172-195 recompute analog).
+        allocatable[constants.RESOURCE_TPU] = float(self.mesh.free_chips)
+        for resource, count in self.mesh.as_resources().items():
+            allocatable[resource] = float(count)
+        return NodeInfo(
+            name=self._name,
+            labels=dict(self.labels),
+            allocatable=allocatable,
+            requested=ResourceList(self.requested),
+            pods=list(self.pods),
+        )
+
+    def add_pod(self, pod: Pod) -> None:
+        request = compute_pod_request(pod)
+        for resource_name, qty in request.items():
+            profile = Profile.from_resource(resource_name)
+            if profile is not None and qty > 0:
+                self.mesh.mark_used(profile, int(round(qty)))
+        self.pods.append(pod)
+        self.requested = self.requested.add(request)
+
+    def has_free_capacity(self) -> bool:
+        return self.mesh.has_free_capacity()
+
+    def clone(self) -> "TpuNode":
+        return TpuNode(
+            name=self._name,
+            mesh=self.mesh.clone(),
+            labels=dict(self.labels),
+            base_allocatable=ResourceList(self.base_allocatable),
+            requested=ResourceList(self.requested),
+            pods=list(self.pods),
+        )
+
+
+class TpuSnapshotTaker:
+    """Builds a Snapshot of TPU-mode nodes from ClusterState
+    (mig/snapshot_taker.go:31-53 analog)."""
+
+    def __init__(self):
+        self.slice_spec = TpuSliceSpec()
+
+    def take_snapshot(self, cluster_state):
+        from nos_tpu.partitioning.core.snapshot import Snapshot
+
+        nodes = {}
+        for node in cluster_state.nodes(
+            label_selector={constants.LABEL_PARTITIONING: constants.KIND_TPU}
+        ):
+            if Topology.from_node_labels(node.metadata.labels) is None:
+                continue
+            name = node.metadata.name
+            nodes[name] = TpuNode.from_node(
+                node,
+                pods=cluster_state.node_pods(name),
+                requested=cluster_state.node_requested(name),
+            )
+        return Snapshot(nodes, self.slice_spec)

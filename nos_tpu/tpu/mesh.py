@@ -12,6 +12,7 @@ from typing import Dict, Mapping, Optional
 
 from nos_tpu.tpu.packing import pack, packable
 from nos_tpu.tpu.profile import Profile
+from nos_tpu.tpu.shape import Shape
 from nos_tpu.tpu.topology import Topology
 
 Geometry = Dict[Profile, int]
@@ -83,18 +84,33 @@ class TpuMesh:
             )
         self.geometry = _clean(new)
 
-    def update_geometry_for(self, required: Mapping[Profile, int]) -> bool:
+    def update_geometry_for(
+        self, required: Mapping[Profile, int], reserved_chips: int = 0
+    ) -> bool:
         """Greedily re-carve free capacity to satisfy as much of `required` as
         possible, never touching used slices. Returns True iff the geometry
         changed. Mirrors mig/gpu.go UpdateGeometryFor:141-195 + the MPS
         delete-free-then-recreate heuristic (slicing/gpu.go:162-232), with
         packability standing in for the allowed-geometry table lookup.
+
+        `reserved_chips` protects uncarved chips held by whole-chip
+        (google.com/tpu) pods: they participate in packability as single-chip
+        placeholders so carving never steals them.
         """
         required = {
             p: n for p, n in required.items() if n > 0 and self.topology.is_profile_allowed(p)
         }
         if not required:
             return False
+
+        unit = Profile(Shape((1,) * self.topology.shape.rank))
+
+        def packable_with_reserved(geometry: Mapping[Profile, int]) -> bool:
+            if reserved_chips <= 0:
+                return packable(self.topology.shape, geometry)
+            trial = dict(geometry)
+            trial[unit] = trial.get(unit, 0) + reserved_chips
+            return packable(self.topology.shape, trial)
 
         # Start from the immutable floor: slices currently in use.
         base: Geometry = dict(self.used)
@@ -105,7 +121,7 @@ class TpuMesh:
             for _ in range(required[profile]):
                 trial = dict(base)
                 trial[profile] = trial.get(profile, 0) + 1
-                if packable(self.topology.shape, trial):
+                if packable_with_reserved(trial):
                     base = trial
                     satisfied_any = True
 
@@ -117,7 +133,7 @@ class TpuMesh:
             for _ in range(n):
                 trial = dict(base)
                 trial[profile] = trial.get(profile, 0) + 1
-                if packable(self.topology.shape, trial):
+                if packable_with_reserved(trial):
                     base = trial
 
         new_geometry = _clean(base)

@@ -1,0 +1,116 @@
+"""ClusterState mirror + Snapshot fork/revert tests
+(reference state/state_test.go + core/snapshot_test.go analog)."""
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodPhase, PodSpec
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster import Cluster
+from nos_tpu.partitioning.core import Snapshot
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.tpu_mode import TpuNode, TpuSliceSpec, TpuSnapshotTaker
+from nos_tpu.tpu import Profile, Topology, TpuMesh
+
+
+def P(name):
+    return Profile.parse(name)
+
+
+def tpu_cluster_node(name="n1", topo="4x4"):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_PARTITIONING: constants.KIND_TPU,
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: topo,
+            },
+        ),
+        status=NodeStatus(allocatable=ResourceList.of({"cpu": 64, "google.com/tpu": 16})),
+    )
+
+
+def running_pod(name, node, resources, ns="default"):
+    p = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(resources=ResourceList.of(resources))]),
+    )
+    p.spec.node_name = node
+    p.status.phase = PodPhase.RUNNING
+    return p
+
+
+def test_cluster_state_mirrors_watch_events():
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+
+    cluster.create(tpu_cluster_node("n1"))
+    cluster.create(running_pod("p1", "n1", {"cpu": 2}))
+    assert state.partitioning_enabled(constants.KIND_TPU)
+    assert not state.partitioning_enabled(constants.KIND_MIG)
+    assert [n.metadata.name for n in state.nodes()] == ["n1"]
+    assert state.node_requested("n1")["cpu"] == 2
+
+    # Pod completes -> usage drops.
+    cluster.patch("Pod", "default", "p1", lambda p: setattr(p.status, "phase", PodPhase.SUCCEEDED))
+    assert state.node_requested("n1") == {}
+
+    cluster.delete("Node", "", "n1")
+    assert state.nodes() == []
+
+
+def test_snapshot_taker_builds_tpu_nodes_from_annotations():
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+
+    node = tpu_cluster_node("n1")
+    node.metadata.annotations.update(
+        {
+            "tpu.nos/status-dev-0-2x2-free": "1",
+            "tpu.nos/status-dev-0-2x2-used": "1",
+        }
+    )
+    cluster.create(node)
+    cluster.create(running_pod("p1", "n1", {"google.com/tpu-2x2": 1}))
+
+    snap = TpuSnapshotTaker().take_snapshot(state)
+    tn = snap.get_node("n1")
+    assert tn.mesh.geometry == {P("2x2"): 2}
+    assert tn.mesh.used == {P("2x2"): 1}
+    info = tn.node_info()
+    assert info.allocatable["google.com/tpu-2x2"] == 2
+    assert info.allocatable[constants.RESOURCE_TPU] == 8  # 16 - carved 8
+    assert info.requested["google.com/tpu-2x2"] == 1
+
+
+def test_snapshot_fork_revert_commit():
+    mesh = TpuMesh(Topology.parse("v5e", "4x4"))
+    node = TpuNode("n1", mesh, base_allocatable=ResourceList.of({"cpu": 8}))
+    snap = Snapshot({"n1": node}, TpuSliceSpec())
+
+    snap.fork()
+    snap.get_node("n1").update_geometry_for({"google.com/tpu-2x2": 2})
+    assert snap.get_node("n1").mesh.geometry == {P("2x2"): 2}
+    snap.revert()
+    assert snap.get_node("n1").mesh.geometry == {}
+
+    snap.fork()
+    snap.get_node("n1").update_geometry_for({"google.com/tpu-2x2": 1})
+    snap.commit()
+    assert snap.get_node("n1").mesh.geometry == {P("2x2"): 1}
+
+
+def test_snapshot_lacking_slices():
+    mesh = TpuMesh(Topology.parse("v5e", "4x4"), {P("2x2"): 1})
+    node = TpuNode("n1", mesh, base_allocatable=ResourceList.of({"cpu": 8}))
+    snap = Snapshot({"n1": node}, TpuSliceSpec())
+
+    pod2 = Pod(
+        spec=PodSpec(
+            containers=[Container(resources=ResourceList.of({"google.com/tpu-2x2": 3}))]
+        ),
+        metadata=ObjectMeta(name="p", namespace="d"),
+    )
+    lacking = snap.get_lacking_slices(pod2)
+    assert lacking == {"google.com/tpu-2x2": 2}  # one free already
