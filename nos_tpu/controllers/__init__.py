@@ -1,0 +1,1 @@
+"""Reconcilers wiring the engine to the cluster (internal/controllers analog)."""

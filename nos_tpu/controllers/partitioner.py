@@ -1,0 +1,135 @@
+"""The central partitioner controller.
+
+Analog of internal/controllers/gpupartitioner/partitioner_controller.go:81-232:
+watches pods, batches the unschedulable ones whose situation extra fractional
+resources could help, gates planning on the plan-id handshake (never plan while
+a node hasn't reported the last plan), and on batch close runs
+snapshot -> plan -> actuate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.api.objects import Pod
+from nos_tpu.cluster.client import Cluster, Event, EventType
+from nos_tpu.partitioning.core import Actuator, Planner
+from nos_tpu.partitioning.core.interface import (
+    NodePartitioning,
+    Partitioner,
+    SimScheduler,
+    SnapshotTaker,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.util import pod as podutil
+from nos_tpu.util.batcher import Batcher
+
+logger = logging.getLogger(__name__)
+
+
+class PartitionerController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        state: ClusterState,
+        kind: str,
+        snapshot_taker: SnapshotTaker,
+        partitioner: Partitioner,
+        sim_scheduler: SimScheduler,
+        batch_timeout_s: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S,
+        batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
+        now=None,
+    ):
+        self.cluster = cluster
+        self.state = state
+        self.kind = kind
+        self.snapshot_taker = snapshot_taker
+        self.planner = Planner(sim_scheduler)
+        self.actuator = Actuator(partitioner, self._current_partitioning)
+        kwargs = {"now": now} if now is not None else {}
+        self.batcher: Batcher[Pod] = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
+        self._unsub = None
+        self._stop = threading.Event()
+
+    # -- watch wiring (partitioner_controller.go:81-149) ---------------------
+    def start_watching(self) -> None:
+        def on_pod(ev: Event) -> None:
+            if ev.type == EventType.DELETED:
+                return
+            self.reconcile_pod(ev.obj)
+
+        self._unsub = self.cluster.watch("Pod", on_pod)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._unsub:
+            self._unsub()
+
+    def reconcile_pod(self, pod: Pod) -> None:
+        if not self.state.partitioning_enabled(self.kind):
+            return
+        if not podutil.extra_resources_could_help_scheduling(pod):
+            return
+        self.batcher.add(pod)
+
+    # -- the planning cycle --------------------------------------------------
+    def waiting_for_plan_reports(self) -> List[str]:
+        """Nodes whose status plan id lags their spec plan id
+        (partitioner_controller.go:212-232)."""
+        lagging = []
+        for node in self.state.nodes(
+            label_selector={constants.LABEL_PARTITIONING: self.kind}
+        ):
+            if not ann.node_reported_last_plan(node.metadata.annotations):
+                lagging.append(node.metadata.name)
+        return lagging
+
+    def process_batch_if_ready(self) -> bool:
+        """One reconcile step; returns True if a planning cycle ran.
+        Deterministic — tests call it directly; run() loops it."""
+        lagging = self.waiting_for_plan_reports()
+        if lagging:
+            logger.info(
+                "partitioner(%s): waiting for nodes to report last plan: %s",
+                self.kind,
+                lagging,
+            )
+            return False
+        if not self.batcher.drain_if_ready():
+            return False
+        pods = self.fetch_pending_pods()
+        if not pods:
+            return False
+        snapshot = self.snapshot_taker.take_snapshot(self.state)
+        plan = self.planner.plan(snapshot, pods)
+        self.actuator.apply(plan)
+        return True
+
+    def fetch_pending_pods(self) -> List[Pod]:
+        """Re-list pending pods at plan time — the batch only signals *when*
+        to plan; the fresh list is the source of truth
+        (partitioner_controller.go fetchPendingPods:202-210)."""
+        return self.cluster.list(
+            "Pod",
+            predicate=podutil.extra_resources_could_help_scheduling,
+        )
+
+    def _current_partitioning(self, node_name: str) -> NodePartitioning:
+        node = self.state.get_node(node_name)
+        if node is None:
+            return {}
+        specs = ann.parse_spec(node.metadata.annotations)
+        out: NodePartitioning = {}
+        for s in specs:
+            out.setdefault(s.device_index, {})[s.profile] = s.quantity
+        return out
+
+    # -- threaded runtime ----------------------------------------------------
+    def run(self, poll_s: float = 0.5) -> None:
+        while not self._stop.is_set():
+            self.process_batch_if_ready()
+            self._stop.wait(poll_s)

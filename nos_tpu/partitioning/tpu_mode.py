@@ -163,6 +163,32 @@ class TpuNode:
         )
 
 
+class TpuPartitioner:
+    """Actuation channel: write the planned geometry as spec annotations on the
+    node plus the plan id (mig/partitioner.go:43-75 analog). The node agent
+    picks it up from its node watch."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+
+    def apply_partitioning(
+        self, node_name: str, plan_id: str, partitioning: NodePartitioning
+    ) -> None:
+        def mutate(node: Node) -> None:
+            ann.strip_spec_annotations(node.metadata.annotations)
+            specs = []
+            for device_index, profiles in partitioning.items():
+                specs.extend(
+                    ann.SpecAnnotation(device_index, prof, qty)
+                    for prof, qty in profiles.items()
+                    if qty > 0
+                )
+            node.metadata.annotations.update(ann.format_spec(specs))
+            node.metadata.annotations[constants.ANNOTATION_SPEC_PLAN] = plan_id
+
+        self._cluster.patch("Node", "", node_name, mutate)
+
+
 class TpuSnapshotTaker:
     """Builds a Snapshot of TPU-mode nodes from ClusterState
     (mig/snapshot_taker.go:31-53 analog)."""

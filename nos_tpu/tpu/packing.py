@@ -122,5 +122,62 @@ def packable(mesh: Shape, geometry: Mapping[Profile, int]) -> bool:
     return pack(mesh, geometry) is not None
 
 
+def _subtract_block(free: List[Block], occupied: Block) -> List[Block]:
+    """Remove `occupied` from a free-cuboid list, splitting overlapped cuboids
+    into remainder cuboids (up to 2 per dimension each)."""
+    out: List[Block] = []
+    for block in free:
+        lo = tuple(max(b, o) for b, o in zip(block.origin, occupied.origin))
+        hi = tuple(
+            min(b + bd, o + od)
+            for b, bd, o, od in zip(block.origin, block.dims, occupied.origin, occupied.dims)
+        )
+        if any(l >= h for l, h in zip(lo, hi)):
+            out.append(block)  # no overlap
+            continue
+        # Slice the block around the intersection, dim by dim.
+        cur_origin, cur_dims = list(block.origin), list(block.dims)
+        for d in range(len(cur_dims)):
+            below = lo[d] - cur_origin[d]
+            if below > 0:
+                dims = list(cur_dims)
+                dims[d] = below
+                out.append(Block(tuple(cur_origin), tuple(dims)))
+            above = (cur_origin[d] + cur_dims[d]) - hi[d]
+            if above > 0:
+                origin = list(cur_origin)
+                origin[d] = hi[d]
+                dims = list(cur_dims)
+                dims[d] = above
+                out.append(Block(tuple(origin), tuple(dims)))
+            cur_origin[d] = lo[d]
+            cur_dims[d] = hi[d] - lo[d]
+    return out
+
+
+def pack_into(
+    mesh: Shape,
+    occupied: List[Tuple[Coord, Coord]],
+    geometry: Mapping[Profile, int],
+) -> Optional[List[Placement]]:
+    """Place `geometry` into the mesh *around* already-placed blocks
+    ((origin, dims) pairs). Used by node agents to add slices without moving
+    existing ones; None if the addition cannot fit."""
+    free: List[Block] = [Block((0,) * mesh.rank, mesh.dims)]
+    for origin, dims in occupied:
+        free = _subtract_block(free, Block(tuple(origin), tuple(dims)))
+    free.sort(key=lambda b: (b.chips, b.origin))
+    placements: List[Placement] = []
+    for profile in sorted(geometry, key=lambda p: (-p.chips, p.name)):
+        if profile.shape.rank != mesh.rank:
+            return None
+        for _ in range(geometry[profile]):
+            placed = _place_one(free, profile)
+            if placed is None:
+                return None
+            placements.append(placed)
+    return placements
+
+
 def free_chips(mesh: Shape, geometry: Mapping[Profile, int]) -> int:
     return mesh.chips - sum(p.chips * n for p, n in geometry.items())

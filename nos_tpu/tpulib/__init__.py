@@ -1,0 +1,14 @@
+"""tpulib: the device-control seam.
+
+Where the reference drives NVML through cgo (pkg/gpu/nvml, build-tagged so CI
+never needs a GPU — SURVEY.md §4 "hardware-boundary mocking"), this package
+drives TPU sub-slice carving. Three backends satisfy one interface:
+
+  - FakeTpuClient (pure Python) — tests and the in-memory runtime;
+  - NativeTpuClient (ctypes over the C++ shim in native/) — the production
+    analog of the cgo layer, modeling slice lifecycle natively;
+  - a real libtpu-backed client would slot in behind the same interface.
+"""
+
+from nos_tpu.tpulib.interface import SliceHandle, TpuClient, TpuLibError  # noqa: F401
+from nos_tpu.tpulib.fake import FakeTpuClient  # noqa: F401

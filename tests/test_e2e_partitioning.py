@@ -1,0 +1,235 @@
+"""End-to-end dynamic TPU partitioning (SURVEY.md §7 step 4, the reference's
+main loop §3.1 — with the in-memory cluster standing in for envtest and the
+fake tpulib for NVML).
+
+Scenario: a v5e-16 node in tpu partitioning mode; an unschedulable JAX pod
+requests a google.com/tpu-2x2 sub-slice; the partitioner controller plans a
+geometry, writes spec annotations; the node agent carves the slice via the
+(fake) device layer, reports status + refreshed allocatable; the pod becomes
+schedulable and is bound; a second cycle respects the now-used slice.
+"""
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.api.resources import ResourceList, compute_pod_request
+from nos_tpu.cluster import Cluster
+from nos_tpu.controllers.partitioner import PartitionerController
+from nos_tpu.controllers.tpu_agent import TpuAgent
+from nos_tpu.partitioning.core.interface import FitSimScheduler
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.tpu_mode import TpuNode, TpuPartitioner, TpuSnapshotTaker
+from nos_tpu.tpu import Profile, Topology
+from nos_tpu.tpulib import FakeTpuClient
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_tpu_node(name="tpu-node-0", topo="4x4"):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_PARTITIONING: constants.KIND_TPU,
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: topo,
+            },
+        ),
+        status=NodeStatus(
+            allocatable=ResourceList.of({"cpu": 64, "memory": "128Gi", "google.com/tpu": 16}),
+            capacity=ResourceList.of({"cpu": 64, "memory": "128Gi", "google.com/tpu": 16}),
+        ),
+    )
+
+
+def unschedulable_slice_pod(name, profile="2x2", ns="ml"):
+    p = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    resources=ResourceList.of(
+                        {f"google.com/tpu-{profile}": 1, "cpu": "500m"}
+                    )
+                )
+            ],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+    p.status.phase = PodPhase.PENDING
+    p.status.conditions.append(
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    return p
+
+
+def bind_if_fits(cluster, state, pod):
+    """Minimal stand-in for the scheduler's bind step (M5 brings the real one):
+    bind the pod to the first TPU node whose refreshed allocatable fits it."""
+    taker = TpuSnapshotTaker()
+    snap = taker.take_snapshot(state)
+    sim = FitSimScheduler()
+    for name in sorted(snap.nodes):
+        info = snap.get_node(name).node_info()
+        if sim.filter(pod, info):
+            def bind(p):
+                p.spec.node_name = name
+                p.status.phase = PodPhase.RUNNING
+                p.status.conditions = []
+            cluster.patch("Pod", pod.metadata.namespace, pod.metadata.name, bind)
+            return name
+    return None
+
+
+@pytest.fixture
+def env():
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    clock = FakeClock()
+    node = make_tpu_node()
+    cluster.create(node)
+
+    client = FakeTpuClient(Topology.parse("v5e", "4x4"))
+    agent = TpuAgent(cluster, "tpu-node-0", client)
+    agent.startup()
+    agent.start_watching()
+
+    controller = PartitionerController(
+        cluster=cluster,
+        state=state,
+        kind=constants.KIND_TPU,
+        snapshot_taker=TpuSnapshotTaker(),
+        partitioner=TpuPartitioner(cluster),
+        sim_scheduler=FitSimScheduler(),
+        batch_timeout_s=60,
+        batch_idle_s=10,
+        now=clock,
+    )
+    controller.start_watching()
+    return cluster, state, clock, client, agent, controller
+
+
+def test_end_to_end_single_pod(env):
+    cluster, state, clock, client, agent, controller = env
+
+    pod = unschedulable_slice_pod("jax-job-0")
+    cluster.create(pod)
+    assert len(controller.batcher) == 1
+
+    # Batch not closed yet -> no planning.
+    assert not controller.process_batch_if_ready()
+    clock.advance(11)  # idle window passes
+    assert controller.process_batch_if_ready()
+
+    # Spec annotations landed and the agent (watch-driven) applied + reported.
+    node = cluster.get("Node", "", "tpu-node-0")
+    assert node.metadata.annotations.get("tpu.nos/spec-dev-0-2x2") == "1"
+    specs = ann.parse_spec(node.metadata.annotations)
+    statuses = ann.parse_status(node.metadata.annotations)
+    assert ann.spec_matches_status(specs, statuses)
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+    # Device layer really carved the slice.
+    assert [s.profile.name for s in client.list_slices()] == ["2x2"]
+    # Allocatable was refreshed: 4 chips carved out of 16.
+    assert node.status.allocatable["google.com/tpu-2x2"] == 1
+    assert node.status.allocatable[constants.RESOURCE_TPU] == 12
+
+    # The pod now fits and binds.
+    bound = bind_if_fits(cluster, state, cluster.get("Pod", "ml", "jax-job-0"))
+    assert bound == "tpu-node-0"
+
+    # Agent usage sync marks the slice used on next report.
+    agent.report()
+    node = cluster.get("Node", "", "tpu-node-0")
+    assert node.metadata.annotations["tpu.nos/status-dev-0-2x2-used"] == "1"
+    assert node.metadata.annotations["tpu.nos/status-dev-0-2x2-free"] == "0"
+
+
+def test_end_to_end_second_cycle_respects_used_slices(env):
+    cluster, state, clock, client, agent, controller = env
+
+    # Cycle 1: place a 2x2 pod and bind it.
+    cluster.create(unschedulable_slice_pod("jax-a"))
+    clock.advance(11)
+    assert controller.process_batch_if_ready()
+    assert bind_if_fits(cluster, state, cluster.get("Pod", "ml", "jax-a"))
+    agent.report()
+
+    # Cycle 2: a 2x4 pod arrives; re-carve must keep the used 2x2.
+    cluster.create(unschedulable_slice_pod("jax-b", profile="2x4"))
+    clock.advance(11)
+    assert controller.process_batch_if_ready()
+
+    node = cluster.get("Node", "", "tpu-node-0")
+    assert node.metadata.annotations.get("tpu.nos/spec-dev-0-2x2") == "1"
+    assert node.metadata.annotations.get("tpu.nos/spec-dev-0-2x4") == "1"
+    profiles = sorted(s.profile.name for s in client.list_slices())
+    assert profiles == ["2x2", "2x4"]
+    # The used 2x2 slice survived (same id).
+    used = [s for s in client.list_slices() if s.in_use]
+    assert len(used) == 1 and used[0].profile.name == "2x2"
+
+    assert bind_if_fits(cluster, state, cluster.get("Pod", "ml", "jax-b"))
+
+
+def test_handshake_blocks_replanning_until_agent_reports(env):
+    cluster, state, clock, client, agent, controller = env
+    agent.stop()  # simulate a dead agent: spec will go unreported
+
+    cluster.create(unschedulable_slice_pod("jax-a"))
+    clock.advance(11)
+    assert controller.process_batch_if_ready()  # plans; spec written, no report
+
+    node = cluster.get("Node", "", "tpu-node-0")
+    assert not ann.node_reported_last_plan(node.metadata.annotations)
+
+    # New pod arrives; planner must refuse to plan while the node lags.
+    cluster.create(unschedulable_slice_pod("jax-b"))
+    clock.advance(61)
+    assert not controller.process_batch_if_ready()
+    assert controller.waiting_for_plan_reports() == ["tpu-node-0"]
+
+    # Agent comes back, catches up, reports -> planning unblocks.
+    agent.reconcile()
+    assert controller.waiting_for_plan_reports() == []
+    clock.advance(61)
+    assert controller.process_batch_if_ready()
+
+
+def test_agent_partial_apply_on_device_failure(env):
+    cluster, state, clock, client, agent, controller = env
+    client.fail_next = 1  # first create_slice will fail
+
+    cluster.create(unschedulable_slice_pod("jax-a"))
+    clock.advance(11)
+    controller.process_batch_if_ready()
+
+    node = cluster.get("Node", "", "tpu-node-0")
+    # Apply failed, but the agent still reported actual (empty) state and the
+    # plan id -> the handshake completes and status shows no slices.
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+    assert client.list_slices() == []
+    # Next reconcile succeeds (controller would requeue; we re-trigger).
+    agent.reconcile()
+    assert [s.profile.name for s in client.list_slices()] == ["2x2"]

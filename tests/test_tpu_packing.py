@@ -103,3 +103,33 @@ def test_pack_orientation_used_when_needed():
 
 def test_empty_geometry_packs():
     assert pack(Shape.parse("4x4"), {}) == []
+
+
+def test_pack_into_around_occupied():
+    from nos_tpu.tpu.packing import pack_into
+
+    mesh = Shape.parse("4x4")
+    # A 2x2 sits at origin (0,0); add a 2x4 and two 1x1s around it.
+    occupied = [((0, 0), (2, 2))]
+    geo = {P("2x4"): 1, P("1x1"): 2}
+    placements = pack_into(mesh, occupied, geo)
+    assert placements is not None
+    cells = set()
+    for pl in placements:
+        c = _cells(pl)
+        assert not c & cells
+        cells |= c
+    occ = {(x, y) for x in range(2) for y in range(2)}
+    assert not cells & occ, "new placements must avoid occupied blocks"
+
+
+def test_pack_into_fragmentation_fails():
+    from nos_tpu.tpu.packing import pack_into
+
+    mesh = Shape.parse("4x4")
+    # Four 1x1s pinned at the corner of each 2x2 quadrant: no 2x2 is placeable
+    # without moving them.
+    occupied = [((0, 0), (1, 1)), ((0, 2), (1, 1)), ((2, 0), (1, 1)), ((2, 2), (1, 1))]
+    assert pack_into(mesh, occupied, {P("2x2"): 1}) is None
+    # But 1x2 strips still fit.
+    assert pack_into(mesh, occupied, {P("1x2"): 4}) is not None
