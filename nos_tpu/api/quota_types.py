@@ -1,0 +1,73 @@
+"""ElasticQuota / CompositeElasticQuota CRD types.
+
+Analog of pkg/api/nos.nebuly.com/v1alpha1/{elasticquota_types.go:30-71,
+compositeelasticquota_types.go:29-66}: `min` is guaranteed capacity, `max` the
+borrowing ceiling (optional), `used` the reconciled status. A
+CompositeElasticQuota spans a *list* of namespaces sharing one budget.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from nos_tpu.api.objects import ObjectMeta
+from nos_tpu.api.resources import ResourceList
+
+
+@dataclass
+class ElasticQuotaSpec:
+    min: ResourceList = field(default_factory=ResourceList)
+    max: Optional[ResourceList] = None
+
+
+@dataclass
+class ElasticQuotaStatus:
+    used: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class ElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ElasticQuotaSpec = field(default_factory=ElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+
+    KIND = "ElasticQuota"
+
+
+@dataclass
+class CompositeElasticQuotaSpec:
+    namespaces: List[str] = field(default_factory=list)
+    min: ResourceList = field(default_factory=ResourceList)
+    max: Optional[ResourceList] = None
+
+
+@dataclass
+class CompositeElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CompositeElasticQuotaSpec = field(default_factory=CompositeElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+
+    KIND = "CompositeElasticQuota"
+
+
+# -- test/builder factories (reference *_factory.go) -------------------------
+def build_eq(namespace: str, name: str, min=None, max=None) -> ElasticQuota:
+    return ElasticQuota(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=ElasticQuotaSpec(
+            min=ResourceList.of(min or {}),
+            max=ResourceList.of(max) if max is not None else None,
+        ),
+    )
+
+
+def build_composite_eq(name: str, namespaces, min=None, max=None) -> CompositeElasticQuota:
+    return CompositeElasticQuota(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=CompositeElasticQuotaSpec(
+            namespaces=list(namespaces),
+            min=ResourceList.of(min or {}),
+            max=ResourceList.of(max) if max is not None else None,
+        ),
+    )

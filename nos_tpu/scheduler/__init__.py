@@ -1,0 +1,1 @@
+"""Quota-aware, topology-aware scheduler (pkg/scheduler analog)."""

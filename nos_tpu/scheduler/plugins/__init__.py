@@ -1,0 +1,1 @@
+"""Scheduler plugins: resource fit, TPU topology scoring, capacity scheduling."""

@@ -1,0 +1,63 @@
+"""Baseline fit + selector filters and a least-allocated score
+(the stock-plugin subset the reference relies on: NodeResourcesFit etc.)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from nos_tpu.api.objects import Pod
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.partitioning.core.interface import NodeInfo
+from nos_tpu.scheduler.framework import CycleState, FilterPlugin, ScorePlugin, Status
+
+
+class NodeSelectorFilter(FilterPlugin):
+    name = "NodeSelector"
+
+    def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
+        for k, v in pod.spec.node_selector.items():
+            if node.labels.get(k) != v:
+                return Status.unschedulable(f"node selector {k}={v} not satisfied")
+        return Status.success()
+
+
+class NodeResourcesFit(FilterPlugin):
+    name = "NodeResourcesFit"
+
+    def __init__(self, request_fn: Callable[[Pod], ResourceList]):
+        self.request_fn = request_fn
+
+    def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
+        from nos_tpu import constants
+
+        request = self.request_fn(pod)
+        free = node.free
+        lacking = [
+            f"{r} (want {q:g}, free {free.get(r, 0.0):g})"
+            for r, q in request.items()
+            # The synthetic accelerator-memory resource is metered against
+            # quotas, never against nodes (resource.go gpu-memory semantics).
+            if r != constants.RESOURCE_ACCELERATOR_MEMORY
+            and q > 0
+            and q > free.get(r, 0.0) + 1e-9
+        ]
+        if lacking:
+            return Status.unschedulable("insufficient " + ", ".join(lacking))
+        return Status.success()
+
+
+class LeastAllocatedScore(ScorePlugin):
+    """Prefer emptier nodes (spreading) for non-accelerator resources."""
+
+    name = "LeastAllocated"
+
+    def score(self, state: CycleState, pod: Pod, node: NodeInfo) -> float:
+        total = 0.0
+        count = 0
+        for resource in ("cpu", "memory"):
+            alloc = node.allocatable.get(resource, 0.0)
+            if alloc <= 0:
+                continue
+            total += max(0.0, 1.0 - node.requested.get(resource, 0.0) / alloc)
+            count += 1
+        return 10.0 * total / count if count else 0.0

@@ -1,0 +1,212 @@
+"""The quota- and topology-aware scheduler loop (nos-scheduler analog).
+
+Wires the plugin framework over the in-memory cluster: pending pods are
+scheduled priority-first; infeasible pods get the Unschedulable PodScheduled
+condition — which is exactly the signal the partitioner controller batches on,
+closing the loop of SURVEY.md §3.1/§3.2 — and PostFilter preemption may evict
+victims and nominate a node.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Node, Pod, PodCondition, PodPhase
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster.client import Cluster, NotFoundError
+from nos_tpu.partitioning.core.interface import NodeInfo
+from nos_tpu.scheduler.framework import CycleState, Framework, Status
+from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
+from nos_tpu.scheduler.plugins.noderesources import (
+    LeastAllocatedScore,
+    NodeResourcesFit,
+    NodeSelectorFilter,
+)
+from nos_tpu.scheduler.plugins.topology import TpuTopologyFilter, TpuTopologyScore
+from nos_tpu.scheduler.resource_calculator import ResourceCalculator
+from nos_tpu.util import pod as podutil
+
+logger = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        cluster: Cluster,
+        calculator: Optional[ResourceCalculator] = None,
+        scheduler_name: str = constants.SCHEDULER_NAME,
+        bind_starts_pods: bool = True,
+    ):
+        self.cluster = cluster
+        self.calculator = calculator or ResourceCalculator()
+        self.scheduler_name = scheduler_name
+        self.bind_starts_pods = bind_starts_pods
+        self.capacity = CapacityScheduling(self.calculator, evict_fn=self._evict)
+        self.framework = Framework(
+            pre_filters=[self.capacity],
+            filters=[
+                NodeSelectorFilter(),
+                NodeResourcesFit(self.calculator.compute_pod_request),
+                TpuTopologyFilter(),
+            ],
+            scores=[LeastAllocatedScore(), TpuTopologyScore()],
+            reserves=[self.capacity],
+            post_filters=[self.capacity],
+            request_fn=self.calculator.compute_pod_request,
+        )
+        self.capacity.framework = self.framework
+
+    # -- cluster views -------------------------------------------------------
+    def node_infos(self) -> List[NodeInfo]:
+        infos = []
+        pods = [p for p in self.cluster.list("Pod") if podutil.is_active(p)]
+        for node in self.cluster.list("Node"):
+            requested = ResourceList()
+            node_pods = []
+            for p in pods:
+                if p.spec.node_name == node.metadata.name:
+                    requested = requested.add(self.calculator.compute_pod_request(p))
+                    node_pods.append(p)
+            infos.append(
+                NodeInfo(
+                    name=node.metadata.name,
+                    labels=dict(node.metadata.labels),
+                    allocatable=ResourceList(node.status.allocatable),
+                    requested=requested,
+                    pods=node_pods,
+                )
+            )
+        return infos
+
+    def pending_pods(self) -> List[Pod]:
+        pods = self.cluster.list(
+            "Pod",
+            predicate=lambda p: (
+                p.status.phase == PodPhase.PENDING
+                and not p.spec.node_name
+                and p.spec.scheduler_name == self.scheduler_name
+            ),
+        )
+        return sorted(
+            pods,
+            key=lambda p: (
+                -p.spec.priority,
+                p.metadata.creation_timestamp,
+                p.metadata.namespaced_name,
+            ),
+        )
+
+    # -- scheduling ----------------------------------------------------------
+    def schedule_pending(self) -> dict:
+        """One full pass over the pending queue. Returns a summary dict."""
+        self.capacity.refresh_from_cluster(self.cluster)
+        bound, unschedulable, nominated = [], [], []
+        pending = self.pending_pods()
+        self.capacity.nominated_pods = [p for p in pending if p.status.nominated_node_name]
+        for pod in pending:
+            result = self.schedule_one(pod)
+            if result is None:
+                if pod.status.nominated_node_name:
+                    nominated.append(pod.metadata.namespaced_name)
+                else:
+                    unschedulable.append(pod.metadata.namespaced_name)
+            else:
+                bound.append((pod.metadata.namespaced_name, result))
+        return {"bound": bound, "unschedulable": unschedulable, "nominated": nominated}
+
+    def schedule_one(self, pod: Pod) -> Optional[str]:
+        state = CycleState()
+        status = self.framework.run_pre_filter(state, pod)
+        if not status.is_success:
+            self._mark_unschedulable(pod, status)
+            return None
+        nodes = self.node_infos()
+        feasible = []
+        for node in nodes:
+            s = self.framework.run_filters_with_nominated_pods(
+                state, pod, node, self.capacity.nominated_pods
+            )
+            if s.is_success:
+                feasible.append(node)
+        if not feasible:
+            nominated_node, post_status = self.framework.run_post_filters(state, pod, nodes)
+            if nominated_node:
+                self._nominate(pod, nominated_node)
+            else:
+                self._mark_unschedulable(
+                    pod,
+                    Status.unschedulable(
+                        f"0/{len(nodes)} nodes available", *post_status.reasons
+                    ),
+                )
+            return None
+        best = max(
+            feasible,
+            key=lambda n: (self.framework.run_scores(state, pod, n), n.name),
+        )
+        reserve_status = self.framework.run_reserve(state, pod, best.name)
+        if not reserve_status.is_success:
+            self._mark_unschedulable(pod, reserve_status)
+            return None
+        try:
+            self._bind(pod, best.name)
+        except Exception:
+            self.framework.run_unreserve(state, pod, best.name)
+            raise
+        return best.name
+
+    # -- cluster mutations ---------------------------------------------------
+    def _bind(self, pod: Pod, node_name: str) -> None:
+        def mutate(p: Pod) -> None:
+            p.spec.node_name = node_name
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ]
+            p.status.conditions.append(
+                PodCondition(type="PodScheduled", status="True", reason="Scheduled")
+            )
+            p.status.nominated_node_name = ""
+            if self.bind_starts_pods:
+                # Kubelet stand-in: bound pods start running immediately.
+                p.status.phase = PodPhase.RUNNING
+
+        self.cluster.patch("Pod", pod.metadata.namespace, pod.metadata.name, mutate)
+        pod.spec.node_name = node_name
+        logger.info("bound %s to %s", pod.metadata.namespaced_name, node_name)
+
+    def _mark_unschedulable(self, pod: Pod, status: Status) -> None:
+        def mutate(p: Pod) -> None:
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ]
+            p.status.conditions.append(
+                PodCondition(
+                    type="PodScheduled",
+                    status="False",
+                    reason="Unschedulable",
+                )
+            )
+
+        try:
+            self.cluster.patch("Pod", pod.metadata.namespace, pod.metadata.name, mutate)
+        except NotFoundError:
+            pass
+
+    def _nominate(self, pod: Pod, node_name: str) -> None:
+        def mutate(p: Pod) -> None:
+            p.status.nominated_node_name = node_name
+
+        try:
+            self.cluster.patch("Pod", pod.metadata.namespace, pod.metadata.name, mutate)
+            pod.status.nominated_node_name = node_name
+        except NotFoundError:
+            pass
+
+    def _evict(self, victim: Pod) -> None:
+        """Preemption eviction: delete the pod (workload controllers recreate)."""
+        try:
+            self.cluster.delete("Pod", victim.metadata.namespace, victim.metadata.name)
+        except NotFoundError:
+            pass

@@ -1,0 +1,180 @@
+"""Full-system closed loop: scheduler + partitioner + node agent.
+
+The complete reference architecture (SURVEY.md §3.1 + §3.2) in one process:
+the scheduler fails a fractional-TPU pod and marks it Unschedulable; the
+partitioner controller batches it, plans a geometry, writes spec annotations;
+the node agent carves slices and refreshes allocatable; the next scheduler
+pass binds the pod. Elastic quotas govern the whole flow.
+"""
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodPhase, PodSpec
+from nos_tpu.api.quota_types import build_eq
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster import Cluster
+from nos_tpu.controllers.partitioner import PartitionerController
+from nos_tpu.controllers.tpu_agent import TpuAgent
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.tpu_mode import TpuPartitioner, TpuSnapshotTaker
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.tpu import Topology
+from nos_tpu.tpulib import FakeTpuClient
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class System:
+    """The whole control plane over one in-memory cluster."""
+
+    def __init__(self, topos={"tpu-node-0": "4x4"}):
+        self.cluster = Cluster()
+        self.state = ClusterState()
+        self.state.start_watching(self.cluster)
+        self.clock = FakeClock()
+        self.scheduler = Scheduler(self.cluster)
+        self.agents = {}
+        for name, topo in topos.items():
+            self.cluster.create(
+                Node(
+                    metadata=ObjectMeta(
+                        name=name,
+                        labels={
+                            constants.LABEL_PARTITIONING: constants.KIND_TPU,
+                            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                            constants.LABEL_TPU_TOPOLOGY: topo,
+                        },
+                    ),
+                    status=NodeStatus(
+                        allocatable=ResourceList.of(
+                            {"cpu": 64, "memory": "128Gi",
+                             "google.com/tpu": Topology.parse("v5e", topo).chips}
+                        )
+                    ),
+                )
+            )
+            agent = TpuAgent(self.cluster, name, FakeTpuClient(Topology.parse("v5e", topo)))
+            agent.startup()
+            agent.start_watching()
+            self.agents[name] = agent
+        self.controller = PartitionerController(
+            cluster=self.cluster,
+            state=self.state,
+            kind=constants.KIND_TPU,
+            snapshot_taker=TpuSnapshotTaker(),
+            partitioner=TpuPartitioner(self.cluster),
+            sim_scheduler=SchedulerSim(self.scheduler),
+            now=self.clock,
+        )
+        self.controller.start_watching()
+
+    def submit(self, name, ns, resources, priority=0):
+        pod = Pod(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=PodSpec(
+                containers=[Container(resources=ResourceList.of(resources))],
+                scheduler_name=constants.SCHEDULER_NAME,
+                priority=priority,
+            ),
+        )
+        self.cluster.create(pod)
+        return pod
+
+    def tick(self, seconds=11.0):
+        """One control-plane round: schedule, close batch window, partition,
+        schedule again."""
+        self.scheduler.schedule_pending()
+        self.clock.advance(seconds)
+        self.controller.process_batch_if_ready()
+        return self.scheduler.schedule_pending()
+
+
+class SchedulerSim:
+    """SimScheduler seam backed by the real scheduler framework — the
+    embedded-framework simulation of the reference
+    (cmd/gpupartitioner/gpupartitioner.go:293-317)."""
+
+    def __init__(self, scheduler: Scheduler):
+        self._scheduler = scheduler
+
+    def pre_filter(self, pod):
+        from nos_tpu.scheduler.framework import CycleState
+
+        self._state = CycleState()
+        self._scheduler.capacity.refresh_from_cluster(self._scheduler.cluster)
+        return self._scheduler.framework.run_pre_filter(self._state, pod).is_success
+
+    def filter(self, pod, node_info):
+        return self._scheduler.framework.run_filters(self._state, pod, node_info).is_success
+
+
+def test_fractional_pod_triggers_carve_and_binds():
+    sys = System()
+    sys.submit("jax-a", "ml", {"google.com/tpu-2x2": 1, "cpu": 1})
+    result = sys.tick()
+    assert result["bound"] == [("ml/jax-a", "tpu-node-0")]
+    pod = sys.cluster.get("Pod", "ml", "jax-a")
+    assert pod.status.phase == PodPhase.RUNNING
+    node = sys.cluster.get("Node", "", "tpu-node-0")
+    assert node.status.allocatable["google.com/tpu-2x2"] == 1
+    assert node.status.allocatable[constants.RESOURCE_TPU] == 12
+
+
+def test_mixed_workload_fills_mesh():
+    sys = System()
+    sys.submit("big", "ml", {"google.com/tpu-2x4": 1})
+    sys.submit("small-1", "ml", {"google.com/tpu-2x2": 1})
+    sys.submit("small-2", "ml", {"google.com/tpu-2x2": 1})
+    result = sys.tick()
+    assert sorted(n for _, n in result["bound"]) == ["tpu-node-0"] * 3
+    # 8 + 4 + 4 = 16 chips: the mesh is fully utilized.
+    node = sys.cluster.get("Node", "", "tpu-node-0")
+    assert node.status.allocatable[constants.RESOURCE_TPU] == 0
+
+
+def test_quota_gates_carving():
+    sys = System()
+    # ml's quota: max 64GB accelerator memory = 4 chips.
+    sys.cluster.create(
+        build_eq("ml", "q", min={constants.RESOURCE_ACCELERATOR_MEMORY: 64},
+                 max={constants.RESOURCE_ACCELERATOR_MEMORY: 64})
+    )
+    sys.submit("ok", "ml", {"google.com/tpu-2x2": 1})       # 64GB
+    sys.submit("blocked", "ml", {"google.com/tpu-2x2": 1})  # would exceed max
+    result = sys.tick()
+    assert result["bound"] == [("ml/ok", "tpu-node-0")]
+    # The blocked pod stays pending and no extra slice was carved for it.
+    sys.clock.advance(61)
+    sys.controller.process_batch_if_ready()
+    result2 = sys.scheduler.schedule_pending()
+    assert result2["bound"] == []
+    pod = sys.cluster.get("Pod", "ml", "blocked")
+    assert pod.status.phase == PodPhase.PENDING
+
+
+def test_two_nodes_spillover():
+    sys = System(topos={"node-a": "4x4", "node-b": "4x4"})
+    for i in range(6):
+        sys.submit(f"p{i}", "ml", {"google.com/tpu-2x4": 1})
+    result = sys.tick()
+    # 6 pods x 8 chips = 48 chips > one node (16); both nodes fill: 4 pods fit.
+    bound_nodes = [n for _, n in result["bound"]]
+    assert len(bound_nodes) == 4
+    assert sorted(set(bound_nodes)) == ["node-a", "node-b"]
+    # Remaining pods stay pending until capacity frees up.
+    pending = [
+        p.metadata.name
+        for p in sys.cluster.list("Pod")
+        if p.status.phase == PodPhase.PENDING
+    ]
+    assert len(pending) == 2

@@ -1,0 +1,79 @@
+"""ElasticQuotaInfo math tests (reference elasticquotainfo_test.go analog)."""
+
+import pytest
+
+from nos_tpu.api.quota_types import build_composite_eq, build_eq
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.scheduler.quota_info import ElasticQuotaInfos
+
+
+def infos(*quotas, ceqs=()):
+    return ElasticQuotaInfos.from_objects(quotas, ceqs)
+
+
+def test_from_objects_and_namespace_lookup():
+    qs = infos(
+        build_eq("ns-a", "qa", min={"cpu": 4}),
+        build_eq("ns-b", "qb", min={"cpu": 2}, max={"cpu": 10}),
+    )
+    assert len(qs) == 2
+    a = qs.for_namespace("ns-a")
+    assert a is not None and a.min["cpu"] == 4 and a.max is None
+    assert qs.for_namespace("nope") is None
+
+
+def test_composite_shadows_member_namespaces():
+    qs = infos(
+        build_eq("ns-a", "qa", min={"cpu": 4}),
+        build_eq("ns-c", "qc", min={"cpu": 1}),
+        ceqs=[build_composite_eq("team", ["ns-a", "ns-b"], min={"cpu": 8})],
+    )
+    a = qs.for_namespace("ns-a")
+    assert a.composite and a.name == "ceq/team"
+    assert qs.for_namespace("ns-b").name == "ceq/team"
+    assert qs.for_namespace("ns-c").name == "eq/ns-c/qc"
+
+
+def test_over_min_and_max():
+    qs = infos(build_eq("ns-a", "qa", min={"cpu": 4}, max={"cpu": 6}))
+    a = qs.for_namespace("ns-a")
+    req = ResourceList.of({"cpu": 3})
+    assert not a.is_over_min_with(req)
+    a.add_used(ResourceList.of({"cpu": 2}))
+    assert a.is_over_min_with(req)  # 2+3 > 4
+    assert a.fits_max(req)  # 2+3 <= 6
+    assert not a.fits_max(ResourceList.of({"cpu": 5}))  # 2+5 > 6
+
+
+def test_aggregated_borrow_guard():
+    qs = infos(
+        build_eq("ns-a", "qa", min={"cpu": 4}),
+        build_eq("ns-b", "qb", min={"cpu": 4}),
+    )
+    qs.for_namespace("ns-a").add_used(ResourceList.of({"cpu": 6}))  # borrowing 2
+    # Σmin=8, Σused=6 -> only 2 cpu left to borrow.
+    assert qs.aggregated_used_fits_total_min(ResourceList.of({"cpu": 2}))
+    assert not qs.aggregated_used_fits_total_min(ResourceList.of({"cpu": 3}))
+
+
+def test_guaranteed_overquotas_proportional_to_min():
+    # Pool = (4-0) + (8-8) + (4-2) = 6 unused cpu; shares 4:8:4.
+    qs = infos(
+        build_eq("ns-a", "qa", min={"cpu": 4}),
+        build_eq("ns-b", "qb", min={"cpu": 8}),
+        build_eq("ns-c", "qc", min={"cpu": 4}),
+    )
+    qs.for_namespace("ns-b").add_used(ResourceList.of({"cpu": 8}))
+    qs.for_namespace("ns-c").add_used(ResourceList.of({"cpu": 2}))
+    g_a = qs.guaranteed_overquotas("eq/ns-a/qa")
+    g_b = qs.guaranteed_overquotas("eq/ns-b/qb")
+    assert g_a["cpu"] == pytest.approx(6 * 4 / 16)
+    assert g_b["cpu"] == pytest.approx(6 * 8 / 16)
+    assert qs.guaranteed_overquotas("missing") == {}
+
+
+def test_clone_is_independent():
+    qs = infos(build_eq("ns-a", "qa", min={"cpu": 4}))
+    c = qs.clone()
+    c.for_namespace("ns-a").add_used(ResourceList.of({"cpu": 2}))
+    assert qs.for_namespace("ns-a").used == {}

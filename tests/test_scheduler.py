@@ -1,0 +1,191 @@
+"""Scheduler + CapacityScheduling tests
+(reference capacity_scheduling_test.go analog, against the in-memory cluster)."""
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.api.quota_types import build_composite_eq, build_eq
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster import Cluster
+from nos_tpu.scheduler.resource_calculator import ResourceCalculator
+from nos_tpu.scheduler.scheduler import Scheduler
+
+
+def make_node(name, resources, labels=None):
+    rl = ResourceList.of(resources)
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        status=NodeStatus(allocatable=rl, capacity=ResourceList(rl)),
+    )
+
+
+def make_pod(name, ns, resources, priority=0, labels=None, phase=PodPhase.PENDING):
+    p = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=PodSpec(
+            containers=[Container(resources=ResourceList.of(resources))],
+            scheduler_name=constants.SCHEDULER_NAME,
+            priority=priority,
+        ),
+    )
+    p.status.phase = phase
+    return p
+
+
+def tpu_labels(topo="4x4"):
+    return {
+        constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+        constants.LABEL_TPU_TOPOLOGY: topo,
+    }
+
+
+def test_resource_calculator_accelerator_memory():
+    calc = ResourceCalculator()
+    pod = make_pod("p", "ns", {"google.com/tpu-2x2": 1, "cpu": 1})
+    req = calc.compute_pod_request(pod)
+    assert req[constants.RESOURCE_ACCELERATOR_MEMORY] == 64  # 4 chips * 16GB
+    pod2 = make_pod("p2", "ns", {"nvidia.com/mig-1g.10gb": 2, "nvidia.com/gpu": 1})
+    req2 = calc.compute_pod_request(pod2)
+    assert req2[constants.RESOURCE_ACCELERATOR_MEMORY] == 2 * 10 + 16
+    pod3 = make_pod("p3", "ns", {"nvidia.com/gpu-10gb": 3})
+    assert calc.compute_pod_request(pod3)[constants.RESOURCE_ACCELERATOR_MEMORY] == 30
+
+
+def test_schedules_basic_pod_and_marks_unschedulable():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 4, "memory": "8Gi"}))
+    cluster.create(make_pod("fits", "ns", {"cpu": 2}))
+    cluster.create(make_pod("too-big", "ns", {"cpu": 8}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["bound"] == [("ns/fits", "n1")]
+    assert result["unschedulable"] == ["ns/too-big"]
+    fits = cluster.get("Pod", "ns", "fits")
+    assert fits.spec.node_name == "n1" and fits.status.phase == PodPhase.RUNNING
+    too_big = cluster.get("Pod", "ns", "too-big")
+    cond = too_big.condition("PodScheduled")
+    assert cond.status == "False" and cond.reason == "Unschedulable"
+
+
+def test_quota_max_rejects():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 32}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 2}, max={"cpu": 4}))
+    cluster.create(make_pod("p1", "ns-a", {"cpu": 8}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["unschedulable"] == ["ns-a/p1"]
+
+
+def test_borrowing_allowed_within_total_min():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 32}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 2}))
+    cluster.create(build_eq("ns-b", "qb", min={"cpu": 6}))
+    # ns-a borrows beyond its min=2 into ns-b's unused guarantee.
+    cluster.create(make_pod("p1", "ns-a", {"cpu": 6}))
+    s = Scheduler(cluster)
+    assert s.schedule_pending()["bound"] == [("ns-a/p1", "n1")]
+    # Second borrower would push Σused=6+3 > Σmin=8 -> rejected.
+    cluster.create(make_pod("p2", "ns-a", {"cpu": 3}))
+    assert s.schedule_pending()["unschedulable"] == ["ns-a/p2"]
+
+
+def test_preemption_in_quota_pod_evicts_over_quota_borrower():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 8}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 6}))
+    cluster.create(build_eq("ns-b", "qb", min={"cpu": 2}))
+    # ns-b borrowed heavily: 6 cpu used (4 over min), marked over-quota.
+    borrower = make_pod(
+        "borrower",
+        "ns-b",
+        {"cpu": 6},
+        labels={constants.LABEL_CAPACITY: constants.CAPACITY_OVER_QUOTA},
+        phase=PodPhase.RUNNING,
+    )
+    borrower.spec.node_name = "n1"
+    cluster.create(borrower)
+    # ns-a wants its guaranteed 6 cpu; node only has 2 free -> preempt.
+    cluster.create(make_pod("claimant", "ns-a", {"cpu": 6}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["nominated"] == ["ns-a/claimant"]
+    assert cluster.try_get("Pod", "ns-b", "borrower") is None  # evicted
+    # Next pass binds the claimant onto the freed node.
+    result2 = s.schedule_pending()
+    assert result2["bound"] == [("ns-a/claimant", "n1")]
+
+
+def test_preemption_spares_in_quota_pods():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 8}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 4}))
+    cluster.create(build_eq("ns-b", "qb", min={"cpu": 4}))
+    victim_safe = make_pod("safe", "ns-b", {"cpu": 4}, phase=PodPhase.RUNNING)
+    victim_safe.spec.node_name = "n1"
+    cluster.create(victim_safe)  # in-quota: used=min
+    cluster.create(make_pod("claimant", "ns-a", {"cpu": 6}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    # claimant is itself over-min (borrowing 2), ns-b pod is in-quota -> no victims.
+    assert result["unschedulable"] == ["ns-a/claimant"]
+    assert cluster.try_get("Pod", "ns-b", "safe") is not None
+
+
+def test_tpu_topology_score_prefers_carved_free_slice():
+    cluster = Cluster()
+    # Both nodes expose a free 2x2; n-tight has no other free capacity while
+    # n-loose has 12 uncarved chips -> bin-packing prefers n-tight.
+    n_tight = make_node(
+        "n-tight",
+        {"cpu": 8, "google.com/tpu": 0, "google.com/tpu-2x2": 1},
+        labels=tpu_labels(),
+    )
+    n_loose = make_node(
+        "n-loose",
+        {"cpu": 8, "google.com/tpu": 12, "google.com/tpu-2x2": 1},
+        labels=tpu_labels(),
+    )
+    cluster.create(n_tight)
+    cluster.create(n_loose)
+    cluster.create(make_pod("p", "ns", {"google.com/tpu-2x2": 1}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["bound"] == [("ns/p", "n-tight")]
+
+
+def test_tpu_topology_filter_rejects_impossible_shape():
+    cluster = Cluster()
+    # Node advertises 8 whole chips but its mesh is 2x4: a 4x4 slice can never
+    # be carved contiguously even though chip count (16 > 8) already fails;
+    # use a 2x4 mesh with 8 free chips vs a request of 2x4 = fits, and a
+    # fragmented case via in-use whole chips.
+    node = make_node("n1", {"cpu": 8, "google.com/tpu": 8}, labels=tpu_labels("2x4"))
+    cluster.create(node)
+    # 4x4 sub-slice (16 chips) into a 2x4 mesh: impossible shape.
+    cluster.create(make_pod("impossible", "ns", {"google.com/tpu-4x4": 1}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["unschedulable"] == ["ns/impossible"]
+
+
+def test_composite_quota_spans_namespaces():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 16}))
+    cluster.create(build_composite_eq("team", ["ns-a", "ns-b"], min={"cpu": 4}, max={"cpu": 4}))
+    cluster.create(make_pod("p1", "ns-a", {"cpu": 3}))
+    s = Scheduler(cluster)
+    assert s.schedule_pending()["bound"] == [("ns-a/p1", "n1")]
+    # ns-b shares the same budget: 3+2 > max 4 -> rejected.
+    cluster.create(make_pod("p2", "ns-b", {"cpu": 2}))
+    assert s.schedule_pending()["unschedulable"] == ["ns-b/p2"]
