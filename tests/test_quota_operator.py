@@ -1,0 +1,190 @@
+"""ElasticQuota operator tests: webhooks + reconcilers
+(reference elasticquota *_test.go + *_int_test.go analog)."""
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Container, ObjectMeta, Pod, PodPhase, PodSpec
+from nos_tpu.api.quota_types import build_composite_eq, build_eq
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.api.webhooks import install_quota_webhooks
+from nos_tpu.cluster import Cluster
+from nos_tpu.cluster.client import AdmissionError
+from nos_tpu.controllers.quota import QuotaReconciler
+
+CPU = "cpu"
+
+
+def running_pod(name, ns, cpu, node="n1", priority=0, created=0.0):
+    p = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, creation_timestamp=created),
+        spec=PodSpec(
+            containers=[Container(resources=ResourceList.of({CPU: cpu}))],
+            priority=priority,
+        ),
+    )
+    p.spec.node_name = node
+    p.status.phase = PodPhase.RUNNING
+    return p
+
+
+# -- webhooks ----------------------------------------------------------------
+def test_webhook_rejects_second_eq_in_namespace():
+    cluster = Cluster()
+    install_quota_webhooks(cluster)
+    cluster.create(build_eq("ns-a", "q1", min={CPU: 2}))
+    with pytest.raises(AdmissionError):
+        cluster.create(build_eq("ns-a", "q2", min={CPU: 1}))
+
+
+def test_webhook_rejects_min_above_max():
+    cluster = Cluster()
+    install_quota_webhooks(cluster)
+    with pytest.raises(AdmissionError):
+        cluster.create(build_eq("ns-a", "q1", min={CPU: 4}, max={CPU: 2}))
+
+
+def test_webhook_rejects_eq_in_ceq_namespace_and_ceq_overlap():
+    cluster = Cluster()
+    install_quota_webhooks(cluster)
+    cluster.create(build_composite_eq("team", ["ns-a", "ns-b"], min={CPU: 4}))
+    with pytest.raises(AdmissionError):
+        cluster.create(build_eq("ns-a", "q1", min={CPU: 1}))
+    with pytest.raises(AdmissionError):
+        cluster.create(build_composite_eq("team2", ["ns-b", "ns-c"], min={CPU: 1}))
+    with pytest.raises(AdmissionError):
+        cluster.create(build_composite_eq("empty", [], min={CPU: 1}))
+
+
+# -- reconciler --------------------------------------------------------------
+def test_over_quota_labeling_and_used_status():
+    cluster = Cluster()
+    reconciler = QuotaReconciler(cluster)
+    reconciler.start_watching()
+
+    cluster.create(build_eq("ns-a", "q", min={CPU: 4}))
+    cluster.create(running_pod("p1", "ns-a", 3, created=1.0))
+    cluster.create(running_pod("p2", "ns-a", 3, created=2.0))
+
+    p1 = cluster.get("Pod", "ns-a", "p1")
+    p2 = cluster.get("Pod", "ns-a", "p2")
+    assert p1.metadata.labels[constants.LABEL_CAPACITY] == constants.CAPACITY_IN_QUOTA
+    assert p2.metadata.labels[constants.LABEL_CAPACITY] == constants.CAPACITY_OVER_QUOTA
+    eq = cluster.get("ElasticQuota", "ns-a", "q")
+    assert eq.status.used[CPU] == 6
+
+
+def test_labels_flip_when_pod_finishes():
+    cluster = Cluster()
+    reconciler = QuotaReconciler(cluster)
+    reconciler.start_watching()
+
+    cluster.create(build_eq("ns-a", "q", min={CPU: 4}))
+    cluster.create(running_pod("early", "ns-a", 3, created=1.0))
+    cluster.create(running_pod("late", "ns-a", 3, created=2.0))
+    assert (
+        cluster.get("Pod", "ns-a", "late").metadata.labels[constants.LABEL_CAPACITY]
+        == constants.CAPACITY_OVER_QUOTA
+    )
+    # The early pod finishes -> the late pod falls within min.
+    cluster.patch(
+        "Pod", "ns-a", "early", lambda p: setattr(p.status, "phase", PodPhase.SUCCEEDED)
+    )
+    assert (
+        cluster.get("Pod", "ns-a", "late").metadata.labels[constants.LABEL_CAPACITY]
+        == constants.CAPACITY_IN_QUOTA
+    )
+    assert cluster.get("ElasticQuota", "ns-a", "q").status.used[CPU] == 3
+
+
+def test_priority_breaks_creation_ties():
+    cluster = Cluster()
+    reconciler = QuotaReconciler(cluster)
+    reconciler.start_watching()
+    cluster.create(build_eq("ns-a", "q", min={CPU: 4}))
+    cluster.create(running_pod("low", "ns-a", 3, priority=0, created=1.0))
+    cluster.create(running_pod("high", "ns-a", 3, priority=10, created=1.0))
+    assert (
+        cluster.get("Pod", "ns-a", "high").metadata.labels[constants.LABEL_CAPACITY]
+        == constants.CAPACITY_IN_QUOTA
+    )
+    assert (
+        cluster.get("Pod", "ns-a", "low").metadata.labels[constants.LABEL_CAPACITY]
+        == constants.CAPACITY_OVER_QUOTA
+    )
+
+
+def test_composite_quota_spans_namespaces_and_deletes_overlapping_eq():
+    cluster = Cluster()
+    reconciler = QuotaReconciler(cluster)
+    reconciler.start_watching()
+
+    cluster.create(build_eq("ns-a", "old-q", min={CPU: 1}))
+    cluster.create(build_composite_eq("team", ["ns-a", "ns-b"], min={CPU: 4}))
+    # Overlapping EQ got deleted by the composite reconciler.
+    assert cluster.try_get("ElasticQuota", "ns-a", "old-q") is None
+
+    cluster.create(running_pod("pa", "ns-a", 2, created=1.0))
+    cluster.create(running_pod("pb", "ns-b", 3, created=2.0))
+    assert (
+        cluster.get("Pod", "ns-a", "pa").metadata.labels[constants.LABEL_CAPACITY]
+        == constants.CAPACITY_IN_QUOTA
+    )
+    assert (
+        cluster.get("Pod", "ns-b", "pb").metadata.labels[constants.LABEL_CAPACITY]
+        == constants.CAPACITY_OVER_QUOTA
+    )
+    ceq = cluster.get("CompositeElasticQuota", "default", "team")
+    assert ceq.status.used[CPU] == 5
+
+
+def test_quota_metering_only_named_resources():
+    cluster = Cluster()
+    reconciler = QuotaReconciler(cluster)
+    reconciler.start_watching()
+    cluster.create(build_eq("ns-a", "q", min={CPU: 4}))
+    pod = running_pod("p", "ns-a", 1)
+    pod.spec.containers[0].resources["memory"] = float(2**30)
+    cluster.create(pod)
+    eq = cluster.get("ElasticQuota", "ns-a", "q")
+    assert eq.status.used == {CPU: 1}  # memory unmetered
+
+
+def test_operator_plus_scheduler_preemption_path():
+    """The labels written by the operator drive scheduler preemption."""
+    from nos_tpu.api.objects import Node, NodeStatus
+    from nos_tpu.scheduler.scheduler import Scheduler
+
+    cluster = Cluster()
+    install_quota_webhooks(cluster)
+    reconciler = QuotaReconciler(cluster)
+    reconciler.start_watching()
+    cluster.create(
+        Node(
+            metadata=ObjectMeta(name="n1"),
+            status=NodeStatus(allocatable=ResourceList.of({CPU: 8})),
+        )
+    )
+    cluster.create(build_eq("ns-a", "qa", min={CPU: 6}))
+    cluster.create(build_eq("ns-b", "qb", min={CPU: 2}))
+    borrower = running_pod("borrower", "ns-b", 6)
+    cluster.create(borrower)  # reconciler labels it over-quota (6 > min 2)
+    assert (
+        cluster.get("Pod", "ns-b", "borrower").metadata.labels[constants.LABEL_CAPACITY]
+        == constants.CAPACITY_OVER_QUOTA
+    )
+
+    claimant = Pod(
+        metadata=ObjectMeta(name="claimant", namespace="ns-a"),
+        spec=PodSpec(
+            containers=[Container(resources=ResourceList.of({CPU: 6}))],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+    cluster.create(claimant)
+    s = Scheduler(cluster)
+    r1 = s.schedule_pending()
+    assert r1["nominated"] == ["ns-a/claimant"]
+    assert cluster.try_get("Pod", "ns-b", "borrower") is None
+    r2 = s.schedule_pending()
+    assert r2["bound"] == [("ns-a/claimant", "n1")]
