@@ -1,0 +1,271 @@
+"""GPU node agents: MIG and MPS (migagent / gpuagent analog).
+
+One generic agent covers both modes — the diff engine is count-based per
+(GPU index, profile) with the never-delete-used invariant and free-first
+deletion ordering of migagent/plan/plan.go:31-134; MIG validity (geometry
+menus) vs MPS validity (memory budget) lives in the device client.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.api.objects import Node
+from nos_tpu.api.resources import compute_pod_request
+from nos_tpu.cluster.client import Cluster, Event, EventType, NotFoundError
+from nos_tpu.controllers.tpu_agent import SharedState, dict_spec
+from nos_tpu.gpu.mig import MigProfile, geometry_allowed
+from nos_tpu.gpu.mps import MpsGpu, MpsProfile
+from nos_tpu.tpulib.interface import TpuLibError
+from nos_tpu.util import pod as podutil
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class GpuDevice:
+    device_id: str
+    gpu_index: int
+    profile: str
+    in_use: bool = False
+
+
+class FakeGpuDeviceClient:
+    """In-memory MIG/MPS device control (the NVML / CUDA-MPS mock analog,
+    pkg/test/mocks). `validate(gpu_index, geometry)` enforces mode rules."""
+
+    def __init__(
+        self,
+        gpu_count: int,
+        validate: Callable[[int, Dict[str, int]], bool],
+        fail_next: int = 0,
+    ):
+        self.gpu_count = gpu_count
+        self._validate = validate
+        self._devices: Dict[str, GpuDevice] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.RLock()
+        self.fail_next = fail_next
+
+    def _geometry(self, gpu_index: int) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for d in self._devices.values():
+            if d.gpu_index == gpu_index:
+                out[d.profile] = out.get(d.profile, 0) + 1
+        return out
+
+    def list_devices(self) -> List[GpuDevice]:
+        with self._lock:
+            return sorted(self._devices.values(), key=lambda d: d.device_id)
+
+    def create_device(self, gpu_index: int, profile: str) -> GpuDevice:
+        with self._lock:
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise TpuLibError("injected failure: create_device")
+            if not 0 <= gpu_index < self.gpu_count:
+                raise TpuLibError(f"no gpu {gpu_index}")
+            trial = self._geometry(gpu_index)
+            trial[profile] = trial.get(profile, 0) + 1
+            if not self._validate(gpu_index, trial):
+                raise TpuLibError(
+                    f"geometry {trial} invalid on gpu {gpu_index}"
+                )
+            d = GpuDevice(f"dev-{next(self._ids)}", gpu_index, profile)
+            self._devices[d.device_id] = d
+            return d
+
+    def delete_device(self, device_id: str) -> None:
+        with self._lock:
+            d = self._devices.get(device_id)
+            if d is None:
+                raise TpuLibError(f"no such device {device_id}")
+            if d.in_use:
+                raise TpuLibError(f"device {device_id} in use")
+            del self._devices[device_id]
+
+    def delete_all_except(self, keep_ids: List[str]) -> List[str]:
+        with self._lock:
+            deleted = []
+            for did in list(self._devices):
+                if did not in keep_ids and not self._devices[did].in_use:
+                    del self._devices[did]
+                    deleted.append(did)
+            return deleted
+
+    def set_in_use(self, device_id: str, in_use: bool) -> None:
+        with self._lock:
+            d = self._devices[device_id]
+            self._devices[device_id] = GpuDevice(d.device_id, d.gpu_index, d.profile, in_use)
+
+
+def mig_validator(model: str) -> Callable[[int, Dict[str, int]], bool]:
+    def validate(gpu_index: int, geometry: Dict[str, int]) -> bool:
+        return geometry_allowed(model, {MigProfile.parse(p): n for p, n in geometry.items()})
+
+    return validate
+
+
+def mps_validator(memory_gb: int) -> Callable[[int, Dict[str, int]], bool]:
+    def validate(gpu_index: int, geometry: Dict[str, int]) -> bool:
+        total = sum(MpsProfile.parse(p).memory_gb * n for p, n in geometry.items())
+        return total <= memory_gb
+
+    return validate
+
+
+class GpuAgent:
+    """Node daemon applying/reporting per-GPU slice geometry."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        node_name: str,
+        client: FakeGpuDeviceClient,
+        parse_profile: Callable[[str], Optional[object]] = MigProfile.from_resource,
+        resource_of: Callable[[str], str] = lambda p: f"{constants.RESOURCE_MIG_PREFIX}{p}",
+    ):
+        self.cluster = cluster
+        self.node_name = node_name
+        self.client = client
+        self.parse_profile = parse_profile
+        self.resource_of = resource_of
+        self.shared = SharedState()
+        self._unsub = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def startup(self) -> None:
+        self.sync_usage_from_pods()
+        used = [d.device_id for d in self.client.list_devices() if d.in_use]
+        deleted = self.client.delete_all_except(used)
+        if deleted:
+            logger.info("gpuagent %s: startup cleanup removed %s", self.node_name, deleted)
+        self.report()
+
+    def start_watching(self) -> None:
+        def on_node(ev: Event) -> None:
+            if ev.type == EventType.DELETED or ev.obj.metadata.name != self.node_name:
+                return
+            old_spec = dict_spec(ev.old_obj) if ev.old_obj is not None else None
+            if old_spec != dict_spec(ev.obj):
+                self.reconcile()
+
+        self._unsub = self.cluster.watch("Node", on_node, replay=False)
+
+    def stop(self) -> None:
+        if self._unsub:
+            self._unsub()
+
+    # -- usage sync ----------------------------------------------------------
+    def sync_usage_from_pods(self) -> None:
+        demand: Dict[str, int] = {}
+        for pod in self.cluster.list(
+            "Pod", predicate=lambda p: p.spec.node_name == self.node_name
+        ):
+            if not podutil.is_active(pod):
+                continue
+            for res, qty in compute_pod_request(pod).items():
+                profile = self.parse_profile(res)
+                if profile is not None and qty > 0:
+                    demand[str(profile)] = demand.get(str(profile), 0) + int(round(qty))
+        for d in self.client.list_devices():
+            want_used = demand.get(d.profile, 0) > 0
+            if want_used:
+                demand[d.profile] -= 1
+            if d.in_use != want_used:
+                self.client.set_in_use(d.device_id, want_used)
+
+    # -- actuator ------------------------------------------------------------
+    def reconcile(self) -> None:
+        node = self.cluster.try_get("Node", "", self.node_name)
+        if node is None:
+            return
+        specs = ann.parse_spec(node.metadata.annotations)
+        self.shared.last_parsed_plan_id = ann.get_spec_plan(node.metadata.annotations)
+        desired: Dict[Tuple[int, str], int] = {}
+        for s in specs:
+            if s.quantity > 0:
+                desired[(s.device_index, s.profile)] = s.quantity
+        self.sync_usage_from_pods()
+        try:
+            self._apply(desired)
+        except TpuLibError:
+            logger.exception("gpuagent %s: apply failed; reporting actual state", self.node_name)
+        self.shared.on_apply()
+        self.report()
+
+    def _apply(self, desired: Dict[Tuple[int, str], int]) -> None:
+        current: Dict[Tuple[int, str], List[GpuDevice]] = {}
+        for d in self.client.list_devices():
+            current.setdefault((d.gpu_index, d.profile), []).append(d)
+        # Delete surplus (free first, never used).
+        for key, devices in current.items():
+            surplus = len(devices) - desired.get(key, 0)
+            free = [d for d in devices if not d.in_use]
+            for d in free[:surplus]:
+                self.client.delete_device(d.device_id)
+        # Create missing, largest profiles first per GPU.
+        for (gpu_index, profile), want in sorted(
+            desired.items(), key=lambda kv: (kv[0][0], kv[0][1])
+        ):
+            have = sum(
+                1
+                for d in self.client.list_devices()
+                if d.gpu_index == gpu_index and d.profile == profile
+            )
+            for _ in range(max(0, want - have)):
+                try:
+                    self.client.create_device(gpu_index, profile)
+                except TpuLibError:
+                    logger.exception(
+                        "gpuagent %s: create %s on gpu %d failed (partial apply)",
+                        self.node_name,
+                        profile,
+                        gpu_index,
+                    )
+
+    # -- reporter ------------------------------------------------------------
+    def report(self) -> None:
+        self.sync_usage_from_pods()
+        per_gpu: Dict[int, Dict[str, List[GpuDevice]]] = {}
+        for d in self.client.list_devices():
+            per_gpu.setdefault(d.gpu_index, {}).setdefault(d.profile, []).append(d)
+
+        statuses = []
+        resources: Dict[str, float] = {}
+        for gpu_index, profiles in sorted(per_gpu.items()):
+            geometry = {p: len(ds) for p, ds in profiles.items()}
+            used = {p: sum(1 for d in ds if d.in_use) for p, ds in profiles.items()}
+            statuses.extend(ann.status_from_geometry(gpu_index, geometry, used))
+            for p, n in geometry.items():
+                resource = self.resource_of(p)
+                resources[resource] = resources.get(resource, 0.0) + n
+
+        def mutate(node: Node) -> None:
+            ann.strip_status_annotations(node.metadata.annotations)
+            node.metadata.annotations.update(ann.format_status(statuses))
+            if self.shared.last_parsed_plan_id is not None:
+                node.metadata.annotations[constants.ANNOTATION_STATUS_PLAN] = (
+                    self.shared.last_parsed_plan_id
+                )
+            for res in [
+                r
+                for r in node.status.allocatable
+                if constants.RESOURCE_MIG_REGEX.match(r)
+                or constants.RESOURCE_MPS_REGEX.match(r)
+            ]:
+                del node.status.allocatable[res]
+            for res, n in resources.items():
+                node.status.allocatable[res] = n
+
+        try:
+            self.cluster.patch("Node", "", self.node_name, mutate)
+        except NotFoundError:
+            return
+        self.shared.on_report()

@@ -1,0 +1,6 @@
+"""NVIDIA GPU parity modes: MIG (hard partitioning) and MPS (memory slicing).
+
+Kept for parity with the reference (SURVEY.md §7 step 8, BASELINE.json
+configs[1-4]); the TPU mode in nos_tpu.tpu/partitioning is first-class. The
+engine contracts are shared — these modules only supply the device models.
+"""

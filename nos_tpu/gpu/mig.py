@@ -1,0 +1,225 @@
+"""MIG device domain model.
+
+Analog of pkg/gpu/mig/{profile.go:29-96, known_configs.go:25-142, gpu.go:97-195}.
+A MIG profile `<G>g.<M>gb` consumes G of the GPU's compute slots and M GB of
+its memory. Where the reference hardcodes the allowed-geometry tables per GPU
+model (A30 / A100 variants), we model the generator behind those tables: a
+geometry is allowed iff its profiles are in the model's menu and fit the
+model's compute-slot and memory budgets. The table can still be overridden per
+model via `set_known_geometries` (the knownMigGeometries config analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import total_ordering
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from nos_tpu import constants
+
+Geometry = Dict["MigProfile", int]
+
+
+@total_ordering
+@dataclass(frozen=True)
+class MigProfile:
+    gi: int  # compute (GPU-instance) slots, the <G>g part
+    memory_gb: int
+
+    @classmethod
+    def parse(cls, name: str) -> "MigProfile":
+        """Parse '1g.10gb' or 'nvidia.com/mig-1g.10gb'."""
+        if name.startswith(constants.RESOURCE_MIG_PREFIX):
+            name = name[len(constants.RESOURCE_MIG_PREFIX):]
+        m = constants.RESOURCE_MIG_REGEX.match(f"{constants.RESOURCE_MIG_PREFIX}{name}")
+        if not m:
+            raise ValueError(f"invalid MIG profile {name!r}")
+        return cls(int(m.group(1)), int(m.group(2)))
+
+    @classmethod
+    def from_resource(cls, resource_name: str) -> Optional["MigProfile"]:
+        m = constants.RESOURCE_MIG_REGEX.match(resource_name)
+        return cls(int(m.group(1)), int(m.group(2))) if m else None
+
+    @property
+    def name(self) -> str:
+        return f"{self.gi}g.{self.memory_gb}gb"
+
+    @property
+    def resource(self) -> str:
+        return f"{constants.RESOURCE_MIG_PREFIX}{self.name}"
+
+    def __lt__(self, other: "MigProfile") -> bool:
+        # Smaller memory first (profile.go ordering :84-96).
+        return (self.memory_gb, self.gi) < (other.memory_gb, other.gi)
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(frozen=True)
+class MigModelSpec:
+    """Per-GPU-model capability: profile menu + compute/memory budgets."""
+
+    name: str
+    total_gi: int
+    memory_gb: int
+    profiles: Tuple[str, ...]
+
+    def menu(self) -> Tuple[MigProfile, ...]:
+        return tuple(MigProfile.parse(p) for p in self.profiles)
+
+
+# Public MIG capability matrix (NVIDIA docs; the known_configs.go analog).
+KNOWN_MIG_MODELS: Dict[str, MigModelSpec] = {
+    "NVIDIA-A30": MigModelSpec(
+        "NVIDIA-A30", total_gi=4, memory_gb=24, profiles=("1g.6gb", "2g.12gb", "4g.24gb")
+    ),
+    "NVIDIA-A100-PCIE-40GB": MigModelSpec(
+        "NVIDIA-A100-PCIE-40GB",
+        total_gi=7,
+        memory_gb=40,
+        profiles=("1g.5gb", "2g.10gb", "3g.20gb", "4g.20gb", "7g.40gb"),
+    ),
+    "NVIDIA-A100-SXM4-80GB": MigModelSpec(
+        "NVIDIA-A100-SXM4-80GB",
+        total_gi=7,
+        memory_gb=80,
+        profiles=("1g.10gb", "2g.20gb", "3g.40gb", "4g.40gb", "7g.80gb"),
+    ),
+}
+# 80GB PCIe variant shares the SXM capability set.
+KNOWN_MIG_MODELS["NVIDIA-A100-PCIE-80GB"] = MigModelSpec(
+    "NVIDIA-A100-PCIE-80GB",
+    total_gi=7,
+    memory_gb=80,
+    profiles=KNOWN_MIG_MODELS["NVIDIA-A100-SXM4-80GB"].profiles,
+)
+
+_overrides: Dict[str, List[Geometry]] = {}
+
+
+def set_known_geometries(model: str, geometries: List[Mapping[str, int]]) -> None:
+    """Override the allowed geometries for a model from config
+    (mig/known_configs.go SetKnownGeometries:144-162 analog)."""
+    _overrides[model] = [
+        {MigProfile.parse(p): n for p, n in g.items()} for g in geometries
+    ]
+
+
+def clear_known_geometry_overrides() -> None:
+    _overrides.clear()
+
+
+def model_spec(model: str) -> Optional[MigModelSpec]:
+    return KNOWN_MIG_MODELS.get(model)
+
+
+def geometry_allowed(model: str, geometry: Mapping[MigProfile, int]) -> bool:
+    geometry = {p: n for p, n in geometry.items() if n > 0}
+    if model in _overrides:
+        return any(geometry == g for g in _overrides[model]) or not geometry
+    spec = KNOWN_MIG_MODELS.get(model)
+    if spec is None:
+        return not geometry
+    menu = set(spec.menu())
+    if any(p not in menu for p in geometry):
+        return False
+    total_gi = sum(p.gi * n for p, n in geometry.items())
+    total_mem = sum(p.memory_gb * n for p, n in geometry.items())
+    return total_gi <= spec.total_gi and total_mem <= spec.memory_gb
+
+
+class MigGpu:
+    """One MIG-capable GPU (mig/gpu.go:97-195 analog)."""
+
+    def __init__(
+        self,
+        model: str,
+        index: int,
+        geometry: Optional[Mapping[MigProfile, int]] = None,
+        used: Optional[Mapping[MigProfile, int]] = None,
+    ):
+        self.model = model
+        self.index = index
+        self.geometry: Geometry = {p: n for p, n in (geometry or {}).items() if n > 0}
+        self.used: Geometry = {p: n for p, n in (used or {}).items() if n > 0}
+        for p, n in self.used.items():
+            if n > self.geometry.get(p, 0):
+                raise ValueError(f"used {n}x{p} exceeds geometry on gpu {index}")
+        if not geometry_allowed(model, self.geometry):
+            raise ValueError(f"geometry not allowed for {model}: {self.geometry}")
+
+    @property
+    def free(self) -> Geometry:
+        return {
+            p: n - self.used.get(p, 0)
+            for p, n in self.geometry.items()
+            if n - self.used.get(p, 0) > 0
+        }
+
+    def has_free_capacity(self) -> bool:
+        spec = KNOWN_MIG_MODELS.get(self.model)
+        if bool(self.free):
+            return True
+        if spec is None:
+            return False
+        used_gi = sum(p.gi * n for p, n in self.geometry.items())
+        return used_gi < spec.total_gi
+
+    def clone(self) -> "MigGpu":
+        return MigGpu(self.model, self.index, dict(self.geometry), dict(self.used))
+
+    def can_apply_geometry(self, new: Mapping[MigProfile, int]) -> bool:
+        new = {p: n for p, n in new.items() if n > 0}
+        for p, n in self.used.items():
+            if new.get(p, 0) < n:
+                return False  # never delete used (gpu.go:103-107)
+        return geometry_allowed(self.model, new)
+
+    def apply_geometry(self, new: Mapping[MigProfile, int]) -> None:
+        if not self.can_apply_geometry(new):
+            raise ValueError(f"cannot apply {new} on gpu {self.index} ({self.model})")
+        self.geometry = {p: n for p, n in new.items() if n > 0}
+
+    def update_geometry_for(self, required: Mapping[MigProfile, int]) -> bool:
+        """Greedy re-carve toward `required`, keeping used slices and then
+        preserving still-fitting free slices (gpu.go UpdateGeometryFor:141-195)."""
+        spec = KNOWN_MIG_MODELS.get(self.model)
+        required = {
+            p: n
+            for p, n in required.items()
+            if n > 0 and (spec is None or p in set(spec.menu()) or self.model in _overrides)
+        }
+        if not required:
+            return False
+        base: Geometry = dict(self.used)
+        satisfied = False
+        for profile in sorted(required, key=lambda p: (-p.memory_gb, -p.gi)):
+            for _ in range(required[profile]):
+                trial = dict(base)
+                trial[profile] = trial.get(profile, 0) + 1
+                if geometry_allowed(self.model, trial):
+                    base = trial
+                    satisfied = True
+        if not satisfied:
+            return False
+        for profile, n in sorted(self.free.items(), key=lambda kv: (-kv[0].memory_gb,)):
+            for _ in range(n):
+                trial = dict(base)
+                trial[profile] = trial.get(profile, 0) + 1
+                if geometry_allowed(self.model, trial):
+                    base = trial
+        if base == self.geometry:
+            return False
+        self.geometry = base
+        return True
+
+    def mark_used(self, profile: MigProfile, count: int = 1) -> None:
+        free = self.geometry.get(profile, 0) - self.used.get(profile, 0)
+        if count > free:
+            raise ValueError(f"cannot use {count}x{profile} on gpu {self.index}")
+        self.used[profile] = self.used.get(profile, 0) + count
+
+    def as_resources(self) -> Dict[str, int]:
+        return {p.resource: n for p, n in self.geometry.items()}

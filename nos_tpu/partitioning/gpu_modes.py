@@ -1,0 +1,381 @@
+"""MIG and MPS partitioning modes over multi-GPU nodes.
+
+Analog of internal/partitioning/{mig,mps}: slice specs, PartitionableNodes
+spanning several GPUs (device indexes in the annotation protocol), snapshot
+takers keyed on the partitioning label + NVIDIA GFD discovery labels, and the
+two actuation channels: MIG via spec annotations (mig/partitioner.go:43-75),
+MPS via the device-plugin ConfigMap + node label flip
+(mps/partitioner.go:61-157) — plus spec annotations for the plan handshake.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Mapping, Optional
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.api.objects import ConfigMap, Node, Pod
+from nos_tpu.api.resources import ResourceList, compute_pod_request
+from nos_tpu.cluster.client import Cluster, NotFoundError
+from nos_tpu.gpu.mig import KNOWN_MIG_MODELS, MigGpu, MigProfile
+from nos_tpu.gpu.mps import MpsGpu, MpsProfile
+from nos_tpu.partitioning.core.interface import NodeInfo, NodePartitioning
+
+
+# ---------------------------------------------------------------------------
+# Slice specs
+# ---------------------------------------------------------------------------
+class MigSliceSpec:
+    def is_slice_resource(self, resource_name: str) -> bool:
+        return bool(constants.RESOURCE_MIG_REGEX.match(resource_name))
+
+    def slice_weight(self, resource_name: str) -> float:
+        p = MigProfile.from_resource(resource_name)
+        return float(p.memory_gb) if p else 0.0
+
+    def pod_slice_request(self, pod: Pod) -> ResourceList:
+        req = compute_pod_request(pod)
+        return ResourceList(
+            {k: v for k, v in req.items() if v > 0 and self.is_slice_resource(k)}
+        )
+
+
+class MpsSliceSpec:
+    def is_slice_resource(self, resource_name: str) -> bool:
+        return bool(constants.RESOURCE_MPS_REGEX.match(resource_name))
+
+    def slice_weight(self, resource_name: str) -> float:
+        p = MpsProfile.from_resource(resource_name)
+        return float(p.memory_gb) if p else 0.0
+
+    def pod_slice_request(self, pod: Pod) -> ResourceList:
+        req = compute_pod_request(pod)
+        return ResourceList(
+            {k: v for k, v in req.items() if v > 0 and self.is_slice_resource(k)}
+        )
+
+
+# ---------------------------------------------------------------------------
+# Multi-GPU partitionable node (shared shape for both modes)
+# ---------------------------------------------------------------------------
+class GpuNode:
+    """PartitionableNode over a list of per-GPU device models
+    (mig/node.go:40-195 and slicing/node.go:32-215 analog)."""
+
+    def __init__(
+        self,
+        name: str,
+        gpus: List,  # MigGpu | MpsGpu
+        profile_parser: Callable[[str], Optional[object]],
+        labels: Optional[Dict[str, str]] = None,
+        base_allocatable: Optional[ResourceList] = None,
+        requested: Optional[ResourceList] = None,
+        pods: Optional[List[Pod]] = None,
+    ):
+        self._name = name
+        self.gpus = gpus
+        self._parse = profile_parser
+        self.labels = dict(labels or {})
+        self.base_allocatable = ResourceList(
+            {
+                k: v
+                for k, v in (base_allocatable or ResourceList()).items()
+                if not constants.RESOURCE_MIG_REGEX.match(k)
+                and not constants.RESOURCE_MPS_REGEX.match(k)
+                and k != constants.RESOURCE_NVIDIA_GPU
+            }
+        )
+        self.requested = ResourceList(requested or {})
+        self.pods: List[Pod] = list(pods or [])
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def update_geometry_for(self, lacking: Mapping[str, float]) -> bool:
+        required = {}
+        for resource_name, qty in lacking.items():
+            profile = self._parse(resource_name)
+            if profile is not None and qty > 0:
+                required[profile] = required.get(profile, 0) + int(round(qty))
+        if not required:
+            return False
+        changed = False
+        remaining = dict(required)
+        for gpu in self.gpus:
+            if not remaining:
+                break
+            if gpu.update_geometry_for(remaining):
+                changed = True
+                # Account for what this GPU now offers free.
+                for profile, free_n in gpu.free.items():
+                    if profile in remaining:
+                        remaining[profile] = max(0, remaining[profile] - free_n)
+                        if remaining[profile] == 0:
+                            del remaining[profile]
+        return changed
+
+    def partitioning(self) -> NodePartitioning:
+        return {
+            gpu.index: {str(p): n for p, n in sorted(gpu.geometry.items())}
+            for gpu in self.gpus
+        }
+
+    def node_info(self) -> NodeInfo:
+        allocatable = ResourceList(self.base_allocatable)
+        used_counts: Dict[str, float] = {}
+        for gpu in self.gpus:
+            for resource, count in gpu.as_resources().items():
+                allocatable[resource] = allocatable.get(resource, 0.0) + float(count)
+            for profile, n in gpu.used.items():
+                res = profile.resource
+                used_counts[res] = used_counts.get(res, 0.0) + float(n)
+        # Device-layer used counts are authoritative even when the pod cache
+        # lags (agent-reported status is the source of truth, util.go:75-89).
+        requested = ResourceList(self.requested)
+        for res, n in used_counts.items():
+            requested[res] = max(requested.get(res, 0.0), n)
+        return NodeInfo(
+            name=self._name,
+            labels=dict(self.labels),
+            allocatable=allocatable,
+            requested=requested,
+            pods=list(self.pods),
+        )
+
+    def add_pod(self, pod: Pod) -> None:
+        request = compute_pod_request(pod)
+        for resource_name, qty in request.items():
+            profile = self._parse(resource_name)
+            if profile is None or qty <= 0:
+                continue
+            need = int(round(qty))
+            for gpu in self.gpus:
+                while need > 0 and gpu.free.get(profile, 0) > 0:
+                    gpu.mark_used(profile)
+                    need -= 1
+            if need > 0:
+                raise ValueError(f"no free {profile} slices on {self._name}")
+        self.pods.append(pod)
+        self.requested = self.requested.add(request)
+
+    def has_free_capacity(self) -> bool:
+        return any(gpu.has_free_capacity() for gpu in self.gpus)
+
+    def clone(self) -> "GpuNode":
+        return GpuNode(
+            name=self._name,
+            gpus=[g.clone() for g in self.gpus],
+            profile_parser=self._parse,
+            labels=dict(self.labels),
+            base_allocatable=ResourceList(self.base_allocatable),
+            requested=ResourceList(self.requested),
+            pods=list(self.pods),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Snapshot takers
+# ---------------------------------------------------------------------------
+def _gfd(node: Node):
+    labels = node.metadata.labels
+    model = labels.get(constants.LABEL_GPU_PRODUCT, "")
+    count = int(labels.get(constants.LABEL_GPU_COUNT, "0") or 0)
+    memory_mb = float(labels.get(constants.LABEL_GPU_MEMORY, "0") or 0)
+    memory_gb = int(round(memory_mb / 1024)) if memory_mb > 256 else int(memory_mb)
+    return model, count, memory_gb
+
+
+def _node_status_geometry(node: Node, parse) -> Dict[int, Dict]:
+    """device index -> (geometry, used) from status annotations."""
+    out: Dict[int, Dict] = {}
+    statuses = ann.parse_status(node.metadata.annotations)
+    for idx, profs in ann.geometry_counts_from_status(statuses).items():
+        geometry, used = {}, {}
+        for prof_name, (free, in_use) in profs.items():
+            profile = parse(prof_name)
+            if profile is None:
+                continue
+            total = free + in_use
+            if total > 0:
+                geometry[profile] = total
+            if in_use > 0:
+                used[profile] = in_use
+        out[idx] = {"geometry": geometry, "used": used}
+    return out
+
+
+class MigSnapshotTaker:
+    def __init__(self):
+        self.slice_spec = MigSliceSpec()
+
+    def take_snapshot(self, cluster_state):
+        from nos_tpu.partitioning.core.snapshot import Snapshot
+
+        nodes = {}
+        for node in cluster_state.nodes(
+            label_selector={constants.LABEL_PARTITIONING: constants.KIND_MIG}
+        ):
+            model, count, _ = _gfd(node)
+            if model not in KNOWN_MIG_MODELS or count < 1:
+                continue
+            per_gpu = _node_status_geometry(node, lambda n: MigProfile.parse(n))
+            gpus = [
+                MigGpu(
+                    model,
+                    idx,
+                    per_gpu.get(idx, {}).get("geometry"),
+                    per_gpu.get(idx, {}).get("used"),
+                )
+                for idx in range(count)
+            ]
+            name = node.metadata.name
+            nodes[name] = GpuNode(
+                name=name,
+                gpus=gpus,
+                profile_parser=MigProfile.from_resource,
+                labels=node.metadata.labels,
+                base_allocatable=node.status.allocatable,
+                requested=cluster_state.node_requested(name),
+                pods=cluster_state.node_pods(name),
+            )
+        return Snapshot(nodes, self.slice_spec)
+
+
+class MpsSnapshotTaker:
+    def __init__(self):
+        self.slice_spec = MpsSliceSpec()
+
+    def take_snapshot(self, cluster_state):
+        from nos_tpu.partitioning.core.snapshot import Snapshot
+
+        nodes = {}
+        for node in cluster_state.nodes(
+            label_selector={constants.LABEL_PARTITIONING: constants.KIND_MPS}
+        ):
+            model, count, memory_gb = _gfd(node)
+            if count < 1:
+                continue
+            memory_gb = memory_gb or constants.DEFAULT_GPU_MEMORY_GB
+            per_gpu = _node_status_geometry(node, lambda n: MpsProfile.parse(n))
+            gpus = [
+                MpsGpu(
+                    memory_gb,
+                    idx,
+                    per_gpu.get(idx, {}).get("geometry"),
+                    per_gpu.get(idx, {}).get("used"),
+                )
+                for idx in range(count)
+            ]
+            name = node.metadata.name
+            nodes[name] = GpuNode(
+                name=name,
+                gpus=gpus,
+                profile_parser=MpsProfile.from_resource,
+                labels=node.metadata.labels,
+                base_allocatable=node.status.allocatable,
+                requested=cluster_state.node_requested(name),
+                pods=cluster_state.node_pods(name),
+            )
+        return Snapshot(nodes, self.slice_spec)
+
+
+# ---------------------------------------------------------------------------
+# Partitioners (actuation channels)
+# ---------------------------------------------------------------------------
+class AnnotationPartitioner:
+    """Spec-annotation writer shared by TPU and MIG modes."""
+
+    def __init__(self, cluster: Cluster):
+        self._cluster = cluster
+
+    def apply_partitioning(
+        self, node_name: str, plan_id: str, partitioning: NodePartitioning
+    ) -> None:
+        def mutate(node: Node) -> None:
+            ann.strip_spec_annotations(node.metadata.annotations)
+            specs = []
+            for device_index, profiles in partitioning.items():
+                specs.extend(
+                    ann.SpecAnnotation(device_index, prof, qty)
+                    for prof, qty in profiles.items()
+                    if qty > 0
+                )
+            node.metadata.annotations.update(ann.format_spec(specs))
+            node.metadata.annotations[constants.ANNOTATION_SPEC_PLAN] = plan_id
+
+        self._cluster.patch("Node", "", node_name, mutate)
+
+
+MigPartitioner = AnnotationPartitioner
+
+
+class MpsPartitioner:
+    """MPS actuation: rewrite the device-plugin ConfigMap with the node's
+    sharing config, then flip the node's device-plugin.config label to
+    <node>-<plan> (mps/partitioner.go:61-157 ToPluginConfig analog). Spec
+    annotations are still written for the plan handshake."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        cm_name: str = constants.DEFAULT_DEVICE_PLUGIN_CM_NAME,
+        cm_namespace: str = constants.DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE,
+    ):
+        self._cluster = cluster
+        self._annotations = AnnotationPartitioner(cluster)
+        self.cm_name = cm_name
+        self.cm_namespace = cm_namespace
+
+    def plugin_config(self, partitioning: NodePartitioning) -> dict:
+        """The nvidia device-plugin 'sharing' config for one node."""
+        resources = []
+        for gpu_index in sorted(partitioning):
+            for prof, qty in sorted(partitioning[gpu_index].items()):
+                if qty <= 0:
+                    continue
+                profile = MpsProfile.parse(prof)
+                resources.append(
+                    {
+                        "name": profile.resource,
+                        "rename": f"gpu-{profile.memory_gb}gb",
+                        "memoryGB": profile.memory_gb,
+                        "replicas": qty,
+                        "devices": [gpu_index],
+                    }
+                )
+        return {"version": "v1", "sharing": {"mps": {"resources": resources}}}
+
+    def apply_partitioning(
+        self, node_name: str, plan_id: str, partitioning: NodePartitioning
+    ) -> None:
+        config_key = f"{node_name}-{plan_id}"
+        payload = json.dumps(self.plugin_config(partitioning), sort_keys=True)
+
+        try:
+            self._cluster.patch(
+                "ConfigMap",
+                self.cm_namespace,
+                self.cm_name,
+                lambda cm: cm.data.__setitem__(config_key, payload),
+            )
+        except NotFoundError:
+            from nos_tpu.api.objects import ObjectMeta
+
+            self._cluster.create(
+                ConfigMap(
+                    metadata=ObjectMeta(name=self.cm_name, namespace=self.cm_namespace),
+                    data={config_key: payload},
+                )
+            )
+        # Write handshake annotations, then activate the config via the label.
+        self._annotations.apply_partitioning(node_name, plan_id, partitioning)
+        self._cluster.patch(
+            "Node",
+            "",
+            node_name,
+            lambda n: n.metadata.labels.__setitem__(
+                constants.LABEL_DEVICE_PLUGIN_CONFIG, config_key
+            ),
+        )

@@ -132,11 +132,16 @@ class TpuNode:
         allocatable[constants.RESOURCE_TPU] = float(self.mesh.free_chips)
         for resource, count in self.mesh.as_resources().items():
             allocatable[resource] = float(count)
+        # Device-layer used counts are authoritative even when the pod cache
+        # lags (agent-reported status is the source of truth, util.go:75-89).
+        requested = ResourceList(self.requested)
+        for profile, n in self.mesh.used.items():
+            requested[profile.resource] = max(requested.get(profile.resource, 0.0), float(n))
         return NodeInfo(
             name=self._name,
             labels=dict(self.labels),
             allocatable=allocatable,
-            requested=ResourceList(self.requested),
+            requested=requested,
             pods=list(self.pods),
         )
 

@@ -1,0 +1,289 @@
+"""MIG + MPS parity mode tests (BASELINE.json configs[1-4]:
+simulated A100 planner scenarios, MIG agent apply, MPS partitioning)."""
+
+import json
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster import Cluster
+from nos_tpu.controllers.gpu_agent import (
+    FakeGpuDeviceClient,
+    GpuAgent,
+    mig_validator,
+    mps_validator,
+)
+from nos_tpu.controllers.partitioner import PartitionerController
+from nos_tpu.gpu.mig import (
+    MigGpu,
+    MigProfile,
+    clear_known_geometry_overrides,
+    geometry_allowed,
+    set_known_geometries,
+)
+from nos_tpu.gpu.mps import MpsGpu, MpsProfile
+from nos_tpu.partitioning.core.interface import FitSimScheduler
+from nos_tpu.partitioning.gpu_modes import (
+    MigPartitioner,
+    MigSnapshotTaker,
+    MpsPartitioner,
+    MpsSnapshotTaker,
+)
+from nos_tpu.partitioning.state import ClusterState
+
+A100_40 = "NVIDIA-A100-PCIE-40GB"
+
+
+def P(name):
+    return MigProfile.parse(name)
+
+
+def S(name):
+    return MpsProfile.parse(name)
+
+
+# -- MIG domain model --------------------------------------------------------
+def test_mig_profile_parse_and_order():
+    p = MigProfile.parse("nvidia.com/mig-1g.10gb")
+    assert p.gi == 1 and p.memory_gb == 10 and p.resource == "nvidia.com/mig-1g.10gb"
+    assert sorted([P("7g.40gb"), P("1g.5gb"), P("2g.10gb")]) == [
+        P("1g.5gb"),
+        P("2g.10gb"),
+        P("7g.40gb"),
+    ]
+
+
+def test_mig_geometry_allowed_a100_40():
+    assert geometry_allowed(A100_40, {P("1g.5gb"): 7})
+    assert geometry_allowed(A100_40, {P("3g.20gb"): 2})
+    assert geometry_allowed(A100_40, {P("2g.10gb"): 3, P("1g.5gb"): 1})
+    assert not geometry_allowed(A100_40, {P("1g.5gb"): 8})  # > 7 compute slots
+    # 2x 3g.20gb + 1g.5gb = 45GB > 40GB memory budget.
+    assert not geometry_allowed(A100_40, {P("3g.20gb"): 2, P("1g.5gb"): 1})
+    assert not geometry_allowed(A100_40, {P("7g.40gb"): 1, P("1g.5gb"): 1})
+    assert not geometry_allowed(A100_40, {P("1g.6gb"): 1})  # A30 profile
+    assert not geometry_allowed("unknown-model", {P("1g.5gb"): 1})
+
+
+def test_mig_geometry_override():
+    set_known_geometries(A100_40, [{"1g.5gb": 2}])
+    try:
+        assert geometry_allowed(A100_40, {P("1g.5gb"): 2})
+        assert not geometry_allowed(A100_40, {P("1g.5gb"): 7})
+    finally:
+        clear_known_geometry_overrides()
+
+
+def test_mig_gpu_update_geometry_never_deletes_used():
+    gpu = MigGpu(A100_40, 0, {P("7g.40gb"): 1}, used={P("7g.40gb"): 1})
+    assert not gpu.update_geometry_for({P("1g.5gb"): 1})  # full with used slice
+    gpu2 = MigGpu(A100_40, 0, {P("1g.5gb"): 2}, used={P("1g.5gb"): 1})
+    assert gpu2.update_geometry_for({P("3g.20gb"): 2})
+    assert gpu2.geometry[P("1g.5gb")] >= 1  # the used slice survived
+    # Memory budget (40GB) fits only one 3g.20gb next to the used 1g.5gb.
+    assert gpu2.geometry[P("3g.20gb")] == 1
+
+
+# -- MPS domain model --------------------------------------------------------
+def test_mps_profile_and_budget():
+    assert S("10gb").memory_gb == 10
+    assert S("nvidia.com/gpu-5gb").resource == "nvidia.com/gpu-5gb"
+    with pytest.raises(ValueError):
+        MpsProfile.parse("0gb")
+    gpu = MpsGpu(40, 0, {S("10gb"): 3})
+    assert gpu.free_gb == 10
+    assert gpu.can_apply_geometry({S("20gb"): 2})
+    assert not gpu.can_apply_geometry({S("20gb"): 3})  # 60 > 40
+
+
+def test_mps_gpu_freeform_carve():
+    gpu = MpsGpu(40, 0, {S("10gb"): 2}, used={S("10gb"): 1})
+    assert gpu.update_geometry_for({S("20gb"): 1})
+    # Used 10gb survives; 20gb carved; leftover refilled with the free 10gb.
+    assert gpu.geometry[S("10gb")] == 2 and gpu.geometry[S("20gb")] == 1
+
+
+# -- planner on simulated A100 nodes (BASELINE configs[1]) -------------------
+def mig_node(cluster, name="gpu-node-0", gpus=1, model=A100_40):
+    node = Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_PARTITIONING: constants.KIND_MIG,
+                constants.LABEL_GPU_PRODUCT: model,
+                constants.LABEL_GPU_COUNT: str(gpus),
+                constants.LABEL_GPU_MEMORY: "40536",
+            },
+        ),
+        status=NodeStatus(allocatable=ResourceList.of({"cpu": 64, "memory": "256Gi"})),
+    )
+    cluster.create(node)
+    return node
+
+
+def unschedulable_pod(name, resources, ns="default"):
+    p = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(resources=ResourceList.of(resources))]),
+    )
+    p.status.phase = PodPhase.PENDING
+    p.status.conditions.append(
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    return p
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_controller(cluster, state, kind, taker, partitioner, clock):
+    c = PartitionerController(
+        cluster=cluster,
+        state=state,
+        kind=kind,
+        snapshot_taker=taker,
+        partitioner=partitioner,
+        sim_scheduler=FitSimScheduler(),
+        now=clock,
+    )
+    c.start_watching()
+    return c
+
+
+def test_mig_end_to_end_with_agent():
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    clock = FakeClock()
+    mig_node(cluster, gpus=2)
+
+    client = FakeGpuDeviceClient(2, mig_validator(A100_40))
+    agent = GpuAgent(cluster, "gpu-node-0", client)
+    agent.startup()
+    agent.start_watching()
+
+    controller = make_controller(
+        cluster, state, constants.KIND_MIG, MigSnapshotTaker(), MigPartitioner(cluster), clock
+    )
+
+    cluster.create(unschedulable_pod("train-a", {"nvidia.com/mig-3g.20gb": 1}))
+    cluster.create(unschedulable_pod("train-b", {"nvidia.com/mig-1g.5gb": 2}))
+    clock.advance(11)
+    assert controller.process_batch_if_ready()
+
+    node = cluster.get("Node", "", "gpu-node-0")
+    specs = ann.parse_spec(node.metadata.annotations)
+    assert specs, "planner wrote MIG spec annotations"
+    statuses = ann.parse_status(node.metadata.annotations)
+    assert ann.spec_matches_status(specs, statuses)
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+    # Devices actually exist and allocatable exposes them.
+    profiles = sorted(d.profile for d in client.list_devices())
+    assert "3g.20gb" in profiles and "1g.5gb" in profiles
+    assert node.status.allocatable.get("nvidia.com/mig-3g.20gb", 0) >= 1
+    assert node.status.allocatable.get("nvidia.com/mig-1g.5gb", 0) >= 2
+
+
+def test_mig_multi_gpu_spreads_when_one_gpu_full():
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    clock = FakeClock()
+    node = mig_node(cluster, gpus=2)
+    # GPU 0 fully used by a 7g.40gb slice.
+    cluster.patch(
+        "Node",
+        "",
+        "gpu-node-0",
+        lambda n: n.metadata.annotations.update(
+            {
+                "tpu.nos/status-dev-0-7g.40gb-used": "1",
+                "tpu.nos/status-dev-0-7g.40gb-free": "0",
+            }
+        ),
+    )
+    controller = make_controller(
+        cluster, state, constants.KIND_MIG, MigSnapshotTaker(), MigPartitioner(cluster), clock
+    )
+    cluster.create(unschedulable_pod("p", {"nvidia.com/mig-7g.40gb": 1}))
+    clock.advance(11)
+    assert controller.process_batch_if_ready()
+    node = cluster.get("Node", "", "gpu-node-0")
+    specs = ann.parse_spec(node.metadata.annotations)
+    by_gpu = ann.geometry_counts_from_spec(specs)
+    assert by_gpu[0] == {"7g.40gb": 1}  # kept (used)
+    assert by_gpu[1] == {"7g.40gb": 1}  # carved on the second GPU
+
+
+def test_mps_end_to_end_configmap_and_label():
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    clock = FakeClock()
+    node = Node(
+        metadata=ObjectMeta(
+            name="mps-node-0",
+            labels={
+                constants.LABEL_PARTITIONING: constants.KIND_MPS,
+                constants.LABEL_GPU_PRODUCT: "NVIDIA-A100-PCIE-40GB",
+                constants.LABEL_GPU_COUNT: "1",
+                constants.LABEL_GPU_MEMORY: "40536",
+            },
+        ),
+        status=NodeStatus(allocatable=ResourceList.of({"cpu": 64})),
+    )
+    cluster.create(node)
+
+    client = FakeGpuDeviceClient(1, mps_validator(40))
+    agent = GpuAgent(
+        cluster,
+        "mps-node-0",
+        client,
+        parse_profile=MpsProfile.from_resource,
+        resource_of=lambda p: f"nvidia.com/gpu-{p}",
+    )
+    agent.startup()
+    agent.start_watching()
+
+    controller = make_controller(
+        cluster, state, constants.KIND_MPS, MpsSnapshotTaker(), MpsPartitioner(cluster), clock
+    )
+    cluster.create(unschedulable_pod("infer-1", {"nvidia.com/gpu-10gb": 1}))
+    cluster.create(unschedulable_pod("infer-2", {"nvidia.com/gpu-10gb": 1}))
+    clock.advance(11)
+    assert controller.process_batch_if_ready()
+
+    node = cluster.get("Node", "", "mps-node-0")
+    # Device-plugin ConfigMap rewritten and node label flipped (mps channel).
+    config_key = node.metadata.labels[constants.LABEL_DEVICE_PLUGIN_CONFIG]
+    cm = cluster.get(
+        "ConfigMap",
+        constants.DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE,
+        constants.DEFAULT_DEVICE_PLUGIN_CM_NAME,
+    )
+    config = json.loads(cm.data[config_key])
+    mps_resources = config["sharing"]["mps"]["resources"]
+    assert any(r["memoryGB"] == 10 and r["replicas"] >= 2 for r in mps_resources)
+    # Handshake completed by the agent and allocatable refreshed.
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+    assert node.status.allocatable.get("nvidia.com/gpu-10gb", 0) >= 2
