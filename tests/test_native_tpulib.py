@@ -1,0 +1,110 @@
+"""Native tpuslice shim tests: lifecycle parity with the fake client and
+bit-for-bit packer equivalence with the Python canonical packer."""
+
+import random
+
+import pytest
+
+from nos_tpu.tpu import Profile, Shape, Topology, pack
+from nos_tpu.tpu.packing import pack_into
+from nos_tpu.tpulib.interface import TpuLibError
+from nos_tpu.tpulib.native_client import NativeTpuClient, ensure_built, native_pack
+
+pytestmark = pytest.mark.skipif(not ensure_built(), reason="native toolchain unavailable")
+
+
+def P(name):
+    return Profile.parse(name)
+
+
+def test_native_client_lifecycle():
+    client = NativeTpuClient(Topology.parse("v5e", "4x4"))
+    assert client.health() is None
+    h = client.create_slice(P("2x2"), (0, 0), (2, 2))
+    assert h.slice_id == "slice-1" and not h.in_use
+
+    with pytest.raises(TpuLibError):
+        client.create_slice(P("2x2"), (1, 1), (2, 2))  # overlap
+    with pytest.raises(TpuLibError):
+        client.create_slice(P("2x2"), (3, 3), (2, 2))  # out of bounds
+
+    client.set_slice_in_use("slice-1", True)
+    with pytest.raises(TpuLibError):
+        client.delete_slice("slice-1")  # in use
+
+    h2 = client.create_slice(P("1x2"), (2, 0), (1, 2))
+    assert {s.slice_id for s in client.list_slices()} == {"slice-1", "slice-2"}
+    deleted = client.delete_all_except([])
+    assert deleted == ["slice-2"]  # in-use slice survives cleanup
+    assert [s.slice_id for s in client.list_slices()] == ["slice-1"]
+
+    client.set_slice_in_use("slice-1", False)
+    client.delete_slice("slice-1")
+    assert client.list_slices() == []
+
+
+def test_native_client_drives_tpu_agent_e2e():
+    """The node agent runs unchanged over the native client (same interface
+    seam as the fake) — the cgo-vs-mock parity of the reference."""
+    from nos_tpu import constants
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.controllers.tpu_agent import TpuAgent
+    from tests.test_e2e_partitioning import make_tpu_node
+
+    cluster = Cluster()
+    cluster.create(make_tpu_node())
+    client = NativeTpuClient(Topology.parse("v5e", "4x4"))
+    agent = TpuAgent(cluster, "tpu-node-0", client)
+    agent.startup()
+
+    cluster.patch(
+        "Node",
+        "",
+        "tpu-node-0",
+        lambda n: n.metadata.annotations.update(
+            {
+                "tpu.nos/spec-dev-0-2x2": "2",
+                "tpu.nos/spec-dev-0-1x2": "1",
+                constants.ANNOTATION_SPEC_PLAN: "plan-native-1",
+            }
+        ),
+    )
+    agent.reconcile()
+    node = cluster.get("Node", "", "tpu-node-0")
+    assert node.metadata.annotations[constants.ANNOTATION_STATUS_PLAN] == "plan-native-1"
+    assert node.metadata.annotations["tpu.nos/status-dev-0-2x2-free"] == "2"
+    assert node.status.allocatable["google.com/tpu-2x2"] == 2
+    assert node.status.allocatable["google.com/tpu-1x2"] == 1
+    assert node.status.allocatable[constants.RESOURCE_TPU] == 16 - 8 - 2
+
+
+def test_native_pack_matches_python_randomized():
+    random.seed(7)
+    for topo_name, gen in [("4x4", "v5e"), ("8x8", "v5e"), ("2x2x4", "v4"), ("4x4x4", "v4")]:
+        topo = Topology.parse(gen, topo_name)
+        menu = list(topo.allowed_profiles)
+        for _ in range(200):
+            geometry = {}
+            for _ in range(random.randint(1, 5)):
+                p = random.choice(menu)
+                geometry[p] = geometry.get(p, 0) + random.randint(1, 3)
+            py = pack(topo.shape, geometry)
+            native = native_pack(topo.shape.dims, [], geometry)
+            if py is None:
+                assert native is None, (topo_name, geometry)
+            else:
+                assert native == [(pl.origin, pl.dims) for pl in py], (topo_name, geometry)
+
+
+def test_native_pack_into_matches_python_with_occupied():
+    mesh = Shape.parse("4x4")
+    occupied = [((0, 0), (2, 2)), ((2, 2), (1, 1))]
+    geometry = {P("1x2"): 2, P("2x2"): 1}
+    py = pack_into(mesh, occupied, geometry)
+    native = native_pack(mesh.dims, occupied, geometry)
+    assert py is not None
+    assert native == [(pl.origin, pl.dims) for pl in py]
+    # Unpackable case agrees too.
+    geometry_big = {P("2x4"): 2}
+    assert pack_into(mesh, occupied, geometry_big) is None
+    assert native_pack(mesh.dims, occupied, geometry_big) is None
