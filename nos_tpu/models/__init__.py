@@ -1,0 +1,11 @@
+"""Flagship JAX workloads.
+
+These are the *workloads* the control plane schedules onto carved sub-slices
+— the analog of the reference's benchmark client (demos/gpu-sharing-comparison
+runs YOLOS-small inference on fractional GPUs; BASELINE.md): a YOLOS-class
+ViT detector for the sharing benchmark, and a decoder LM exercising the
+dp/tp/sp-sharded training path.
+"""
+
+from nos_tpu.models.vit import ViTConfig, init_vit, vit_forward  # noqa: F401
+from nos_tpu.models.gpt import GPTConfig, init_gpt, gpt_forward, gpt_loss  # noqa: F401

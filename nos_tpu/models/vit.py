@@ -1,0 +1,136 @@
+"""YOLOS-class ViT detector.
+
+The bench workload mirroring the reference's GPU-sharing comparison demo
+(YOLOS-small inference, demos/gpu-sharing-comparison/README.md:60-72 —
+BASELINE.md): a plain ViT backbone (hidden 384, 12 layers, 6 heads = the
+-small size) with detection tokens and class/box heads, built TPU-first:
+bfloat16 everywhere, attention through the Pallas flash kernel, all matmuls
+MXU-shaped.
+
+Functional style: params are a pytree of dicts, so the generic sharding rules
+in nos_tpu.parallel.sharding apply directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.ops.flash_attention import flash_attention
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden: int = 384        # YOLOS-small
+    layers: int = 12
+    heads: int = 6
+    mlp_ratio: int = 4
+    det_tokens: int = 100
+    num_classes: int = 92    # COCO + no-object
+    dtype: str = "bfloat16"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.n_patches + self.det_tokens
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _init_dense(key, shape, dtype, scale=None):
+    scale = scale if scale is not None else (1.0 / shape[0]) ** 0.5
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_vit(key, cfg: ViTConfig) -> Dict:
+    dt = cfg.jdtype
+    h = cfg.hidden
+    keys = iter(jax.random.split(key, 8 + cfg.layers * 8))
+    params: Dict = {
+        "patch_emb": _init_dense(next(keys), (cfg.patch_size**2 * 3, h), dt),
+        "pos_emb": (jax.random.normal(next(keys), (cfg.seq_len, h)) * 0.02).astype(dt),
+        "det_tok": (jax.random.normal(next(keys), (cfg.det_tokens, h)) * 0.02).astype(dt),
+        "layers": {},
+        "ln_f": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+        "class_head": _init_dense(next(keys), (h, cfg.num_classes), dt),
+        "box_head": _init_dense(next(keys), (h, 4), dt),
+    }
+    for i in range(cfg.layers):
+        params["layers"][str(i)] = {
+            "ln1": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+            "wq": _init_dense(next(keys), (h, h), dt),
+            "wk": _init_dense(next(keys), (h, h), dt),
+            "wv": _init_dense(next(keys), (h, h), dt),
+            "wo": _init_dense(next(keys), (h, h), dt),
+            "ln2": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+            "fc1": _init_dense(next(keys), (h, h * cfg.mlp_ratio), dt),
+            "fc2": _init_dense(next(keys), (h * cfg.mlp_ratio, h), dt),
+        }
+    return params
+
+
+def _layernorm(x, p):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-6)
+    return (out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(x, p, cfg: ViTConfig):
+    b, t, h = x.shape
+    nh, hd = cfg.heads, cfg.head_dim
+
+    def heads(proj):
+        return (x @ proj).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(p["wq"]), heads(p["wk"]), heads(p["wv"])
+    o = flash_attention(q, k, v, causal=False)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, h)
+    return o @ p["wo"]
+
+
+def _block(x, p, cfg: ViTConfig):
+    x = x + _attention(_layernorm(x, p["ln1"]), p, cfg)
+    y = _layernorm(x, p["ln2"])
+    y = jax.nn.gelu(y @ p["fc1"]) @ p["fc2"]
+    return x + y
+
+
+def patchify(images, cfg: ViTConfig):
+    """[B, H, W, 3] -> [B, n_patches, patch*patch*3]."""
+    b = images.shape[0]
+    ps = cfg.patch_size
+    n = cfg.image_size // ps
+    x = images.reshape(b, n, ps, n, ps, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, n * n, ps * ps * 3)
+
+
+def vit_forward(params, images, cfg: ViTConfig):
+    """images [B, H, W, 3] -> (class logits [B, det, classes], boxes [B, det, 4])."""
+    x = patchify(images.astype(cfg.jdtype), cfg) @ params["patch_emb"]
+    b = x.shape[0]
+    det = jnp.broadcast_to(params["det_tok"], (b,) + params["det_tok"].shape)
+    x = jnp.concatenate([x, det], axis=1) + params["pos_emb"]
+    for i in range(cfg.layers):
+        x = _block(x, params["layers"][str(i)], cfg)
+    x = _layernorm(x, params["ln_f"])
+    det_out = x[:, cfg.n_patches :, :]
+    logits = det_out @ params["class_head"]
+    boxes = jax.nn.sigmoid((det_out @ params["box_head"]).astype(jnp.float32))
+    return logits.astype(jnp.float32), boxes

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for workload hot ops."""
+
+from nos_tpu.ops.flash_attention import flash_attention  # noqa: F401

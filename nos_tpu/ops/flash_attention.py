@@ -1,0 +1,170 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The workload-plane hot op: blocked attention with online softmax, streaming
+K/V blocks through VMEM so the T x T score matrix never materializes in HBM.
+Forward is the Pallas kernel (MXU matmuls, f32 accumulators); backward uses
+recompute via the XLA reference implementation (jax.custom_vjp), trading
+FLOPs for memory exactly like jax.checkpoint would.
+
+On non-TPU backends (tests run on a CPU mesh) the reference XLA path is used;
+the public `flash_attention` keeps one signature everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, causal: bool, scale: float):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k, preferred_element_type=jnp.float32)
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), t_k - t_q)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t_real, d = q.shape
+    bh = b * h
+    # Pad the sequence to a block multiple; padded K positions are masked out
+    # in-kernel, padded Q rows are sliced away after.
+    block = max(min(block_q, t_real), min(block_k, t_real))
+    block = max(block, 8)
+    t = ((t_real + block - 1) // block) * block
+    pad = t - t_real
+
+    def prep(x):
+        x = x.reshape(bh, t_real, d)
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    q3, k3, v3 = prep(q), prep(k), prep(v)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    n_q = pl.cdiv(t, block_q)
+    n_k = pl.cdiv(t, block_k)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        q_blk = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+
+        o_acc = jnp.zeros((block_q, d), jnp.float32)
+        m_acc = jnp.full((block_q,), NEG_INF, jnp.float32)
+        l_acc = jnp.zeros((block_q,), jnp.float32)
+
+        def body(ki, carry):
+            o_acc, m_acc, l_acc = carry
+            k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q_blk,
+                k_blk,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [block_q, block_k]
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            if pad:
+                s = jnp.where(k_pos < t_real, s, NEG_INF)
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_blk = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_acc, m_blk)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_acc - m_new)
+            l_new = l_acc * alpha + jnp.sum(p, axis=-1)
+            o_new = o_acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v_blk.dtype),
+                v_blk,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return o_new, m_new, l_new
+
+        if causal:
+            # Only k blocks up to the diagonal contribute.
+            upper = jnp.minimum(n_k, (qi + 1) * block_q // block_k + 1)
+        else:
+            upper = n_k
+        o_acc, m_acc, l_acc = jax.lax.fori_loop(0, upper, body, (o_acc, m_acc, l_acc))
+        o_ref[0] = (o_acc / jnp.maximum(l_acc, 1e-30)[:, None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+        ),
+    )(q3, k3, v3)
+    if pad:
+        out = out[:, :t_real, :]
+    return out.reshape(b, h, t_real, d)
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("NOS_TPU_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    if _use_pallas():
+        return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k)
+    return _reference_attention(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
+    # Recompute-based backward through the XLA reference (memory-for-FLOPs).
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    scale: float = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+):
+    """Attention over [B, H, T, D] tensors. Pallas kernel on TPU, XLA
+    reference elsewhere; differentiable everywhere."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, causal, scale, block_q, block_k)

@@ -1,0 +1,18 @@
+"""Workload-plane parallelism: meshes, shardings, collectives, ring attention.
+
+This is the TPU-native layer with no reference analog (SURVEY.md §2.9: the
+reference schedules opaque pods; the *workload's* parallelism lives inside the
+JAX job). The control plane above hands a JAX workload an ICI-connected
+sub-slice; this package is what the workload runs on it: device meshes over
+the carved topology, dp/tp/sp sharding rules for pjit, and ring attention for
+long-context sequence parallelism over the ICI ring.
+"""
+
+from nos_tpu.parallel.mesh import build_mesh, mesh_from_topology  # noqa: F401
+from nos_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicated,
+    shard_params,
+    transformer_param_rules,
+)
+from nos_tpu.parallel.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,54 @@
+"""Collective helpers for shard_map code.
+
+Thin, named wrappers over XLA collectives (psum / all_gather / ppermute /
+reduce_scatter) — the data-plane vocabulary that replaces the reference
+stack's NCCL calls. Within a carved sub-slice these ride ICI; the mesh
+construction in nos_tpu.parallel.mesh guarantees the axis maps to physical
+links.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_perm(axis_name: str, shift: int = 1):
+    """The (src, dst) permutation for a unidirectional ring over an axis."""
+    n = lax.axis_size(axis_name)
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def ring_pass(x, axis_name: str, shift: int = 1):
+    """Send this shard one step around the ring (neighbor exchange on ICI)."""
+    return lax.ppermute(x, axis_name, ring_perm(axis_name, shift))
+
+
+def all_reduce_sum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x, axis_name: str):
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    """The Ulysses-style sequence<->head exchange primitive."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)

@@ -1,0 +1,74 @@
+"""Device-mesh construction over carved TPU sub-slices.
+
+The bridge between the control plane and the workload: a pod scheduled onto a
+`google.com/tpu-4x4` sub-slice builds its `jax.sharding.Mesh` here. Axis
+sizes multiply to the sub-slice chip count; the physical ICI layout of the
+sub-slice (a contiguous cuboid, guaranteed by the canonical packer) means XLA
+collectives over these axes ride ICI links, not DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from nos_tpu.tpu.topology import Topology
+
+
+def build_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh with the given axis sizes (e.g. {"dp": 2, "tp": 4}).
+
+    Axis sizes must multiply to the device count; an axis size of -1 is
+    inferred. Defaults to a pure data-parallel mesh over all local devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {"dp": n})
+    infer = [k for k, v in axes.items() if v == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(np.prod([v for v in axes.values() if v != -1]))
+    if infer:
+        if n % known != 0:
+            raise ValueError(f"cannot infer {infer[0]}: {n} devices / {known}")
+        axes[infer[0]] = n // known
+    total = int(np.prod(list(axes.values())))
+    if total != n:
+        raise ValueError(f"mesh axes {axes} need {total} devices, have {n}")
+    arr = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def mesh_from_topology(
+    topology: Topology,
+    axis_names: Sequence[str] = ("dp", "tp"),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh shaped like a sub-slice's physical ICI topology.
+
+    A v5e `4x4` sub-slice becomes a ("dp","tp") 4x4 mesh whose axes follow the
+    physical mesh dimensions — collectives along each named axis map onto one
+    ICI dimension (the scaling-book recipe: pick the mesh to match the wiring).
+    Extra topology dims beyond axis_names are folded into the last axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    dims = list(topology.shape.dims)
+    if len(devices) != topology.chips:
+        raise ValueError(
+            f"topology {topology} has {topology.chips} chips, "
+            f"got {len(devices)} devices"
+        )
+    if len(dims) < len(axis_names):
+        dims += [1] * (len(axis_names) - len(dims))
+    if len(dims) > len(axis_names):
+        folded = int(np.prod(dims[len(axis_names) - 1 :]))
+        dims = dims[: len(axis_names) - 1] + [folded]
+    arr = np.array(devices).reshape(tuple(dims))
+    return Mesh(arr, tuple(axis_names))

@@ -1,0 +1,119 @@
+"""Sharding rules for transformer workloads.
+
+The scaling-book recipe: name the mesh axes (dp = data, tp = tensor/model,
+sp = sequence), annotate parameters and activations with PartitionSpecs, and
+let XLA insert the collectives. Rules are regex patterns over parameter tree
+paths, so any pytree-of-dicts model can be sharded without bespoke code.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def transformer_param_rules(
+    tp_axis: str = "tp",
+) -> Sequence[Tuple[str, P]]:
+    """Megatron-style tensor-parallel layout:
+    - attention qkv / mlp up projections: shard output features (column)
+    - attention out / mlp down projections: shard input features (row)
+    - embeddings: shard vocab/features on tp
+    - everything else (norms, biases, small heads): replicated
+    First match wins; paths look like 'layers/3/attn/wq'.
+    """
+    return (
+        (r".*(wq|wk|wv|qkv|up_proj|fc1|w_gate|w_up)$", P(None, tp_axis)),
+        (r".*(wo|out_proj|down_proj|fc2|w_down)$", P(tp_axis, None)),
+        (r".*(tok_emb|pos_emb|patch_emb)$", P(None, tp_axis)),
+        (r".*(lm_head|class_head|box_head)$", P(None, tp_axis)),
+        (r".*", P()),
+    )
+
+
+def spec_for_path(path: str, rules: Sequence[Tuple[str, P]]) -> P:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            return spec
+    return P()
+
+
+def _tree_paths(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _tree_paths(v, f"{prefix}/{k}" if prefix else str(k))
+    else:
+        yield prefix, tree
+
+
+def shard_params(params, mesh: Mesh, rules=None):
+    """Apply rules to a pytree of arrays, placing each on the mesh. Arrays
+    whose shape is incompatible with their matched spec fall back to
+    replication (rank/divisibility guard)."""
+    rules = rules or transformer_param_rules()
+    flat = dict(_tree_paths(params))
+
+    def place(path, arr):
+        spec = spec_for_path(path, rules)
+        # Guard: spec rank must not exceed array rank, and sharded dims must
+        # divide evenly.
+        if len(spec) > getattr(arr, "ndim", 0):
+            spec = P()
+        else:
+            for dim, axis in enumerate(spec):
+                if axis is None:
+                    continue
+                if axis not in mesh.shape or arr.shape[dim] % mesh.shape[axis] != 0:
+                    spec = P()
+                    break
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    def rebuild(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {
+                k: rebuild(v, f"{prefix}/{k}" if prefix else str(k))
+                for k, v in tree.items()
+            }
+        return place(prefix, tree)
+
+    return rebuild(params)
+
+
+def param_shardings(params, mesh: Mesh, rules=None):
+    """NamedShardings (not placed arrays) matching shard_params — for jit
+    in_shardings/out_shardings."""
+    rules = rules or transformer_param_rules()
+
+    def build(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {
+                k: build(v, f"{prefix}/{k}" if prefix else str(k))
+                for k, v in tree.items()
+            }
+        spec = spec_for_path(prefix, rules)
+        if len(spec) > getattr(tree, "ndim", 0):
+            spec = P()
+        else:
+            for dim, axis in enumerate(spec):
+                if axis is None:
+                    continue
+                if axis not in mesh.shape or tree.shape[dim] % mesh.shape[axis] != 0:
+                    spec = P()
+                    break
+        return NamedSharding(mesh, spec)
+
+    return build(params)
+
+
+def batch_sharding(mesh: Mesh, dp_axis: str = "dp", sp_axis: str = None) -> NamedSharding:
+    """Batch data layout: batch on dp, optionally sequence on sp."""
+    if sp_axis and sp_axis in mesh.shape:
+        return NamedSharding(mesh, P(dp_axis, sp_axis))
+    return NamedSharding(mesh, P(dp_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

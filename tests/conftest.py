@@ -1,13 +1,23 @@
 """Test harness setup.
 
 JAX runs on a virtual 8-device CPU mesh during tests (multi-chip sharding
-paths compile and execute without TPU hardware); this must be configured
-before the first `import jax` anywhere in the test process.
+paths compile and execute without TPU hardware; the driver validates the same
+way — SURVEY.md §4 test seams). The accelerator plugin may already be
+registered by the environment's sitecustomize, so we both set the env vars
+and switch the platform via jax.config before any backend initializes.
+Set NOS_TPU_TEST_ON_TPU=1 to run the suite against the real accelerator.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("NOS_TPU_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
