@@ -1,0 +1,3 @@
+"""Workload runtime: the serving side of a carved sub-slice."""
+
+from nos_tpu.runtime.slice_server import SliceServer  # noqa: F401

@@ -1,0 +1,145 @@
+"""SliceServer: dynamic micro-batching executor for a shared accelerator.
+
+The TPU-native answer to GPU sharing (reference demo: MPS pods time-share an
+A100, BASELINE.md): when the scheduler co-locates N inference workloads on one
+chip/sub-slice, the runtime *batches* their concurrent requests into single
+MXU-shaped executions instead of time-slicing them. The systolic array is
+starved at batch 1, so batching N requests costs almost nothing extra — each
+client sees latency close to a single inference instead of N of them.
+
+Implementation: one executor thread drains a request queue, stacks up to
+`max_batch` requests (padding to fixed bucket sizes so XLA reuses compiled
+programs), runs the jitted batched forward, and scatters results to waiting
+futures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SliceServer:
+    def __init__(
+        self,
+        batched_fn: Callable,
+        max_batch: int = 8,
+        max_wait_s: float = 0.002,
+        buckets: Optional[Sequence[int]] = None,
+        stack_in_program: bool = True,
+    ):
+        """`batched_fn(batch_input)` must accept a leading batch dimension.
+        `buckets` are the batch sizes compiled for (requests padded up).
+
+        With `stack_in_program` (default), the per-request inputs are stacked
+        *inside* a per-bucket jitted program — one dispatch per batch, no
+        host-side stacking: an eager jnp.stack of device arrays costs a
+        dispatch per element, catastrophic over a remote-dispatch link."""
+        self._fn = batched_fn
+        self.stack_in_program = stack_in_program
+        self._bucket_fns = {}
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        if buckets is None:
+            buckets = []
+            b = 1
+            while b < max_batch:
+                buckets.append(b)
+                b *= 2
+            buckets.append(max_batch)
+        self.buckets = sorted(set(buckets))
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.batches_run = 0
+        self.requests_served = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "SliceServer":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _get_bucket_fn(self, bucket: int) -> Callable:
+        if not self.stack_in_program:
+            return lambda *xs: self._fn(jnp.stack(xs))
+        fn = self._bucket_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(lambda *xs: self._fn(jnp.stack(xs)))
+            self._bucket_fns[bucket] = fn
+        return fn
+
+    def warmup(self, example_input) -> None:
+        """Compile every bucket size up front (first-call latency off the
+        serving path)."""
+        for b in self.buckets:
+            args = (example_input,) * b
+            jax.block_until_ready(self._get_bucket_fn(b)(*args))
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, x) -> Future:
+        """Queue one request (a single un-batched input). Returns a Future
+        resolving to the un-batched output."""
+        fut: Future = Future()
+        self._queue.put((x, fut))
+        return fut
+
+    def infer(self, x, timeout: Optional[float] = None):
+        return self.submit(x).result(timeout=timeout)
+
+    # -- executor ------------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch: List = [first]
+            deadline = time.perf_counter() + self.max_wait_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            inputs = [x for x, _ in batch]
+            futures = [f for _, f in batch]
+            try:
+                n = len(inputs)
+                bucket = self._bucket_for(n)
+                # Pad by repeating the first input (device-array reference,
+                # no data movement); padded rows are discarded below.
+                args = tuple(inputs) + (inputs[0],) * (bucket - n)
+                out = self._get_bucket_fn(bucket)(*args)
+                # One device->host transfer per batch; per-request results are
+                # then zero-copy numpy views (a per-request device slice would
+                # cost a dispatch each).
+                out = jax.device_get(out)
+                self.batches_run += 1
+                self.requests_served += n
+                for i, fut in enumerate(futures):
+                    fut.set_result(jax.tree.map(lambda o: o[i], out))
+            except Exception as e:  # noqa: BLE001
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(e)

@@ -1,0 +1,85 @@
+"""SliceServer dynamic micro-batching tests."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.runtime.slice_server import SliceServer
+
+
+def make_server(**kw):
+    fn = jax.jit(lambda x: x * 2.0 + 1.0)
+    return SliceServer(fn, **kw)
+
+
+def test_single_request_roundtrip():
+    server = make_server(max_batch=4).start()
+    try:
+        x = jnp.ones((3,))
+        out = server.infer(x, timeout=5)
+        np.testing.assert_allclose(np.asarray(out), np.full(3, 3.0))
+        assert server.requests_served == 1
+    finally:
+        server.stop()
+
+
+def test_concurrent_requests_batched():
+    server = make_server(max_batch=8, max_wait_s=0.05).start()
+    try:
+        results = {}
+
+        def client(i):
+            x = jnp.full((2,), float(i))
+            results[i] = np.asarray(server.infer(x, timeout=10))
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(8):
+            np.testing.assert_allclose(results[i], np.full(2, 2.0 * i + 1.0))
+        # Concurrency should have produced fewer batches than requests.
+        assert server.requests_served == 8
+        assert server.batches_run < 8
+    finally:
+        server.stop()
+
+
+def test_bucket_padding_returns_correct_rows():
+    server = make_server(max_batch=8, max_wait_s=0.03).start()
+    try:
+        futs = [server.submit(jnp.full((2,), float(i))) for i in range(3)]
+        outs = [np.asarray(f.result(timeout=10)) for f in futs]
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(out, np.full(2, 2.0 * i + 1.0))
+    finally:
+        server.stop()
+
+
+def test_error_propagates_to_futures():
+    def bad_fn(x):
+        raise RuntimeError("boom")
+
+    server = SliceServer(bad_fn, max_batch=2).start()
+    try:
+        fut = server.submit(jnp.ones((1,)))
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=5)
+    finally:
+        server.stop()
+
+
+def test_pytree_outputs():
+    fn = jax.jit(lambda x: {"a": x + 1, "b": (x * 2, x - 1)})
+    server = SliceServer(fn, max_batch=4).start()
+    try:
+        out = server.infer(jnp.zeros((2,)), timeout=5)
+        np.testing.assert_allclose(np.asarray(out["a"]), np.ones(2))
+        np.testing.assert_allclose(np.asarray(out["b"][0]), np.zeros(2))
+    finally:
+        server.stop()
