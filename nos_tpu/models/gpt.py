@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from nos_tpu.ops.flash_attention import flash_attention
-from nos_tpu.parallel.ring_attention import ring_attention
+from nos_tpu.parallel.ring_attention import ring_attention, ulysses_attention
 
 
 @dataclass(frozen=True)
@@ -100,6 +100,8 @@ def _attention(x, p, cfg: GPTConfig, positions, mesh):
     v = heads(p["wv"])
     if cfg.attention == "ring" and mesh is not None and "sp" in mesh.shape:
         o = ring_attention(q, k, v, mesh=mesh, axis_name="sp", causal=True)
+    elif cfg.attention == "ulysses" and mesh is not None and "sp" in mesh.shape:
+        o = ulysses_attention(q, k, v, mesh=mesh, axis_name="sp", causal=True)
     else:
         o = flash_attention(q, k, v, causal=True)
     o = o.transpose(0, 2, 1, 3).reshape(b, t, h)

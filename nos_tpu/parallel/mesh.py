@@ -40,9 +40,10 @@ def build_mesh(
             raise ValueError(f"cannot infer {infer[0]}: {n} devices / {known}")
         axes[infer[0]] = n // known
     total = int(np.prod(list(axes.values())))
-    if total != n:
+    if total > n:
         raise ValueError(f"mesh axes {axes} need {total} devices, have {n}")
-    arr = np.array(devices).reshape(tuple(axes.values()))
+    # Fewer axes than devices: use a prefix (a sub-slice of the allocation).
+    arr = np.array(devices[:total]).reshape(tuple(axes.values()))
     return Mesh(arr, tuple(axes.keys()))
 
 

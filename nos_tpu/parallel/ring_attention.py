@@ -120,6 +120,55 @@ def ring_attention(
     return fn(q, k, v)
 
 
+def ulysses_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: float = None,
+):
+    """DeepSpeed-Ulysses-style sequence parallelism: all_to_all exchanges the
+    sequence sharding for a *head* sharding, each device then runs full-length
+    attention over its head group, and a second all_to_all restores the
+    sequence sharding. Two ICI all-to-alls instead of ring steps — better when
+    heads >> devices and sequence blocks are small."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(f"heads {q.shape[1]} not divisible by {axis_name}={n}")
+    dp = "dp" if "dp" in mesh.shape else None
+    spec = P(dp, None, axis_name, None)
+
+    def local(q, k, v):
+        # [b, h, t/n, d] -> [b, h/n, t, d]
+        def to_heads(x):
+            return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+        def to_seq(x):
+            return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+        o = _local_full_attention(to_heads(q), to_heads(k), to_heads(v), causal, scale)
+        return to_seq(o)
+
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _local_full_attention(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k, preferred_element_type=jnp.float32)
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), t_k - t_q)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
 def reference_attention(q, k, v, causal: bool = False, scale: float = None):
     """Plain XLA attention for correctness checks."""
     if scale is None:

@@ -1,0 +1,152 @@
+"""Pipeline (pp) and expert (ep) parallelism tests vs single-device refs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.parallel.mesh import build_mesh
+from nos_tpu.parallel.moe import init_moe, moe_apply
+from nos_tpu.parallel.pipeline import pipeline_apply
+
+
+def test_pipeline_matches_sequential():
+    mesh = build_mesh({"pp": 4})
+    # 4 stages, each an affine map; params leading axis = stage.
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (4, 8, 8)) * 0.3
+    b = jax.random.normal(jax.random.PRNGKey(1), (4, 8)) * 0.1
+    params = {"w": w, "b": b}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    batch = jax.random.normal(jax.random.PRNGKey(2), (8, 8))
+
+    # Sequential reference.
+    ref = batch
+    for s in range(4):
+        ref = stage_fn({"w": w[s], "b": b[s]}, ref)
+
+    out = pipeline_apply(params, batch, stage_fn, mesh, n_microbatches=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_with_dp_axis_and_grad():
+    mesh = build_mesh({"pp": 2, "dp": 4})
+    w = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4)) * 0.3
+    params = {"w": w}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    batch = jax.random.normal(jax.random.PRNGKey(1), (8, 4))
+
+    def loss(params, batch):
+        out = pipeline_apply(params, batch, stage_fn, mesh, n_microbatches=2)
+        return jnp.mean(out**2)
+
+    ref = batch
+    for s in range(2):
+        ref = jnp.tanh(ref @ w[s])
+    ref_loss = jnp.mean(ref**2)
+
+    val, grads = jax.value_and_grad(loss)(params, batch)
+    assert np.isclose(float(val), float(ref_loss), atol=1e-5)
+
+    # Gradient matches the sequential model's gradient.
+    def ref_loss_fn(params, batch):
+        out = batch
+        for s in range(2):
+            out = jnp.tanh(out @ params["w"][s])
+        return jnp.mean(out**2)
+
+    ref_grads = jax.grad(ref_loss_fn)(params, batch)
+    np.testing.assert_allclose(
+        np.asarray(grads["w"]), np.asarray(ref_grads["w"]), atol=1e-4, rtol=1e-4
+    )
+
+
+def _moe_reference(params, x, capacity):
+    """Single-device reference with identical top-1 + capacity semantics."""
+    b, t, h = x.shape
+    flat = x.reshape(b * t, h)
+    n_experts = params["w_in"].shape[0]
+    logits = flat.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)
+    slot = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+    kept = slot < capacity
+    outs = []
+    for i in range(flat.shape[0]):
+        e = int(expert_idx[i])
+        y = jax.nn.gelu(
+            (flat[i] @ params["w_in"][e]).astype(jnp.float32)
+        ).astype(flat.dtype) @ params["w_out"][e]
+        outs.append(jnp.where(kept[i], y * gate[i].astype(y.dtype), 0))
+    return jnp.stack(outs).reshape(b, t, h)
+
+
+def test_moe_matches_reference():
+    mesh = build_mesh({"ep": 4})
+    params = init_moe(jax.random.PRNGKey(0), hidden=16, mlp_dim=32, n_experts=4,
+                      dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16), jnp.float32)
+    # Tokens are sequence-sharded over ep: routing/capacity act per rank, so
+    # the reference applies the same semantics per sequence chunk.
+    ep = 4
+    t_chunk = 8 // ep
+    capacity = max(1, int(2.0 * (2 * t_chunk) / 4))
+    chunks = [
+        _moe_reference(params, x[:, i * t_chunk : (i + 1) * t_chunk, :], capacity)
+        for i in range(ep)
+    ]
+    want = jnp.concatenate(chunks, axis=1)
+    got = moe_apply(params, x, mesh, capacity_factor=2.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4)
+
+
+def test_moe_with_dp_axis_runs_and_is_finite():
+    mesh = build_mesh({"dp": 2, "ep": 4})
+    params = init_moe(jax.random.PRNGKey(0), hidden=16, mlp_dim=32, n_experts=8,
+                      dtype=jnp.float32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    out = moe_apply(params, x, mesh)
+    assert out.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_moe_rejects_indivisible_experts():
+    mesh = build_mesh({"ep": 4})
+    params = init_moe(jax.random.PRNGKey(0), hidden=8, mlp_dim=16, n_experts=6)
+    x = jnp.zeros((1, 4, 8))
+    with pytest.raises(ValueError):
+        moe_apply(params, x, mesh)
+
+
+def test_ulysses_attention_matches_reference():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nos_tpu.parallel.ring_attention import reference_attention, ulysses_attention
+
+    mesh = build_mesh({"sp": 4})
+    b, h, t, d = 2, 8, 32, 16
+    key = jax.random.PRNGKey(3)
+    q, k, v = (
+        jax.random.normal(kk, (b, h, t, d), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    for causal in (False, True):
+        want = reference_attention(q, k, v, causal=causal)
+        spec = NamedSharding(mesh, P(None, None, "sp", None))
+        qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+        got = ulysses_attention(qs, ks, vs, mesh=mesh, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
