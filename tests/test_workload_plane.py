@@ -31,8 +31,11 @@ def test_build_mesh_axes_and_inference():
     assert mesh.shape == {"dp": 2, "tp": 4}
     mesh2 = build_mesh({"dp": -1, "tp": 2})
     assert mesh2.shape == {"dp": 4, "tp": 2}
+    # Fewer devices than available: a prefix sub-mesh is built.
+    assert build_mesh({"dp": 3}).shape == {"dp": 3}
+    # More devices than available: error.
     with pytest.raises(ValueError):
-        build_mesh({"dp": 3})
+        build_mesh({"dp": 16})
 
 
 def test_mesh_from_topology():
