@@ -1,0 +1,36 @@
+# nos-tpu build/test entry points (reference Makefile:103-187 analog).
+
+PY ?= python
+
+.PHONY: all test test-tpu native bench dryrun demo clean
+
+all: native test
+
+# Unit + integration tests on the virtual 8-device CPU mesh (SURVEY.md §4).
+test:
+	$(PY) -m pytest tests/ -q
+
+# Same suite against the real accelerator (slow: per-test compiles).
+test-tpu:
+	NOS_TPU_TEST_ON_TPU=1 $(PY) -m pytest tests/ -q
+
+# Native tpuslice shim (the cgo/NVML-layer analog).
+native:
+	$(MAKE) -C nos_tpu/tpulib/native
+
+# Headline benchmark on the real chip (prints one JSON line).
+bench:
+	$(PY) bench.py
+
+# Multi-chip sharding dry-run on 8 virtual CPU devices.
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) __graft_entry__.py
+
+# Single-process full-system demo.
+demo:
+	$(PY) -m nos_tpu.cli demo
+
+clean:
+	$(MAKE) -C nos_tpu/tpulib/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,260 @@
+"""Command-line entry points — the analog of the reference's six binaries
+(cmd/: operator, scheduler, gpupartitioner, migagent, gpuagent,
+metricsexporter; SURVEY.md §2.1).
+
+    python -m nos_tpu.cli operator        --config operator.yaml
+    python -m nos_tpu.cli scheduler       --config scheduler.yaml
+    python -m nos_tpu.cli partitioner     --config partitioner.yaml
+    python -m nos_tpu.cli tpu-agent       --node <name>
+    python -m nos_tpu.cli gpu-agent       --node <name> --mode mig|mps
+    python -m nos_tpu.cli telemetry       [--share]
+    python -m nos_tpu.cli demo            # single-process full system demo
+
+Outside a k8s deployment these run against the in-process cluster bus; the
+`demo` subcommand assembles the whole control plane, carves a mesh for a
+fractional workload, and prints the resulting cluster state.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from nos_tpu import constants
+from nos_tpu.config import (
+    AgentConfig,
+    OperatorConfig,
+    PartitionerConfig,
+    SchedulerConfig,
+    load_config,
+)
+from nos_tpu.observability import HealthManager, ObservabilityServer, metrics, setup_logging
+
+
+def _obs(manager_cfg) -> ObservabilityServer:
+    health = HealthManager()
+    server = ObservabilityServer(metrics, health, port=0).start()
+    print(f"observability: http://127.0.0.1:{server.port}/metrics /healthz /readyz")
+    return server
+
+
+def cmd_operator(args) -> int:
+    cfg = load_config(OperatorConfig, args.config)
+    setup_logging(cfg.manager.log_level)
+    from nos_tpu.api.webhooks import install_quota_webhooks
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.controllers.quota import QuotaReconciler
+    from nos_tpu.scheduler.resource_calculator import ResourceCalculator
+
+    cluster = Cluster()
+    install_quota_webhooks(cluster)
+    calc = ResourceCalculator(cfg.tpu_chip_memory_gb, cfg.nvidia_gpu_memory_gb)
+    QuotaReconciler(cluster, calc).start_watching()
+    _obs(cfg.manager)
+    print("operator running (quota webhooks + reconcilers); ctrl-c to exit")
+    return _wait(args)
+
+
+def cmd_scheduler(args) -> int:
+    cfg = load_config(SchedulerConfig, args.config)
+    setup_logging(cfg.manager.log_level)
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.system import build_scheduler
+
+    scheduler = build_scheduler(Cluster(), cfg)
+    _obs(cfg.manager)
+    print(f"scheduler '{cfg.scheduler_name}' running; ctrl-c to exit")
+    while not args.once:
+        scheduler.schedule_pending()
+        time.sleep(1.0)
+    return 0
+
+
+def cmd_partitioner(args) -> int:
+    cfg = load_config(PartitionerConfig, args.config)
+    setup_logging(cfg.manager.log_level)
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.partitioning.state import ClusterState
+    from nos_tpu.system import build_partitioner_controllers, build_scheduler
+
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    scheduler = build_scheduler(cluster)
+    controllers = build_partitioner_controllers(cluster, state, scheduler, cfg)
+    for controller in controllers.values():
+        controller.start_watching()
+    _obs(cfg.manager)
+    print(f"partitioner running for modes {cfg.modes}; ctrl-c to exit")
+    while not args.once:
+        for controller in controllers.values():
+            controller.process_batch_if_ready()
+        time.sleep(1.0)
+    return 0
+
+
+def cmd_tpu_agent(args) -> int:
+    cfg = load_config(AgentConfig, args.config)
+    setup_logging(cfg.manager.log_level)
+    node_name = args.node or cfg.node_name or os.environ.get(constants.ENV_NODE_NAME, "")
+    if not node_name:
+        print("--node or $NODE_NAME required", file=sys.stderr)
+        return 2
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.system import build_tpu_agent
+
+    cluster = Cluster()
+    agent = build_tpu_agent(cluster, node_name, cfg)
+    agent.startup()
+    agent.start_watching()
+    _obs(cfg.manager)
+    print(f"tpu-agent for node {node_name} running; ctrl-c to exit")
+    while not args.once:
+        agent.report()
+        time.sleep(cfg.report_interval_s)
+    return 0
+
+
+def cmd_gpu_agent(args) -> int:
+    cfg = load_config(AgentConfig, args.config)
+    setup_logging(cfg.manager.log_level)
+    node_name = args.node or cfg.node_name or os.environ.get(constants.ENV_NODE_NAME, "")
+    if not node_name:
+        print("--node or $NODE_NAME required", file=sys.stderr)
+        return 2
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.system import build_gpu_agent
+
+    cluster = Cluster()
+    agent = build_gpu_agent(
+        cluster, node_name, args.mode, args.gpus, args.model or args.memory_gb
+    )
+    agent.startup()
+    agent.start_watching()
+    _obs(cfg.manager)
+    print(f"{args.mode}-agent for node {node_name} running; ctrl-c to exit")
+    while not args.once:
+        agent.report()
+        time.sleep(cfg.report_interval_s)
+    return 0
+
+
+def cmd_telemetry(args) -> int:
+    setup_logging("INFO")
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.telemetry import export
+
+    report = export(Cluster(), share_telemetry=args.share)
+    print("telemetry:", report)
+    return 0
+
+
+def cmd_demo(args) -> int:
+    """Single-process demo: full control plane + one TPU node + a fractional
+    workload, driven synchronously."""
+    setup_logging("INFO")
+    from nos_tpu.api.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec
+    from nos_tpu.api.resources import ResourceList
+    from nos_tpu.system import ControlPlane
+    from nos_tpu.tpu import Topology
+
+    class FastClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FastClock()
+    plane = ControlPlane(now=clock).start()
+    plane.cluster.create(
+        Node(
+            metadata=ObjectMeta(
+                name="tpu-node-0",
+                labels={
+                    constants.LABEL_PARTITIONING: constants.KIND_TPU,
+                    constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                    constants.LABEL_TPU_TOPOLOGY: "4x4",
+                },
+            ),
+            status=NodeStatus(
+                allocatable=ResourceList.of({"cpu": 64, "google.com/tpu": 16})
+            ),
+        )
+    )
+    plane.add_tpu_agent("tpu-node-0")
+    pod = Pod(
+        metadata=ObjectMeta(name="jax-job", namespace="demo"),
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceList.of({"google.com/tpu-2x2": 1, "cpu": 1}))
+            ],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+    plane.cluster.create(pod)
+    plane.scheduler.schedule_pending()  # marks the pod Unschedulable -> batched
+    clock.t += 61  # close the batch window
+    result = plane.tick()
+    node = plane.cluster.get("Node", "", "tpu-node-0")
+    bound = plane.cluster.get("Pod", "demo", "jax-job")
+    print("\n--- demo result ---")
+    print("pod bound to:", bound.spec.node_name, "phase:", bound.status.phase)
+    print("node annotations:")
+    for k, v in sorted(node.metadata.annotations.items()):
+        print(f"  {k} = {v}")
+    print("node allocatable:", dict(node.status.allocatable))
+    return 0 if bound.spec.node_name else 1
+
+
+def _wait(args) -> int:
+    if args.once:
+        return 0
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="nos-tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def common(p):
+        p.add_argument("--config", default=None, help="component config file (YAML/JSON)")
+        p.add_argument("--once", action="store_true", help="run one cycle and exit")
+
+    common(sub.add_parser("operator"))
+    common(sub.add_parser("scheduler"))
+    common(sub.add_parser("partitioner"))
+    p_tpu = sub.add_parser("tpu-agent")
+    common(p_tpu)
+    p_tpu.add_argument("--node", default=None)
+    p_gpu = sub.add_parser("gpu-agent")
+    common(p_gpu)
+    p_gpu.add_argument("--node", default=None)
+    p_gpu.add_argument("--mode", choices=["mig", "mps"], default="mig")
+    p_gpu.add_argument("--gpus", type=int, default=1)
+    p_gpu.add_argument("--model", default="NVIDIA-A100-PCIE-40GB")
+    p_gpu.add_argument("--memory-gb", type=int, default=40)
+    p_tel = sub.add_parser("telemetry")
+    p_tel.add_argument("--share", action="store_true")
+    sub.add_parser("demo")
+
+    args = parser.parse_args(argv)
+    handlers = {
+        "operator": cmd_operator,
+        "scheduler": cmd_scheduler,
+        "partitioner": cmd_partitioner,
+        "tpu-agent": cmd_tpu_agent,
+        "gpu-agent": cmd_gpu_agent,
+        "telemetry": cmd_telemetry,
+        "demo": cmd_demo,
+    }
+    return handlers[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

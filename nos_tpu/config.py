@@ -1,0 +1,147 @@
+"""Component configuration (the pkg/api/nos.nebuly.com/config/v1alpha1 analog).
+
+Each binary takes a config file (YAML or JSON) deserialized into a component
+config dataclass with validation — mirroring GpuPartitionerConfig
+(gpu_partitioner_config.go:28-55: batch windows, known geometries file,
+device-plugin CM/delay), OperatorConfig (operator_config.go:26-30) and the
+agent configs (report interval). A common block carries the manager-level
+settings (ControllerManagerConfigurationSpec analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from nos_tpu import constants
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class ManagerConfig:
+    """Common manager settings (health/metrics endpoints, leader election)."""
+
+    health_probe_port: int = 8081
+    metrics_port: int = 8080
+    leader_election: bool = False
+    log_level: str = "INFO"
+
+
+@dataclass
+class OperatorConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    # GB assumed per whole device for quota metering
+    # (operator_config.go NvidiaGpuResourceMemoryGB analog).
+    tpu_chip_memory_gb: float = constants.DEFAULT_TPU_CHIP_MEMORY_GB
+    nvidia_gpu_memory_gb: float = constants.DEFAULT_GPU_MEMORY_GB
+
+    def validate(self) -> None:
+        if self.tpu_chip_memory_gb <= 0 or self.nvidia_gpu_memory_gb <= 0:
+            raise ConfigError("device memory GB values must be positive")
+
+
+@dataclass
+class PartitionerConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    batch_window_timeout_s: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S
+    batch_window_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S
+    modes: List[str] = field(default_factory=lambda: list(constants.PARTITIONING_KINDS))
+    device_plugin_cm_name: str = constants.DEFAULT_DEVICE_PLUGIN_CM_NAME
+    device_plugin_cm_namespace: str = constants.DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE
+    device_plugin_delay_s: float = constants.DEFAULT_DEVICE_PLUGIN_DELAY_S
+    # Per-model MIG geometry overrides (knownMigGeometries analog):
+    # {"NVIDIA-A100-PCIE-40GB": [{"1g.5gb": 7}, ...]}
+    known_mig_geometries: Dict[str, List[Dict[str, int]]] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.batch_window_timeout_s <= 0:
+            raise ConfigError("batch_window_timeout_s must be positive")
+        if not 0 < self.batch_window_idle_s <= self.batch_window_timeout_s:
+            raise ConfigError(
+                "batch_window_idle_s must be in (0, batch_window_timeout_s]"
+            )
+        unknown = set(self.modes) - set(constants.PARTITIONING_KINDS)
+        if unknown:
+            raise ConfigError(f"unknown partitioning modes: {sorted(unknown)}")
+
+    def apply_mig_overrides(self) -> None:
+        from nos_tpu.gpu import mig
+
+        for model, geometries in self.known_mig_geometries.items():
+            mig.set_known_geometries(model, geometries)
+
+
+@dataclass
+class AgentConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    node_name: str = ""  # defaults to $NODE_NAME
+    report_interval_s: float = 10.0
+    use_native_tpulib: bool = True
+
+    def validate(self) -> None:
+        if self.report_interval_s <= 0:
+            raise ConfigError("report_interval_s must be positive")
+
+
+@dataclass
+class SchedulerConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    scheduler_name: str = constants.SCHEDULER_NAME
+    tpu_chip_memory_gb: float = constants.DEFAULT_TPU_CHIP_MEMORY_GB
+    nvidia_gpu_memory_gb: float = constants.DEFAULT_GPU_MEMORY_GB
+
+    def validate(self) -> None:
+        if not self.scheduler_name:
+            raise ConfigError("scheduler_name must be non-empty")
+
+
+def _from_dict(cls, data: dict):
+    """Build a (possibly nested) dataclass from a plain dict, rejecting
+    unknown keys (config typos fail fast)."""
+    if not dataclasses.is_dataclass(cls):
+        return data
+    names = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(names)
+    if unknown:
+        raise ConfigError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs = {}
+    for key, value in data.items():
+        f = names[key]
+        if dataclasses.is_dataclass(f.type) or f.type in (ManagerConfig,):
+            kwargs[key] = _from_dict(f.type, value)
+        elif f.name == "manager" and isinstance(value, dict):
+            kwargs[key] = _from_dict(ManagerConfig, value)
+        else:
+            kwargs[key] = value
+    return cls(**kwargs)
+
+
+def load_config(cls, path: Optional[str] = None):
+    """Load a component config from a YAML/JSON file (None -> defaults)."""
+    if path is None:
+        cfg = cls()
+    else:
+        text = Path(path).read_text()
+        data = None
+        try:
+            data = json.loads(text)
+        except json.JSONDecodeError:
+            try:
+                import yaml  # type: ignore
+
+                data = yaml.safe_load(text)
+            except ImportError as e:
+                raise ConfigError(
+                    f"{path} is not JSON and pyyaml is unavailable"
+                ) from e
+        if not isinstance(data, dict):
+            raise ConfigError(f"config file {path} must contain a mapping")
+        cfg = _from_dict(cls, data)
+    cfg.validate()
+    return cfg

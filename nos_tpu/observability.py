@@ -1,0 +1,188 @@
+"""Observability: metrics registry, health checks, structured logging.
+
+The analog of the reference's controller-runtime metrics endpoint +
+healthz/readyz probes (SURVEY.md §5): a small Prometheus-text metrics
+registry, a health manager every component registers checks with, and leveled
+logging setup (zap analog). An optional HTTP server exposes /metrics,
+/healthz and /readyz for deployments.
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+class Metrics:
+    """Counters, gauges and duration histograms with label support."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+        self._durations: Dict[Tuple[str, Tuple], list] = defaultdict(list)
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]) -> Tuple[str, Tuple]:
+        return name, tuple(sorted((labels or {}).items()))
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, seconds: float, **labels) -> None:
+        with self._lock:
+            self._durations[self._key(name, labels)].append(seconds)
+
+    def time(self, name: str, **labels):
+        """Context manager recording a duration."""
+        metrics = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                metrics.observe(name, time.perf_counter() - self.t0, **labels)
+                return False
+
+        return _Timer()
+
+    def get(self, name: str, **labels) -> float:
+        with self._lock:
+            key = self._key(name, labels)
+            if key in self._counters:
+                return self._counters[key]
+            return self._gauges.get(key, 0.0)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        def fmt(name, labels, value):
+            if labels:
+                inner = ",".join(f'{k}="{v}"' for k, v in labels)
+                return f"{name}{{{inner}}} {value:g}"
+            return f"{name} {value:g}"
+
+        lines = []
+        with self._lock:
+            for (name, labels), value in sorted(self._counters.items()):
+                lines.append(fmt(name + "_total", labels, value))
+            for (name, labels), value in sorted(self._gauges.items()):
+                lines.append(fmt(name, labels, value))
+            for (name, labels), values in sorted(self._durations.items()):
+                lines.append(fmt(name + "_seconds_count", labels, len(values)))
+                lines.append(fmt(name + "_seconds_sum", labels, sum(values)))
+        return "\n".join(lines) + "\n"
+
+
+# Global default registry (components may also carry their own).
+metrics = Metrics()
+
+
+# ---------------------------------------------------------------------------
+# Health
+# ---------------------------------------------------------------------------
+class HealthManager:
+    """healthz/readyz checks (AddHealthzCheck/AddReadyzCheck analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._healthz: Dict[str, Callable[[], Optional[str]]] = {}
+        self._readyz: Dict[str, Callable[[], Optional[str]]] = {}
+
+    def add_healthz(self, name: str, check: Callable[[], Optional[str]]) -> None:
+        with self._lock:
+            self._healthz[name] = check
+
+    def add_readyz(self, name: str, check: Callable[[], Optional[str]]) -> None:
+        with self._lock:
+            self._readyz[name] = check
+
+    def _run(self, checks) -> Tuple[bool, Dict[str, str]]:
+        failures = {}
+        for name, check in list(checks.items()):
+            try:
+                reason = check()
+            except Exception as e:  # noqa: BLE001
+                reason = f"check raised: {e}"
+            if reason is not None:
+                failures[name] = reason
+        return not failures, failures
+
+    def healthz(self) -> Tuple[bool, Dict[str, str]]:
+        with self._lock:
+            checks = dict(self._healthz)
+        return self._run(checks)
+
+    def readyz(self) -> Tuple[bool, Dict[str, str]]:
+        with self._lock:
+            checks = dict(self._readyz)
+        return self._run(checks)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint
+# ---------------------------------------------------------------------------
+class ObservabilityServer:
+    """Serves /metrics, /healthz, /readyz (kube-rbac-proxy-less analog)."""
+
+    def __init__(self, metrics_registry: Metrics, health: HealthManager, port: int = 0):
+        self.metrics = metrics_registry
+        self.health = health
+        obs = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = obs.metrics.render().encode()
+                    self.send_response(200)
+                elif self.path == "/healthz":
+                    ok, failures = obs.health.healthz()
+                    body = (b"ok" if ok else repr(failures).encode())
+                    self.send_response(200 if ok else 500)
+                elif self.path == "/readyz":
+                    ok, failures = obs.health.readyz()
+                    body = (b"ok" if ok else repr(failures).encode())
+                    self.send_response(200 if ok else 500)
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ObservabilityServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def setup_logging(level: str = "INFO") -> None:
+    """Leveled structured logging (zap-options analog)."""
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)-5s %(name)s %(message)s",
+    )

@@ -213,10 +213,14 @@ class MigSnapshotTaker:
     def take_snapshot(self, cluster_state):
         from nos_tpu.partitioning.core.snapshot import Snapshot
 
+        from nos_tpu.controllers.health import is_node_device_healthy
+
         nodes = {}
         for node in cluster_state.nodes(
             label_selector={constants.LABEL_PARTITIONING: constants.KIND_MIG}
         ):
+            if not is_node_device_healthy(node):
+                continue
             model, count, _ = _gfd(node)
             if model not in KNOWN_MIG_MODELS or count < 1:
                 continue
@@ -250,10 +254,14 @@ class MpsSnapshotTaker:
     def take_snapshot(self, cluster_state):
         from nos_tpu.partitioning.core.snapshot import Snapshot
 
+        from nos_tpu.controllers.health import is_node_device_healthy
+
         nodes = {}
         for node in cluster_state.nodes(
             label_selector={constants.LABEL_PARTITIONING: constants.KIND_MPS}
         ):
+            if not is_node_device_healthy(node):
+                continue
             model, count, memory_gb = _gfd(node)
             if count < 1:
                 continue

@@ -204,12 +204,16 @@ class TpuSnapshotTaker:
     def take_snapshot(self, cluster_state):
         from nos_tpu.partitioning.core.snapshot import Snapshot
 
+        from nos_tpu.controllers.health import is_node_device_healthy
+
         nodes = {}
         for node in cluster_state.nodes(
             label_selector={constants.LABEL_PARTITIONING: constants.KIND_TPU}
         ):
             if Topology.from_node_labels(node.metadata.labels) is None:
                 continue
+            if not is_node_device_healthy(node):
+                continue  # never carve a node whose device layer is unhealthy
             name = node.metadata.name
             nodes[name] = TpuNode.from_node(
                 node,

@@ -1,0 +1,241 @@
+"""Full control-plane assembly.
+
+Wires every component over one cluster bus, the way the reference's six
+binaries + Helm chart assemble the running system (SURVEY.md §3.5): quota
+webhooks + reconciler (operator), the quota/topology-aware scheduler, one
+partitioner controller per enabled mode, and node agents with health
+monitors. Components are individually constructible (each CLI binary runs
+one); ControlPlane runs them all in-process — the single-binary dev/test
+deployment.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.api.webhooks import install_quota_webhooks
+from nos_tpu.cluster.client import Cluster
+from nos_tpu.config import AgentConfig, OperatorConfig, PartitionerConfig, SchedulerConfig
+from nos_tpu.controllers.gpu_agent import (
+    FakeGpuDeviceClient,
+    GpuAgent,
+    mig_validator,
+    mps_validator,
+)
+from nos_tpu.controllers.health import DeviceHealthMonitor
+from nos_tpu.controllers.partitioner import PartitionerController
+from nos_tpu.controllers.quota import QuotaReconciler
+from nos_tpu.controllers.tpu_agent import TpuAgent
+from nos_tpu.gpu.mig import MigProfile
+from nos_tpu.gpu.mps import MpsProfile
+from nos_tpu.observability import HealthManager, Metrics, metrics, setup_logging
+from nos_tpu.partitioning.gpu_modes import (
+    MigPartitioner,
+    MigSnapshotTaker,
+    MpsPartitioner,
+    MpsSnapshotTaker,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.tpu_mode import TpuPartitioner, TpuSnapshotTaker
+from nos_tpu.scheduler.resource_calculator import ResourceCalculator
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.tpu import Topology
+from nos_tpu.tpulib import FakeTpuClient
+
+logger = logging.getLogger(__name__)
+
+
+class SchedulerSim:
+    """The embedded-framework simulation seam for the planner
+    (cmd/gpupartitioner/gpupartitioner.go:293-317 analog)."""
+
+    def __init__(self, scheduler: Scheduler):
+        self._scheduler = scheduler
+        self._state = None
+
+    def pre_filter(self, pod) -> bool:
+        from nos_tpu.scheduler.framework import CycleState
+
+        self._state = CycleState()
+        self._scheduler.capacity.refresh_from_cluster(self._scheduler.cluster)
+        return self._scheduler.framework.run_pre_filter(self._state, pod).is_success
+
+    def filter(self, pod, node_info) -> bool:
+        return self._scheduler.framework.run_filters(self._state, pod, node_info).is_success
+
+
+def build_scheduler(cluster: Cluster, config: Optional[SchedulerConfig] = None) -> Scheduler:
+    config = config or SchedulerConfig()
+    calculator = ResourceCalculator(
+        tpu_chip_memory_gb=config.tpu_chip_memory_gb,
+        nvidia_gpu_memory_gb=config.nvidia_gpu_memory_gb,
+    )
+    return Scheduler(cluster, calculator=calculator, scheduler_name=config.scheduler_name)
+
+
+def build_partitioner_controllers(
+    cluster: Cluster,
+    state: ClusterState,
+    scheduler: Scheduler,
+    config: Optional[PartitionerConfig] = None,
+    now=None,
+) -> Dict[str, PartitionerController]:
+    config = config or PartitionerConfig()
+    config.apply_mig_overrides()
+    sim = SchedulerSim(scheduler)
+    controllers: Dict[str, PartitionerController] = {}
+    mode_wiring = {
+        constants.KIND_TPU: (TpuSnapshotTaker(), TpuPartitioner(cluster)),
+        constants.KIND_MIG: (MigSnapshotTaker(), MigPartitioner(cluster)),
+        constants.KIND_MPS: (
+            MpsSnapshotTaker(),
+            MpsPartitioner(
+                cluster,
+                cm_name=config.device_plugin_cm_name,
+                cm_namespace=config.device_plugin_cm_namespace,
+            ),
+        ),
+    }
+    for mode in config.modes:
+        taker, partitioner = mode_wiring[mode]
+        controllers[mode] = PartitionerController(
+            cluster=cluster,
+            state=state,
+            kind=mode,
+            snapshot_taker=taker,
+            partitioner=partitioner,
+            sim_scheduler=sim,
+            batch_timeout_s=config.batch_window_timeout_s,
+            batch_idle_s=config.batch_window_idle_s,
+            now=now,
+        )
+    return controllers
+
+
+def build_tpu_agent(
+    cluster: Cluster,
+    node_name: str,
+    config: Optional[AgentConfig] = None,
+    client=None,
+) -> TpuAgent:
+    """Node agent with the best available device backend: native tpuslice if
+    it builds, else the pure-Python fake (the build-tag seam)."""
+    config = config or AgentConfig()
+    if client is None:
+        node = cluster.get("Node", "", node_name)
+        topology = Topology.from_node_labels(node.metadata.labels)
+        if topology is None:
+            raise ValueError(f"node {node_name} has no TPU topology labels")
+        client = None
+        if config.use_native_tpulib:
+            try:
+                from nos_tpu.tpulib.native_client import NativeTpuClient
+
+                client = NativeTpuClient(topology)
+            except Exception:  # noqa: BLE001
+                logger.warning("native tpuslice unavailable; using fake backend")
+        if client is None:
+            client = FakeTpuClient(topology)
+    return TpuAgent(cluster, node_name, client)
+
+
+class ControlPlane:
+    """Everything in one process over one cluster bus."""
+
+    def __init__(
+        self,
+        cluster: Optional[Cluster] = None,
+        operator_config: Optional[OperatorConfig] = None,
+        partitioner_config: Optional[PartitionerConfig] = None,
+        scheduler_config: Optional[SchedulerConfig] = None,
+        now=None,
+    ):
+        self.cluster = cluster or Cluster()
+        self.health = HealthManager()
+        install_quota_webhooks(self.cluster)
+        op_cfg = operator_config or OperatorConfig()
+        calculator = ResourceCalculator(
+            tpu_chip_memory_gb=op_cfg.tpu_chip_memory_gb,
+            nvidia_gpu_memory_gb=op_cfg.nvidia_gpu_memory_gb,
+        )
+        self.quota_reconciler = QuotaReconciler(self.cluster, calculator)
+        self.state = ClusterState()
+        self.scheduler = build_scheduler(self.cluster, scheduler_config)
+        self.partitioners = build_partitioner_controllers(
+            self.cluster, self.state, self.scheduler, partitioner_config, now=now
+        )
+        self.agents: Dict[str, TpuAgent] = {}
+        self.monitors: List[DeviceHealthMonitor] = []
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.health.add_healthz("cluster", lambda: None)
+        self.health.add_readyz("state", lambda: None)
+
+    def add_tpu_agent(self, node_name: str, client=None, config=None) -> TpuAgent:
+        agent = build_tpu_agent(self.cluster, node_name, config, client)
+        agent.startup()
+        agent.start_watching()
+        monitor = DeviceHealthMonitor(self.cluster, node_name, agent.client)
+        self.monitors.append(monitor)
+        self.agents[node_name] = agent
+        return agent
+
+    def start(self) -> "ControlPlane":
+        self.state.start_watching(self.cluster)
+        self.quota_reconciler.start_watching()
+        for controller in self.partitioners.values():
+            controller.start_watching()
+        return self
+
+    def tick(self) -> dict:
+        """One synchronous control round (deterministic driving for tests and
+        the single-process dev runtime)."""
+        result = self.scheduler.schedule_pending()
+        for controller in self.partitioners.values():
+            if controller.process_batch_if_ready():
+                metrics.inc("nos_tpu_partitioning_cycles", kind=controller.kind)
+        result_after = self.scheduler.schedule_pending()
+        return {"first_pass": result, "second_pass": result_after}
+
+    def run(self, interval_s: float = 1.0) -> None:
+        """Threaded runtime: periodic scheduling + partitioning + monitors."""
+        for monitor in self.monitors:
+            monitor.start()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001
+                    logger.exception("control plane tick failed")
+                self._stop.wait(interval_s)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for monitor in self.monitors:
+            monitor.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+def build_gpu_agent(cluster: Cluster, node_name: str, mode: str, gpu_count: int, model_or_memory) -> GpuAgent:
+    """MIG/MPS node agent over the fake device layer (real NVML/CUDA-MPS
+    backends would slot in behind the same client interface)."""
+    if mode == constants.KIND_MIG:
+        client = FakeGpuDeviceClient(gpu_count, mig_validator(model_or_memory))
+        return GpuAgent(cluster, node_name, client)
+    client = FakeGpuDeviceClient(gpu_count, mps_validator(int(model_or_memory)))
+    return GpuAgent(
+        cluster,
+        node_name,
+        client,
+        parse_profile=MpsProfile.from_resource,
+        resource_of=lambda p: f"nvidia.com/gpu-{p}",
+    )

@@ -1,0 +1,72 @@
+"""One-shot install telemetry (the metricsexporter analog).
+
+Mirrors cmd/metricsexporter (metricsexporter.go:33-91, metrics/metrics.go:24-42):
+collect anonymous cluster facts (node/accelerator counts, component versions)
+and POST them once at install time. Opt-in via `share_telemetry`; the sink is
+injectable (and defaults to a no-op logger in zero-egress environments).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Dict, Optional
+
+import nos_tpu
+from nos_tpu import constants
+from nos_tpu.cluster.client import Cluster
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ClusterReport:
+    version: str = nos_tpu.__version__
+    node_count: int = 0
+    tpu_nodes: int = 0
+    gpu_nodes: int = 0
+    tpu_chips: int = 0
+    partitioning_modes: Dict[str, int] = field(default_factory=dict)
+    elastic_quotas: int = 0
+    composite_quotas: int = 0
+
+
+def collect(cluster: Cluster) -> ClusterReport:
+    report = ClusterReport()
+    for node in cluster.list("Node"):
+        report.node_count += 1
+        labels = node.metadata.labels
+        if constants.LABEL_TPU_ACCELERATOR in labels:
+            report.tpu_nodes += 1
+            report.tpu_chips += int(
+                node.status.allocatable.get(constants.RESOURCE_TPU, 0)
+            )
+        if constants.LABEL_GPU_PRODUCT in labels:
+            report.gpu_nodes += 1
+        mode = labels.get(constants.LABEL_PARTITIONING)
+        if mode:
+            report.partitioning_modes[mode] = report.partitioning_modes.get(mode, 0) + 1
+    report.elastic_quotas = len(cluster.list("ElasticQuota"))
+    report.composite_quotas = len(cluster.list("CompositeElasticQuota"))
+    return report
+
+
+def export(
+    cluster: Cluster,
+    share_telemetry: bool = False,
+    sink: Optional[Callable[[str], None]] = None,
+) -> Optional[ClusterReport]:
+    """Collect and (when opted in) ship the report. Returns the report, or
+    None when telemetry is disabled."""
+    if not share_telemetry:
+        logger.debug("telemetry disabled (share_telemetry=false)")
+        return None
+    report = collect(cluster)
+    payload = json.dumps(asdict(report), sort_keys=True)
+    if sink is None:
+        # Zero-egress default: log instead of POSTing.
+        logger.info("telemetry report: %s", payload)
+    else:
+        sink(payload)
+    return report

@@ -1,0 +1,190 @@
+"""Operational layer tests: configs, observability, health monitor,
+telemetry, full control-plane assembly, CLI demo."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from nos_tpu.api.quota_types import build_eq
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster import Cluster
+from nos_tpu.config import (
+    ConfigError,
+    OperatorConfig,
+    PartitionerConfig,
+    load_config,
+)
+from nos_tpu.controllers.health import (
+    LABEL_DEVICE_HEALTH,
+    UNHEALTHY,
+    DeviceHealthMonitor,
+    is_node_device_healthy,
+)
+from nos_tpu.observability import HealthManager, Metrics, ObservabilityServer
+from nos_tpu.system import ControlPlane
+from nos_tpu.telemetry import collect, export
+from nos_tpu.tpu import Topology
+from nos_tpu.tpulib import FakeTpuClient
+
+
+def tpu_node(name="tpu-node-0", topo="4x4"):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_PARTITIONING: constants.KIND_TPU,
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: topo,
+            },
+        ),
+        status=NodeStatus(allocatable=ResourceList.of({"cpu": 64, "google.com/tpu": 16})),
+    )
+
+
+# -- config ------------------------------------------------------------------
+def test_config_defaults_and_validation():
+    cfg = load_config(PartitionerConfig)
+    assert cfg.batch_window_timeout_s == 60
+    bad = PartitionerConfig(batch_window_idle_s=120)
+    with pytest.raises(ConfigError):
+        bad.validate()
+    with pytest.raises(ConfigError):
+        PartitionerConfig(modes=["tpu", "bogus"]).validate()
+
+
+def test_config_file_loading_rejects_unknown_keys(tmp_path):
+    good = tmp_path / "cfg.json"
+    good.write_text(json.dumps({"tpu_chip_memory_gb": 32, "manager": {"log_level": "DEBUG"}}))
+    cfg = load_config(OperatorConfig, str(good))
+    assert cfg.tpu_chip_memory_gb == 32 and cfg.manager.log_level == "DEBUG"
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"tpu_chips_memory_gb": 32}))
+    with pytest.raises(ConfigError):
+        load_config(OperatorConfig, str(bad))
+
+
+# -- observability -----------------------------------------------------------
+def test_metrics_registry_and_render():
+    m = Metrics()
+    m.inc("cycles", kind="tpu")
+    m.inc("cycles", kind="tpu")
+    m.set_gauge("capacity", 16, node="n1")
+    with m.time("plan"):
+        pass
+    text = m.render()
+    assert 'cycles_total{kind="tpu"} 2' in text
+    assert 'capacity{node="n1"} 16' in text
+    assert "plan_seconds_count 1" in text
+    assert m.get("cycles", kind="tpu") == 2
+
+
+def test_observability_http_endpoints():
+    m = Metrics()
+    m.inc("requests")
+    health = HealthManager()
+    health.add_healthz("always-ok", lambda: None)
+    health.add_readyz("not-ready", lambda: "warming up")
+    server = ObservabilityServer(m, health, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "requests_total 1" in body
+        assert urllib.request.urlopen(f"{base}/healthz").status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/readyz")
+        assert exc.value.code == 500
+    finally:
+        server.stop()
+
+
+# -- health monitor ----------------------------------------------------------
+def test_health_monitor_cordons_and_recovers():
+    cluster = Cluster()
+    cluster.create(tpu_node())
+    client = FakeTpuClient(Topology.parse("v5e", "4x4"))
+    monitor = DeviceHealthMonitor(cluster, "tpu-node-0", client)
+
+    assert monitor.check_once() is None
+    assert is_node_device_healthy(cluster.get("Node", "", "tpu-node-0"))
+
+    client.set_healthy(False)
+    assert monitor.check_once() is not None
+    node = cluster.get("Node", "", "tpu-node-0")
+    assert node.metadata.labels[LABEL_DEVICE_HEALTH] == UNHEALTHY
+    assert not is_node_device_healthy(node)
+
+    # Planner skips the unhealthy node entirely.
+    from nos_tpu.partitioning.state import ClusterState
+    from nos_tpu.partitioning.tpu_mode import TpuSnapshotTaker
+
+    state = ClusterState()
+    state.start_watching(cluster)
+    snap = TpuSnapshotTaker().take_snapshot(state)
+    assert snap.nodes == {}
+
+    client.set_healthy(True)
+    monitor.check_once()
+    assert is_node_device_healthy(cluster.get("Node", "", "tpu-node-0"))
+
+
+# -- telemetry ---------------------------------------------------------------
+def test_telemetry_collect_and_optin():
+    cluster = Cluster()
+    cluster.create(tpu_node())
+    cluster.create(build_eq("ns-a", "q", min={"cpu": 1}))
+    assert export(cluster, share_telemetry=False) is None
+    sent = []
+    report = export(cluster, share_telemetry=True, sink=sent.append)
+    assert report.tpu_nodes == 1 and report.tpu_chips == 16
+    assert report.elastic_quotas == 1
+    assert sent and json.loads(sent[0])["node_count"] == 1
+
+
+# -- full control plane ------------------------------------------------------
+def test_control_plane_end_to_end():
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    plane = ControlPlane(now=clock).start()
+    plane.cluster.create(tpu_node())
+    plane.add_tpu_agent("tpu-node-0", client=FakeTpuClient(Topology.parse("v5e", "4x4")))
+    plane.cluster.create(build_eq("ml", "q", min={constants.RESOURCE_ACCELERATOR_MEMORY: 128}))
+
+    pod = Pod(
+        metadata=ObjectMeta(name="job", namespace="ml"),
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceList.of({"google.com/tpu-2x2": 1, "cpu": 1}))
+            ],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+    plane.cluster.create(pod)
+    plane.scheduler.schedule_pending()
+    clock.t += 61
+    result = plane.tick()
+    bound = plane.cluster.get("Pod", "ml", "job")
+    assert bound.spec.node_name == "tpu-node-0"
+    # Quota reconciler labeled the now-running pod.
+    assert bound.metadata.labels.get(constants.LABEL_CAPACITY) == constants.CAPACITY_IN_QUOTA
+    plane.stop()
+
+
+def test_cli_demo_exits_zero():
+    from nos_tpu.cli import main
+
+    assert main(["demo"]) == 0
+
+
+def test_cli_telemetry():
+    from nos_tpu.cli import main
+
+    assert main(["telemetry", "--share"]) == 0
