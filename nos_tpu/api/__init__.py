@@ -6,6 +6,8 @@ from nos_tpu.api.objects import (  # noqa: F401
     Node,
     ObjectMeta,
     Pod,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
     PodPhase,
     PodSpec,
     PodStatus,

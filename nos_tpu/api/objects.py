@@ -128,3 +128,45 @@ class ConfigMap:
 
     def deepcopy(self) -> "ConfigMap":
         return copy.deepcopy(self)
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    """Exactly one of min_available / max_unavailable is meaningful (k8s
+    policy/v1 semantics); selector matches pod labels within the namespace."""
+
+    selector: Dict[str, str] = field(default_factory=dict)
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    """The preemption reprieve loop consults these: evicting a victim whose
+    budget is exhausted counts as a PDB violation, and candidate nodes are
+    ranked fewest-violations-first (the vendored preemption.Evaluator the
+    reference runs in PostFilter, capacity_scheduling.go:323-341)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
+
+    KIND = "PodDisruptionBudget"
+
+    def deepcopy(self) -> "PodDisruptionBudget":
+        return copy.deepcopy(self)
+
+    def matches(self, pod: Pod) -> bool:
+        # policy/v1 semantics: an empty selector selects every pod in the
+        # namespace.
+        return pod.metadata.namespace == self.metadata.namespace and all(
+            pod.metadata.labels.get(k) == v for k, v in self.spec.selector.items()
+        )

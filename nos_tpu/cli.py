@@ -66,10 +66,11 @@ def cmd_scheduler(args) -> int:
     scheduler = build_scheduler(Cluster(), cfg)
     _obs(cfg.manager)
     print(f"scheduler '{cfg.scheduler_name}' running; ctrl-c to exit")
-    while not args.once:
+    while True:
         scheduler.schedule_pending()
+        if args.once:
+            return 0
         time.sleep(1.0)
-    return 0
 
 
 def cmd_partitioner(args) -> int:
@@ -88,11 +89,12 @@ def cmd_partitioner(args) -> int:
         controller.start_watching()
     _obs(cfg.manager)
     print(f"partitioner running for modes {cfg.modes}; ctrl-c to exit")
-    while not args.once:
+    while True:
         for controller in controllers.values():
             controller.process_batch_if_ready()
+        if args.once:
+            return 0
         time.sleep(1.0)
-    return 0
 
 
 def cmd_tpu_agent(args) -> int:
@@ -111,10 +113,11 @@ def cmd_tpu_agent(args) -> int:
     agent.start_watching()
     _obs(cfg.manager)
     print(f"tpu-agent for node {node_name} running; ctrl-c to exit")
-    while not args.once:
+    while True:
         agent.report()
+        if args.once:
+            return 0
         time.sleep(cfg.report_interval_s)
-    return 0
 
 
 def cmd_gpu_agent(args) -> int:
@@ -135,10 +138,11 @@ def cmd_gpu_agent(args) -> int:
     agent.start_watching()
     _obs(cfg.manager)
     print(f"{args.mode}-agent for node {node_name} running; ctrl-c to exit")
-    while not args.once:
+    while True:
         agent.report()
+        if args.once:
+            return 0
         time.sleep(cfg.report_interval_s)
-    return 0
 
 
 def cmd_telemetry(args) -> int:

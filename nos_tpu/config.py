@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import typing
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -106,17 +107,18 @@ def _from_dict(cls, data: dict):
     unknown keys (config typos fail fast)."""
     if not dataclasses.is_dataclass(cls):
         return data
+    # PEP 563 (`from __future__ import annotations`) makes f.type a string;
+    # resolve real types so nested dataclasses recurse with validation.
+    hints = typing.get_type_hints(cls)
     names = {f.name: f for f in dataclasses.fields(cls)}
     unknown = set(data) - set(names)
     if unknown:
         raise ConfigError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
     kwargs = {}
     for key, value in data.items():
-        f = names[key]
-        if dataclasses.is_dataclass(f.type) or f.type in (ManagerConfig,):
-            kwargs[key] = _from_dict(f.type, value)
-        elif f.name == "manager" and isinstance(value, dict):
-            kwargs[key] = _from_dict(ManagerConfig, value)
+        ftype = hints.get(key)
+        if dataclasses.is_dataclass(ftype) and isinstance(value, dict):
+            kwargs[key] = _from_dict(ftype, value)
         else:
             kwargs[key] = value
     return cls(**kwargs)

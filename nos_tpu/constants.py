@@ -108,6 +108,11 @@ PLAN_REPORT_REQUEUE_S = 10.0
 DEFAULT_DEVICE_PLUGIN_CM_NAME = "nvidia-device-plugin-configs"
 DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE = "kube-system"
 DEFAULT_DEVICE_PLUGIN_DELAY_S = 5.0
+# Device-plugin DaemonSet pod identification + restart poll bounds
+# (reference gpu/client.go:37-132).
+DEVICE_PLUGIN_POD_LABEL = "name"
+DEVICE_PLUGIN_POD_LABEL_VALUE = "nvidia-device-plugin-ds"
+DEFAULT_DEVICE_PLUGIN_RESTART_TIMEOUT_S = 60.0
 
 # Scheduler name used by pods that want quota-aware scheduling.
 SCHEDULER_NAME = "nos-tpu-scheduler"

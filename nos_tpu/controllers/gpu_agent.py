@@ -130,12 +130,14 @@ class GpuAgent:
         client: FakeGpuDeviceClient,
         parse_profile: Callable[[str], Optional[object]] = MigProfile.from_resource,
         resource_of: Callable[[str], str] = lambda p: f"{constants.RESOURCE_MIG_PREFIX}{p}",
+        plugin_client: Optional[object] = None,
     ):
         self.cluster = cluster
         self.node_name = node_name
         self.client = client
         self.parse_profile = parse_profile
         self.resource_of = resource_of
+        self.plugin_client = plugin_client
         self.shared = SharedState()
         self._unsub = None
 
@@ -193,42 +195,109 @@ class GpuAgent:
             if s.quantity > 0:
                 desired[(s.device_index, s.profile)] = s.quantity
         self.sync_usage_from_pods()
+        changed = False
         try:
-            self._apply(desired)
+            changed = self._apply(desired)
         except TpuLibError:
             logger.exception("gpuagent %s: apply failed; reporting actual state", self.node_name)
+        if changed and self.plugin_client is not None:
+            # Force the device plugin to re-register the new device set with
+            # the kubelet (migagent actuator.go:205-209 restart path).
+            # reconcile runs inside a Node watch dispatch (bus lock held), so
+            # any waiting must happen off-thread.
+            try:
+                self.plugin_client.restart(self.node_name, wait="background")
+            except Exception:  # noqa: BLE001
+                logger.exception("gpuagent %s: device-plugin restart failed", self.node_name)
         self.shared.on_apply()
         self.report()
 
-    def _apply(self, desired: Dict[Tuple[int, str], int]) -> None:
+    def _apply(self, desired: Dict[Tuple[int, str], int]) -> bool:
+        """Diff-apply the desired geometry; returns True if any device was
+        created or deleted (the device plugin must then re-register).
+
+        Per GPU: delete surplus free devices (never used ones), then create
+        the missing profiles. Device creation can be order-sensitive (MIG
+        placement constraints), so when creating we (a) also delete + recreate
+        the GPU's surviving *free* devices to widen the space of valid
+        creation orders (plan/plan.go:94-109 extractResourcesToRecreate) and
+        (b) try bounded distinct permutations of the creation order with
+        cleanup between attempts (nvml/client.go:225-340)."""
+        changed = False
         current: Dict[Tuple[int, str], List[GpuDevice]] = {}
         for d in self.client.list_devices():
             current.setdefault((d.gpu_index, d.profile), []).append(d)
-        # Delete surplus (free first, never used).
-        for key, devices in current.items():
-            surplus = len(devices) - desired.get(key, 0)
-            free = [d for d in devices if not d.in_use]
-            for d in free[:surplus]:
-                self.client.delete_device(d.device_id)
-        # Create missing, largest profiles first per GPU.
-        for (gpu_index, profile), want in sorted(
-            desired.items(), key=lambda kv: (kv[0][0], kv[0][1])
-        ):
-            have = sum(
-                1
-                for d in self.client.list_devices()
-                if d.gpu_index == gpu_index and d.profile == profile
-            )
-            for _ in range(max(0, want - have)):
-                try:
-                    self.client.create_device(gpu_index, profile)
-                except TpuLibError:
-                    logger.exception(
-                        "gpuagent %s: create %s on gpu %d failed (partial apply)",
-                        self.node_name,
-                        profile,
-                        gpu_index,
-                    )
+        gpu_indices = sorted(
+            {gi for gi, _ in current} | {gi for gi, _ in desired}
+        )
+        for gpu_index in gpu_indices:
+            # Delete surplus (free first, never used).
+            for (gi, profile), devices in sorted(current.items()):
+                if gi != gpu_index:
+                    continue
+                surplus = len(devices) - desired.get((gi, profile), 0)
+                free = [d for d in devices if not d.in_use]
+                for d in free[: max(0, surplus)]:
+                    self.client.delete_device(d.device_id)
+                    changed = True
+            # Creates still missing on this GPU.
+            have: Dict[str, int] = {}
+            for d in self.client.list_devices():
+                if d.gpu_index == gpu_index:
+                    have[d.profile] = have.get(d.profile, 0) + 1
+            creates: List[str] = []
+            for (gi, profile), want in sorted(desired.items()):
+                if gi == gpu_index:
+                    creates.extend([profile] * max(0, want - have.get(profile, 0)))
+            if not creates:
+                continue
+            # Recreate surviving free devices alongside the new ones.
+            for d in self.client.list_devices():
+                if d.gpu_index == gpu_index and not d.in_use:
+                    self.client.delete_device(d.device_id)
+                    creates.append(d.profile)
+                    changed = True
+            changed |= self._create_with_permutations(gpu_index, creates)
+        return changed
+
+    MAX_CREATE_PERMUTATIONS = 20  # nvml/client.go:286-331 attempt bound
+
+    def _create_with_permutations(self, gpu_index: int, creates: List[str]) -> bool:
+        """Create `creates` on the GPU, retrying distinct creation orders with
+        cleanup on failure; falls back to best-effort partial creation."""
+        from nos_tpu.util import distinct_permutations
+
+        for attempt, order in enumerate(distinct_permutations(creates)):
+            if attempt >= self.MAX_CREATE_PERMUTATIONS:
+                break
+            made: List[GpuDevice] = []
+            try:
+                for profile in order:
+                    made.append(self.client.create_device(gpu_index, profile))
+                return True
+            except TpuLibError:
+                for d in made:
+                    try:
+                        self.client.delete_device(d.device_id)
+                    except TpuLibError:
+                        logger.exception(
+                            "gpuagent %s: cleanup of %s failed", self.node_name, d.device_id
+                        )
+        # No full ordering worked: apply partially (the reference's plan-level
+        # partial apply; the reporter will publish the actual state).
+        any_created = False
+        for profile in sorted(creates, reverse=True):
+            try:
+                self.client.create_device(gpu_index, profile)
+                any_created = True
+            except TpuLibError:
+                logger.warning(
+                    "gpuagent %s: create %s on gpu %d failed (partial apply)",
+                    self.node_name,
+                    profile,
+                    gpu_index,
+                )
+        return any_created
 
     # -- reporter ------------------------------------------------------------
     def report(self) -> None:

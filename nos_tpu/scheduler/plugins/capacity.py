@@ -20,7 +20,7 @@ import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
 from nos_tpu import constants
-from nos_tpu.api.objects import Pod
+from nos_tpu.api.objects import Pod, PodDisruptionBudget, PodPhase
 from nos_tpu.api.resources import ResourceList
 from nos_tpu.partitioning.core.interface import NodeInfo
 from nos_tpu.scheduler.framework import (
@@ -53,6 +53,7 @@ class CapacityScheduling(PreFilterPlugin, ReservePlugin, PostFilterPlugin):
         self.evict_fn = evict_fn
         self.framework = None  # injected by the Scheduler for reprieve checks
         self.nominated_pods: List[Pod] = []
+        self.pdbs: List[PodDisruptionBudget] = []
 
     # -- live state ----------------------------------------------------------
     def refresh_from_cluster(self, cluster) -> None:
@@ -64,13 +65,34 @@ class CapacityScheduling(PreFilterPlugin, ReservePlugin, PostFilterPlugin):
         )
         for info in infos:
             info.used = ResourceList()
+        active = []
         for pod in cluster.list("Pod"):
             if not podutil.is_active(pod):
                 continue
+            active.append(pod)
             info = infos.for_namespace(pod.metadata.namespace)
             if info is not None:
                 info.add_used(self.calculator.compute_pod_request(pod))
         self.infos = infos
+        self.pdbs = cluster.list("PodDisruptionBudget")
+        for pdb in self.pdbs:
+            # currentHealthy counts only ready pods: scheduled-but-Pending
+            # pods must not inflate the disruption budget.
+            healthy = sum(
+                1
+                for p in active
+                if pdb.matches(p) and p.status.phase == PodPhase.RUNNING
+            )
+            if pdb.spec.min_available is not None:
+                desired = pdb.spec.min_available
+            elif pdb.spec.max_unavailable is not None:
+                desired = max(0, healthy - pdb.spec.max_unavailable)
+            else:
+                desired = 0
+            pdb.status.current_healthy = healthy
+            pdb.status.desired_healthy = desired
+            pdb.status.expected_pods = healthy
+            pdb.status.disruptions_allowed = max(0, healthy - desired)
 
     # -- PreFilter -----------------------------------------------------------
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
@@ -126,23 +148,25 @@ class CapacityScheduling(PreFilterPlugin, ReservePlugin, PostFilterPlugin):
     ) -> Tuple[Optional[str], Status]:
         if not self._eligible_to_preempt(pod):
             return None, Status.unschedulable("pod not eligible to preempt")
-        candidates: Dict[str, List[Pod]] = {}
+        candidates: Dict[str, Tuple[List[Pod], int]] = {}
         for node in nodes:
-            victims = self._select_victims_on_node(state, pod, node)
-            if victims is not None:
-                candidates[node.name] = victims
+            selected = self._select_victims_on_node(state, pod, node)
+            if selected is not None:
+                candidates[node.name] = selected
         if not candidates:
             return None, Status.unschedulable("preemption: no node yields victims")
-        # Fewest victims, then lowest max victim priority, then node name.
+        # Fewest PDB violations, then fewest victims, then lowest max victim
+        # priority, then node name (preemption.Evaluator candidate ordering).
         def rank(item):
-            name, victims = item
+            name, (victims, violations) = item
             return (
+                violations,
                 len(victims),
                 max((v.spec.priority for v in victims), default=0),
                 name,
             )
 
-        node_name, victims = min(candidates.items(), key=rank)
+        node_name, (victims, _) = min(candidates.items(), key=rank)
         for victim in victims:
             logger.info(
                 "preempting %s to make room for %s on %s",
@@ -163,8 +187,9 @@ class CapacityScheduling(PreFilterPlugin, ReservePlugin, PostFilterPlugin):
 
     def _select_victims_on_node(
         self, state: CycleState, pod: Pod, node: NodeInfo
-    ) -> Optional[List[Pod]]:
-        """SelectVictimsOnNode analog (:468-675). Returns victims or None."""
+    ) -> Optional[Tuple[List[Pod], int]]:
+        """SelectVictimsOnNode analog (:468-675). Returns (victims, number of
+        PDB violations among them) or None."""
         request: ResourceList = state.get(STATE_REQUEST)
         base: ElasticQuotaInfos = state.get(STATE_SNAPSHOT)
         if request is None or base is None:
@@ -225,19 +250,38 @@ class CapacityScheduling(PreFilterPlugin, ReservePlugin, PostFilterPlugin):
         if not self._feasible(state, pod, sim, snapshot, request):
             return None
 
-        # Reprieve: re-add victims (highest priority first, over-quota last)
-        # while the pod still fits (:610-673).
+        # Split candidates by whether evicting them would violate a
+        # PodDisruptionBudget (dynamic budget walk, preemption's
+        # filterPodsWithPDBViolation), then reprieve — violating pods first so
+        # they are spared whenever the pod fits without them, then the rest
+        # highest priority first with over-quota borrowers last (:610-673).
+        ordered = sorted(
+            candidates, key=lambda p: (podutil.is_over_quota(p), -p.spec.priority)
+        )
+        budget = {pdb.metadata.uid: pdb.status.disruptions_allowed for pdb in self.pdbs}
+        violating, non_violating = [], []
+        for p in ordered:
+            matching = [pdb for pdb in self.pdbs if pdb.matches(p)]
+            if any(budget[pdb.metadata.uid] <= 0 for pdb in matching):
+                violating.append(p)
+                continue
+            for pdb in matching:
+                budget[pdb.metadata.uid] -= 1
+            non_violating.append(p)
+
         victims: List[Pod] = []
-        for victim in sorted(
-            candidates,
-            key=lambda p: (podutil.is_over_quota(p), -p.spec.priority),
-        ):
+        violations = 0
+        for victim in violating + non_violating:
             self._sim_add(sim, snapshot, victim)
             if self._feasible(state, pod, sim, snapshot, request):
                 continue  # victim reprieved
             self._sim_remove(sim, snapshot, victim)
             victims.append(victim)
-        return victims or None
+            if victim in violating:
+                violations += 1
+        if not victims:
+            return None
+        return victims, violations
 
     # -- helpers -------------------------------------------------------------
     def _sim_remove(self, sim: NodeInfo, snapshot: ElasticQuotaInfos, victim: Pod) -> None:

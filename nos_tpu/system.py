@@ -225,12 +225,27 @@ class ControlPlane:
             t.join(timeout=5)
 
 
-def build_gpu_agent(cluster: Cluster, node_name: str, mode: str, gpu_count: int, model_or_memory) -> GpuAgent:
+def build_gpu_agent(
+    cluster: Cluster,
+    node_name: str,
+    mode: str,
+    gpu_count: int,
+    model_or_memory,
+    with_fake_device_plugin: bool = True,
+) -> GpuAgent:
     """MIG/MPS node agent over the fake device layer (real NVML/CUDA-MPS
-    backends would slot in behind the same client interface)."""
+    backends would slot in behind the same client interface). By default a
+    fake device-plugin DaemonSet (one per cluster bus) backs the post-apply
+    plugin restart; pass with_fake_device_plugin=False when a real DaemonSet
+    manages the plugin pods."""
+    from nos_tpu.gpu.device_plugin import DevicePluginClient, ensure_fake_daemonset
+
+    if with_fake_device_plugin:
+        ensure_fake_daemonset(cluster).ensure_pod(node_name)
+    plugin_client = DevicePluginClient(cluster)
     if mode == constants.KIND_MIG:
         client = FakeGpuDeviceClient(gpu_count, mig_validator(model_or_memory))
-        return GpuAgent(cluster, node_name, client)
+        return GpuAgent(cluster, node_name, client, plugin_client=plugin_client)
     client = FakeGpuDeviceClient(gpu_count, mps_validator(int(model_or_memory)))
     return GpuAgent(
         cluster,
@@ -238,4 +253,5 @@ def build_gpu_agent(cluster: Cluster, node_name: str, mode: str, gpu_count: int,
         client,
         parse_profile=MpsProfile.from_resource,
         resource_of=lambda p: f"nvidia.com/gpu-{p}",
+        plugin_client=plugin_client,
     )

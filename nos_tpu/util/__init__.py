@@ -1,1 +1,31 @@
 """Generic utilities: batching, pod predicates, small generics."""
+
+from typing import Iterator, List, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def distinct_permutations(items: Sequence[T]) -> Iterator[List[T]]:
+    """Lazily yield the distinct permutations of a multiset in lexicographic
+    order (pkg/util IterPermutations analog; same next-permutation walk as the
+    native tpuslice shim). Duplicates collapse, so ['a','a','b'] yields 3
+    orders, not 6."""
+    seq = sorted(items)
+    n = len(seq)
+    if n == 0:
+        yield []
+        return
+    while True:
+        yield list(seq)
+        # Standard next_permutation: find the rightmost ascent, pivot-swap,
+        # reverse the suffix; stop once fully descending.
+        i = n - 2
+        while i >= 0 and seq[i] >= seq[i + 1]:
+            i -= 1
+        if i < 0:
+            return
+        j = n - 1
+        while seq[j] <= seq[i]:
+            j -= 1
+        seq[i], seq[j] = seq[j], seq[i]
+        seq[i + 1 :] = reversed(seq[i + 1 :])

@@ -287,3 +287,107 @@ def test_mps_end_to_end_configmap_and_label():
     # Handshake completed by the agent and allocatable refreshed.
     assert ann.node_reported_last_plan(node.metadata.annotations)
     assert node.status.allocatable.get("nvidia.com/gpu-10gb", 0) >= 2
+
+
+def test_device_plugin_restart_after_geometry_change():
+    from nos_tpu.gpu.device_plugin import (
+        DevicePluginClient,
+        FakeDevicePluginDaemonSet,
+        RestartTimeoutError,
+    )
+
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    clock = FakeClock()
+    mig_node(cluster, gpus=1)
+
+    ds = FakeDevicePluginDaemonSet(cluster).start()
+    ds.ensure_pod("gpu-node-0")
+    old_pod = cluster.list(
+        "Pod", namespace=constants.DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE
+    )[0]
+
+    client = FakeGpuDeviceClient(1, mig_validator(A100_40))
+    agent = GpuAgent(
+        cluster, "gpu-node-0", client, plugin_client=DevicePluginClient(cluster)
+    )
+    agent.startup()
+    agent.start_watching()
+    controller = make_controller(
+        cluster, state, constants.KIND_MIG, MigSnapshotTaker(), MigPartitioner(cluster), clock
+    )
+    cluster.create(unschedulable_pod("p", {"nvidia.com/mig-1g.5gb": 1}))
+    clock.advance(11)
+    assert controller.process_batch_if_ready()
+
+    # Geometry changed -> the plugin pod was deleted and a replacement
+    # (new uid) recreated by the DaemonSet simulator, already Running.
+    pods = cluster.list("Pod", namespace=constants.DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE)
+    assert len(pods) == 1
+    assert pods[0].metadata.uid != old_pod.metadata.uid
+    assert pods[0].status.phase == PodPhase.RUNNING
+
+    # Without a DaemonSet recreating the pod, restart times out.
+    ds.stop()
+    fake_time = {"t": 0.0}
+    restarter = DevicePluginClient(
+        cluster,
+        timeout_s=1.0,
+        now=lambda: fake_time["t"],
+        sleep=lambda dt: fake_time.__setitem__("t", fake_time["t"] + dt),
+    )
+    with pytest.raises(RestartTimeoutError):
+        restarter.restart("gpu-node-0")
+
+
+def test_permutation_search_handles_order_sensitive_creation():
+    """Placement-constrained device creation (MIG's NVML behavior): this fake
+    rejects creating a profile larger than any profile already present on the
+    GPU, so a mixed geometry only applies big-to-small. The agent's bounded
+    permutation search (nvml/client.go:225-340 analog) must find that order;
+    naive sorted-ascending creation would partial-fail."""
+    from nos_tpu.util import distinct_permutations
+
+    class OrderSensitiveClient(FakeGpuDeviceClient):
+        def create_device(self, gpu_index, profile):
+            size = MigProfile.parse(profile).gi
+            existing = [
+                MigProfile.parse(d.profile).gi
+                for d in self.list_devices()
+                if d.gpu_index == gpu_index
+            ]
+            if existing and size > min(existing):
+                from nos_tpu.tpulib.interface import TpuLibError
+
+                raise TpuLibError(f"fragmented: cannot place {profile}")
+            return super().create_device(gpu_index, profile)
+
+    cluster = Cluster()
+    mig_node(cluster, gpus=1)
+    client = OrderSensitiveClient(1, mig_validator(A100_40))
+    agent = GpuAgent(cluster, "gpu-node-0", client)
+    agent.startup()
+
+    # Desired: 1x 3g.20gb + 3x 1g.5gb. Ascending creation order would fail
+    # at the 3g.20gb; the search must land on descending.
+    changed = agent._apply({(0, "1g.5gb"): 3, (0, "3g.20gb"): 1})
+    assert changed
+    profiles = sorted(d.profile for d in client.list_devices())
+    assert profiles == ["1g.5gb", "1g.5gb", "1g.5gb", "3g.20gb"]
+
+    # Re-carving 3g.20gb -> 2g.10gb recreates the free 1g survivors so the
+    # permutation space includes them (plan/plan.go:94-109): the 2g must be
+    # placed before the recreated 1gs, which only the search discovers.
+    changed = agent._apply({(0, "1g.5gb"): 3, (0, "2g.10gb"): 1})
+    assert changed
+    profiles = sorted(d.profile for d in client.list_devices())
+    assert profiles == ["1g.5gb", "1g.5gb", "1g.5gb", "2g.10gb"]
+
+
+def test_distinct_permutations_dedupes_and_orders():
+    from nos_tpu.util import distinct_permutations
+
+    perms = list(distinct_permutations(["b", "a", "a"]))
+    assert perms == [["a", "a", "b"], ["a", "b", "a"], ["b", "a", "a"]]
+    assert list(distinct_permutations([])) == [[]]

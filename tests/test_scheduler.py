@@ -179,6 +179,86 @@ def test_tpu_topology_filter_rejects_impossible_shape():
     assert result["unschedulable"] == ["ns/impossible"]
 
 
+def make_pdb(name, ns, selector, min_available=None, max_unavailable=None):
+    from nos_tpu.api.objects import PodDisruptionBudget, PodDisruptionBudgetSpec
+
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodDisruptionBudgetSpec(
+            selector=selector,
+            min_available=min_available,
+            max_unavailable=max_unavailable,
+        ),
+    )
+
+
+def _over_quota_borrower(name, ns, node, cpu, labels=None):
+    labels = dict(labels or {})
+    labels[constants.LABEL_CAPACITY] = constants.CAPACITY_OVER_QUOTA
+    p = make_pod(name, ns, {"cpu": cpu}, labels=labels, phase=PodPhase.RUNNING)
+    p.spec.node_name = node
+    return p
+
+
+def test_preemption_prefers_node_without_pdb_violation():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 8}))
+    cluster.create(make_node("n2", {"cpu": 8}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 6}))
+    cluster.create(build_eq("ns-b", "qb", min={"cpu": 2}))
+    # Equivalent over-quota borrowers on both nodes; only n1's is protected
+    # by a PodDisruptionBudget with no disruptions to spare.
+    cluster.create(
+        _over_quota_borrower("protected", "ns-b", "n1", 6, labels={"app": "svc"})
+    )
+    cluster.create(_over_quota_borrower("expendable", "ns-b", "n2", 6))
+    cluster.create(make_pdb("svc-pdb", "ns-b", {"app": "svc"}, min_available=1))
+    cluster.create(make_pod("claimant", "ns-a", {"cpu": 6}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["nominated"] == ["ns-a/claimant"]
+    # The unprotected victim was chosen (fewest PDB violations rank).
+    assert cluster.try_get("Pod", "ns-b", "expendable") is None
+    assert cluster.try_get("Pod", "ns-b", "protected") is not None
+
+
+def test_preemption_reprieves_pdb_protected_victim_first():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 10}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 4}))
+    cluster.create(build_eq("ns-b", "qb", min={"cpu": 2}))
+    # Two borrower victims on the node; evicting either frees enough, and the
+    # PDB-protected one must be the one reprieved.
+    cluster.create(
+        _over_quota_borrower("protected", "ns-b", "n1", 4, labels={"app": "svc"})
+    )
+    cluster.create(_over_quota_borrower("plain", "ns-b", "n1", 4))
+    cluster.create(make_pdb("svc-pdb", "ns-b", {"app": "svc"}, min_available=1))
+    cluster.create(make_pod("claimant", "ns-a", {"cpu": 4}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["nominated"] == ["ns-a/claimant"]
+    assert cluster.try_get("Pod", "ns-b", "plain") is None
+    assert cluster.try_get("Pod", "ns-b", "protected") is not None
+
+
+def test_pdb_with_budget_allows_eviction():
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 8}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 6}))
+    cluster.create(build_eq("ns-b", "qb", min={"cpu": 2}))
+    # max_unavailable=1 leaves one disruption in the budget: not a violation.
+    cluster.create(
+        _over_quota_borrower("borrower", "ns-b", "n1", 6, labels={"app": "svc"})
+    )
+    cluster.create(make_pdb("svc-pdb", "ns-b", {"app": "svc"}, max_unavailable=1))
+    cluster.create(make_pod("claimant", "ns-a", {"cpu": 6}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["nominated"] == ["ns-a/claimant"]
+    assert cluster.try_get("Pod", "ns-b", "borrower") is None
+
+
 def test_composite_quota_spans_namespaces():
     cluster = Cluster()
     cluster.create(make_node("n1", {"cpu": 16}))
