@@ -32,14 +32,17 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from nos_tpu.models.vit import ViTConfig, init_vit, vit_forward
+    from nos_tpu.models.vit import ViTConfig, init_vit, vit_detect
     from nos_tpu.runtime.slice_server import SliceServer
 
     cfg = ViTConfig()  # YOLOS-small class: 384 hidden, 12 layers, 6 heads
     params = init_vit(jax.random.PRNGKey(0), cfg)
 
+    # Serve the full detector (labels/scores/boxes postprocessed on device):
+    # what crosses the host link per request is the detection set, not raw
+    # logits, and the fetch pipeline overlaps transfers with the next batch.
     server = SliceServer(
-        lambda im: vit_forward(params, im, cfg),
+        lambda im: vit_detect(params, im, cfg),
         max_batch=N_WORKLOADS,
         max_wait_s=0.003,
         buckets=(1, 2, 4, N_WORKLOADS),

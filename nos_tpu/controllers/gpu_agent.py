@@ -139,6 +139,7 @@ class GpuAgent:
         self.resource_of = resource_of
         self.plugin_client = plugin_client
         self.shared = SharedState()
+        self._apply_changed = False
         self._unsub = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -195,11 +196,14 @@ class GpuAgent:
             if s.quantity > 0:
                 desired[(s.device_index, s.profile)] = s.quantity
         self.sync_usage_from_pods()
-        changed = False
+        # Mutation flag survives a mid-apply exception: devices already
+        # deleted/created before the failure still require a plugin restart.
+        self._apply_changed = False
         try:
-            changed = self._apply(desired)
+            self._apply(desired)
         except TpuLibError:
             logger.exception("gpuagent %s: apply failed; reporting actual state", self.node_name)
+        changed = self._apply_changed
         if changed and self.plugin_client is not None:
             # Force the device plugin to re-register the new device set with
             # the kubelet (migagent actuator.go:205-209 restart path).
@@ -212,9 +216,11 @@ class GpuAgent:
         self.shared.on_apply()
         self.report()
 
-    def _apply(self, desired: Dict[Tuple[int, str], int]) -> bool:
-        """Diff-apply the desired geometry; returns True if any device was
-        created or deleted (the device plugin must then re-register).
+    def _apply(self, desired: Dict[Tuple[int, str], int]) -> None:
+        """Diff-apply the desired geometry; sets self._apply_changed when any
+        device is created or deleted (the device plugin must then
+        re-register) — a flag rather than a return value so mutations that
+        precede a mid-apply failure still trigger the restart.
 
         Per GPU: delete surplus free devices (never used ones), then create
         the missing profiles. Device creation can be order-sensitive (MIG
@@ -223,7 +229,6 @@ class GpuAgent:
         creation orders (plan/plan.go:94-109 extractResourcesToRecreate) and
         (b) try bounded distinct permutations of the creation order with
         cleanup between attempts (nvml/client.go:225-340)."""
-        changed = False
         current: Dict[Tuple[int, str], List[GpuDevice]] = {}
         for d in self.client.list_devices():
             current.setdefault((d.gpu_index, d.profile), []).append(d)
@@ -239,7 +244,7 @@ class GpuAgent:
                 free = [d for d in devices if not d.in_use]
                 for d in free[: max(0, surplus)]:
                     self.client.delete_device(d.device_id)
-                    changed = True
+                    self._apply_changed = True
             # Creates still missing on this GPU.
             have: Dict[str, int] = {}
             for d in self.client.list_devices():
@@ -256,25 +261,28 @@ class GpuAgent:
                 if d.gpu_index == gpu_index and not d.in_use:
                     self.client.delete_device(d.device_id)
                     creates.append(d.profile)
-                    changed = True
-            changed |= self._create_with_permutations(gpu_index, creates)
-        return changed
+                    self._apply_changed = True
+            self._create_with_permutations(gpu_index, creates)
 
     MAX_CREATE_PERMUTATIONS = 20  # nvml/client.go:286-331 attempt bound
 
-    def _create_with_permutations(self, gpu_index: int, creates: List[str]) -> bool:
+    def _create_with_permutations(self, gpu_index: int, creates: List[str]) -> None:
         """Create `creates` on the GPU, retrying distinct creation orders with
-        cleanup on failure; falls back to best-effort partial creation."""
+        cleanup on failure; falls back to best-effort partial creation.
+        Descending-first enumeration: large-profile-first orders are the ones
+        placement constraints tend to admit, so they must not sit behind the
+        attempt bound."""
         from nos_tpu.util import distinct_permutations
 
-        for attempt, order in enumerate(distinct_permutations(creates)):
+        for attempt, order in enumerate(distinct_permutations(creates, reverse=True)):
             if attempt >= self.MAX_CREATE_PERMUTATIONS:
                 break
             made: List[GpuDevice] = []
             try:
                 for profile in order:
                     made.append(self.client.create_device(gpu_index, profile))
-                return True
+                self._apply_changed = True
+                return
             except TpuLibError:
                 for d in made:
                     try:
@@ -285,11 +293,10 @@ class GpuAgent:
                         )
         # No full ordering worked: apply partially (the reference's plan-level
         # partial apply; the reporter will publish the actual state).
-        any_created = False
         for profile in sorted(creates, reverse=True):
             try:
                 self.client.create_device(gpu_index, profile)
-                any_created = True
+                self._apply_changed = True
             except TpuLibError:
                 logger.warning(
                     "gpuagent %s: create %s on gpu %d failed (partial apply)",
@@ -297,7 +304,6 @@ class GpuAgent:
                     profile,
                     gpu_index,
                 )
-        return any_created
 
     # -- reporter ------------------------------------------------------------
     def report(self) -> None:

@@ -79,6 +79,11 @@ class DevicePluginClient:
                 pod.metadata.namespaced_name,
                 node_name,
             )
+        if not old_uids:
+            # Nothing to restart (no plugin pod on this node); waiting for a
+            # "replacement" would just burn the timeout.
+            logger.info("no device-plugin pod on %s; skipping restart", node_name)
+            return
         if self._replacement_running(node_name, old_uids):
             return
         if wait == "background":

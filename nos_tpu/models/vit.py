@@ -121,6 +121,21 @@ def patchify(images, cfg: ViTConfig):
     return x.reshape(b, n * n, ps * ps * 3)
 
 
+def vit_detect(params, images, cfg: ViTConfig):
+    """Full detector inference with on-device postprocessing: softmax over
+    classes, top-1 label + score per detection token. Returns
+    (labels [B, det] int32, scores [B, det] f32, boxes [B, det, 4] f32) —
+    the actual detector output, ~17x smaller on the wire than raw logits
+    (what a serving path should ship over the host link)."""
+    logits, boxes = vit_forward(params, images, cfg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # Last class is the no-object background; detections argmax over the rest.
+    obj_probs = probs[..., :-1]
+    labels = jnp.argmax(obj_probs, axis=-1).astype(jnp.int32)
+    scores = jnp.max(obj_probs, axis=-1)
+    return labels, scores, boxes
+
+
 def vit_forward(params, images, cfg: ViTConfig):
     """images [B, H, W, 3] -> (class logits [B, det, classes], boxes [B, det, 4])."""
     x = patchify(images.astype(cfg.jdtype), cfg) @ params["patch_emb"]

@@ -34,6 +34,7 @@ class SliceServer:
         max_wait_s: float = 0.002,
         buckets: Optional[Sequence[int]] = None,
         stack_in_program: bool = True,
+        pipeline_fetch: bool = True,
     ):
         """`batched_fn(batch_input)` must accept a leading batch dimension.
         `buckets` are the batch sizes compiled for (requests padded up).
@@ -41,7 +42,13 @@ class SliceServer:
         With `stack_in_program` (default), the per-request inputs are stacked
         *inside* a per-bucket jitted program — one dispatch per batch, no
         host-side stacking: an eager jnp.stack of device arrays costs a
-        dispatch per element, catastrophic over a remote-dispatch link."""
+        dispatch per element, catastrophic over a remote-dispatch link.
+
+        With `pipeline_fetch` (default), the device->host result transfer
+        happens on a dedicated thread: batch k+1 is collected and dispatched
+        while batch k's results are still coming down the host link (which
+        can cost more than the execution itself). Bounded to 2 in-flight
+        batches for backpressure."""
         self._fn = batched_fn
         self.stack_in_program = stack_in_program
         self._bucket_fns = {}
@@ -58,6 +65,9 @@ class SliceServer:
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.pipeline_fetch = pipeline_fetch
+        self._fetch_queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._fetch_thread: Optional[threading.Thread] = None
         self.batches_run = 0
         self.requests_served = 0
 
@@ -65,12 +75,17 @@ class SliceServer:
     def start(self) -> "SliceServer":
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        if self.pipeline_fetch:
+            self._fetch_thread = threading.Thread(target=self._run_fetch, daemon=True)
+            self._fetch_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._fetch_thread is not None:
+            self._fetch_thread.join(timeout=5)
 
     def _get_bucket_fn(self, bucket: int) -> Callable:
         if not self.stack_in_program:
@@ -131,15 +146,38 @@ class SliceServer:
                 # no data movement); padded rows are discarded below.
                 args = tuple(inputs) + (inputs[0],) * (bucket - n)
                 out = self._get_bucket_fn(bucket)(*args)
-                # One device->host transfer per batch; per-request results are
-                # then zero-copy numpy views (a per-request device slice would
-                # cost a dispatch each).
-                out = jax.device_get(out)
-                self.batches_run += 1
-                self.requests_served += n
-                for i, fut in enumerate(futures):
-                    fut.set_result(jax.tree.map(lambda o: o[i], out))
+                if self.pipeline_fetch:
+                    # Async dispatch done: hand the on-device result to the
+                    # fetch thread and immediately collect the next batch.
+                    self._fetch_queue.put((out, futures, n))
+                else:
+                    self._fetch(out, futures, n)
             except Exception as e:  # noqa: BLE001
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(e)
+        if self.pipeline_fetch:
+            self._fetch_queue.put(None)  # drain sentinel
+
+    def _run_fetch(self) -> None:
+        while True:
+            item = self._fetch_queue.get()
+            if item is None:
+                return
+            out, futures, n = item
+            try:
+                self._fetch(out, futures, n)
+            except Exception as e:  # noqa: BLE001
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def _fetch(self, out, futures, n) -> None:
+        # One device->host transfer per batch; per-request results are
+        # then zero-copy numpy views (a per-request device slice would
+        # cost a dispatch each).
+        out = jax.device_get(out)
+        self.batches_run += 1
+        self.requests_served += n
+        for i, fut in enumerate(futures):
+            fut.set_result(jax.tree.map(lambda o: o[i], out))

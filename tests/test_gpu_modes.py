@@ -371,16 +371,18 @@ def test_permutation_search_handles_order_sensitive_creation():
 
     # Desired: 1x 3g.20gb + 3x 1g.5gb. Ascending creation order would fail
     # at the 3g.20gb; the search must land on descending.
-    changed = agent._apply({(0, "1g.5gb"): 3, (0, "3g.20gb"): 1})
-    assert changed
+    agent._apply_changed = False
+    agent._apply({(0, "1g.5gb"): 3, (0, "3g.20gb"): 1})
+    assert agent._apply_changed
     profiles = sorted(d.profile for d in client.list_devices())
     assert profiles == ["1g.5gb", "1g.5gb", "1g.5gb", "3g.20gb"]
 
     # Re-carving 3g.20gb -> 2g.10gb recreates the free 1g survivors so the
     # permutation space includes them (plan/plan.go:94-109): the 2g must be
     # placed before the recreated 1gs, which only the search discovers.
-    changed = agent._apply({(0, "1g.5gb"): 3, (0, "2g.10gb"): 1})
-    assert changed
+    agent._apply_changed = False
+    agent._apply({(0, "1g.5gb"): 3, (0, "2g.10gb"): 1})
+    assert agent._apply_changed
     profiles = sorted(d.profile for d in client.list_devices())
     assert profiles == ["1g.5gb", "1g.5gb", "1g.5gb", "2g.10gb"]
 

@@ -83,3 +83,39 @@ def test_pytree_outputs():
         np.testing.assert_allclose(np.asarray(out["b"][0]), np.zeros(2))
     finally:
         server.stop()
+
+
+def test_pipelined_fetch_preserves_results_under_load():
+    """With pipeline_fetch, batch k+1 executes while batch k's results
+    download; every future must still resolve to its own request's output."""
+    fn = jax.jit(lambda x: x * 10.0)
+    server = SliceServer(fn, max_batch=4, max_wait_s=0.001, pipeline_fetch=True).start()
+    try:
+        import concurrent.futures
+
+        def one(i):
+            out = server.infer(jnp.full((2,), float(i)), timeout=30)
+            return i, np.asarray(out)
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            for i, out in ex.map(one, range(40)):
+                np.testing.assert_allclose(out, np.full(2, 10.0 * i))
+        assert server.requests_served == 40
+    finally:
+        server.stop()
+
+
+def test_vit_detect_compact_output():
+    from nos_tpu.models.vit import ViTConfig, init_vit, vit_detect
+
+    cfg = ViTConfig(image_size=32, patch_size=16, hidden=64, layers=1, heads=2, det_tokens=5)
+    params = init_vit(jax.random.PRNGKey(0), cfg)
+    images = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    labels, scores, boxes = jax.jit(lambda p, im: vit_detect(p, im, cfg))(params, images)
+    assert labels.shape == (2, 5) and labels.dtype == jnp.int32
+    assert scores.shape == (2, 5) and float(scores.min()) >= 0.0
+    assert boxes.shape == (2, 5, 4)
+    # Labels never the no-object class (last index is background).
+    assert int(labels.max()) < cfg.num_classes - 1
+    # Boxes are sigmoid-bounded.
+    assert float(boxes.min()) >= 0.0 and float(boxes.max()) <= 1.0
