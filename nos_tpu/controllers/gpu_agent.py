@@ -165,6 +165,13 @@ class GpuAgent:
         if self._unsub:
             self._unsub()
 
+    def pod_resources(self):
+        """Device accounting view (kubelet pod-resources API seam,
+        resource/client.go:26-87)."""
+        from nos_tpu.cluster.pod_resources import GpuPodResources
+
+        return GpuPodResources(self.client, self.resource_of)
+
     # -- usage sync ----------------------------------------------------------
     def sync_usage_from_pods(self) -> None:
         demand: Dict[str, int] = {}

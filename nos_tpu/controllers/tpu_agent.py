@@ -96,6 +96,13 @@ class TpuAgent:
         if self._unsub:
             self._unsub()
 
+    def pod_resources(self):
+        """Device accounting view (kubelet pod-resources API seam,
+        resource/client.go:26-87)."""
+        from nos_tpu.cluster.pod_resources import TpuPodResources
+
+        return TpuPodResources(self.client)
+
     # -- usage sync (pod-resources gRPC analog) ------------------------------
     def sync_usage_from_pods(self) -> None:
         """Mark slices in-use according to pods bound to this node — the

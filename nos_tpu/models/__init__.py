@@ -7,5 +7,11 @@ ViT detector for the sharing benchmark, and a decoder LM exercising the
 dp/tp/sp-sharded training path.
 """
 
-from nos_tpu.models.vit import ViTConfig, init_vit, vit_forward  # noqa: F401
+from nos_tpu.models.vit import ViTConfig, init_vit, vit_detect, vit_forward  # noqa: F401
 from nos_tpu.models.gpt import GPTConfig, init_gpt, gpt_forward, gpt_loss  # noqa: F401
+from nos_tpu.models.decode import (  # noqa: F401
+    decode_step,
+    generate,
+    init_cache,
+    prefill,
+)

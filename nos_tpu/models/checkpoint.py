@@ -1,0 +1,130 @@
+"""Checkpoint / resume for sharded train state.
+
+The control plane is deliberately stateless (SURVEY.md §5: annotations are
+the database, controllers rebuild from the API server); the *workload* is
+where durable state lives. This module checkpoints a training job's
+params + optimizer state with Orbax when available (async-capable,
+multi-host-aware) and a plain .npz fallback otherwise, and restores onto a
+mesh: arrays come back placed according to the same sharding rules they were
+trained under, so a job rescheduled onto a re-carved sub-slice resumes where
+it left off.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.parallel.sharding import shard_params
+
+logger = logging.getLogger(__name__)
+
+STEP_DIR = re.compile(r"^step_(\d+)$")
+NPZ = "state.npz"
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp  # type: ignore
+
+        return ocp
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def save_checkpoint(directory: str, step: int, params, opt_state) -> str:
+    """Write params + optimizer state for `step`. Returns the step path."""
+    path = os.path.join(directory, f"step_{step}")
+    state = {"params": params, "opt_state": opt_state}
+    ocp = _try_orbax()
+    if ocp is not None:
+        ckpt = ocp.StandardCheckpointer()
+        ckpt.save(os.path.abspath(path), state, force=True)
+        ckpt.wait_until_finished()
+        return path
+    os.makedirs(path, exist_ok=True)
+    leaves = jax.tree.leaves(state)
+    arrays = {}
+    dtypes = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        dtypes.append(str(arr.dtype))
+        # npz stores ml_dtypes (bfloat16 etc.) as raw void and the round-trip
+        # breaks; persist the bit pattern and the dtype name side-by-side.
+        if arr.dtype.kind == "V" or arr.dtype.name == "bfloat16":
+            arr = arr.view(np.uint16) if arr.dtype.itemsize == 2 else arr.view(np.uint8)
+        arrays[f"leaf_{i}"] = arr
+    np.savez(
+        os.path.join(path, NPZ),
+        __dtypes__=np.array(dtypes),
+        **arrays,
+    )
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(m.group(1))
+        for name in os.listdir(directory)
+        if (m := STEP_DIR.match(name))
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(
+    directory: str,
+    step: Optional[int],
+    like: Tuple[Any, Any],
+    mesh=None,
+) -> Tuple[Any, Any, int]:
+    """Restore (params, opt_state, step). `like` provides the target pytree
+    structure/dtypes (e.g. a freshly initialized state); with a mesh, params
+    are re-placed by the sharding rules after restore."""
+    if step is None:
+        step = latest_step(directory)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(directory, f"step_{step}")
+    like_state = {"params": like[0], "opt_state": like[1]}
+    structure = jax.tree.structure(like_state)
+    ocp = _try_orbax()
+    if ocp is not None and not os.path.exists(os.path.join(path, NPZ)):
+        ckpt = ocp.StandardCheckpointer()
+        target = jax.tree.map(np.asarray, like_state)
+        state = ckpt.restore(os.path.abspath(path), target)
+        leaves = jax.tree.leaves(state)
+    else:
+        data = np.load(os.path.join(path, NPZ))
+        n = len([f for f in data.files if f.startswith("leaf_")])
+        dtypes = [str(d) for d in data["__dtypes__"]] if "__dtypes__" in data.files else []
+        leaves = []
+        for i in range(n):
+            arr = data[f"leaf_{i}"]
+            if i < len(dtypes) and str(arr.dtype) != dtypes[i]:
+                import ml_dtypes
+
+                arr = arr.view(np.dtype(getattr(ml_dtypes, dtypes[i], dtypes[i])))
+            leaves.append(arr)
+    like_leaves = jax.tree.leaves(like_state)
+    if len(leaves) != len(like_leaves):
+        raise ValueError(
+            f"checkpoint at {path} has {len(leaves)} leaves, "
+            f"target expects {len(like_leaves)}"
+        )
+    leaves = [
+        jnp.asarray(l).astype(ref.dtype) if hasattr(ref, "dtype") else l
+        for l, ref in zip(leaves, like_leaves)
+    ]
+    state = jax.tree.unflatten(structure, leaves)
+    params, opt_state = state["params"], state["opt_state"]
+    if mesh is not None:
+        params = shard_params(params, mesh)
+    return params, opt_state, step

@@ -1,0 +1,154 @@
+"""Autoregressive decoding with a KV cache.
+
+The serving-side counterpart of the training stack: batched prefill fills the
+cache for the prompt in one MXU-shaped pass, then a `lax.scan` decode loop
+generates one token per step against the cache. Grouped-query attention pays
+off here — the cache holds `n_kv` heads, cutting HBM per decoded sequence by
+heads/kv_heads. Everything is jit-compatible: static shapes (cache sized to
+`max_len`), masking by position instead of dynamic slicing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.models.gpt import GPTConfig, _rmsnorm, _rope, project_qkv
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict:
+    """Per-layer K/V buffers [B, n_kv, max_len, head_dim]."""
+    shape = (batch, cfg.n_kv, max_len, cfg.head_dim)
+    return {
+        str(i): {
+            "k": jnp.zeros(shape, cfg.jdtype),
+            "v": jnp.zeros(shape, cfg.jdtype),
+        }
+        for i in range(cfg.layers)
+    }
+
+
+def _attend_cache(q, cache_k, cache_v, n_rep: int, limit):
+    """q [B,nh,T,hd] against the cache [B,nkv,max,hd]. `limit` is a [T]
+    vector: query t attends to cache positions < limit[t] (causal-within-
+    chunk prefill uses start+arange(t)+1; single-token decode uses
+    [start+1])."""
+    if n_rep > 1:
+        cache_k = jnp.repeat(cache_k, n_rep, axis=1)
+        cache_v = jnp.repeat(cache_v, n_rep, axis=1)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bhtd,bhsd->bhts", q.astype(jnp.float32), cache_k.astype(jnp.float32)
+    ) * scale
+    idx = jnp.arange(cache_k.shape[2])
+    mask = idx[None, :] < jnp.reshape(limit, (-1, 1))  # [T, max]
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bhsd->bhtd", probs, cache_v.astype(jnp.float32))
+    return out.astype(cache_v.dtype)
+
+
+def _block_with_cache(x, p, cfg: GPTConfig, layer_cache, positions, start):
+    """One transformer block writing its new K/V into the cache at `start`
+    and attending over everything cached so far. x: [B, T, h]."""
+    b, t, h = x.shape
+    nh, nkv, hd = cfg.heads, cfg.n_kv, cfg.head_dim
+    y = _rmsnorm(x, p["ln1"])
+
+    def heads(proj, n):
+        return (y @ proj).reshape(b, t, n, hd).transpose(0, 2, 1, 3)
+
+    q = _rope(heads(p["wq"], nh), positions, cfg.rope_theta)
+    k_new = _rope(heads(p["wk"], nkv), positions, cfg.rope_theta)
+    v_new = heads(p["wv"], nkv)
+    cache_k = jax.lax.dynamic_update_slice(layer_cache["k"], k_new, (0, 0, start, 0))
+    cache_v = jax.lax.dynamic_update_slice(layer_cache["v"], v_new, (0, 0, start, 0))
+    # Causal within the new chunk: token j attends to cache[: start + j + 1].
+    limit = start + jnp.arange(t) + 1  # [T]
+    o = _attend_cache(q, cache_k, cache_v, nh // nkv, limit)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, h)
+    x = x + o @ p["wo"]
+    z = _rmsnorm(x, p["ln2"])
+    z = (jax.nn.silu(z @ p["w_gate"]) * (z @ p["w_up"])) @ p["w_down"]
+    return x + z, {"k": cache_k, "v": cache_v}
+
+
+def _forward_with_cache(params, tokens, cfg: GPTConfig, cache, start):
+    b, t = tokens.shape
+    x = params["tok_emb"][tokens]
+    positions = jnp.broadcast_to(
+        start + jnp.arange(t, dtype=jnp.int32), (b, t)
+    )
+    new_cache = {}
+    for i in range(cfg.layers):
+        x, new_cache[str(i)] = _block_with_cache(
+            x, params["layers"][str(i)], cfg, cache[str(i)], positions, start
+        )
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["lm_head"]).astype(jnp.float32), new_cache
+
+
+def prefill(params, tokens, cfg: GPTConfig, max_len: int) -> Tuple[jnp.ndarray, Dict]:
+    """Run the prompt [B, T] through the model in one batched pass, filling a
+    fresh cache sized for `max_len`. Returns (last-position logits [B, vocab],
+    cache)."""
+    if tokens.shape[1] > max_len:
+        raise ValueError(
+            f"prompt length {tokens.shape[1]} exceeds cache max_len {max_len}"
+        )
+    cache = init_cache(cfg, tokens.shape[0], max_len)
+    logits, cache = _forward_with_cache(params, tokens, cfg, cache, 0)
+    return logits[:, -1, :], cache
+
+
+def decode_step(params, token, cfg: GPTConfig, cache, pos):
+    """One token [B] at position `pos` -> (logits [B, vocab], new cache)."""
+    logits, cache = _forward_with_cache(params, token[:, None], cfg, cache, pos)
+    return logits[:, 0, :], cache
+
+
+def generate(
+    params,
+    prompt,
+    cfg: GPTConfig,
+    steps: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+):
+    """Greedy (temperature 0) or sampled continuation of `prompt` [B, T].
+    Returns tokens [B, steps]. jit-friendly: the decode loop is a lax.scan."""
+    b, t = prompt.shape
+    max_len = max_len or (t + steps)
+    # The cache must hold the prompt plus every generated token except the
+    # last (which is sampled, not re-attended): positions t .. t+steps-2 are
+    # written by the decode loop. dynamic_update_slice would silently clamp
+    # out-of-range writes, so reject oversized requests up front.
+    if t + steps - 1 > max_len:
+        raise ValueError(
+            f"prompt ({t}) + steps ({steps}) exceed cache max_len {max_len}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    logits, cache = prefill(params, prompt, cfg, max_len)
+
+    def pick(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    keys = jax.random.split(rng, steps)
+    first = pick(logits, keys[0]).astype(jnp.int32)
+
+    def step(carry, key):
+        token, cache, pos = carry
+        logits, cache = decode_step(params, token, cfg, cache, pos)
+        nxt = pick(logits, key).astype(jnp.int32)
+        return (nxt, cache, pos + 1), nxt
+
+    # steps-1 scan iterations: the first token came from prefill's logits,
+    # and no forward pass is spent on a token that would be discarded.
+    (_, _, _), rest = jax.lax.scan(step, (first, cache, t), keys[1:])
+    return jnp.concatenate([first[:, None], rest.T], axis=1)  # [B, steps]

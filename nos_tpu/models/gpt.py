@@ -24,15 +24,23 @@ class GPTConfig:
     hidden: int = 512
     layers: int = 4
     heads: int = 8
+    kv_heads: Optional[int] = None  # < heads => grouped-query attention
     max_seq: int = 2048
     mlp_ratio: int = 4
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"
-    attention: str = "flash"  # "flash" | "ring" | "reference"
+    attention: str = "flash"  # "flash" | "ring" | "ulysses" | "reference"
 
     @property
     def head_dim(self) -> int:
         return self.hidden // self.heads
+
+    @property
+    def n_kv(self) -> int:
+        nkv = self.kv_heads or self.heads
+        if self.heads % nkv != 0:
+            raise ValueError(f"heads {self.heads} not divisible by kv_heads {nkv}")
+        return nkv
 
     @property
     def jdtype(self):
@@ -54,12 +62,13 @@ def init_gpt(key, cfg: GPTConfig) -> Dict:
         "ln_f": {"scale": jnp.ones((h,), dt)},
         "lm_head": _init_dense(next(keys), (h, cfg.vocab), dt),
     }
+    kv_dim = cfg.n_kv * cfg.head_dim
     for i in range(cfg.layers):
         params["layers"][str(i)] = {
             "ln1": {"scale": jnp.ones((h,), dt)},
             "wq": _init_dense(next(keys), (h, h), dt),
-            "wk": _init_dense(next(keys), (h, h), dt),
-            "wv": _init_dense(next(keys), (h, h), dt),
+            "wk": _init_dense(next(keys), (h, kv_dim), dt),
+            "wv": _init_dense(next(keys), (h, kv_dim), dt),
             "wo": _init_dense(next(keys), (h, h), dt),
             "ln2": {"scale": jnp.ones((h,), dt)},
             "w_up": _init_dense(next(keys), (h, h * cfg.mlp_ratio), dt),
@@ -88,16 +97,27 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def project_qkv(x, p, cfg: GPTConfig, positions):
+    """QKV projections with RoPE; grouped KV heads are repeated up to the
+    query head count (GQA), so every attention backend sees full heads."""
+    b, t, _ = x.shape
+    nh, nkv, hd = cfg.heads, cfg.n_kv, cfg.head_dim
+
+    def heads(proj, n):
+        return (x @ proj).reshape(b, t, n, hd).transpose(0, 2, 1, 3)
+
+    q = _rope(heads(p["wq"], nh), positions, cfg.rope_theta)
+    k = _rope(heads(p["wk"], nkv), positions, cfg.rope_theta)
+    v = heads(p["wv"], nkv)
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=1)
+        v = jnp.repeat(v, nh // nkv, axis=1)
+    return q, k, v
+
+
 def _attention(x, p, cfg: GPTConfig, positions, mesh):
     b, t, h = x.shape
-    nh, hd = cfg.heads, cfg.head_dim
-
-    def heads(proj):
-        return (x @ proj).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-
-    q = _rope(heads(p["wq"]), positions, cfg.rope_theta)
-    k = _rope(heads(p["wk"]), positions, cfg.rope_theta)
-    v = heads(p["wv"])
+    q, k, v = project_qkv(x, p, cfg, positions)
     if cfg.attention == "ring" and mesh is not None and "sp" in mesh.shape:
         o = ring_attention(q, k, v, mesh=mesh, axis_name="sp", causal=True)
     elif cfg.attention == "ulysses" and mesh is not None and "sp" in mesh.shape:

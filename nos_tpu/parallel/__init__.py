@@ -8,7 +8,11 @@ the carved topology, dp/tp/sp sharding rules for pjit, and ring attention for
 long-context sequence parallelism over the ICI ring.
 """
 
-from nos_tpu.parallel.mesh import build_mesh, mesh_from_topology  # noqa: F401
+from nos_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    build_multislice_mesh,
+    mesh_from_topology,
+)
 from nos_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     replicated,

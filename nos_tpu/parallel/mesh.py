@@ -47,6 +47,67 @@ def build_mesh(
     return Mesh(arr, tuple(axes.keys()))
 
 
+def build_multislice_mesh(
+    ici_axes: Optional[Dict[str, int]] = None,
+    dcn_axis: str = "dcn",
+    num_slices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh for a multislice workload: a leading `dcn` axis spans slice
+    boundaries, the remaining (ICI) axes tile within each slice.
+
+    Collectives over the dcn axis cross the data-center network; everything
+    else stays on ICI. Lay out the parallelism accordingly: data parallelism
+    (gradient all-reduce, latency-tolerant) on `dcn`; tensor/sequence/expert
+    parallelism (bandwidth-hungry, per-step) on the ICI axes — the scaling
+    book's multislice recipe, and the DCN-alignment the partitioner's
+    topology score plans for (SURVEY.md §2.9).
+
+    Slices are discovered from `device.slice_index` (TPU runtime attribute);
+    when absent (CPU simulation, single-slice), devices are split into
+    `num_slices` equal contiguous groups. ICI axis sizes must multiply to the
+    per-slice device count (one size may be -1 to infer).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    groups: Dict[int, list] = {}
+    if all(hasattr(d, "slice_index") and d.slice_index is not None for d in devices):
+        for d in devices:
+            groups.setdefault(d.slice_index, []).append(d)
+    elif num_slices:
+        if len(devices) % num_slices != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {num_slices} slices"
+            )
+        per = len(devices) // num_slices
+        groups = {i: devices[i * per : (i + 1) * per] for i in range(num_slices)}
+    else:
+        groups = {0: devices}
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) != 1:
+        raise ValueError(f"slices are unequal: {sorted(sizes)} devices per slice")
+    per_slice = sizes.pop()
+    n_slices = len(groups)
+    if num_slices is not None and n_slices != num_slices:
+        raise ValueError(f"found {n_slices} slices, expected {num_slices}")
+
+    ici_axes = dict(ici_axes or {"dp": per_slice})
+    infer = [k for k, v in ici_axes.items() if v == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one ICI axis size may be -1")
+    known = int(np.prod([v for v in ici_axes.values() if v != -1]))
+    if infer:
+        if per_slice % known != 0:
+            raise ValueError(f"cannot infer {infer[0]}: {per_slice} / {known}")
+        ici_axes[infer[0]] = per_slice // known
+    if int(np.prod(list(ici_axes.values()))) != per_slice:
+        raise ValueError(
+            f"ICI axes {ici_axes} must multiply to {per_slice} devices per slice"
+        )
+    ordered = [groups[k] for k in sorted(groups)]
+    arr = np.array(ordered).reshape((n_slices,) + tuple(ici_axes.values()))
+    return Mesh(arr, (dcn_axis,) + tuple(ici_axes.keys()))
+
+
 def mesh_from_topology(
     topology: Topology,
     axis_names: Sequence[str] = ("dp", "tp"),

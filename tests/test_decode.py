@@ -1,0 +1,93 @@
+"""GQA + KV-cache decoding tests: the cached decode loop must reproduce the
+full-forward greedy continuation exactly (float32 configs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.decode import decode_step, generate, init_cache, prefill
+from nos_tpu.models.gpt import GPTConfig, gpt_forward, init_gpt
+
+CFG = GPTConfig(
+    vocab=64, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=32, dtype="float32"
+)
+
+
+def naive_greedy(params, prompt, cfg, steps):
+    tokens = prompt
+    out = []
+    for _ in range(steps):
+        logits = gpt_forward(params, tokens, cfg)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        out.append(nxt)
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    return jnp.stack(out, axis=1)
+
+
+def test_gqa_param_shapes_and_forward():
+    params = init_gpt(jax.random.PRNGKey(0), CFG)
+    wk = params["layers"]["0"]["wk"]
+    assert wk.shape == (32, CFG.n_kv * CFG.head_dim)  # kv heads < heads
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab)
+    logits = gpt_forward(params, tokens, CFG)
+    assert logits.shape == (2, 8, CFG.vocab)
+
+
+def test_kv_heads_must_divide_heads():
+    with pytest.raises(ValueError, match="not divisible"):
+        GPTConfig(heads=6, kv_heads=4).n_kv
+
+
+def test_cached_decode_matches_full_forward():
+    params = init_gpt(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, CFG.vocab)
+    steps = 6
+    want = naive_greedy(params, prompt, CFG, steps)
+    got = jax.jit(
+        lambda p, t: generate(p, t, CFG, steps=steps, max_len=16)
+    )(params, prompt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prefill_then_manual_steps():
+    params = init_gpt(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, CFG.vocab)
+    logits, cache = prefill(params, prompt, CFG, max_len=8)
+    # Prefill's last-position logits equal the full forward's.
+    full = gpt_forward(params, prompt, CFG)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, -1, :]), rtol=2e-5, atol=2e-5
+    )
+    # One manual decode step matches the extended full forward.
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    step_logits, cache = decode_step(params, nxt, CFG, cache, 4)
+    extended = jnp.concatenate([prompt, nxt[:, None]], axis=1)
+    full2 = gpt_forward(params, extended, CFG)
+    np.testing.assert_allclose(
+        np.asarray(step_logits), np.asarray(full2[:, -1, :]), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_cache_shape_uses_grouped_heads():
+    cache = init_cache(CFG, batch=3, max_len=16)
+    assert cache["0"]["k"].shape == (3, CFG.n_kv, 16, CFG.head_dim)
+
+
+def test_sampled_generation_shape_and_range():
+    params = init_gpt(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 3), 0, CFG.vocab)
+    toks = generate(
+        params, prompt, CFG, steps=4, temperature=0.8, rng=jax.random.PRNGKey(9)
+    )
+    assert toks.shape == (2, 4)
+    assert int(toks.min()) >= 0 and int(toks.max()) < CFG.vocab
+
+
+def test_generate_rejects_overflowing_cache():
+    params = init_gpt(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, CFG.vocab)
+    with pytest.raises(ValueError, match="exceed cache max_len"):
+        generate(params, prompt, CFG, steps=6, max_len=8)
+    with pytest.raises(ValueError, match="exceeds cache max_len"):
+        prefill(params, prompt, CFG, max_len=4)

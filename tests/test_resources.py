@@ -62,3 +62,69 @@ def test_compute_pod_request_max_of_init_and_sum_of_containers():
     req = compute_pod_request(pod)
     assert req["cpu"] == pytest.approx(5.1)  # max(init 5, sum 3) + overhead
     assert req["memory"] == pytest.approx(4 * 2**30)
+
+
+# -- pod-resources device accounting (pkg/resource/client.go analog) ---------
+def test_tpu_pod_resources_accounting():
+    from nos_tpu.cluster.pod_resources import TpuPodResources
+    from nos_tpu.tpu import Topology
+    from nos_tpu.tpulib import FakeTpuClient
+
+    client = FakeTpuClient(Topology.parse("tpu-v5-lite-podslice", "4x4"))
+    from nos_tpu.tpu import Profile
+
+    h1 = client.create_slice(Profile.parse("2x2"), (0, 0), (2, 2))
+    client.create_slice(Profile.parse("2x2"), (2, 0), (2, 2))
+    client.set_slice_in_use(h1.slice_id, True)
+
+    pr = TpuPodResources(client)
+    allocatable = pr.get_allocatable_devices()
+    assert len(allocatable) == 2
+    assert all(d.resource_name == "google.com/tpu-2x2" for d in allocatable)
+    used = pr.get_used_devices()
+    assert [d.device_id for d in used] == [h1.slice_id]
+
+
+def test_gpu_pod_resources_accounting():
+    from nos_tpu.cluster.pod_resources import GpuPodResources
+    from nos_tpu.controllers.gpu_agent import FakeGpuDeviceClient
+
+    client = FakeGpuDeviceClient(1, lambda gi, g: True)
+    d1 = client.create_device(0, "1g.5gb")
+    client.create_device(0, "3g.20gb")
+    client.set_in_use(d1.device_id, True)
+
+    pr = GpuPodResources(client, lambda p: f"nvidia.com/mig-{p}")
+    names = sorted(d.resource_name for d in pr.get_allocatable_devices())
+    assert names == ["nvidia.com/mig-1g.5gb", "nvidia.com/mig-3g.20gb"]
+    assert [d.device_id for d in pr.get_used_devices()] == [d1.device_id]
+
+
+def test_agents_expose_pod_resources():
+    from nos_tpu import constants
+    from nos_tpu.api.objects import Node, NodeStatus, ObjectMeta
+    from nos_tpu.api.resources import ResourceList
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.system import build_gpu_agent, build_tpu_agent
+
+    cluster = Cluster()
+    cluster.create(
+        Node(
+            metadata=ObjectMeta(
+                name="t0",
+                labels={
+                    constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                    constants.LABEL_TPU_TOPOLOGY: "2x2",
+                },
+            ),
+            status=NodeStatus(allocatable=ResourceList.of({"google.com/tpu": 4})),
+        )
+    )
+    tpu_agent = build_tpu_agent(cluster, "t0")
+    assert tpu_agent.pod_resources().get_allocatable_devices() == []
+
+    cluster.create(
+        Node(metadata=ObjectMeta(name="g0"), status=NodeStatus())
+    )
+    gpu_agent = build_gpu_agent(cluster, "g0", constants.KIND_MIG, 1, "NVIDIA-A100-PCIE-40GB")
+    assert gpu_agent.pod_resources().get_used_devices() == []
