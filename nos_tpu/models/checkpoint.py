@@ -98,11 +98,25 @@ def restore_checkpoint(
     ocp = _try_orbax()
     if ocp is not None and not os.path.exists(os.path.join(path, NPZ)):
         ckpt = ocp.StandardCheckpointer()
-        target = jax.tree.map(np.asarray, like_state)
+        # Abstract target: shapes/dtypes only — never materializes `like` on
+        # host, and works when `like` is sharded across non-addressable hosts.
+        target = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape")
+            else x,
+            like_state,
+        )
         state = ckpt.restore(os.path.abspath(path), target)
         leaves = jax.tree.leaves(state)
     else:
-        data = np.load(os.path.join(path, NPZ))
+        npz_path = os.path.join(path, NPZ)
+        if ocp is None and not os.path.exists(npz_path) and os.path.isdir(path):
+            raise RuntimeError(
+                f"checkpoint at {path} was written in Orbax format but orbax "
+                "is not importable here — install orbax-checkpoint on this "
+                "node (or re-save with the .npz fallback) to restore it"
+            )
+        data = np.load(npz_path)
         n = len([f for f in data.files if f.startswith("leaf_")])
         dtypes = [str(d) for d in data["__dtypes__"]] if "__dtypes__" in data.files else []
         leaves = []

@@ -15,7 +15,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from nos_tpu.models.gpt import GPTConfig, _rmsnorm, _rope, project_qkv
+from nos_tpu.models.gpt import GPTConfig, _rmsnorm, project_qkv
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict:
@@ -34,35 +34,30 @@ def _attend_cache(q, cache_k, cache_v, n_rep: int, limit):
     """q [B,nh,T,hd] against the cache [B,nkv,max,hd]. `limit` is a [T]
     vector: query t attends to cache positions < limit[t] (causal-within-
     chunk prefill uses start+arange(t)+1; single-token decode uses
-    [start+1])."""
-    if n_rep > 1:
-        cache_k = jnp.repeat(cache_k, n_rep, axis=1)
-        cache_v = jnp.repeat(cache_v, n_rep, axis=1)
-    scale = q.shape[-1] ** -0.5
+    [start+1]). Query heads are grouped against the un-repeated cache — the
+    cache is never materialized at n_heads width, which is the HBM saving
+    GQA exists for."""
+    b, nh, t, hd = q.shape
+    qg = q.reshape(b, nh // n_rep, n_rep, t, hd)  # [B, nkv, rep, T, hd]
+    scale = hd ** -0.5
     scores = jnp.einsum(
-        "bhtd,bhsd->bhts", q.astype(jnp.float32), cache_k.astype(jnp.float32)
+        "bgrtd,bgsd->bgrts", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
     ) * scale
     idx = jnp.arange(cache_k.shape[2])
     mask = idx[None, :] < jnp.reshape(limit, (-1, 1))  # [T, max]
-    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    scores = jnp.where(mask[None, None, None, :, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhts,bhsd->bhtd", probs, cache_v.astype(jnp.float32))
-    return out.astype(cache_v.dtype)
+    out = jnp.einsum("bgrts,bgsd->bgrtd", probs, cache_v.astype(jnp.float32))
+    return out.reshape(b, nh, t, hd).astype(cache_v.dtype)
 
 
 def _block_with_cache(x, p, cfg: GPTConfig, layer_cache, positions, start):
     """One transformer block writing its new K/V into the cache at `start`
     and attending over everything cached so far. x: [B, T, h]."""
     b, t, h = x.shape
-    nh, nkv, hd = cfg.heads, cfg.n_kv, cfg.head_dim
+    nh, nkv = cfg.heads, cfg.n_kv
     y = _rmsnorm(x, p["ln1"])
-
-    def heads(proj, n):
-        return (y @ proj).reshape(b, t, n, hd).transpose(0, 2, 1, 3)
-
-    q = _rope(heads(p["wq"], nh), positions, cfg.rope_theta)
-    k_new = _rope(heads(p["wk"], nkv), positions, cfg.rope_theta)
-    v_new = heads(p["wv"], nkv)
+    q, k_new, v_new = project_qkv(y, p, cfg, positions, repeat_kv=False)
     cache_k = jax.lax.dynamic_update_slice(layer_cache["k"], k_new, (0, 0, start, 0))
     cache_v = jax.lax.dynamic_update_slice(layer_cache["v"], v_new, (0, 0, start, 0))
     # Causal within the new chunk: token j attends to cache[: start + j + 1].
@@ -120,6 +115,8 @@ def generate(
 ):
     """Greedy (temperature 0) or sampled continuation of `prompt` [B, T].
     Returns tokens [B, steps]. jit-friendly: the decode loop is a lax.scan."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
     b, t = prompt.shape
     max_len = max_len or (t + steps)
     # The cache must hold the prompt plus every generated token except the

@@ -97,9 +97,10 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
-def project_qkv(x, p, cfg: GPTConfig, positions):
-    """QKV projections with RoPE; grouped KV heads are repeated up to the
-    query head count (GQA), so every attention backend sees full heads."""
+def project_qkv(x, p, cfg: GPTConfig, positions, repeat_kv: bool = True):
+    """QKV projections with RoPE. With `repeat_kv`, grouped KV heads are
+    repeated up to the query head count (GQA) so every attention backend sees
+    full heads; cached decode passes False and attends grouped instead."""
     b, t, _ = x.shape
     nh, nkv, hd = cfg.heads, cfg.n_kv, cfg.head_dim
 
@@ -109,7 +110,7 @@ def project_qkv(x, p, cfg: GPTConfig, positions):
     q = _rope(heads(p["wq"], nh), positions, cfg.rope_theta)
     k = _rope(heads(p["wk"], nkv), positions, cfg.rope_theta)
     v = heads(p["wv"], nkv)
-    if nkv != nh:
+    if repeat_kv and nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=1)
         v = jnp.repeat(v, nh // nkv, axis=1)
     return q, k, v
