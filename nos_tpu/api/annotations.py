@@ -163,3 +163,55 @@ def strip_spec_annotations(annotations: Dict[str, str]) -> None:
 def strip_status_annotations(annotations: Dict[str, str]) -> None:
     for k in [k for k in annotations if constants.ANNOTATION_STATUS_REGEX.match(k)]:
         del annotations[k]
+    annotations.pop(constants.ANNOTATION_STATUS_LAYOUT, None)
+
+
+# -- physical slice layout ---------------------------------------------------
+# TPU sub-slices are position-constrained (ICI contiguity): the planner cannot
+# judge whether a new slice fits without knowing where the in-use ones sit.
+# The agent therefore reports the full layout — "<profile>@<origin>/<dims>:u|f"
+# entries joined by ";", e.g. "2x4@0,0/2,4:u;1x1@6,6/1,1:f". `dims` is the
+# oriented footprint actually placed (may be a rotation of the profile shape).
+
+
+@dataclass(frozen=True)
+class SliceLayoutEntry:
+    profile: str
+    origin: Tuple[int, ...]
+    dims: Tuple[int, ...]
+    used: bool
+
+
+def format_layout(entries: Iterable[SliceLayoutEntry]) -> str:
+    parts = []
+    for e in sorted(entries, key=lambda e: (e.origin, e.profile)):
+        origin = ",".join(str(c) for c in e.origin)
+        dims = ",".join(str(c) for c in e.dims)
+        parts.append(f"{e.profile}@{origin}/{dims}:{'u' if e.used else 'f'}")
+    return ";".join(parts)
+
+
+def parse_layout(value: Optional[str]) -> List[SliceLayoutEntry]:
+    if not value:
+        return []
+    out = []
+    for part in value.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, flag = part.rpartition(":")
+        profile, _, pos = head.partition("@")
+        origin_s, _, dims_s = pos.partition("/")
+        out.append(
+            SliceLayoutEntry(
+                profile=profile,
+                origin=tuple(int(c) for c in origin_s.split(",")),
+                dims=tuple(int(c) for c in dims_s.split(",")),
+                used=flag == "u",
+            )
+        )
+    return out
+
+
+def get_layout(annotations: Mapping[str, str]) -> List[SliceLayoutEntry]:
+    return parse_layout(annotations.get(constants.ANNOTATION_STATUS_LAYOUT))

@@ -8,7 +8,6 @@ store/read, like an API server.
 
 from __future__ import annotations
 
-import copy
 import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -36,6 +35,18 @@ class ObjectMeta:
     @property
     def namespaced_name(self) -> str:
         return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+    def deepcopy(self) -> "ObjectMeta":
+        return ObjectMeta(
+            name=self.name,
+            namespace=self.namespace,
+            labels=dict(self.labels),
+            annotations=dict(self.annotations),
+            uid=self.uid,
+            resource_version=self.resource_version,
+            creation_timestamp=self.creation_timestamp,
+            deletion_timestamp=self.deletion_timestamp,
+        )
 
 
 class PodPhase:
@@ -93,7 +104,38 @@ class Pod:
     KIND = "Pod"
 
     def deepcopy(self) -> "Pod":
-        return copy.deepcopy(self)
+        # Hand-rolled: the in-memory cluster copies on every store/read (API
+        # server value semantics) and generic copy.deepcopy dominated control
+        # rounds end to end under load.
+        return Pod(
+            metadata=self.metadata.deepcopy(),
+            spec=PodSpec(
+                containers=[
+                    Container(c.name, ResourceList(c.resources))
+                    for c in self.spec.containers
+                ],
+                init_containers=[
+                    Container(c.name, ResourceList(c.resources))
+                    for c in self.spec.init_containers
+                ],
+                node_name=self.spec.node_name,
+                scheduler_name=self.spec.scheduler_name,
+                priority=self.spec.priority,
+                overhead=ResourceList(self.spec.overhead),
+                node_selector=dict(self.spec.node_selector),
+            ),
+            status=PodStatus(
+                phase=self.status.phase,
+                conditions=[
+                    PodCondition(c.type, c.status, c.reason)
+                    for c in self.status.conditions
+                ],
+                nominated_node_name=self.status.nominated_node_name,
+            ),
+            owner_references=[
+                OwnerReference(o.kind, o.name) for o in self.owner_references
+            ],
+        )
 
     def condition(self, ctype: str) -> Optional[PodCondition]:
         for c in self.status.conditions:
@@ -116,7 +158,13 @@ class Node:
     KIND = "Node"
 
     def deepcopy(self) -> "Node":
-        return copy.deepcopy(self)
+        return Node(
+            metadata=self.metadata.deepcopy(),
+            status=NodeStatus(
+                capacity=ResourceList(self.status.capacity),
+                allocatable=ResourceList(self.status.allocatable),
+            ),
+        )
 
 
 @dataclass
@@ -127,7 +175,7 @@ class ConfigMap:
     KIND = "ConfigMap"
 
     def deepcopy(self) -> "ConfigMap":
-        return copy.deepcopy(self)
+        return ConfigMap(metadata=self.metadata.deepcopy(), data=dict(self.data))
 
 
 @dataclass
@@ -162,7 +210,20 @@ class PodDisruptionBudget:
     KIND = "PodDisruptionBudget"
 
     def deepcopy(self) -> "PodDisruptionBudget":
-        return copy.deepcopy(self)
+        return PodDisruptionBudget(
+            metadata=self.metadata.deepcopy(),
+            spec=PodDisruptionBudgetSpec(
+                selector=dict(self.spec.selector),
+                min_available=self.spec.min_available,
+                max_unavailable=self.spec.max_unavailable,
+            ),
+            status=PodDisruptionBudgetStatus(
+                disruptions_allowed=self.status.disruptions_allowed,
+                current_healthy=self.status.current_healthy,
+                desired_healthy=self.status.desired_healthy,
+                expected_pods=self.status.expected_pods,
+            ),
+        )
 
     def matches(self, pod: Pod) -> bool:
         # policy/v1 semantics: an empty selector selects every pod in the

@@ -34,6 +34,16 @@ class ElasticQuota:
 
     KIND = "ElasticQuota"
 
+    def deepcopy(self) -> "ElasticQuota":
+        return ElasticQuota(
+            metadata=self.metadata.deepcopy(),
+            spec=ElasticQuotaSpec(
+                min=ResourceList(self.spec.min),
+                max=ResourceList(self.spec.max) if self.spec.max is not None else None,
+            ),
+            status=ElasticQuotaStatus(used=ResourceList(self.status.used)),
+        )
+
 
 @dataclass
 class CompositeElasticQuotaSpec:
@@ -49,6 +59,17 @@ class CompositeElasticQuota:
     status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
 
     KIND = "CompositeElasticQuota"
+
+    def deepcopy(self) -> "CompositeElasticQuota":
+        return CompositeElasticQuota(
+            metadata=self.metadata.deepcopy(),
+            spec=CompositeElasticQuotaSpec(
+                namespaces=list(self.spec.namespaces),
+                min=ResourceList(self.spec.min),
+                max=ResourceList(self.spec.max) if self.spec.max is not None else None,
+            ),
+            status=ElasticQuotaStatus(used=ResourceList(self.status.used)),
+        )
 
 
 # -- test/builder factories (reference *_factory.go) -------------------------

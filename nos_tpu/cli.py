@@ -9,6 +9,7 @@ metricsexporter; SURVEY.md §2.1).
     python -m nos_tpu.cli gpu-agent       --node <name> --mode mig|mps
     python -m nos_tpu.cli telemetry       [--share]
     python -m nos_tpu.cli demo            # single-process full system demo
+    python -m nos_tpu.cli simulate        # north-star capacity simulation
 
 Outside a k8s deployment these run against the in-process cluster bus; the
 `demo` subcommand assembles the whole control plane, carves a mesh for a
@@ -212,6 +213,52 @@ def cmd_demo(args) -> int:
     return 0 if bound.spec.node_name else 1
 
 
+def cmd_simulate(args) -> int:
+    """Capacity simulation: drive the full control plane with a synthetic
+    mixed JAX workload trace and print the north-star metrics (utilization %,
+    p50 schedule-to-running latency) as one JSON line."""
+    import json
+
+    setup_logging("WARNING")
+    from nos_tpu.sim import WorkloadSim, mixed_workload
+
+    from nos_tpu.tpu import Topology
+    from nos_tpu.tpu.topology import _ACCELERATOR_GENERATIONS as ACCELERATOR_GENERATIONS
+
+    generation_label = args.generation
+    generation = ACCELERATOR_GENERATIONS.get(generation_label)
+    if generation is None:
+        print(f"unknown accelerator {generation_label!r}; known: "
+              f"{sorted(ACCELERATOR_GENERATIONS)}", file=sys.stderr)
+        return 2
+    allowed = Topology.parse(generation, args.topology).allowed_profiles
+    if not allowed:
+        print(f"topology {args.topology!r} has no valid {generation} "
+              f"sub-slices", file=sys.stderr)
+        return 2
+    topos = {}
+    for i in range(args.nodes):
+        topos[f"tpu-node-{i}"] = args.topology
+    sim = WorkloadSim(topos=topos, generation_label=generation_label)
+    # Job mix: every sub-slice the node topology supports, weighted toward
+    # the small end (a 4x8 job on a cluster of 4x4 nodes can never bind).
+    weights = [2.0 ** -i for i in range(len(allowed))]
+    profiles = tuple(
+        (p.name, w / sum(weights)) for p, w in zip(allowed, weights)
+    )
+    jobs = mixed_workload(
+        args.jobs,
+        seed=args.seed,
+        profiles=profiles,
+        mean_interarrival_s=args.interarrival,
+        duration_range_s=(args.min_duration, args.max_duration),
+    )
+    window = (args.window_start, args.window_end) if args.window_end > 0 else None
+    report = sim.run(jobs, measure_window=window, max_s=args.max_seconds)
+    print(json.dumps(report.to_dict()))
+    return 0
+
+
 def _wait(args) -> int:
     if args.once:
         return 0
@@ -246,6 +293,22 @@ def main(argv=None) -> int:
     p_tel = sub.add_parser("telemetry")
     p_tel.add_argument("--share", action="store_true")
     sub.add_parser("demo")
+    p_sim = sub.add_parser("simulate", help="north-star capacity simulation")
+    p_sim.add_argument("--nodes", type=int, default=4)
+    p_sim.add_argument("--topology", default="8x8")
+    p_sim.add_argument(
+        "--generation",
+        default="tpu-v5-lite-podslice",
+        help="gke-tpu-accelerator label value (sets the TPU generation)",
+    )
+    p_sim.add_argument("--jobs", type=int, default=200)
+    p_sim.add_argument("--seed", type=int, default=0)
+    p_sim.add_argument("--interarrival", type=float, default=2.0)
+    p_sim.add_argument("--min-duration", type=float, default=60.0)
+    p_sim.add_argument("--max-duration", type=float, default=600.0)
+    p_sim.add_argument("--window-start", type=float, default=180.0)
+    p_sim.add_argument("--window-end", type=float, default=900.0)
+    p_sim.add_argument("--max-seconds", type=float, default=86400.0)
 
     args = parser.parse_args(argv)
     handlers = {
@@ -256,6 +319,7 @@ def main(argv=None) -> int:
         "gpu-agent": cmd_gpu_agent,
         "telemetry": cmd_telemetry,
         "demo": cmd_demo,
+        "simulate": cmd_simulate,
     }
     return handlers[args.command](args)
 

@@ -59,6 +59,13 @@ class AdmissionError(Exception):
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
 
+def _copy(obj: Any) -> Any:
+    """Value-semantics copy. Stored object classes provide a hand-rolled
+    deepcopy (generic copy.deepcopy dominated control-round profiles);
+    anything else falls back to the generic path."""
+    dc = getattr(obj, "deepcopy", None)
+    return dc() if dc is not None else copy.deepcopy(obj)
+
 def _kind_of(obj: Any) -> str:
     return getattr(obj, "KIND", type(obj).__name__)
 
@@ -80,7 +87,7 @@ class Cluster:
         """Run admission webhooks. `obj` is the to-be-stored copy (hooks may
         mutate it — mutating-webhook semantics); `old` is a defensive copy."""
         for hook in self._webhooks.get(_kind_of(obj), []):
-            hook(op, obj, copy.deepcopy(old) if old is not None else None)
+            hook(op, obj, _copy(old) if old is not None else None)
 
     def _dispatch_locked(self, ev: Event) -> None:
         # Delivered under the lock so per-object event order matches commit
@@ -98,15 +105,15 @@ class Cluster:
             key = self._key(obj)
             if key in self._store:
                 raise AlreadyExistsError(f"{key} already exists")
-            stored = copy.deepcopy(obj)
+            stored = _copy(obj)
             self._admit("CREATE", stored, None)
             self._rv += 1
             stored.metadata.resource_version = self._rv
             if not stored.metadata.creation_timestamp:
                 stored.metadata.creation_timestamp = self._now()
             self._store[key] = stored
-            self._dispatch_locked(Event(EventType.ADDED, copy.deepcopy(stored)))
-            return copy.deepcopy(stored)
+            self._dispatch_locked(Event(EventType.ADDED, _copy(stored)))
+            return _copy(stored)
 
     def update(self, obj: Any) -> Any:
         with self._lock:
@@ -122,7 +129,7 @@ class Cluster:
                     f"{key}: resource_version {obj.metadata.resource_version} "
                     f"!= {old.metadata.resource_version}"
                 )
-            stored = copy.deepcopy(obj)
+            stored = _copy(obj)
             self._admit("UPDATE", stored, old)
             self._rv += 1
             stored.metadata.resource_version = self._rv
@@ -131,9 +138,9 @@ class Cluster:
             stored.metadata.uid = old.metadata.uid
             self._store[key] = stored
             self._dispatch_locked(
-                Event(EventType.MODIFIED, copy.deepcopy(stored), copy.deepcopy(old))
+                Event(EventType.MODIFIED, _copy(stored), _copy(old))
             )
-            return copy.deepcopy(stored)
+            return _copy(stored)
 
     def patch(self, kind: str, namespace: str, name: str, fn: Callable[[Any], None]) -> Any:
         """Read-modify-write under the lock; `fn` mutates the object in place.
@@ -144,7 +151,7 @@ class Cluster:
             old = self._store.get(key)
             if old is None:
                 raise NotFoundError(key)
-            obj = copy.deepcopy(old)
+            obj = _copy(old)
             fn(obj)
             if self._key(obj) != key:
                 raise ValueError(f"patch must not change object identity {key}")
@@ -154,9 +161,9 @@ class Cluster:
             obj.metadata.uid = old.metadata.uid
             self._store[key] = obj
             self._dispatch_locked(
-                Event(EventType.MODIFIED, copy.deepcopy(obj), copy.deepcopy(old))
+                Event(EventType.MODIFIED, _copy(obj), _copy(old))
             )
-            return copy.deepcopy(obj)
+            return _copy(obj)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
@@ -164,7 +171,7 @@ class Cluster:
             old = self._store.pop(key, None)
             if old is None:
                 raise NotFoundError(key)
-            self._dispatch_locked(Event(EventType.DELETED, copy.deepcopy(old)))
+            self._dispatch_locked(Event(EventType.DELETED, _copy(old)))
 
     # -- read path ---------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -172,12 +179,12 @@ class Cluster:
             obj = self._store.get((kind, namespace, name))
             if obj is None:
                 raise NotFoundError((kind, namespace, name))
-            return copy.deepcopy(obj)
+            return _copy(obj)
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
         with self._lock:
             obj = self._store.get((kind, namespace, name))
-            return copy.deepcopy(obj) if obj is not None else None
+            return _copy(obj) if obj is not None else None
 
     def list(
         self,
@@ -199,7 +206,7 @@ class Cluster:
                     continue
                 if predicate is not None and not predicate(obj):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(_copy(obj))
             out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
             return out
 
@@ -214,7 +221,7 @@ class Cluster:
                 for (k, _, _), obj in list(self._store.items()):
                     if k == kind:
                         try:
-                            handler(Event(EventType.ADDED, copy.deepcopy(obj)))
+                            handler(Event(EventType.ADDED, _copy(obj)))
                         except Exception:  # noqa: BLE001
                             logger.exception("watch replay handler failed for %s", kind)
             self._watchers.setdefault(kind, []).append(handler)

@@ -79,6 +79,11 @@ ANNOTATION_SPEC_PREFIX = f"{DOMAIN}/spec-dev-"
 ANNOTATION_STATUS_PREFIX = f"{DOMAIN}/status-dev-"
 ANNOTATION_SPEC_PLAN = f"{DOMAIN}/spec-partitioning-plan"
 ANNOTATION_STATUS_PLAN = f"{DOMAIN}/status-partitioning-plan"
+# Physical slice layout reported by the TPU node agent. ICI contiguity makes
+# placement a *graph* constraint the planner must respect (it cannot re-carve
+# around in-use slices without knowing where they sit) — unlike the reference,
+# where NVML owns MIG placement and counts suffice (SURVEY.md §7 hard parts).
+ANNOTATION_STATUS_LAYOUT = f"{DOMAIN}/status-slice-layout"
 
 ANNOTATION_SPEC_REGEX = re.compile(
     rf"^{re.escape(ANNOTATION_SPEC_PREFIX)}(\d+)-(.+)$"
@@ -100,6 +105,9 @@ DEFAULT_TPU_CHIP_MEMORY_GB = 16
 # (reference gpu_partitioner_config.go:33-34 defaults).
 DEFAULT_BATCH_WINDOW_TIMEOUT_S = 60.0
 DEFAULT_BATCH_WINDOW_IDLE_S = 10.0
+# Periodic re-plan while pods stay pending (the reference's RequeueAfter=10s,
+# partitioner_controller.go:118-122).
+DEFAULT_PARTITIONER_RESYNC_S = 10.0
 # Requeue delay while waiting for nodes to report the last plan
 # (reference partitioner_controller.go:118-122).
 PLAN_REPORT_REQUEUE_S = 10.0

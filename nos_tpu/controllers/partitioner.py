@@ -42,6 +42,7 @@ class PartitionerController:
         sim_scheduler: SimScheduler,
         batch_timeout_s: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S,
         batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
+        resync_s: float = constants.DEFAULT_PARTITIONER_RESYNC_S,
         now=None,
     ):
         self.cluster = cluster
@@ -50,8 +51,13 @@ class PartitionerController:
         self.snapshot_taker = snapshot_taker
         self.planner = Planner(sim_scheduler)
         self.actuator = Actuator(partitioner, self._current_partitioning)
+        import time as _time
+
+        self._now = now if now is not None else _time.monotonic
         kwargs = {"now": now} if now is not None else {}
         self.batcher: Batcher[Pod] = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
+        self.resync_s = resync_s
+        self._last_cycle_at = self._now()
         self._unsub = None
         self._stop = threading.Event()
 
@@ -99,15 +105,30 @@ class PartitionerController:
                 lagging,
             )
             return False
-        if not self.batcher.drain_if_ready():
+        if not self.batcher.drain_if_ready() and not self._resync_due():
             return False
         pods = self.fetch_pending_pods()
         if not pods:
+            # Still a completed cycle for resync purposes: without the stamp,
+            # an idle cluster would re-list all pods every control round once
+            # resync_s first elapsed.
+            self._last_cycle_at = self._now()
             return False
         snapshot = self.snapshot_taker.take_snapshot(self.state)
         plan = self.planner.plan(snapshot, pods)
         self.actuator.apply(plan)
+        self._last_cycle_at = self._now()
         return True
+
+    def _resync_due(self) -> bool:
+        """The reference requeues its reconcile every 10s while pods stay
+        pending (partitioner_controller.go RequeueAfter); the scheduler stamps
+        the Unschedulable condition only on transition, so long-pending pods
+        produce no fresh watch events — the periodic resync re-plans for them
+        once capacity or demand has shifted."""
+        if self.resync_s <= 0:
+            return False
+        return (self._now() - self._last_cycle_at) >= self.resync_s
 
     def fetch_pending_pods(self) -> List[Pod]:
         """Re-list pending pods at plan time — the batch only signals *when*

@@ -73,13 +73,15 @@ class TpuAgent:
     # -- lifecycle ----------------------------------------------------------
     def startup(self) -> None:
         """Crash recovery: re-sync usage, drop every slice not in use, then
-        report actual state."""
+        run one reconcile (controller-runtime delivers an initial event on
+        start): it re-parses any standing spec so the plan-id handshake
+        resumes after a restart, re-applies it, and reports actual state."""
         self.sync_usage_from_pods()
         used_ids = [s.slice_id for s in self.client.list_slices() if s.in_use]
         deleted = self.client.delete_all_except(used_ids)
         if deleted:
             logger.info("tpuagent %s: startup cleanup removed %s", self.node_name, deleted)
-        self.report()
+        self.reconcile()
 
     def start_watching(self) -> None:
         def on_node(ev: Event) -> None:
@@ -218,7 +220,12 @@ class TpuAgent:
     # -- reporter -----------------------------------------------------------
     def report(self) -> None:
         """Write status annotations + allocatable from actual device state
-        (reporter.go:54-109)."""
+        (reporter.go:54-109). Runs on reconcile AND periodically (the
+        reference's reportConfigIntervalSeconds): without the periodic pass,
+        slices freed by completed pods would stay marked used in the status
+        annotations and the planner's never-delete-used invariant would block
+        reshaping them. The patch is skipped when nothing changed, so the
+        periodic pass does not churn the watch bus."""
         self.sync_usage_from_pods()
         slices = self.client.list_slices()
         geometry: Dict[Profile, int] = {}
@@ -229,16 +236,53 @@ class TpuAgent:
                 used[s.profile] = used.get(s.profile, 0) + 1
         topology = self.client.get_topology()
         carved = sum(p.chips * n for p, n in geometry.items())
+        desired_status = dict(
+            ann.format_status(ann.status_from_geometry(DEVICE_INDEX, geometry, used))
+        )
+        layout = ann.format_layout(
+            ann.SliceLayoutEntry(
+                profile=s.profile.name,
+                origin=tuple(s.origin),
+                dims=tuple(s.dims),
+                used=s.in_use,
+            )
+            for s in slices
+        )
+        if layout:
+            desired_status[constants.ANNOTATION_STATUS_LAYOUT] = layout
+        if self.shared.last_parsed_plan_id is not None:
+            desired_status[constants.ANNOTATION_STATUS_PLAN] = (
+                self.shared.last_parsed_plan_id
+            )
+        desired_alloc = {constants.RESOURCE_TPU: float(topology.chips - carved)}
+        for p, n in geometry.items():
+            desired_alloc[p.resource] = float(n)
+
+        def unchanged(node: Node) -> bool:
+            current_status = {
+                k: v
+                for k, v in node.metadata.annotations.items()
+                if constants.ANNOTATION_STATUS_REGEX.match(k)
+                or k == constants.ANNOTATION_STATUS_PLAN
+                or k == constants.ANNOTATION_STATUS_LAYOUT
+            }
+            if current_status != desired_status:
+                return False
+            current_alloc = {
+                r: node.status.allocatable[r]
+                for r in node.status.allocatable
+                if constants.RESOURCE_TPU_SLICE_REGEX.match(r)
+                or r == constants.RESOURCE_TPU
+            }
+            return current_alloc == desired_alloc
 
         def mutate(node: Node) -> None:
             ann.strip_status_annotations(node.metadata.annotations)
-            node.metadata.annotations.update(
-                ann.format_status(ann.status_from_geometry(DEVICE_INDEX, geometry, used))
-            )
-            if self.shared.last_parsed_plan_id is not None:
-                node.metadata.annotations[constants.ANNOTATION_STATUS_PLAN] = (
-                    self.shared.last_parsed_plan_id
-                )
+            if self.shared.last_parsed_plan_id is None:
+                # A stale plan id from a previous agent run would otherwise
+                # survive every rewrite and keep unchanged() false forever.
+                node.metadata.annotations.pop(constants.ANNOTATION_STATUS_PLAN, None)
+            node.metadata.annotations.update(desired_status)
             # Device-plugin re-registration analog: refresh extended resources.
             for res in [
                 r
@@ -246,15 +290,16 @@ class TpuAgent:
                 if constants.RESOURCE_TPU_SLICE_REGEX.match(r)
             ]:
                 del node.status.allocatable[res]
-            node.status.allocatable[constants.RESOURCE_TPU] = float(
-                topology.chips - carved
-            )
-            for p, n in geometry.items():
-                node.status.allocatable[p.resource] = float(n)
+            for res, qty in desired_alloc.items():
+                node.status.allocatable[res] = qty
             node.status.capacity = type(node.status.allocatable)(node.status.allocatable)
 
         try:
-            self.cluster.patch("Node", "", self.node_name, mutate)
+            node = self.cluster.try_get("Node", "", self.node_name)
+            if node is None:
+                return
+            if not unchanged(node):
+                self.cluster.patch("Node", "", self.node_name, mutate)
         except NotFoundError:
             return
         self.shared.on_report()

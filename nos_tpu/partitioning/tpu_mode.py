@@ -91,7 +91,12 @@ class TpuNode:
                     geometry[profile] = total
                 if in_use > 0:
                     used[profile] = in_use
-        mesh = TpuMesh(topology, geometry, used)
+        # Pin the physical placement of in-use slices (layout annotation):
+        # re-carving must pack around them, not assume a blank mesh — ICI
+        # placement is the graph constraint the counts model can't see.
+        layout = ann.get_layout(node.metadata.annotations)
+        pinned = [(e.origin, e.dims) for e in layout if e.used] if layout else None
+        mesh = TpuMesh(topology, geometry, used, pinned=pinned)
         if requested is None:
             requested = ResourceList()
             for p in pods or []:

@@ -100,13 +100,18 @@ class Scheduler:
 
     # -- scheduling ----------------------------------------------------------
     def schedule_pending(self) -> dict:
-        """One full pass over the pending queue. Returns a summary dict."""
+        """One full pass over the pending queue. Returns a summary dict.
+
+        Node infos are snapshotted ONCE per pass (the kube-scheduler snapshot
+        model) and updated incrementally as pods bind — re-listing the cluster
+        per pod is O(pods^2 x objects) and dominated saturated-backlog runs."""
         self.capacity.refresh_from_cluster(self.cluster)
         bound, unschedulable, nominated = [], [], []
         pending = self.pending_pods()
         self.capacity.nominated_pods = [p for p in pending if p.status.nominated_node_name]
+        nodes = self.node_infos()
         for pod in pending:
-            result = self.schedule_one(pod)
+            result = self.schedule_one(pod, nodes)
             if result is None:
                 if pod.status.nominated_node_name:
                     nominated.append(pod.metadata.namespaced_name)
@@ -116,13 +121,14 @@ class Scheduler:
                 bound.append((pod.metadata.namespaced_name, result))
         return {"bound": bound, "unschedulable": unschedulable, "nominated": nominated}
 
-    def schedule_one(self, pod: Pod) -> Optional[str]:
+    def schedule_one(self, pod: Pod, nodes: Optional[List[NodeInfo]] = None) -> Optional[str]:
         state = CycleState()
         status = self.framework.run_pre_filter(state, pod)
         if not status.is_success:
             self._mark_unschedulable(pod, status)
             return None
-        nodes = self.node_infos()
+        if nodes is None:
+            nodes = self.node_infos()
         feasible = []
         for node in nodes:
             s = self.framework.run_filters_with_nominated_pods(
@@ -155,6 +161,9 @@ class Scheduler:
         except Exception:
             self.framework.run_unreserve(state, pod, best.name)
             raise
+        # Keep the pass-level snapshot coherent with the bind.
+        best.requested = best.requested.add(self.calculator.compute_pod_request(pod))
+        best.pods.append(pod)
         return best.name
 
     # -- cluster mutations ---------------------------------------------------
@@ -177,6 +186,15 @@ class Scheduler:
         logger.info("bound %s to %s", pod.metadata.namespaced_name, node_name)
 
     def _mark_unschedulable(self, pod: Pod, status: Status) -> None:
+        # Only patch on transition: re-stamping an already-Unschedulable pod
+        # every pass floods the watch bus (and the partitioner batcher) with
+        # no-op events — O(backlog) patches per scheduling pass.
+        if any(
+            c.type == "PodScheduled" and c.status == "False" and c.reason == "Unschedulable"
+            for c in pod.status.conditions
+        ):
+            return
+
         def mutate(p: Pod) -> None:
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"

@@ -194,6 +194,11 @@ class ControlPlane:
         """One synchronous control round (deterministic driving for tests and
         the single-process dev runtime)."""
         result = self.scheduler.schedule_pending()
+        # Periodic reporter pass (reportConfigIntervalSeconds analog): keeps
+        # status annotations in step with pod completions so the planner can
+        # reshape freed slices. No-op patch-free when nothing changed.
+        for agent in self.agents.values():
+            agent.report()
         for controller in self.partitioners.values():
             if controller.process_batch_if_ready():
                 metrics.inc("nos_tpu_partitioning_cycles", kind=controller.kind)

@@ -8,14 +8,16 @@ UpdateGeometryFor search (gpu.go:141-195) under the ICI packability constraint.
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Optional
+from typing import Dict, List, Mapping, Optional, Tuple
 
-from nos_tpu.tpu.packing import pack, packable
+from nos_tpu.tpu.packing import pack, pack_into, packable
 from nos_tpu.tpu.profile import Profile
 from nos_tpu.tpu.shape import Shape
 from nos_tpu.tpu.topology import Topology
 
 Geometry = Dict[Profile, int]
+# Physical footprint of a pinned (in-use) slice: (origin, oriented dims).
+Pin = Tuple[Tuple[int, ...], Tuple[int, ...]]
 
 
 def _clean(g: Mapping[Profile, int]) -> Geometry:
@@ -28,16 +30,34 @@ class TpuMesh:
         topology: Topology,
         geometry: Optional[Mapping[Profile, int]] = None,
         used: Optional[Mapping[Profile, int]] = None,
+        pinned: Optional[List[Pin]] = None,
     ):
+        """`pinned` (optional) is the physical footprint of the in-use slices
+        as reported by the node agent's layout annotation. When present, every
+        feasibility check packs *around* those immovable blocks with the same
+        guillotine packer the agent applies plans with — so planner feasibility
+        equals actuation feasibility. When absent (GPU modes, plain tests) the
+        counts-only model is used, matching the reference where NVML owns MIG
+        placement (SURVEY.md §7 hard parts: placement, not just counts)."""
         self.topology = topology
         self.geometry: Geometry = _clean(geometry or {})
         self.used: Geometry = _clean(used or {})
+        self.pinned: Optional[List[Pin]] = list(pinned) if pinned is not None else None
         for p, n in self.used.items():
             if n > self.geometry.get(p, 0):
                 raise ValueError(
                     f"used {n}x{p} exceeds geometry {self.geometry.get(p, 0)}x{p}"
                 )
-        if not packable(self.topology.shape, self.geometry):
+        if self.pinned is not None:
+            # Agent-reported state is physically real; only sanity-check the
+            # chip budget (the heuristic packer may not reproduce an exotic
+            # but valid layout, and that must not crash the snapshot).
+            carved = sum(p.chips * n for p, n in self.geometry.items())
+            if carved > topology.chips:
+                raise ValueError(
+                    f"geometry {self._fmt(self.geometry)} exceeds {topology}"
+                )
+        elif not self._feasible(self.geometry):
             raise ValueError(
                 f"geometry {self._fmt(self.geometry)} does not pack onto {topology}"
             )
@@ -61,7 +81,36 @@ class TpuMesh:
         return self.free_chips > 0 or bool(self.free)
 
     def clone(self) -> "TpuMesh":
-        return TpuMesh(self.topology, dict(self.geometry), dict(self.used))
+        return TpuMesh(
+            self.topology, dict(self.geometry), dict(self.used), self.pinned
+        )
+
+    # -- feasibility --------------------------------------------------------
+    def _feasible(
+        self, geometry: Mapping[Profile, int], extra_unit_chips: int = 0
+    ) -> bool:
+        """Can `geometry` be realized on this mesh? With pinned placements,
+        the in-use slices are immovable and only the remainder (free slices —
+        the agent may delete and recreate those — plus any additions) must
+        pack around them. `extra_unit_chips` adds single-chip placeholders for
+        uncarved chips held by whole-chip pods."""
+        geometry = _clean(geometry)
+        unit = Profile(Shape((1,) * self.topology.shape.rank))
+        if self.pinned is None:
+            trial = dict(geometry)
+            if extra_unit_chips > 0:
+                trial[unit] = trial.get(unit, 0) + extra_unit_chips
+            return packable(self.topology.shape, trial)
+        movable: Geometry = {}
+        for p, n in geometry.items():
+            extra = n - self.used.get(p, 0)
+            if extra < 0:
+                return False  # geometry drops an in-use slice
+            if extra > 0:
+                movable[p] = extra
+        if extra_unit_chips > 0:
+            movable[unit] = movable.get(unit, 0) + extra_unit_chips
+        return pack_into(self.topology.shape, list(self.pinned), movable) is not None
 
     # -- geometry transitions ---------------------------------------------
     def can_apply_geometry(self, new: Mapping[Profile, int]) -> bool:
@@ -74,7 +123,7 @@ class TpuMesh:
                 return False
         if any(not self.topology.is_profile_allowed(p) for p in new):
             return False
-        return packable(self.topology.shape, new)
+        return self._feasible(new)
 
     def apply_geometry(self, new: Mapping[Profile, int]) -> None:
         if not self.can_apply_geometry(new):
@@ -103,25 +152,17 @@ class TpuMesh:
         if not required:
             return False
 
-        unit = Profile(Shape((1,) * self.topology.shape.rank))
-
-        def packable_with_reserved(geometry: Mapping[Profile, int]) -> bool:
-            if reserved_chips <= 0:
-                return packable(self.topology.shape, geometry)
-            trial = dict(geometry)
-            trial[unit] = trial.get(unit, 0) + reserved_chips
-            return packable(self.topology.shape, trial)
-
         # Start from the immutable floor: slices currently in use.
         base: Geometry = dict(self.used)
         satisfied_any = False
         # Add required profiles largest-first so big contiguous blocks are
-        # reserved before fragmentation.
+        # reserved before fragmentation. Feasibility packs around the pinned
+        # in-use placements when the agent reported them.
         for profile in sorted(required, key=lambda p: (-p.chips, p.name)):
             for _ in range(required[profile]):
                 trial = dict(base)
                 trial[profile] = trial.get(profile, 0) + 1
-                if packable_with_reserved(trial):
+                if self._feasible(trial, extra_unit_chips=reserved_chips):
                     base = trial
                     satisfied_any = True
 
@@ -133,7 +174,7 @@ class TpuMesh:
             for _ in range(n):
                 trial = dict(base)
                 trial[profile] = trial.get(profile, 0) + 1
-                if packable_with_reserved(trial):
+                if self._feasible(trial, extra_unit_chips=reserved_chips):
                     base = trial
 
         new_geometry = _clean(base)

@@ -96,8 +96,12 @@ def _allowed_profiles(generation: str, mesh: Shape) -> Tuple[Profile, ...]:
     out = []
     for name in KNOWN_SLICE_SHAPES.get(generation, ()):
         shape = Shape.parse(name)
-        if shape.chips >= mesh.chips:
-            continue  # the whole mesh is the plain google.com/tpu resource
+        if shape.chips > mesh.chips:
+            continue
+        # The identity profile (the whole mesh as one sub-slice) is allowed:
+        # a workload asking for a connected NxM mesh must be placeable on a
+        # node whose mesh is exactly NxM, not only on larger nodes. Uncarved
+        # chips remain the plain google.com/tpu resource.
         if any(o.divides(mesh) for o in shape.orientations()):
             out.append(Profile(shape))
     return tuple(sorted(out))

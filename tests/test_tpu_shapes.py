@@ -57,19 +57,24 @@ def test_accelerator_generation():
 def test_topology_allowed_profiles_v5e_4x4():
     t = Topology.parse("v5e", "4x4")
     names = [p.name for p in t.allowed_profiles]
-    # Whole-mesh 4x4 excluded (that's the plain google.com/tpu resource).
-    assert names == ["1x1", "1x2", "2x2", "2x4"]
+    # The identity profile (whole mesh as one sub-slice) is allowed: a pod
+    # asking for a connected 4x4 must be placeable on a 4x4 node.
+    assert names == ["1x1", "1x2", "2x2", "2x4", "4x4"]
     assert t.chips == 16 and t.chip_memory_gb == 16
 
 
 def test_topology_allowed_profiles_v5e_8x8():
     t = Topology.parse("v5e", "8x8")
-    assert [p.name for p in t.allowed_profiles] == ["1x1", "1x2", "2x2", "2x4", "4x4", "4x8"]
+    assert [p.name for p in t.allowed_profiles] == [
+        "1x1", "1x2", "2x2", "2x4", "4x4", "4x8", "8x8",
+    ]
 
 
 def test_topology_allowed_profiles_v4_cube():
     t = Topology.parse("v4", "2x2x4")
-    assert [p.name for p in t.allowed_profiles] == ["1x1x1", "1x2x2", "2x2x2"]
+    assert [p.name for p in t.allowed_profiles] == [
+        "1x1x1", "1x2x2", "2x2x2", "2x2x4",
+    ]
 
 
 def test_topology_from_node_labels():
