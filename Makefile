@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all test test-tpu native bench dryrun demo clean
+.PHONY: all test test-tpu native bench dryrun demo simulate clean
 
 all: native test
 
@@ -30,6 +30,10 @@ dryrun:
 # Single-process full-system demo.
 demo:
 	$(PY) -m nos_tpu.cli demo
+
+# North-star capacity simulation (virtual clock, fake device layer).
+simulate:
+	JAX_PLATFORMS=cpu $(PY) -m nos_tpu.cli simulate
 
 clean:
 	$(MAKE) -C nos_tpu/tpulib/native clean
