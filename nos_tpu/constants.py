@@ -58,6 +58,23 @@ CAPACITY_OVER_QUOTA = "over-quota"
 LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"  # e.g. "tpu-v5-lite-podslice"
 LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"        # e.g. "4x4"
 
+# Multi-host podslice discovery: a slice group is the set of host nodes of
+# one TPU pod (GKE: one multi-host node pool). The global mesh comes from the
+# GKE topology label (identical on every member); each host owns one
+# host-topology block of it at host-coord (in host-block units).
+LABEL_TPU_SLICE = f"{DOMAIN}/slice"                   # slice-group id
+LABEL_TPU_HOST_TOPOLOGY = f"{DOMAIN}/host-topology"   # e.g. "2x2" (v5e host)
+LABEL_TPU_HOST_COORD = f"{DOMAIN}/host-coord"         # e.g. "3,2" (host units)
+# Scheduling surface written by host agents after a carve is acknowledged:
+# gang pods select their sub-slice by topology, the binder keeps one gang on
+# one sub-slice id.
+LABEL_TPU_SUBSLICE_ID = f"{DOMAIN}/subslice-id"
+LABEL_TPU_SUBSLICE_TOPOLOGY = f"{DOMAIN}/subslice-topology"
+
+# Gang scheduling (multi-host workloads: one pod per host, all-or-nothing).
+LABEL_GANG = f"{DOMAIN}/gang"            # gang name, unique per namespace
+LABEL_GANG_SIZE = f"{DOMAIN}/gang-size"  # expected member count
+
 # NVIDIA GFD labels (kept verbatim for MIG/MPS parity modes).
 LABEL_GPU_PRODUCT = "nvidia.com/gpu.product"
 LABEL_GPU_COUNT = "nvidia.com/gpu.count"
@@ -79,6 +96,14 @@ ANNOTATION_SPEC_PREFIX = f"{DOMAIN}/spec-dev-"
 ANNOTATION_STATUS_PREFIX = f"{DOMAIN}/status-dev-"
 ANNOTATION_SPEC_PLAN = f"{DOMAIN}/spec-partitioning-plan"
 ANNOTATION_STATUS_PLAN = f"{DOMAIN}/status-partitioning-plan"
+# Multi-host sub-slice assignment protocol (per host node). The planner
+# assigns each member host to at most one carved sub-slice; the host agent
+# acknowledges by mirroring spec -> status and flipping the scheduling labels.
+ANNOTATION_SPEC_SUBSLICE_ID = f"{DOMAIN}/spec-subslice-id"
+ANNOTATION_SPEC_SUBSLICE_TOPOLOGY = f"{DOMAIN}/spec-subslice-topology"
+ANNOTATION_SPEC_SUBSLICE_ORIGIN = f"{DOMAIN}/spec-subslice-origin"  # chip units
+ANNOTATION_STATUS_SUBSLICE_ID = f"{DOMAIN}/status-subslice-id"
+ANNOTATION_STATUS_SUBSLICE_TOPOLOGY = f"{DOMAIN}/status-subslice-topology"
 # Physical slice layout reported by the TPU node agent. ICI contiguity makes
 # placement a *graph* constraint the planner must respect (it cannot re-carve
 # around in-use slices without knowing where they sit) — unlike the reference,
@@ -130,6 +155,9 @@ ENV_NODE_NAME = "NODE_NAME"
 
 # Partitioning kinds.
 KIND_TPU = "tpu"
+# Multi-host podslice mode: nodes are member hosts of a slice group; carving
+# assigns host blocks, not local chips.
+KIND_TPU_MULTIHOST = "tpu-multihost"
 KIND_MIG = "mig"
 KIND_MPS = "mps"
-PARTITIONING_KINDS = (KIND_TPU, KIND_MIG, KIND_MPS)
+PARTITIONING_KINDS = (KIND_TPU, KIND_TPU_MULTIHOST, KIND_MIG, KIND_MPS)

@@ -1,0 +1,313 @@
+"""Multi-host podslice controllers: group partitioner + host agent.
+
+The multi-host analogs of the partitioner controller and node agent
+(partitioner_controller.go:81-232, migagent actuator/reporter): the
+GroupPartitioner watches gang pods that cannot schedule, derives sub-slice
+demand per *gang* (one 4x8 sub-slice per 8-pod gang — not one per pod),
+plans host-block assignments through SliceGroup, and writes per-host spec
+annotations. The HostAgent acknowledges its host's assignment by mirroring
+spec -> status and flipping the scheduling labels. Re-planning a group is
+gated on EVERY member host having reported the current plan — the
+slice-level barrier a per-node handshake cannot provide (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+import uuid
+from typing import Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Node, Pod, PodPhase
+from nos_tpu.cluster.client import Cluster, Event, EventType, NotFoundError
+from nos_tpu.tpu import Profile
+from nos_tpu.tpu.slice_group import SliceGroup, SubSlice
+from nos_tpu.util import pod as podutil
+from nos_tpu.util.batcher import Batcher
+
+logger = logging.getLogger(__name__)
+
+
+gang_of = podutil.gang_of
+gang_size_of = podutil.gang_size_of
+wanted_subslice_topology = podutil.wanted_subslice_topology
+
+
+class GroupPartitioner:
+    """Carves multi-host slice groups toward pending gang demand."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        batch_timeout_s: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S,
+        batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
+        resync_s: float = constants.DEFAULT_PARTITIONER_RESYNC_S,
+        now=None,
+    ):
+        self.cluster = cluster
+        self._now = now if now is not None else _time.monotonic
+        kwargs = {"now": now} if now is not None else {}
+        self.batcher: Batcher[Pod] = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
+        self.resync_s = resync_s
+        self._last_cycle_at = self._now()
+        self._unsub = None
+        self._stop = threading.Event()
+
+    # -- watch wiring --------------------------------------------------------
+    def start_watching(self) -> None:
+        def on_pod(ev: Event) -> None:
+            if ev.type == EventType.DELETED:
+                return
+            pod = ev.obj
+            if wanted_subslice_topology(pod) is None:
+                return
+            if not podutil.extra_resources_could_help_scheduling(pod):
+                return
+            self.batcher.add(pod)
+
+        self._unsub = self.cluster.watch("Pod", on_pod)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._unsub:
+            self._unsub()
+
+    # -- group views ---------------------------------------------------------
+    def member_nodes(self) -> Dict[str, List[Node]]:
+        groups: Dict[str, List[Node]] = {}
+        for node in self.cluster.list(
+            "Node",
+            label_selector={
+                constants.LABEL_PARTITIONING: constants.KIND_TPU_MULTIHOST
+            },
+        ):
+            slice_id = node.metadata.labels.get(constants.LABEL_TPU_SLICE)
+            if slice_id:
+                groups.setdefault(slice_id, []).append(node)
+        return groups
+
+    def _active_node_names(self) -> set:
+        """Node names hosting an active pod — computed ONCE per cycle (a
+        per-host cluster list would make each cycle O(hosts x pods))."""
+        return {
+            p.spec.node_name
+            for p in self.cluster.list("Pod", predicate=podutil.is_active)
+            if p.spec.node_name
+        }
+
+    # -- demand --------------------------------------------------------------
+    def pending_gang_demand(self) -> Dict[Profile, int]:
+        """One sub-slice per COMPLETE pending gang (all members present and
+        helped by extra resources); a gang is one workload, not N."""
+        gangs: Dict[str, List[Pod]] = {}
+        for pod in self.cluster.list(
+            "Pod", predicate=podutil.extra_resources_could_help_scheduling
+        ):
+            profile = wanted_subslice_topology(pod)
+            gang = gang_of(pod)
+            if profile is None or gang is None:
+                continue
+            gangs.setdefault(gang, []).append(pod)
+        demand: Dict[Profile, int] = {}
+        for gang, pods in gangs.items():
+            size = gang_size_of(pods[0])
+            if len(pods) < size:
+                continue  # incomplete gang: wait for all members
+            profile = wanted_subslice_topology(pods[0])
+            demand[profile] = demand.get(profile, 0) + 1
+        return demand
+
+    # -- the planning cycle --------------------------------------------------
+    def process_batch_if_ready(self) -> bool:
+        ready = bool(self.batcher.drain_if_ready())
+        if not ready and not self._resync_due():
+            return False
+        demand = self.pending_gang_demand()
+        if not demand:
+            self._last_cycle_at = self._now()
+            return False
+        plan_id = f"{int(self._now())}-{uuid.uuid4().hex[:8]}"
+        planned_any = False
+        active = self._active_node_names()
+        node_has_workload = active.__contains__
+        for slice_id, nodes in sorted(self.member_nodes().items()):
+            group = SliceGroup.from_nodes(slice_id, nodes)
+            if not group.all_reported():
+                logger.info(
+                    "group partitioner: slice %s waiting on host reports", slice_id
+                )
+                continue
+            desired = group.plan_subslices(demand, node_has_workload)
+            if desired is None:
+                continue
+            current = group.current_subslices(node_has_workload)
+            if {s.id for s in desired} == {s.id for s in current}:
+                continue  # no change
+            self._actuate(group, desired, plan_id)
+            planned_any = True
+            # Satisfied demand is satisfied once; don't double-carve on the
+            # next group.
+            for s in desired:
+                if s.profile in demand and s.id not in {c.id for c in current}:
+                    demand[s.profile] -= 1
+                    if demand[s.profile] <= 0:
+                        del demand[s.profile]
+            if not demand:
+                break
+        self._last_cycle_at = self._now()
+        return planned_any
+
+    def _resync_due(self) -> bool:
+        if self.resync_s <= 0:
+            return False
+        return (self._now() - self._last_cycle_at) >= self.resync_s
+
+    # -- actuation -----------------------------------------------------------
+    def _actuate(
+        self, group: SliceGroup, subslices: List[SubSlice], plan_id: str
+    ) -> None:
+        assignment = group.assignment(subslices)
+        for node_name, subslice in assignment.items():
+            def mutate(node: Node, subslice=subslice) -> None:
+                ann = node.metadata.annotations
+                if subslice is None:
+                    ann.pop(constants.ANNOTATION_SPEC_SUBSLICE_ID, None)
+                    ann.pop(constants.ANNOTATION_SPEC_SUBSLICE_TOPOLOGY, None)
+                    ann.pop(constants.ANNOTATION_SPEC_SUBSLICE_ORIGIN, None)
+                else:
+                    ann[constants.ANNOTATION_SPEC_SUBSLICE_ID] = subslice.id
+                    ann[constants.ANNOTATION_SPEC_SUBSLICE_TOPOLOGY] = (
+                        subslice.profile.name
+                    )
+                    ann[constants.ANNOTATION_SPEC_SUBSLICE_ORIGIN] = ",".join(
+                        str(o * h)
+                        for o, h in zip(
+                            subslice.host_origin, group.host_shape.dims
+                        )
+                    )
+                ann[constants.ANNOTATION_SPEC_PLAN] = plan_id
+
+            try:
+                self.cluster.patch("Node", "", node_name, mutate)
+            except NotFoundError:
+                continue
+        logger.info(
+            "group partitioner: slice %s plan %s -> %d sub-slices",
+            group.slice_id,
+            plan_id,
+            len(subslices),
+        )
+
+    def run(self, poll_s: float = 0.5) -> None:
+        while not self._stop.is_set():
+            self.process_batch_if_ready()
+            self._stop.wait(poll_s)
+
+
+class HostAgent:
+    """Per-host acknowledger: mirrors the spec sub-slice assignment into
+    status annotations + scheduling labels. The real-device analog would also
+    (re)initialize the local TPU runtime for the new ICI neighbor set; the
+    fake path models that as instantaneous."""
+
+    def __init__(self, cluster: Cluster, node_name: str):
+        self.cluster = cluster
+        self.node_name = node_name
+        self._unsub = None
+
+    def start_watching(self) -> None:
+        def on_node(ev: Event) -> None:
+            if ev.type == EventType.DELETED or ev.obj.metadata.name != self.node_name:
+                return
+            spec_keys = (
+                constants.ANNOTATION_SPEC_SUBSLICE_ID,
+                constants.ANNOTATION_SPEC_SUBSLICE_TOPOLOGY,
+                constants.ANNOTATION_SPEC_PLAN,
+            )
+            new = {k: ev.obj.metadata.annotations.get(k) for k in spec_keys}
+            old = (
+                {k: ev.old_obj.metadata.annotations.get(k) for k in spec_keys}
+                if ev.old_obj is not None
+                else None
+            )
+            if new != old:
+                self.reconcile()
+
+        self._unsub = self.cluster.watch("Node", on_node, replay=False)
+
+    def stop(self) -> None:
+        if self._unsub:
+            self._unsub()
+
+    def reconcile(self) -> None:
+        node = self.cluster.try_get("Node", "", self.node_name)
+        if node is None:
+            return
+        ann = node.metadata.annotations
+        spec_id = ann.get(constants.ANNOTATION_SPEC_SUBSLICE_ID)
+        spec_topo = ann.get(constants.ANNOTATION_SPEC_SUBSLICE_TOPOLOGY)
+        spec_plan = ann.get(constants.ANNOTATION_SPEC_PLAN)
+
+        # Never tear a sub-slice out from under a running workload: refuse to
+        # ack an UNASSIGNMENT (or re-assignment) while a pod on this host is
+        # still active. The group planner keeps in-use sub-slices pinned, so
+        # this only triggers on planner/agent races.
+        current_id = node.metadata.labels.get(constants.LABEL_TPU_SUBSLICE_ID)
+        if current_id and spec_id != current_id and self._has_active_pod():
+            logger.warning(
+                "host agent %s: refusing to drop in-use sub-slice %s",
+                self.node_name,
+                current_id,
+            )
+            return
+
+        # No-op guard: reconcile also runs periodically (to retry a refused
+        # ack once the blocking workload completes), so a patch must only
+        # happen when something actually changes.
+        unchanged = (
+            ann.get(constants.ANNOTATION_STATUS_SUBSLICE_ID) == spec_id
+            and ann.get(constants.ANNOTATION_STATUS_SUBSLICE_TOPOLOGY)
+            == (spec_topo if spec_id else None)
+            and node.metadata.labels.get(constants.LABEL_TPU_SUBSLICE_ID) == spec_id
+            and (spec_plan is None or ann.get(constants.ANNOTATION_STATUS_PLAN) == spec_plan)
+        )
+        if unchanged:
+            return
+
+        def mutate(n: Node) -> None:
+            a = n.metadata.annotations
+            if spec_id:
+                a[constants.ANNOTATION_STATUS_SUBSLICE_ID] = spec_id
+                a[constants.ANNOTATION_STATUS_SUBSLICE_TOPOLOGY] = spec_topo or ""
+                n.metadata.labels[constants.LABEL_TPU_SUBSLICE_ID] = spec_id
+                n.metadata.labels[constants.LABEL_TPU_SUBSLICE_TOPOLOGY] = (
+                    spec_topo or ""
+                )
+            else:
+                a.pop(constants.ANNOTATION_STATUS_SUBSLICE_ID, None)
+                a.pop(constants.ANNOTATION_STATUS_SUBSLICE_TOPOLOGY, None)
+                n.metadata.labels.pop(constants.LABEL_TPU_SUBSLICE_ID, None)
+                n.metadata.labels.pop(constants.LABEL_TPU_SUBSLICE_TOPOLOGY, None)
+            if spec_plan is not None:
+                a[constants.ANNOTATION_STATUS_PLAN] = spec_plan
+
+        try:
+            self.cluster.patch("Node", "", self.node_name, mutate)
+        except NotFoundError:
+            return
+
+    def _has_active_pod(self) -> bool:
+        return any(
+            True
+            for _ in self.cluster.list(
+                "Pod",
+                predicate=lambda p: (
+                    p.spec.node_name == self.node_name and podutil.is_active(p)
+                ),
+            )
+        )
+
+    def startup(self) -> None:
+        self.reconcile()

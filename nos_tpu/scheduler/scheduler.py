@@ -110,7 +110,33 @@ class Scheduler:
         pending = self.pending_pods()
         self.capacity.nominated_pods = [p for p in pending if p.status.nominated_node_name]
         nodes = self.node_infos()
+        # Gangs are scheduling UNITS interleaved with single pods in priority
+        # order (a gang handled before higher-priority singles would consume
+        # shared quota out of turn). A gang's priority is its best member's.
+        units: List[tuple] = []
+        gangs: dict = {}
         for pod in pending:
+            gang = podutil.gang_of(pod)
+            if gang is None:
+                units.append((-pod.spec.priority, pod.metadata.creation_timestamp,
+                              pod.metadata.namespaced_name, "pod", pod))
+            else:
+                gangs.setdefault(gang, []).append(pod)
+        for gang_name, pods in gangs.items():
+            best = min(
+                (-p.spec.priority, p.metadata.creation_timestamp,
+                 p.metadata.namespaced_name)
+                for p in pods
+            )
+            units.append(best + ("gang", (gang_name, pods)))
+        for *_, kind, item in sorted(units, key=lambda u: u[:3]):
+            if kind == "gang":
+                gang_name, pods = item
+                g_bound, g_unsched = self._schedule_gangs({gang_name: pods}, nodes)
+                bound.extend(g_bound)
+                unschedulable.extend(g_unsched)
+                continue
+            pod = item
             result = self.schedule_one(pod, nodes)
             if result is None:
                 if pod.status.nominated_node_name:
@@ -166,6 +192,129 @@ class Scheduler:
         best.pods.append(pod)
         return best.name
 
+    # -- gang scheduling (multi-host workloads) ------------------------------
+    def _schedule_gangs(self, gangs: dict, nodes: List[NodeInfo]):
+        """All-or-nothing binding of complete gangs onto ONE carved sub-slice:
+        every member pod lands on a distinct host carrying the same
+        subslice-id label. A multi-host JAX job is a single ICI mesh; pods
+        scattered across different sub-slices (which plain per-pod scheduling
+        would happily do, since every host of the right topology matches the
+        node selector) would not be connected."""
+        bound, unschedulable = [], []
+        for gang_name in sorted(gangs):
+            pods = sorted(gangs[gang_name], key=lambda p: p.metadata.name)
+            size = podutil.gang_size_of(pods[0])
+            if len(pods) != size:
+                # Too few: wait for the rest. Too many: mis-labeled gang —
+                # either way every member gets a visible condition instead of
+                # silent starvation.
+                for pod in pods:
+                    self._mark_unschedulable(
+                        pod,
+                        Status.unschedulable(
+                            f"gang {gang_name}: {len(pods)}/{size} members present"
+                        ),
+                    )
+                    unschedulable.append(pod.metadata.namespaced_name)
+                continue
+            placed = self._try_place_gang(gang_name, pods, nodes)
+            if placed is None:
+                for pod in pods:
+                    self._mark_unschedulable(
+                        pod,
+                        Status.unschedulable(
+                            f"gang {gang_name}: no sub-slice with {size} free hosts"
+                        ),
+                    )
+                    unschedulable.append(pod.metadata.namespaced_name)
+            else:
+                bound.extend(placed)
+        return bound, unschedulable
+
+    def _try_place_gang(
+        self, gang_name: str, pods: List[Pod], nodes: List[NodeInfo]
+    ) -> Optional[List]:
+        """Find one sub-slice with enough feasible hosts and bind every pod;
+        rolls back reservations if any member fails."""
+        from nos_tpu import constants as C
+
+        wanted = podutil.wanted_subslice_topology(pods[0])
+        by_subslice: dict = {}
+        for node in nodes:
+            sid = node.labels.get(C.LABEL_TPU_SUBSLICE_ID)
+            if not sid:
+                continue
+            if wanted is not None and (
+                node.labels.get(C.LABEL_TPU_SUBSLICE_TOPOLOGY) != wanted.name
+            ):
+                continue
+            by_subslice.setdefault(sid, []).append(node)
+        for sid in sorted(by_subslice, key=lambda s: (len(by_subslice[s]), s)):
+            hosts = by_subslice[sid]
+            if len(hosts) < len(pods):
+                continue
+            state = CycleState()
+            # Feasibility + reservation per member, in order: reserving
+            # against LIVE quota usage makes each subsequent member's
+            # PreFilter see its gang-mates' share (the same semantics the
+            # per-pod path gets from reserve-after-bind). Roll every
+            # reservation back if any member cannot place.
+            hosts = sorted(hosts, key=lambda n: n.name)
+            assignment = []
+            used_hosts: set = set()
+            feasible = True
+            for pod in pods:
+                if not self.framework.run_pre_filter(state, pod).is_success:
+                    feasible = False
+                    break
+                target = None
+                for host in hosts:
+                    if host.name in used_hosts:
+                        continue
+                    if self.framework.run_filters_with_nominated_pods(
+                        state, pod, host, self.capacity.nominated_pods
+                    ).is_success:
+                        target = host
+                        break
+                if target is None or not self.framework.run_reserve(
+                    state, pod, target.name
+                ).is_success:
+                    feasible = False
+                    break
+                used_hosts.add(target.name)
+                assignment.append((pod, target))
+            if not feasible:
+                for pod, host in assignment:
+                    self.framework.run_unreserve(state, pod, host.name)
+                continue
+            # Commit: every member holds a reservation; bind them all.
+            bound_members = []
+            try:
+                for pod, host in assignment:
+                    self._bind(pod, host.name)
+                    bound_members.append((pod, host))
+                    host.requested = host.requested.add(
+                        self.calculator.compute_pod_request(pod)
+                    )
+                    host.pods.append(pod)
+            except Exception:
+                for pod, host in assignment:
+                    self.framework.run_unreserve(state, pod, host.name)
+                for pod, _ in bound_members:
+                    self._unbind(pod)
+                logger.exception("gang %s: rollback on %s", gang_name, sid)
+                return None
+            logger.info(
+                "gang %s bound to sub-slice %s (%d hosts)",
+                gang_name,
+                sid,
+                len(assignment),
+            )
+            return [
+                (pod.metadata.namespaced_name, host.name) for pod, host in assignment
+            ]
+        return None
+
     # -- cluster mutations ---------------------------------------------------
     def _bind(self, pod: Pod, node_name: str) -> None:
         def mutate(p: Pod) -> None:
@@ -184,6 +333,21 @@ class Scheduler:
         self.cluster.patch("Pod", pod.metadata.namespace, pod.metadata.name, mutate)
         pod.spec.node_name = node_name
         logger.info("bound %s to %s", pod.metadata.namespaced_name, node_name)
+
+    def _unbind(self, pod: Pod) -> None:
+        """Gang rollback: return an already-bound member to pending."""
+
+        def mutate(p: Pod) -> None:
+            p.spec.node_name = ""
+            p.status.phase = PodPhase.PENDING
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ]
+
+        try:
+            self.cluster.patch("Pod", pod.metadata.namespace, pod.metadata.name, mutate)
+        except NotFoundError:
+            pass
 
     def _mark_unschedulable(self, pod: Pod, status: Status) -> None:
         # Only patch on transition: re-stamping an already-Unschedulable pod

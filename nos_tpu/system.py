@@ -100,6 +100,8 @@ def build_partitioner_controllers(
         ),
     }
     for mode in config.modes:
+        if mode == constants.KIND_TPU_MULTIHOST:
+            continue  # host-group carving runs in the dedicated GroupPartitioner
         taker, partitioner = mode_wiring[mode]
         controllers[mode] = PartitionerController(
             cluster=cluster,
@@ -167,12 +169,38 @@ class ControlPlane:
         self.partitioners = build_partitioner_controllers(
             self.cluster, self.state, self.scheduler, partitioner_config, now=now
         )
+        p_cfg = partitioner_config or PartitionerConfig()
+        from nos_tpu.controllers.slice_group import GroupPartitioner, HostAgent
+
+        # Gated on config.modes like every other partitioning mode; it runs
+        # as a dedicated controller only because carving host groups has a
+        # different shape (gang demand, slice-level barrier) than the
+        # per-node planner.
+        self.group_partitioner: Optional[GroupPartitioner] = None
+        if constants.KIND_TPU_MULTIHOST in p_cfg.modes:
+            self.group_partitioner = GroupPartitioner(
+                self.cluster,
+                batch_timeout_s=p_cfg.batch_window_timeout_s,
+                batch_idle_s=p_cfg.batch_window_idle_s,
+                now=now,
+            )
+        self.host_agents: Dict[str, HostAgent] = {}
         self.agents: Dict[str, TpuAgent] = {}
         self.monitors: List[DeviceHealthMonitor] = []
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.health.add_healthz("cluster", lambda: None)
         self.health.add_readyz("state", lambda: None)
+
+    def add_host_agent(self, node_name: str):
+        """Member-host agent for a multi-host slice group."""
+        from nos_tpu.controllers.slice_group import HostAgent
+
+        agent = HostAgent(self.cluster, node_name)
+        agent.startup()
+        agent.start_watching()
+        self.host_agents[node_name] = agent
+        return agent
 
     def add_tpu_agent(self, node_name: str, client=None, config=None) -> TpuAgent:
         agent = build_tpu_agent(self.cluster, node_name, config, client)
@@ -188,6 +216,8 @@ class ControlPlane:
         self.quota_reconciler.start_watching()
         for controller in self.partitioners.values():
             controller.start_watching()
+        if self.group_partitioner is not None:
+            self.group_partitioner.start_watching()
         return self
 
     def tick(self) -> dict:
@@ -199,9 +229,20 @@ class ControlPlane:
         # reshape freed slices. No-op patch-free when nothing changed.
         for agent in self.agents.values():
             agent.report()
+        # Host agents re-reconcile too: an ack refused while a workload was
+        # still running must retry after it completes (patch-free when
+        # nothing changed).
+        for host_agent in self.host_agents.values():
+            host_agent.reconcile()
         for controller in self.partitioners.values():
             if controller.process_batch_if_ready():
                 metrics.inc("nos_tpu_partitioning_cycles", kind=controller.kind)
+        if self.group_partitioner is not None and (
+            self.group_partitioner.process_batch_if_ready()
+        ):
+            metrics.inc(
+                "nos_tpu_partitioning_cycles", kind=constants.KIND_TPU_MULTIHOST
+            )
         result_after = self.scheduler.schedule_pending()
         return {"first_pass": result, "second_pass": result_after}
 

@@ -73,13 +73,21 @@ def _split(block: Block, want: Coord) -> Tuple[Block, List[Block]]:
     return Block(origin, want), remainders
 
 
-def _place_one(free: List[Block], profile: Profile) -> Optional[Placement]:
+def _place_one(
+    free: List[Block],
+    profile: Profile,
+    allowed_dims: Optional[Tuple[Coord, ...]] = None,
+) -> Optional[Placement]:
     """Best-fit: smallest free block (ties: lexicographic origin) and the first
-    orientation (canonical order) that fits."""
+    orientation (canonical order) that fits. `allowed_dims` restricts the
+    orientations tried (host-grid packing on anisotropic hosts: only
+    rotations that keep the carved chip region congruent are legal)."""
     best: Optional[Tuple[int, Coord, int, Coord]] = None  # (chips, origin, idx, want)
     for idx, block in enumerate(free):
         for orient in profile.shape.orientations():
             want = orient.dims
+            if allowed_dims is not None and want not in allowed_dims:
+                continue
             if _fits(block, want):
                 key = (block.chips, block.origin, idx, want)
                 if best is None or key < best:
@@ -159,10 +167,12 @@ def pack_into(
     mesh: Shape,
     occupied: List[Tuple[Coord, Coord]],
     geometry: Mapping[Profile, int],
+    allowed_dims: Optional[Mapping[Profile, Tuple[Coord, ...]]] = None,
 ) -> Optional[List[Placement]]:
     """Place `geometry` into the mesh *around* already-placed blocks
     ((origin, dims) pairs). Used by node agents to add slices without moving
-    existing ones; None if the addition cannot fit."""
+    existing ones; None if the addition cannot fit. `allowed_dims` optionally
+    restricts the orientations per profile."""
     free: List[Block] = [Block((0,) * mesh.rank, mesh.dims)]
     for origin, dims in occupied:
         free = _subtract_block(free, Block(tuple(origin), tuple(dims)))
@@ -171,8 +181,9 @@ def pack_into(
     for profile in sorted(geometry, key=lambda p: (-p.chips, p.name)):
         if profile.shape.rank != mesh.rank:
             return None
+        restrict = allowed_dims.get(profile) if allowed_dims else None
         for _ in range(geometry[profile]):
-            placed = _place_one(free, profile)
+            placed = _place_one(free, profile, restrict)
             if placed is None:
                 return None
             placements.append(placed)

@@ -58,3 +58,33 @@ def is_active(pod: Pod) -> bool:
         PodPhase.SUCCEEDED,
         PodPhase.FAILED,
     )
+
+
+# -- gang membership (multi-host workloads: one pod per host) ----------------
+def gang_of(pod: Pod):
+    """'<ns>/<gang-name>' or None."""
+    name = pod.metadata.labels.get(constants.LABEL_GANG)
+    if not name:
+        return None
+    return f"{pod.metadata.namespace}/{name}"
+
+
+def gang_size_of(pod: Pod) -> int:
+    try:
+        return int(pod.metadata.labels.get(constants.LABEL_GANG_SIZE, "1"))
+    except ValueError:
+        return 1
+
+
+def wanted_subslice_topology(pod: Pod):
+    """The sub-slice shape a gang pod selects (its nodeSelector on the
+    subslice-topology label), as a Profile; None for non-gang pods."""
+    value = pod.spec.node_selector.get(constants.LABEL_TPU_SUBSLICE_TOPOLOGY)
+    if not value:
+        return None
+    from nos_tpu.tpu import Profile
+
+    try:
+        return Profile.parse(value)
+    except Exception:  # noqa: BLE001
+        return None

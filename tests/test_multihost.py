@@ -1,0 +1,301 @@
+"""Multi-host podslice carving + gang scheduling, end to end.
+
+A multi-host TPU pod is a node pool: one Node per host VM exposing only its
+local chips. Carving it into ICI-contiguous sub-slices is host-block
+assignment, actuated through per-host spec/status annotations with a
+slice-LEVEL plan barrier (every member host must ack before re-planning), and
+consumed by gangs — one pod per host, all-or-nothing, all members on ONE
+sub-slice id (SURVEY.md §7 hard parts; BASELINE.json north star:
+"carve a v5e-256 into ICI-contiguous sub-slices").
+"""
+
+from nos_tpu import constants
+from nos_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.system import ControlPlane
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_group(plane, slice_id="s0", global_topo="8x8", host_topo="2x2", grid=(4, 4)):
+    """Create a slice group: grid[0] x grid[1] hosts of host_topo chips."""
+    names = []
+    for r in range(grid[0]):
+        for c in range(grid[1]):
+            name = f"{slice_id}-host-{r}-{c}"
+            plane.cluster.create(
+                Node(
+                    metadata=ObjectMeta(
+                        name=name,
+                        labels={
+                            constants.LABEL_PARTITIONING: constants.KIND_TPU_MULTIHOST,
+                            constants.LABEL_TPU_SLICE: slice_id,
+                            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                            constants.LABEL_TPU_TOPOLOGY: global_topo,
+                            constants.LABEL_TPU_HOST_TOPOLOGY: host_topo,
+                            constants.LABEL_TPU_HOST_COORD: f"{r},{c}",
+                        },
+                    ),
+                    status=NodeStatus(
+                        allocatable=ResourceList.of(
+                            {"cpu": 32, "memory": "64Gi", "google.com/tpu": 4}
+                        )
+                    ),
+                )
+            )
+            plane.add_host_agent(name)
+            names.append(name)
+    return names
+
+
+def submit_gang(plane, name, ns, topology, size, priority=0):
+    pods = []
+    for i in range(size):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{name}-{i}",
+                namespace=ns,
+                labels={
+                    constants.LABEL_GANG: name,
+                    constants.LABEL_GANG_SIZE: str(size),
+                },
+            ),
+            spec=PodSpec(
+                containers=[
+                    Container(resources=ResourceList.of({"google.com/tpu": 4, "cpu": 1}))
+                ],
+                scheduler_name=constants.SCHEDULER_NAME,
+                priority=priority,
+                node_selector={constants.LABEL_TPU_SUBSLICE_TOPOLOGY: topology},
+            ),
+        )
+        plane.cluster.create(pod)
+        pods.append(pod)
+    return pods
+
+
+def build_plane():
+    clock = Clock()
+    plane = ControlPlane(now=clock).start()
+    return plane, clock
+
+
+def tick(plane, clock, dt=61.0):
+    plane.scheduler.schedule_pending()
+    clock.t += dt
+    plane.group_partitioner.process_batch_if_ready()
+    return plane.scheduler.schedule_pending()
+
+
+def gang_nodes(plane, ns, name, size):
+    out = []
+    for i in range(size):
+        pod = plane.cluster.get("Pod", ns, f"{name}-{i}")
+        out.append((pod.spec.node_name, pod.status.phase))
+    return out
+
+
+def test_gang_carve_and_bind():
+    plane, clock = build_plane()
+    make_group(plane)  # 8x8 chips = 4x4 hosts of 2x2
+    submit_gang(plane, "train", "ml", "4x8", size=8)  # 4x8 chips = 2x4 hosts
+    result = tick(plane, clock)
+    assert len(result["bound"]) == 8
+    placements = gang_nodes(plane, "ml", "train", 8)
+    hosts = [n for n, phase in placements]
+    assert all(phase == PodPhase.RUNNING for _, phase in placements)
+    assert len(set(hosts)) == 8  # one pod per host
+    # All hosts share one sub-slice id with the requested topology.
+    sids = set()
+    for h in hosts:
+        node = plane.cluster.get("Node", "", h)
+        sids.add(node.metadata.labels[constants.LABEL_TPU_SUBSLICE_ID])
+        assert (
+            node.metadata.labels[constants.LABEL_TPU_SUBSLICE_TOPOLOGY] == "4x8"
+        )
+    assert len(sids) == 1
+
+
+def test_two_gangs_disjoint_blocks():
+    plane, clock = build_plane()
+    make_group(plane)
+    submit_gang(plane, "a", "ml", "4x8", size=8)
+    submit_gang(plane, "b", "ml", "4x8", size=8)
+    result = tick(plane, clock)
+    assert len(result["bound"]) == 16
+    hosts_a = {n for n, _ in gang_nodes(plane, "ml", "a", 8)}
+    hosts_b = {n for n, _ in gang_nodes(plane, "ml", "b", 8)}
+    assert not (hosts_a & hosts_b)
+    sid_a = {
+        plane.cluster.get("Node", "", h).metadata.labels[
+            constants.LABEL_TPU_SUBSLICE_ID
+        ]
+        for h in hosts_a
+    }
+    sid_b = {
+        plane.cluster.get("Node", "", h).metadata.labels[
+            constants.LABEL_TPU_SUBSLICE_ID
+        ]
+        for h in hosts_b
+    }
+    assert len(sid_a) == 1 and len(sid_b) == 1 and sid_a != sid_b
+
+
+def test_incomplete_gang_waits():
+    plane, clock = build_plane()
+    make_group(plane)
+    pods = submit_gang(plane, "partial", "ml", "4x8", size=8)
+    # Delete two members: 6/8 present.
+    for pod in pods[6:]:
+        plane.cluster.delete("Pod", "ml", pod.metadata.name)
+    result = tick(plane, clock)
+    assert result["bound"] == []
+    for i in range(6):
+        pod = plane.cluster.get("Pod", "ml", f"partial-{i}")
+        assert pod.status.phase == PodPhase.PENDING
+    # No sub-slice was carved for the incomplete gang.
+    for node in plane.cluster.list("Node"):
+        assert constants.LABEL_TPU_SUBSLICE_ID not in node.metadata.labels
+
+
+def test_slice_level_barrier_blocks_replanning():
+    plane, clock = build_plane()
+    names = make_group(plane)
+    # Silence one host agent: its node will never ack plans.
+    plane.host_agents[names[0]].stop()
+    submit_gang(plane, "a", "ml", "2x4", size=2)
+    tick(plane, clock)
+    node0 = plane.cluster.get("Node", "", names[0])
+    if node0.metadata.annotations.get(constants.ANNOTATION_SPEC_PLAN):
+        # The first plan reached the silenced host: its ack is missing, so a
+        # NEW demand must not trigger another plan for this group.
+        submit_gang(plane, "b", "ml", "2x4", size=2)
+        before = {
+            n.metadata.name: n.metadata.annotations.get(constants.ANNOTATION_SPEC_PLAN)
+            for n in plane.cluster.list("Node")
+        }
+        clock.t += 61
+        plane.group_partitioner.process_batch_if_ready()
+        after = {
+            n.metadata.name: n.metadata.annotations.get(constants.ANNOTATION_SPEC_PLAN)
+            for n in plane.cluster.list("Node")
+        }
+        assert before == after
+
+
+def test_in_use_subslice_never_reassigned():
+    plane, clock = build_plane()
+    make_group(plane)
+    submit_gang(plane, "run", "ml", "4x8", size=8)
+    tick(plane, clock)
+    hosts_before = {n for n, _ in gang_nodes(plane, "ml", "run", 8)}
+    sid_before = {
+        plane.cluster.get("Node", "", h).metadata.labels[
+            constants.LABEL_TPU_SUBSLICE_ID
+        ]
+        for h in hosts_before
+    }
+    # A new gang demanding the WHOLE mesh cannot fit around the running one.
+    submit_gang(plane, "huge", "ml", "8x8", size=16)
+    result = tick(plane, clock)
+    assert result["bound"] == []
+    # The running gang's sub-slice is untouched.
+    hosts_after = {n for n, _ in gang_nodes(plane, "ml", "run", 8)}
+    sid_after = {
+        plane.cluster.get("Node", "", h).metadata.labels[
+            constants.LABEL_TPU_SUBSLICE_ID
+        ]
+        for h in hosts_after
+    }
+    assert hosts_after == hosts_before
+    assert sid_after == sid_before
+
+
+def test_completed_gang_frees_hosts_for_recarve():
+    plane, clock = build_plane()
+    make_group(plane)
+    submit_gang(plane, "first", "ml", "8x8", size=16)  # whole mesh
+    result = tick(plane, clock)
+    assert len(result["bound"]) == 16
+    # The workload finishes.
+    for i in range(16):
+        plane.cluster.patch(
+            "Pod", "ml", f"first-{i}",
+            lambda p: setattr(p.status, "phase", PodPhase.SUCCEEDED),
+        )
+    # A differently-shaped gang must be able to re-carve over the freed block.
+    submit_gang(plane, "second", "ml", "4x8", size=8)
+    result = tick(plane, clock)
+    assert len(result["bound"]) == 8
+    placements = gang_nodes(plane, "ml", "second", 8)
+    assert all(phase == PodPhase.RUNNING for _, phase in placements)
+
+
+def test_gang_quota_enforced():
+    plane, clock = build_plane()
+    from nos_tpu.api.quota_types import build_eq
+
+    # ml's quota caps accelerator memory at 8 chips' worth (8 x 16GB).
+    plane.cluster.create(
+        build_eq(
+            "ml", "q",
+            min={constants.RESOURCE_ACCELERATOR_MEMORY: 128},
+            max={constants.RESOURCE_ACCELERATOR_MEMORY: 128},
+        )
+    )
+    make_group(plane)
+    submit_gang(plane, "big", "ml", "8x8", size=16)  # 64 chips >> quota
+    result = tick(plane, clock)
+    assert result["bound"] == []
+    for i in range(16):
+        pod = plane.cluster.get("Pod", "ml", f"big-{i}")
+        assert pod.status.phase == PodPhase.PENDING
+
+
+def test_anisotropic_hosts_never_rotate_into_wrong_chip_shape():
+    """v4-style hosts are 2x2x1 chips: rotating a host block changes the
+    carved CHIP shape. The planner must only use orientations whose chip
+    region stays congruent to the requested profile."""
+    from nos_tpu.tpu import Profile, Topology
+    from nos_tpu.tpu.shape import Shape
+    from nos_tpu.tpu.slice_group import HostInfo, SliceGroup
+
+    topo = Topology.parse("v4", "4x4x4")  # 64 chips
+    host = Shape.parse("2x2x1")           # host grid 2x2x4
+    hosts = {}
+    for r in range(2):
+        for c in range(2):
+            for d in range(4):
+                coord = (r, c, d)
+                hosts[coord] = HostInfo(
+                    node_name=f"h-{r}-{c}-{d}",
+                    coord=coord,
+                    subslice_id=None,
+                    spec_subslice_id=None,
+                    reported_plan=True,
+                )
+    group = SliceGroup("s0", topo, host, hosts)
+    # 2x2x4 chips = 1x1x4 host block; rotations like 4x1x1 host units would
+    # carve 8x2x1 chips — NOT congruent to 2x2x4.
+    want = Profile.parse("2x2x4")
+    planned = group.plan_subslices({want: 1}, lambda n: False)
+    assert planned is not None and len(planned) == 1
+    sub = planned[0]
+    chip_dims = tuple(
+        d * h for d, h in zip(sub.host_dims, host.dims)
+    )
+    assert sorted(chip_dims) == sorted(want.shape.dims)
