@@ -222,6 +222,9 @@ def cmd_simulate(args) -> int:
     setup_logging("WARNING")
     from nos_tpu.sim import WorkloadSim, mixed_workload
 
+    if args.multihost:
+        return _simulate_multihost(args)
+
     from nos_tpu.tpu import Topology
     from nos_tpu.tpu.topology import _ACCELERATOR_GENERATIONS as ACCELERATOR_GENERATIONS
 
@@ -250,6 +253,55 @@ def cmd_simulate(args) -> int:
         args.jobs,
         seed=args.seed,
         profiles=profiles,
+        mean_interarrival_s=args.interarrival,
+        duration_range_s=(args.min_duration, args.max_duration),
+    )
+    window = (args.window_start, args.window_end) if args.window_end > 0 else None
+    report = sim.run(jobs, measure_window=window, max_s=args.max_seconds)
+    print(json.dumps(report.to_dict()))
+    return 0
+
+
+def _simulate_multihost(args) -> int:
+    """Multi-host variant: one slice group carved by the GroupPartitioner,
+    consumed by gang workloads (the north star at its true shape)."""
+    import json
+    import math
+
+    from nos_tpu.sim import MultiHostSim, mixed_gang_workload
+    from nos_tpu.tpu.shape import Shape
+
+    global_shape = Shape.parse(args.topology)
+    host_shape = Shape.parse(args.host_topology)
+    if not host_shape.divides(global_shape):
+        print(
+            f"host topology {args.host_topology} does not tile {args.topology}",
+            file=sys.stderr,
+        )
+        return 2
+    grid = tuple(g // h for g, h in zip(global_shape.dims, host_shape.dims))
+    if len(grid) != 2:
+        print("multihost simulation currently models 2D slice groups", file=sys.stderr)
+        return 2
+    sim = MultiHostSim(
+        groups={"slice-0": (args.topology, args.host_topology, grid)},
+        generation_label=args.generation,
+    )
+    # Gang mix: host-aligned sub-slice shapes up to the full mesh.
+    shapes = []
+    d = list(host_shape.dims)
+    w = 1.0
+    while all(x <= g for x, g in zip(d, global_shape.dims)):
+        hosts = math.prod(x // h for x, h in zip(d, host_shape.dims))
+        shapes.append(("x".join(map(str, d)), hosts, w))
+        # Grow the smaller axis first (2x2 -> 2x4 -> 4x4 -> 4x8 ...).
+        i = min(range(len(d)), key=lambda j: d[j])
+        d = [x * 2 if j == i else x for j, x in enumerate(d)]
+        w /= 2
+    jobs = mixed_gang_workload(
+        args.jobs,
+        seed=args.seed,
+        shapes=tuple(shapes),
         mean_interarrival_s=args.interarrival,
         duration_range_s=(args.min_duration, args.max_duration),
     )
@@ -309,6 +361,16 @@ def main(argv=None) -> int:
     p_sim.add_argument("--window-start", type=float, default=180.0)
     p_sim.add_argument("--window-end", type=float, default=900.0)
     p_sim.add_argument("--max-seconds", type=float, default=86400.0)
+    p_sim.add_argument(
+        "--multihost",
+        action="store_true",
+        help="simulate ONE multi-host slice group with gang workloads",
+    )
+    p_sim.add_argument(
+        "--host-topology",
+        default="2x2",
+        help="chips per host VM in --multihost mode",
+    )
 
     args = parser.parse_args(argv)
     handlers = {

@@ -365,6 +365,293 @@ def mixed_workload(
     return jobs
 
 
+@dataclass
+class GangJob:
+    """A multi-host workload: `hosts` pods, one per host, gang-bound onto a
+    sub-slice of `topology` chips."""
+
+    name: str
+    namespace: str
+    topology: str  # chip shape, e.g. "4x8"
+    hosts: int
+    arrival_s: float
+    duration_s: float
+    priority: int = 0
+
+
+class MultiHostSim:
+    """North-star scenario at its true shape: slice groups of host nodes
+    (one Node per VM, local chips only), carved by the GroupPartitioner and
+    consumed by gang workloads. Chip accounting is per gang (hosts x chips
+    per host)."""
+
+    def __init__(
+        self,
+        groups: Dict[str, Tuple[str, str, Tuple[int, int]]],
+        generation_label: str = "tpu-v5-lite-podslice",
+        batch_timeout_s: float = 10.0,
+        batch_idle_s: float = 2.0,
+    ):
+        from nos_tpu.api.objects import Node, NodeStatus
+
+        self.clock = VirtualClock()
+        cfg = PartitionerConfig(
+            batch_window_timeout_s=batch_timeout_s,
+            batch_window_idle_s=batch_idle_s,
+        )
+        self.plane = ControlPlane(partitioner_config=cfg, now=self.clock)
+        self.total_chips = 0
+        self.chips_per_host: Dict[str, int] = {}
+        for slice_id, (global_topo, host_topo, grid) in groups.items():
+            host_chips = 1
+            for d in host_topo.split("x"):
+                host_chips *= int(d)
+            self.chips_per_host[slice_id] = host_chips
+            for r in range(grid[0]):
+                for c in range(grid[1]):
+                    name = f"{slice_id}-host-{r}-{c}"
+                    self.plane.cluster.create(
+                        Node(
+                            metadata=ObjectMeta(
+                                name=name,
+                                labels={
+                                    constants.LABEL_PARTITIONING: constants.KIND_TPU_MULTIHOST,
+                                    constants.LABEL_TPU_SLICE: slice_id,
+                                    constants.LABEL_TPU_ACCELERATOR: generation_label,
+                                    constants.LABEL_TPU_TOPOLOGY: global_topo,
+                                    constants.LABEL_TPU_HOST_TOPOLOGY: host_topo,
+                                    constants.LABEL_TPU_HOST_COORD: f"{r},{c}",
+                                },
+                            ),
+                            status=NodeStatus(
+                                allocatable=ResourceList.of(
+                                    {"cpu": 32, "memory": "64Gi",
+                                     constants.RESOURCE_TPU: host_chips}
+                                )
+                            ),
+                        )
+                    )
+                    self.plane.add_host_agent(name)
+                    self.total_chips += host_chips
+        self._host_chips = next(iter(self.chips_per_host.values()))
+        self.plane.start()
+
+    def run(
+        self,
+        jobs: Sequence[GangJob],
+        tick_s: float = 1.0,
+        max_s: float = 86_400.0,
+        measure_window: Optional[Tuple[float, float]] = None,
+    ) -> SimReport:
+        records: Dict[str, JobRecord] = {
+            j.name: JobRecord(job=SimJob(j.name, j.namespace, {}, j.arrival_s, j.duration_s, j.priority))
+            for j in jobs
+        }
+        gang_meta = {j.name: j for j in jobs}
+        pending_arrivals = sorted(jobs, key=lambda j: (j.arrival_s, j.name))
+        running: Dict[str, JobRecord] = {}
+        used_chip_seconds = 0.0
+        used_chip_seconds_busy = 0.0
+        used_chip_seconds_window = 0.0
+        backlog_seconds = 0.0
+        last_progress_s = 0.0
+
+        def gang_chips(name: str) -> int:
+            g = gang_meta[name]
+            p = Profile.parse(g.topology)
+            return p.chips
+
+        while self.clock.t < max_s:
+            now = self.clock.t
+            while pending_arrivals and pending_arrivals[0].arrival_s <= now:
+                job = pending_arrivals.pop(0)
+                self._submit(job)
+                records[job.name].submitted_s = now
+                last_progress_s = now
+            # Preempted gangs: losing ANY member kills the whole mesh; the
+            # workload controller restarts the gang from scratch.
+            for name, rec in list(running.items()):
+                g = gang_meta[name]
+                alive = [
+                    self.plane.cluster.try_get("Pod", g.namespace, f"{name}-{i}")
+                    for i in range(g.hosts)
+                ]
+                if any(m is None for m in alive):
+                    for i, m in enumerate(alive):
+                        if m is not None:
+                            try:
+                                self.plane.cluster.delete(
+                                    "Pod", g.namespace, f"{name}-{i}"
+                                )
+                            except Exception:  # noqa: BLE001
+                                pass
+                    rec.preemptions += 1
+                    rec.bound_s = None
+                    rec.node = None
+                    del running[name]
+                    self._submit(g)
+                    rec.submitted_s = now
+            # Completions.
+            for name, rec in list(running.items()):
+                if rec.bound_s is not None and now >= rec.bound_s + rec.job.duration_s:
+                    self._complete(gang_meta[name])
+                    rec.completed_s = now
+                    del running[name]
+                    last_progress_s = now
+            self.plane.tick()
+            # A gang is bound when every member runs.
+            for name, rec in records.items():
+                if rec.bound_s is not None or rec.submitted_s is None:
+                    continue
+                g = gang_meta[name]
+                members = [
+                    self.plane.cluster.try_get("Pod", g.namespace, f"{name}-{i}")
+                    for i in range(g.hosts)
+                ]
+                if all(
+                    m is not None and m.status.phase == PodPhase.RUNNING
+                    for m in members
+                ):
+                    rec.bound_s = now
+                    rec.node = members[0].spec.node_name
+                    running[name] = rec
+                    last_progress_s = now
+            tick_used = sum(gang_chips(n) for n in running)
+            used_chip_seconds += tick_used * tick_s
+            if any(
+                r.submitted_s is not None and r.bound_s is None
+                for r in records.values()
+            ):
+                used_chip_seconds_busy += tick_used * tick_s
+                backlog_seconds += tick_s
+            if measure_window and measure_window[0] <= now < measure_window[1]:
+                used_chip_seconds_window += tick_used * tick_s
+            if not pending_arrivals and not running and all(
+                r.completed_s is not None for r in records.values()
+            ):
+                break
+            if (
+                not pending_arrivals
+                and not running
+                and now - last_progress_s > 120.0
+            ):
+                break
+            self.clock.advance(tick_s)
+
+        horizon = max(self.clock.t, tick_s)
+        latencies = [r.latency_s for r in records.values() if r.latency_s is not None]
+        busy_window = max(backlog_seconds, tick_s)
+        if measure_window:
+            span = max(tick_s, min(measure_window[1], self.clock.t) - measure_window[0])
+            utilization_window = min(
+                1.0, used_chip_seconds_window / (self.total_chips * span)
+            )
+        else:
+            utilization_window = used_chip_seconds_busy / (self.total_chips * busy_window)
+        return SimReport(
+            total_chips=self.total_chips,
+            jobs=list(records.values()),
+            utilization=used_chip_seconds_busy / (self.total_chips * busy_window),
+            utilization_total=used_chip_seconds / (self.total_chips * horizon),
+            utilization_window=utilization_window,
+            p50_latency_s=_percentile(latencies, 0.50),
+            p95_latency_s=_percentile(latencies, 0.95),
+            makespan_s=horizon,
+            completed=sum(1 for r in records.values() if r.completed_s is not None),
+            unfinished=sum(1 for r in records.values() if r.completed_s is None),
+        )
+
+    def _submit(self, job: GangJob) -> None:
+        for i in range(job.hosts):
+            self.plane.cluster.create(
+                Pod(
+                    metadata=ObjectMeta(
+                        name=f"{job.name}-{i}",
+                        namespace=job.namespace,
+                        labels={
+                            constants.LABEL_GANG: job.name,
+                            constants.LABEL_GANG_SIZE: str(job.hosts),
+                        },
+                    ),
+                    spec=PodSpec(
+                        containers=[
+                            Container(
+                                resources=ResourceList.of(
+                                    {constants.RESOURCE_TPU: self._host_chips, "cpu": 1}
+                                )
+                            )
+                        ],
+                        scheduler_name=constants.SCHEDULER_NAME,
+                        priority=job.priority,
+                        node_selector={
+                            constants.LABEL_TPU_SUBSLICE_TOPOLOGY: job.topology
+                        },
+                    ),
+                )
+            )
+
+    def _complete(self, job: GangJob) -> None:
+        for i in range(job.hosts):
+            def mutate(p: Pod) -> None:
+                p.status.phase = PodPhase.SUCCEEDED
+
+            try:
+                self.plane.cluster.patch(
+                    "Pod", job.namespace, f"{job.name}-{i}", mutate
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def mixed_gang_workload(
+    n_jobs: int,
+    seed: int = 0,
+    shapes: Sequence[Tuple[str, int, float]] = (
+        ("2x2", 1, 0.30), ("2x4", 2, 0.30), ("4x4", 4, 0.20),
+        ("4x8", 8, 0.15), ("8x8", 16, 0.05),
+    ),
+    namespaces: Sequence[str] = ("team-a", "team-b", "team-c"),
+    mean_interarrival_s: float = 4.0,
+    duration_range_s: Tuple[float, float] = (60.0, 600.0),
+) -> List[GangJob]:
+    """Gang-shaped mixed trace: (chip topology, hosts) weighted toward the
+    small end, Poisson arrivals, uniform durations."""
+    rng = random.Random(seed)
+    names = [(t, h) for t, h, _ in shapes]
+    weights = [w for _, _, w in shapes]
+    jobs: List[GangJob] = []
+    t = 0.0
+    for i in range(n_jobs):
+        t += rng.expovariate(1.0 / mean_interarrival_s)
+        topology, hosts = rng.choices(names, weights=weights)[0]
+        jobs.append(
+            GangJob(
+                name=f"gang-{i:04d}",
+                namespace=rng.choice(list(namespaces)),
+                topology=topology,
+                hosts=hosts,
+                arrival_s=t,
+                duration_s=rng.uniform(*duration_range_s),
+                priority=rng.choice([0, 0, 0, 10]),
+            )
+        )
+    return jobs
+
+
+def simulate_north_star_multihost(
+    n_jobs: int = 120,
+    seed: int = 0,
+    tick_s: float = 1.0,
+    measure_window: Optional[Tuple[float, float]] = (180.0, 900.0),
+) -> SimReport:
+    """The north star at its TRUE shape: ONE v5e-256 pod = 64 host nodes of
+    2x2 chips (16x16 global mesh), dynamically carved into ICI-contiguous
+    sub-slices consumed by gang workloads."""
+    sim = MultiHostSim(groups={"v5e-256": ("16x16", "2x2", (8, 8))})
+    jobs = mixed_gang_workload(n_jobs, seed=seed)
+    return sim.run(jobs, tick_s=tick_s, measure_window=measure_window)
+
+
 def simulate_north_star(
     n_jobs: int = 200,
     seed: int = 0,

@@ -110,3 +110,23 @@ def test_agent_reports_layout():
     used = [e for e in layout if e.used]
     assert len(used) == 1
     assert used[0].profile == "2x2"
+
+
+def test_north_star_multihost_steady_state_utilization():
+    """The north star at its true shape, CI-sized: one multi-host pod (16
+    hosts of 2x2 = an 8x8 mesh) dynamically carved into sub-slices consumed
+    by gang workloads, sustaining >=85% chip utilization at steady state."""
+    from nos_tpu.sim import MultiHostSim, mixed_gang_workload
+
+    sim = MultiHostSim(groups={"s0": ("8x8", "2x2", (4, 4))})
+    jobs = mixed_gang_workload(
+        40,
+        seed=5,
+        shapes=(("2x2", 1, 0.4), ("2x4", 2, 0.3), ("4x4", 4, 0.2), ("4x8", 8, 0.1)),
+        mean_interarrival_s=2.0,
+        duration_range_s=(30.0, 120.0),
+    )
+    report = sim.run(jobs, measure_window=(60.0, 240.0), max_s=3600.0)
+    assert report.completed == 40
+    assert report.unfinished == 0
+    assert report.utilization_window >= 0.85
