@@ -106,9 +106,26 @@ def cmd_tpu_agent(args) -> int:
         print("--node or $NODE_NAME required", file=sys.stderr)
         return 2
     from nos_tpu.cluster import Cluster
-    from nos_tpu.system import build_tpu_agent
 
     cluster = Cluster()
+    if args.host_mode:
+        # Member host of a multi-host slice group: acknowledge sub-slice
+        # assignments instead of carving local chips.
+        from nos_tpu.controllers.slice_group import HostAgent
+
+        host_agent = HostAgent(cluster, node_name)
+        host_agent.startup()
+        host_agent.start_watching()
+        _obs(cfg.manager)
+        print(f"tpu host-agent for node {node_name} running; ctrl-c to exit")
+        while True:
+            host_agent.reconcile()
+            if args.once:
+                return 0
+            time.sleep(cfg.report_interval_s)
+
+    from nos_tpu.system import build_tpu_agent
+
     agent = build_tpu_agent(cluster, node_name, cfg)
     agent.startup()
     agent.start_watching()
@@ -335,6 +352,11 @@ def main(argv=None) -> int:
     p_tpu = sub.add_parser("tpu-agent")
     common(p_tpu)
     p_tpu.add_argument("--node", default=None)
+    p_tpu.add_argument(
+        "--host-mode",
+        action="store_true",
+        help="run as a multi-host slice-group member (ack sub-slice assignments)",
+    )
     p_gpu = sub.add_parser("gpu-agent")
     common(p_gpu)
     p_gpu.add_argument("--node", default=None)

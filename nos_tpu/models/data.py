@@ -1,0 +1,87 @@
+"""Input pipeline: host-side batching with device prefetch.
+
+TPU steps should never wait on the host: while step k executes, batch k+1
+must already be on (or on its way to) the device. This module provides the
+standard double-buffered prefetch used by TPU training loops — a thin,
+dependency-free equivalent of flax.jax_utils.prefetch_to_device generalized
+to sharded meshes:
+
+  - `prefetch_to_device(it, size)`  — single-device double buffering via an
+    eager `jax.device_put` queue (transfers overlap compute because device
+    puts are async under dispatch).
+  - `prefetch_to_mesh(it, mesh, spec, size)` — the sharded variant: each
+    batch is laid out with a NamedSharding before the step consumes it, so
+    dp/sp input sharding happens on the host link, not inside the step.
+  - `synthetic_token_stream(...)` — a deterministic host generator standing
+    in for a real dataset (the reference has no data plane at all; its
+    workloads are opaque pods).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2) -> Iterator:
+    """Yield items of `iterator` with up to `size` batches resident on the
+    device ahead of the consumer. jax.device_put is asynchronous: queueing
+    the next transfer before the current step finishes overlaps host->device
+    copies with compute."""
+    queue: collections.deque = collections.deque()
+    it = iter(iterator)
+
+    def enqueue(n: int) -> None:
+        for item in itertools.islice(it, n):
+            queue.append(jax.tree.map(jax.device_put, item))
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)
+
+
+def prefetch_to_mesh(
+    iterator: Iterable,
+    mesh: Mesh,
+    spec: P,
+    size: int = 2,
+) -> Iterator:
+    """Sharded prefetch: every array in each batch is transferred with the
+    given PartitionSpec layout over `mesh`, ready for a pjit-ed step to
+    consume without a relayout."""
+    sharding = NamedSharding(mesh, spec)
+    queue: collections.deque = collections.deque()
+    it = iter(iterator)
+
+    def put(item):
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), item)
+
+    def enqueue(n: int) -> None:
+        for item in itertools.islice(it, n):
+            queue.append(put(item))
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)
+
+
+def synthetic_token_stream(
+    vocab: int,
+    batch: int,
+    seq: int,
+    seed: int = 0,
+    steps: Optional[int] = None,
+) -> Iterator[np.ndarray]:
+    """Deterministic [batch, seq] int32 token batches (numpy on the host —
+    the transfer to device is the prefetcher's job)."""
+    rng = np.random.default_rng(seed)
+    count = itertools.count() if steps is None else range(steps)
+    for _ in count:
+        yield rng.integers(0, vocab, size=(batch, seq), dtype=np.int32)

@@ -1,0 +1,77 @@
+"""Input pipeline: device prefetch (single-device and sharded)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nos_tpu.models.data import (
+    prefetch_to_device,
+    prefetch_to_mesh,
+    synthetic_token_stream,
+)
+
+
+def test_prefetch_preserves_order_and_values():
+    batches = [np.full((2, 3), i, dtype=np.int32) for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert isinstance(b, jax.Array)
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+
+def test_prefetch_handles_short_iterators():
+    assert list(prefetch_to_device(iter([]), size=2)) == []
+    one = list(prefetch_to_device(iter([np.ones((1,))]), size=4))
+    assert len(one) == 1
+
+
+def test_prefetch_pytree_batches():
+    batches = [{"x": np.ones((2,)) * i, "y": np.zeros((3,))} for i in range(3)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 3
+    assert float(out[2]["x"][0]) == 2.0
+
+
+def test_prefetch_to_mesh_shards_batches():
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("dp",))
+    stream = synthetic_token_stream(vocab=100, batch=8, seq=16, seed=1, steps=3)
+    out = list(prefetch_to_mesh(stream, mesh, P("dp", None), size=2))
+    assert len(out) == 3
+    for b in out:
+        assert b.shape == (8, 16)
+        assert b.sharding.spec == P("dp", None)
+    # A jitted consumer uses the already-sharded input without relayout.
+    total = jax.jit(lambda x: jnp.sum(x))(out[0])
+    assert int(total) >= 0
+
+
+def test_synthetic_stream_deterministic():
+    a = list(synthetic_token_stream(50, 2, 4, seed=9, steps=4))
+    b = list(synthetic_token_stream(50, 2, 4, seed=9, steps=4))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_prefetch_feeds_train_step():
+    """End to end: the prefetched stream drives sharded training steps."""
+    from nos_tpu.models.gpt import GPTConfig
+    from nos_tpu.models.train import TrainConfig, init_train_state, make_train_step
+    from nos_tpu.parallel.mesh import build_mesh
+
+    cfg = TrainConfig(
+        model=GPTConfig(vocab=64, hidden=32, layers=1, heads=2, max_seq=32)
+    )
+    mesh = build_mesh({"dp": 2, "tp": 2})
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh)
+    stream = synthetic_token_stream(cfg.model.vocab, batch=4, seq=16, seed=0, steps=3)
+    losses = []
+    for batch in prefetch_to_mesh(stream, mesh, P("dp", None), size=2):
+        params, opt_state, metrics = step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert len(losses) == 3
+    assert all(np.isfinite(l) for l in losses)

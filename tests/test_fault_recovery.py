@@ -1,0 +1,118 @@
+"""Crash/restart recovery: annotations are the database.
+
+The reference keeps no persistent state anywhere — every controller is a
+stateless mirror rebuilt from the API server, desired geometry lives in node
+annotations, and agents re-derive actual state from the device layer
+(SURVEY.md §5 "Checkpoint / resume"). These tests restart each component
+mid-flight and assert the system converges without disturbing running
+workloads."""
+
+from nos_tpu import constants
+from nos_tpu.api import annotations as ann
+from nos_tpu.api.objects import PodPhase
+from nos_tpu.controllers.partitioner import PartitionerController
+from nos_tpu.controllers.slice_group import GroupPartitioner, HostAgent
+from nos_tpu.controllers.tpu_agent import TpuAgent
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.tpu_mode import TpuPartitioner, TpuSnapshotTaker
+from nos_tpu.tpu import Profile
+from tests.test_full_system import SchedulerSim, System
+from tests.test_multihost import build_plane, gang_nodes, make_group, submit_gang, tick
+
+
+def test_partitioner_restart_is_stateless():
+    """A fresh PartitionerController over the same cluster neither re-plans
+    (spec already matches status) nor disturbs the bound pod."""
+    sys = System()
+    sys.submit("job", "ml", {"google.com/tpu-2x2": 1})
+    sys.tick()
+    node_before = sys.cluster.get("Node", "", "tpu-node-0")
+    plan_before = node_before.metadata.annotations[constants.ANNOTATION_SPEC_PLAN]
+
+    # "Restart": new mirror + controller from the live cluster only.
+    state2 = ClusterState()
+    state2.start_watching(sys.cluster)
+    ctrl2 = PartitionerController(
+        cluster=sys.cluster,
+        state=state2,
+        kind=constants.KIND_TPU,
+        snapshot_taker=TpuSnapshotTaker(),
+        partitioner=TpuPartitioner(sys.cluster),
+        sim_scheduler=SchedulerSim(sys.scheduler),
+        now=sys.clock,
+    )
+    ctrl2.start_watching()
+    sys.clock.advance(61)
+    ctrl2.process_batch_if_ready()
+    node_after = sys.cluster.get("Node", "", "tpu-node-0")
+    assert node_after.metadata.annotations[constants.ANNOTATION_SPEC_PLAN] == plan_before
+    pod = sys.cluster.get("Pod", "ml", "job")
+    assert pod.status.phase == PodPhase.RUNNING
+
+
+def test_agent_restart_preserves_used_cleans_free():
+    """Agent crash + restart: startup deletes slices not in use (crash-safe
+    re-sync, cmd/migagent/migagent.go:190-199 analog) and re-acks the
+    standing spec so the plan handshake resumes."""
+    sys = System()
+    sys.submit("keep", "ml", {"google.com/tpu-2x2": 1})
+    sys.tick()
+    agent = sys.agents["tpu-node-0"]
+    # Carve an extra free slice directly on the device layer (as if a crash
+    # left an orphan).
+    agent.client.create_slice(Profile.parse("1x1"), (3, 3), (1, 1))
+    assert len(agent.client.list_slices()) == 2
+
+    agent2 = TpuAgent(sys.cluster, "tpu-node-0", agent.client)
+    agent2.startup()
+    slices = agent2.client.list_slices()
+    assert len(slices) == 1  # orphan free slice cleaned, used slice kept
+    assert slices[0].in_use
+    node = sys.cluster.get("Node", "", "tpu-node-0")
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+    pod = sys.cluster.get("Pod", "ml", "keep")
+    assert pod.status.phase == PodPhase.RUNNING
+
+
+def test_host_agent_restart_reacks_assignment():
+    plane, clock = build_plane()
+    names = make_group(plane)
+    submit_gang(plane, "g", "ml", "4x8", size=8)
+    tick(plane, clock)
+    hosts = {n for n, _ in gang_nodes(plane, "ml", "g", 8)}
+    victim = sorted(hosts)[0]
+    # Simulate losing the ack state: strip status annotations + labels.
+    def wipe(n):
+        n.metadata.annotations.pop(constants.ANNOTATION_STATUS_SUBSLICE_ID, None)
+        n.metadata.annotations.pop(constants.ANNOTATION_STATUS_PLAN, None)
+        n.metadata.labels.pop(constants.LABEL_TPU_SUBSLICE_ID, None)
+
+    plane.cluster.patch("Node", "", victim, wipe)
+    agent2 = HostAgent(plane.cluster, victim)
+    agent2.startup()
+    node = plane.cluster.get("Node", "", victim)
+    assert constants.LABEL_TPU_SUBSLICE_ID in node.metadata.labels
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+
+
+def test_group_partitioner_restart_is_stateless():
+    plane, clock = build_plane()
+    make_group(plane)
+    submit_gang(plane, "g", "ml", "4x8", size=8)
+    tick(plane, clock)
+    plans_before = {
+        n.metadata.name: n.metadata.annotations.get(constants.ANNOTATION_SPEC_PLAN)
+        for n in plane.cluster.list("Node")
+    }
+    gp2 = GroupPartitioner(plane.cluster, now=clock)
+    gp2.start_watching()
+    clock.t += 61
+    gp2.process_batch_if_ready()
+    plans_after = {
+        n.metadata.name: n.metadata.annotations.get(constants.ANNOTATION_SPEC_PLAN)
+        for n in plane.cluster.list("Node")
+    }
+    assert plans_before == plans_after
+    assert all(
+        phase == PodPhase.RUNNING for _, phase in gang_nodes(plane, "ml", "g", 8)
+    )
