@@ -74,6 +74,10 @@ LABEL_TPU_SUBSLICE_TOPOLOGY = f"{DOMAIN}/subslice-topology"
 # Gang scheduling (multi-host workloads: one pod per host, all-or-nothing).
 LABEL_GANG = f"{DOMAIN}/gang"            # gang name, unique per namespace
 LABEL_GANG_SIZE = f"{DOMAIN}/gang-size"  # expected member count
+# Multislice workloads: the gang spans N same-topology sub-slices carved in
+# N DIFFERENT slice groups — ICI inside each sub-slice, DCN between them
+# (jax multislice). gang-size must be divisible by the count.
+LABEL_MULTISLICE_COUNT = f"{DOMAIN}/multislice-count"
 
 # NVIDIA GFD labels (kept verbatim for MIG/MPS parity modes).
 LABEL_GPU_PRODUCT = "nvidia.com/gpu.product"

@@ -98,9 +98,12 @@ class GroupPartitioner:
         }
 
     # -- demand --------------------------------------------------------------
-    def pending_gang_demand(self) -> Dict[Profile, int]:
-        """One sub-slice per COMPLETE pending gang (all members present and
-        helped by extra resources); a gang is one workload, not N."""
+    def pending_gang_demand(self) -> List[dict]:
+        """Sub-slice demand per COMPLETE pending gang (a gang is one
+        workload, not N pods). A plain gang needs one sub-slice anywhere; a
+        multislice gang needs `multislice-count` sub-slices SPREAD over
+        distinct slice groups (at most one per group — DCN connects slices,
+        not sub-slices within one)."""
         gangs: Dict[str, List[Pod]] = {}
         for pod in self.cluster.list(
             "Pod", predicate=podutil.extra_resources_could_help_scheduling
@@ -110,29 +113,83 @@ class GroupPartitioner:
             if profile is None or gang is None:
                 continue
             gangs.setdefault(gang, []).append(pod)
-        demand: Dict[Profile, int] = {}
-        for gang, pods in gangs.items():
+        items: List[dict] = []
+        for gang, pods in sorted(gangs.items()):
             size = gang_size_of(pods[0])
             if len(pods) < size:
                 continue  # incomplete gang: wait for all members
-            profile = wanted_subslice_topology(pods[0])
-            demand[profile] = demand.get(profile, 0) + 1
+            count = podutil.multislice_count(pods[0])
+            items.append(
+                {
+                    "gang": gang,
+                    "profile": wanted_subslice_topology(pods[0]),
+                    "remaining": count,
+                    "spread": count > 1,
+                }
+            )
+        return items
+
+    @staticmethod
+    def _group_demand(items: List[dict]) -> Dict[Profile, int]:
+        """What THIS group may carve: spread gangs contribute at most one
+        sub-slice per group."""
+        demand: Dict[Profile, int] = {}
+        for item in items:
+            if item["remaining"] <= 0:
+                continue
+            take = 1 if item["spread"] else item["remaining"]
+            demand[item["profile"]] = demand.get(item["profile"], 0) + take
         return demand
+
+    @staticmethod
+    def _absorb(items: List[dict], carved: Dict[Profile, int]) -> None:
+        """Account newly carved sub-slices against demand: spread gangs take
+        at most one each (per group), plain gangs absorb the rest."""
+        for profile, k in carved.items():
+            for item in items:
+                if k <= 0:
+                    break
+                if item["profile"] == profile and item["spread"] and item["remaining"] > 0:
+                    item["remaining"] -= 1
+                    k -= 1
+            for item in items:
+                if k <= 0:
+                    break
+                if item["profile"] == profile and not item["spread"]:
+                    took = min(k, item["remaining"])
+                    item["remaining"] -= took
+                    k -= took
 
     # -- the planning cycle --------------------------------------------------
     def process_batch_if_ready(self) -> bool:
         ready = bool(self.batcher.drain_if_ready())
         if not ready and not self._resync_due():
             return False
-        demand = self.pending_gang_demand()
-        if not demand:
+        items = self.pending_gang_demand()
+        groups = self.member_nodes()
+        # A multislice gang needing more slice groups than exist can never
+        # bind; carving for it would tie up hosts the scheduler will not use.
+        for item in list(items):
+            if item["spread"] and item["remaining"] > len(groups):
+                logger.info(
+                    "group partitioner: gang %s needs %d slice groups, only "
+                    "%d exist — skipping",
+                    item["gang"],
+                    item["remaining"],
+                    len(groups),
+                )
+                items.remove(item)
+        if not items:
             self._last_cycle_at = self._now()
             return False
         plan_id = f"{int(self._now())}-{uuid.uuid4().hex[:8]}"
         planned_any = False
         active = self._active_node_names()
         node_has_workload = active.__contains__
-        for slice_id, nodes in sorted(self.member_nodes().items()):
+        for slice_id, nodes in sorted(groups.items()):
+            demand = self._group_demand(items)
+            if not demand:
+                break
             group = SliceGroup.from_nodes(slice_id, nodes)
             if not group.all_reported():
                 logger.info(
@@ -143,19 +200,18 @@ class GroupPartitioner:
             if desired is None:
                 continue
             current = group.current_subslices(node_has_workload)
-            if {s.id for s in desired} == {s.id for s in current}:
+            current_ids = {s.id for s in current}
+            if {s.id for s in desired} == current_ids:
                 continue  # no change
             self._actuate(group, desired, plan_id)
             planned_any = True
             # Satisfied demand is satisfied once; don't double-carve on the
-            # next group.
+            # next group (spread gangs take at most one per group).
+            carved: Dict[Profile, int] = {}
             for s in desired:
-                if s.profile in demand and s.id not in {c.id for c in current}:
-                    demand[s.profile] -= 1
-                    if demand[s.profile] <= 0:
-                        del demand[s.profile]
-            if not demand:
-                break
+                if s.id not in current_ids:
+                    carved[s.profile] = carved.get(s.profile, 0) + 1
+            self._absorb(items, carved)
         self._last_cycle_at = self._now()
         return planned_any
 

@@ -217,6 +217,19 @@ class Scheduler:
                     )
                     unschedulable.append(pod.metadata.namespaced_name)
                 continue
+            count = podutil.multislice_count(pods[0])
+            if size % count != 0:
+                # Label misconfiguration, not a capacity problem: say so.
+                for pod in pods:
+                    self._mark_unschedulable(
+                        pod,
+                        Status.unschedulable(
+                            f"gang {gang_name}: gang-size {size} not divisible "
+                            f"by multislice-count {count}"
+                        ),
+                    )
+                    unschedulable.append(pod.metadata.namespaced_name)
+                continue
             placed = self._try_place_gang(gang_name, pods, nodes)
             if placed is None:
                 for pod in pods:
@@ -234,12 +247,17 @@ class Scheduler:
     def _try_place_gang(
         self, gang_name: str, pods: List[Pod], nodes: List[NodeInfo]
     ) -> Optional[List]:
-        """Find one sub-slice with enough feasible hosts and bind every pod;
-        rolls back reservations if any member fails."""
+        """Find sub-slice(s) with enough feasible hosts and bind every pod;
+        rolls back reservations if any member fails. A multislice gang
+        (multislice-count=N) splits evenly over N same-topology sub-slices in
+        N DISTINCT slice groups — ICI inside each sub-slice, DCN between
+        them; two sub-slices of one pod would not be DCN peers."""
         from nos_tpu import constants as C
 
         wanted = podutil.wanted_subslice_topology(pods[0])
+        count = podutil.multislice_count(pods[0])
         by_subslice: dict = {}
+        slice_group_of: dict = {}
         for node in nodes:
             sid = node.labels.get(C.LABEL_TPU_SUBSLICE_ID)
             if not sid:
@@ -249,25 +267,44 @@ class Scheduler:
             ):
                 continue
             by_subslice.setdefault(sid, []).append(node)
+            slice_group_of[sid] = node.labels.get(C.LABEL_TPU_SLICE, "")
+        if count > 1:
+            return self._try_place_multislice_gang(
+                gang_name, pods, by_subslice, slice_group_of, count
+            )
         for sid in sorted(by_subslice, key=lambda s: (len(by_subslice[s]), s)):
             hosts = by_subslice[sid]
             if len(hosts) < len(pods):
                 continue
             state = CycleState()
-            # Feasibility + reservation per member, in order: reserving
-            # against LIVE quota usage makes each subsequent member's
-            # PreFilter see its gang-mates' share (the same semantics the
-            # per-pod path gets from reserve-after-bind). Roll every
-            # reservation back if any member cannot place.
-            hosts = sorted(hosts, key=lambda n: n.name)
-            assignment = []
-            used_hosts: set = set()
-            feasible = True
-            for pod in pods:
-                if not self.framework.run_pre_filter(state, pod).is_success:
-                    feasible = False
-                    break
-                target = None
+            assignment = self._reserve_chunk(state, pods, hosts)
+            if assignment is None:
+                continue
+            result = self._bind_assignment(state, gang_name, assignment)
+            if result is not None:
+                logger.info(
+                    "gang %s bound to sub-slice %s (%d hosts)",
+                    gang_name,
+                    sid,
+                    len(assignment),
+                )
+            return result
+        return None
+
+    def _reserve_chunk(
+        self, state: CycleState, chunk: List[Pod], hosts: List[NodeInfo]
+    ) -> Optional[List]:
+        """Feasibility + reservation per member, in order: reserving against
+        LIVE quota usage makes each subsequent member's PreFilter see its
+        gang-mates' share (the same semantics the per-pod path gets from
+        reserve-after-bind). On failure every reservation made here is rolled
+        back and None is returned."""
+        hosts = sorted(hosts, key=lambda n: n.name)
+        assignment: List = []
+        used_hosts: set = set()
+        for pod in chunk:
+            target = None
+            if self.framework.run_pre_filter(state, pod).is_success:
                 for host in hosts:
                     if host.name in used_hosts:
                         continue
@@ -276,43 +313,96 @@ class Scheduler:
                     ).is_success:
                         target = host
                         break
-                if target is None or not self.framework.run_reserve(
-                    state, pod, target.name
-                ).is_success:
-                    feasible = False
-                    break
-                used_hosts.add(target.name)
-                assignment.append((pod, target))
-            if not feasible:
-                for pod, host in assignment:
-                    self.framework.run_unreserve(state, pod, host.name)
-                continue
-            # Commit: every member holds a reservation; bind them all.
-            bound_members = []
-            try:
-                for pod, host in assignment:
-                    self._bind(pod, host.name)
-                    bound_members.append((pod, host))
-                    host.requested = host.requested.add(
-                        self.calculator.compute_pod_request(pod)
-                    )
-                    host.pods.append(pod)
-            except Exception:
-                for pod, host in assignment:
-                    self.framework.run_unreserve(state, pod, host.name)
-                for pod, _ in bound_members:
-                    self._unbind(pod)
-                logger.exception("gang %s: rollback on %s", gang_name, sid)
+            if target is None or not self.framework.run_reserve(
+                state, pod, target.name
+            ).is_success:
+                for p, h in assignment:
+                    self.framework.run_unreserve(state, p, h.name)
                 return None
-            logger.info(
-                "gang %s bound to sub-slice %s (%d hosts)",
-                gang_name,
-                sid,
-                len(assignment),
-            )
-            return [
-                (pod.metadata.namespaced_name, host.name) for pod, host in assignment
-            ]
+            used_hosts.add(target.name)
+            assignment.append((pod, target))
+        return assignment
+
+    def _bind_assignment(
+        self, state: CycleState, gang_name: str, assignment: List
+    ) -> Optional[List]:
+        """Commit a fully-reserved assignment: bind every member, keep the
+        pass-level node snapshot coherent, roll everything back on failure."""
+        bound_members = []
+        try:
+            for pod, host in assignment:
+                self._bind(pod, host.name)
+                bound_members.append((pod, host))
+                host.requested = host.requested.add(
+                    self.calculator.compute_pod_request(pod)
+                )
+                host.pods.append(pod)
+        except Exception:
+            for pod, host in assignment:
+                self.framework.run_unreserve(state, pod, host.name)
+            for pod, _ in bound_members:
+                self._unbind(pod)
+            logger.exception("gang %s: rollback", gang_name)
+            return None
+        return [
+            (pod.metadata.namespaced_name, host.name) for pod, host in assignment
+        ]
+
+    def _try_place_multislice_gang(
+        self,
+        gang_name: str,
+        pods: List[Pod],
+        by_subslice: dict,
+        slice_group_of: dict,
+        count: int,
+    ) -> Optional[List]:
+        """Multislice placement: `count` sub-slices in DISTINCT slice groups,
+        each hosting size/count members, under one CycleState so quota sees
+        the whole gang. Candidate (group combination x sub-slice choice)
+        sets are tried with backtracking, bounded to 20 attempts — the same
+        cap the reference puts on NVML creation-order permutations
+        (nvml/client.go:291-331) — so one occupied sub-slice cannot starve a
+        feasible gang."""
+        import itertools
+
+        if len(pods) % count != 0:
+            return None
+        per = len(pods) // count
+        eligible = [
+            sid for sid, hosts in by_subslice.items() if len(hosts) >= per
+        ]
+        by_group: dict = {}
+        for sid in sorted(eligible):
+            by_group.setdefault(slice_group_of[sid], []).append(sid)
+        if len(by_group) < count:
+            return None
+        groups_sorted = sorted(by_group, key=lambda g: (len(by_group[g]), g))
+        attempts = 0
+        for combo in itertools.combinations(groups_sorted, count):
+            for sids in itertools.product(*(by_group[g] for g in combo)):
+                attempts += 1
+                if attempts > 20:
+                    return None
+                state = CycleState()
+                assignment: List = []
+                ok = True
+                for chunk_idx, sid in enumerate(sids):
+                    chunk = pods[chunk_idx * per:(chunk_idx + 1) * per]
+                    got = self._reserve_chunk(state, chunk, by_subslice[sid])
+                    if got is None:
+                        ok = False
+                        break
+                    assignment.extend(got)
+                if not ok:
+                    for p, h in assignment:
+                        self.framework.run_unreserve(state, p, h.name)
+                    continue
+                result = self._bind_assignment(state, gang_name, assignment)
+                if result is not None:
+                    logger.info(
+                        "multislice gang %s bound across %s", gang_name, list(sids)
+                    )
+                return result
         return None
 
     # -- cluster mutations ---------------------------------------------------

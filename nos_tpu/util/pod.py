@@ -76,6 +76,14 @@ def gang_size_of(pod: Pod) -> int:
         return 1
 
 
+def multislice_count(pod: Pod) -> int:
+    """How many DCN-connected sub-slices the gang spans (default 1)."""
+    try:
+        return max(1, int(pod.metadata.labels.get(constants.LABEL_MULTISLICE_COUNT, "1")))
+    except ValueError:
+        return 1
+
+
 def wanted_subslice_topology(pod: Pod):
     """The sub-slice shape a gang pod selects (its nodeSelector on the
     subslice-topology label), as a Profile; None for non-gang pods."""

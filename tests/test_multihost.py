@@ -299,3 +299,87 @@ def test_anisotropic_hosts_never_rotate_into_wrong_chip_shape():
         d * h for d, h in zip(sub.host_dims, host.dims)
     )
     assert sorted(chip_dims) == sorted(want.shape.dims)
+
+
+def test_multislice_gang_spans_two_slice_groups():
+    """A multislice gang (multislice-count=2) lands HALF its pods on a
+    sub-slice in each of two slice groups — ICI inside each sub-slice, DCN
+    between them. Two sub-slices in one group must not be used."""
+    plane, clock = build_plane()
+    make_group(plane, slice_id="s0")
+    make_group(plane, slice_id="s1")
+    pods = submit_gang(plane, "xl", "ml", "4x8", size=16)
+    for pod in pods:
+        plane.cluster.patch(
+            "Pod", "ml", pod.metadata.name,
+            lambda p: p.metadata.labels.__setitem__(
+                constants.LABEL_MULTISLICE_COUNT, "2"
+            ),
+        )
+    result = tick(plane, clock)
+    assert len(result["bound"]) == 16
+    placements = gang_nodes(plane, "ml", "xl", 16)
+    assert all(phase == PodPhase.RUNNING for _, phase in placements)
+    groups_used = {}
+    for host, _ in placements:
+        node = plane.cluster.get("Node", "", host)
+        slice_id = node.metadata.labels[constants.LABEL_TPU_SLICE]
+        sid = node.metadata.labels[constants.LABEL_TPU_SUBSLICE_ID]
+        groups_used.setdefault(slice_id, set()).add(sid)
+    # Exactly two slice groups, one sub-slice each, 8 hosts per sub-slice.
+    assert len(groups_used) == 2
+    assert all(len(sids) == 1 for sids in groups_used.values())
+
+
+def test_multislice_gang_waits_with_single_group():
+    """With only ONE slice group available, a 2-slice multislice gang must
+    not bind (two sub-slices in one group are not DCN peers)."""
+    plane, clock = build_plane()
+    make_group(plane, slice_id="only")
+    pods = submit_gang(plane, "xl", "ml", "2x4", size=4)
+    for pod in pods:
+        plane.cluster.patch(
+            "Pod", "ml", pod.metadata.name,
+            lambda p: p.metadata.labels.__setitem__(
+                constants.LABEL_MULTISLICE_COUNT, "2"
+            ),
+        )
+    result = tick(plane, clock)
+    assert result["bound"] == []
+    for i in range(4):
+        pod = plane.cluster.get("Pod", "ml", f"xl-{i}")
+        assert pod.status.phase == PodPhase.PENDING
+    # And no capacity was wasted carving a sub-slice the gang can never use.
+    for node in plane.cluster.list("Node"):
+        assert constants.LABEL_TPU_SUBSLICE_ID not in node.metadata.labels
+
+
+def test_multislice_backtracks_past_occupied_subslice():
+    """Backtracking: an occupied same-topology sub-slice in a group must not
+    starve a feasible multislice gang — the scheduler tries the group's other
+    sub-slice (bounded attempts), mirroring the single-slice path's scan."""
+    plane, clock = build_plane()
+    make_group(plane, slice_id="s0")
+    make_group(plane, slice_id="s1")
+    # A plain gang occupies one 4x8 sub-slice in s0.
+    submit_gang(plane, "busy", "ml", "4x8", size=8)
+    r1 = tick(plane, clock)
+    assert len(r1["bound"]) == 8
+    # The multislice gang needs a 4x8 in TWO groups; s0's free half must be
+    # carved and chosen even though its occupied sub-slice is also eligible.
+    pods = submit_gang(plane, "xl", "ml", "4x8", size=16)
+    for pod in pods:
+        plane.cluster.patch(
+            "Pod", "ml", pod.metadata.name,
+            lambda p: p.metadata.labels.__setitem__(
+                constants.LABEL_MULTISLICE_COUNT, "2"
+            ),
+        )
+    r2 = tick(plane, clock)
+    assert len(r2["bound"]) == 16
+    groups_used = set()
+    for host, phase in gang_nodes(plane, "ml", "xl", 16):
+        assert phase == PodPhase.RUNNING
+        node = plane.cluster.get("Node", "", host)
+        groups_used.add(node.metadata.labels[constants.LABEL_TPU_SLICE])
+    assert groups_used == {"s0", "s1"}
