@@ -236,6 +236,15 @@ class TpuAgent:
                 used[s.profile] = used.get(s.profile, 0) + 1
         topology = self.client.get_topology()
         carved = sum(p.chips * n for p, n in geometry.items())
+        from nos_tpu.observability import metrics
+
+        metrics.set_gauge("nos_tpu_chips_total", topology.chips, node=self.node_name)
+        metrics.set_gauge("nos_tpu_chips_carved", carved, node=self.node_name)
+        metrics.set_gauge(
+            "nos_tpu_chips_used",
+            sum(p.chips * n for p, n in used.items()),
+            node=self.node_name,
+        )
         desired_status = dict(
             ann.format_status(ann.status_from_geometry(DEVICE_INDEX, geometry, used))
         )

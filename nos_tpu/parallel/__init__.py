@@ -11,6 +11,7 @@ long-context sequence parallelism over the ICI ring.
 from nos_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     build_multislice_mesh,
+    mesh_from_assignment,
     mesh_from_topology,
 )
 from nos_tpu.parallel.sharding import (  # noqa: F401

@@ -134,3 +134,45 @@ def mesh_from_topology(
         dims = dims[: len(axis_names) - 1] + [folded]
     arr = np.array(devices).reshape(tuple(dims))
     return Mesh(arr, tuple(axis_names))
+
+
+def mesh_from_assignment(
+    node_labels: Dict[str, str],
+    axis_names: Sequence[str] = ("dp", "tp"),
+    devices: Optional[Sequence] = None,
+    num_slices: int = 1,
+    ici_axes: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Build the workload mesh straight from the labels of the node this pod
+    landed on (exposed to the container via the downward API) — the last link
+    of the control-plane -> workload chain: the host agent stamps
+    `tpu.nos/subslice-topology` when the carve is acknowledged, and the
+    gang-scheduled job turns that label into its jax mesh without any
+    out-of-band configuration.
+
+    Single-slice gangs get an ICI mesh shaped like the carved topology;
+    multislice gangs (num_slices > 1, matching their multislice-count label)
+    get a leading dcn axis over the slices with `ici_axes` inside each.
+    """
+    from nos_tpu import constants
+    from nos_tpu.tpu.topology import accelerator_generation
+
+    topo_str = node_labels.get(
+        constants.LABEL_TPU_SUBSLICE_TOPOLOGY
+    ) or node_labels.get(constants.LABEL_TPU_TOPOLOGY)
+    if not topo_str:
+        raise ValueError("node labels carry no sub-slice or mesh topology")
+    generation = (
+        accelerator_generation(
+            node_labels.get(constants.LABEL_TPU_ACCELERATOR, "")
+        )
+        or "v5e"
+    )
+    topology = Topology.parse(generation, topo_str)
+    if num_slices > 1:
+        if ici_axes is None:
+            ici_axes = {"tp": topology.chips}
+        return build_multislice_mesh(
+            dict(ici_axes), num_slices=num_slices, devices=devices
+        )
+    return mesh_from_topology(topology, axis_names, devices)

@@ -106,3 +106,35 @@ def test_placements_cover_geometry():
     m = v5e_4x4({P("2x2"): 2, P("1x2"): 1})
     pls = m.placements()
     assert pls is not None and len(pls) == 3
+
+
+def test_mesh_from_assignment_single_slice():
+    """A gang pod builds its mesh from the labels its host carries after the
+    carve is acknowledged — no out-of-band configuration."""
+    import jax
+    from nos_tpu import constants
+    from nos_tpu.parallel.mesh import mesh_from_assignment
+
+    labels = {
+        constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+        constants.LABEL_TPU_TOPOLOGY: "16x16",
+        constants.LABEL_TPU_SUBSLICE_TOPOLOGY: "2x4",
+    }
+    mesh = mesh_from_assignment(labels, ("dp", "tp"), devices=jax.devices()[:8])
+    assert dict(mesh.shape) == {"dp": 2, "tp": 4}
+
+
+def test_mesh_from_assignment_multislice():
+    import jax
+    from nos_tpu import constants
+    from nos_tpu.parallel.mesh import mesh_from_assignment
+
+    labels = {
+        constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+        constants.LABEL_TPU_SUBSLICE_TOPOLOGY: "2x2",
+    }
+    mesh = mesh_from_assignment(
+        labels, devices=jax.devices()[:8], num_slices=2,
+        ici_axes={"tp": 4},
+    )
+    assert dict(mesh.shape) == {"dcn": 2, "tp": 4}
