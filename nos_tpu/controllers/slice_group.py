@@ -190,7 +190,16 @@ class GroupPartitioner:
             demand = self._group_demand(items)
             if not demand:
                 break
-            group = SliceGroup.from_nodes(slice_id, nodes)
+            try:
+                group = SliceGroup.from_nodes(slice_id, nodes)
+            except ValueError:
+                # One mislabeled group must not take down planning for the
+                # rest of the cluster.
+                logger.exception(
+                    "group partitioner: slice %s has invalid member labels",
+                    slice_id,
+                )
+                continue
             if not group.all_reported():
                 logger.info(
                     "group partitioner: slice %s waiting on host reports", slice_id

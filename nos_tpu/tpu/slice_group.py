@@ -113,14 +113,26 @@ class SliceGroup:
         topology = Topology.from_node_labels(first.metadata.labels)
         if topology is None:
             raise ValueError(f"slice {slice_id}: no topology labels")
-        host_shape = Shape.parse(
-            first.metadata.labels[constants.LABEL_TPU_HOST_TOPOLOGY]
-        )
+        host_label = first.metadata.labels.get(constants.LABEL_TPU_HOST_TOPOLOGY)
+        if host_label is None:
+            raise ValueError(
+                f"slice {slice_id}: no {constants.LABEL_TPU_HOST_TOPOLOGY} label"
+            )
+        host_shape = Shape.parse(host_label)
         hosts: Dict[Coord, HostInfo] = {}
         for node in nodes:
-            coord = parse_host_coord(
-                node.metadata.labels[constants.LABEL_TPU_HOST_COORD]
-            )
+            raw = node.metadata.labels.get(constants.LABEL_TPU_HOST_COORD)
+            if raw is None:
+                raise ValueError(
+                    f"slice {slice_id}: node {node.metadata.name} has no "
+                    f"{constants.LABEL_TPU_HOST_COORD} label"
+                )
+            coord = parse_host_coord(raw)
+            if coord in hosts:
+                raise ValueError(
+                    f"slice {slice_id}: duplicate host coord {raw} "
+                    f"({hosts[coord].node_name} vs {node.metadata.name})"
+                )
             ann = node.metadata.annotations
             spec_plan = ann.get(constants.ANNOTATION_SPEC_PLAN)
             status_plan = ann.get(constants.ANNOTATION_STATUS_PLAN)

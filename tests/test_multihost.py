@@ -383,3 +383,36 @@ def test_multislice_backtracks_past_occupied_subslice():
         node = plane.cluster.get("Node", "", host)
         groups_used.add(node.metadata.labels[constants.LABEL_TPU_SLICE])
     assert groups_used == {"s0", "s1"}
+
+
+def test_malformed_group_does_not_block_others():
+    """A mislabeled slice group (missing host-coord) is skipped with a log;
+    gangs still land on the healthy group."""
+    plane, clock = build_plane()
+    make_group(plane, slice_id="good")
+    # A broken group: member without host-coord.
+    plane.cluster.create(
+        Node(
+            metadata=ObjectMeta(
+                name="broken-host",
+                labels={
+                    constants.LABEL_PARTITIONING: constants.KIND_TPU_MULTIHOST,
+                    constants.LABEL_TPU_SLICE: "broken",
+                    constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                    constants.LABEL_TPU_TOPOLOGY: "8x8",
+                    constants.LABEL_TPU_HOST_TOPOLOGY: "2x2",
+                    # no LABEL_TPU_HOST_COORD
+                },
+            ),
+            status=NodeStatus(
+                allocatable=ResourceList.of({"google.com/tpu": 4})
+            ),
+        )
+    )
+    submit_gang(plane, "g", "ml", "2x4", size=2)
+    result = tick(plane, clock)
+    assert len(result["bound"]) == 2
+    for host, phase in gang_nodes(plane, "ml", "g", 2):
+        assert phase == PodPhase.RUNNING
+        node = plane.cluster.get("Node", "", host)
+        assert node.metadata.labels[constants.LABEL_TPU_SLICE] == "good"
