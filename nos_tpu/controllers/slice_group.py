@@ -88,26 +88,24 @@ class GroupPartitioner:
                 groups.setdefault(slice_id, []).append(node)
         return groups
 
-    def _active_node_names(self) -> set:
-        """Node names hosting an active pod — computed ONCE per cycle (a
-        per-host cluster list would make each cycle O(hosts x pods))."""
-        return {
-            p.spec.node_name
-            for p in self.cluster.list("Pod", predicate=podutil.is_active)
-            if p.spec.node_name
-        }
+    def _pods_snapshot(self):
+        """ONE pod list per cycle feeds both demand derivation and the
+        active-node set (each extra list deep-copies every pod)."""
+        return self.cluster.list("Pod")
 
     # -- demand --------------------------------------------------------------
-    def pending_gang_demand(self) -> List[dict]:
+    def pending_gang_demand(self, pods: Optional[List[Pod]] = None) -> List[dict]:
         """Sub-slice demand per COMPLETE pending gang (a gang is one
         workload, not N pods). A plain gang needs one sub-slice anywhere; a
         multislice gang needs `multislice-count` sub-slices SPREAD over
         distinct slice groups (at most one per group — DCN connects slices,
         not sub-slices within one)."""
+        if pods is None:
+            pods = self._pods_snapshot()
         gangs: Dict[str, List[Pod]] = {}
-        for pod in self.cluster.list(
-            "Pod", predicate=podutil.extra_resources_could_help_scheduling
-        ):
+        for pod in pods:
+            if not podutil.extra_resources_could_help_scheduling(pod):
+                continue
             profile = wanted_subslice_topology(pod)
             gang = gang_of(pod)
             if profile is None or gang is None:
@@ -165,7 +163,8 @@ class GroupPartitioner:
         ready = bool(self.batcher.drain_if_ready())
         if not ready and not self._resync_due():
             return False
-        items = self.pending_gang_demand()
+        pods = self._pods_snapshot()
+        items = self.pending_gang_demand(pods)
         groups = self.member_nodes()
         # A multislice gang needing more slice groups than exist can never
         # bind; carving for it would tie up hosts the scheduler will not use.
@@ -184,7 +183,9 @@ class GroupPartitioner:
             return False
         plan_id = f"{int(self._now())}-{uuid.uuid4().hex[:8]}"
         planned_any = False
-        active = self._active_node_names()
+        active = {
+            p.spec.node_name for p in pods if podutil.is_active(p) and p.spec.node_name
+        }
         node_has_workload = active.__contains__
         for slice_id, nodes in sorted(groups.items()):
             demand = self._group_demand(items)

@@ -28,9 +28,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def prefetch_to_device(iterator: Iterable, size: int = 2) -> Iterator:
-    """Yield items of `iterator` with up to `size` batches resident on the
-    device ahead of the consumer. jax.device_put is asynchronous: queueing
+def _prefetch(iterator: Iterable, put, size: int) -> Iterator:
+    """The double-buffer core: keep up to `size` already-transferred batches
+    queued ahead of the consumer. jax.device_put is asynchronous, so queueing
     the next transfer before the current step finishes overlaps host->device
     copies with compute."""
     queue: collections.deque = collections.deque()
@@ -38,12 +38,18 @@ def prefetch_to_device(iterator: Iterable, size: int = 2) -> Iterator:
 
     def enqueue(n: int) -> None:
         for item in itertools.islice(it, n):
-            queue.append(jax.tree.map(jax.device_put, item))
+            queue.append(put(item))
 
     enqueue(size)
     while queue:
         yield queue.popleft()
         enqueue(1)
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2) -> Iterator:
+    """Yield items of `iterator` with up to `size` batches resident on the
+    device ahead of the consumer."""
+    return _prefetch(iterator, lambda item: jax.tree.map(jax.device_put, item), size)
 
 
 def prefetch_to_mesh(
@@ -56,20 +62,11 @@ def prefetch_to_mesh(
     given PartitionSpec layout over `mesh`, ready for a pjit-ed step to
     consume without a relayout."""
     sharding = NamedSharding(mesh, spec)
-    queue: collections.deque = collections.deque()
-    it = iter(iterator)
-
-    def put(item):
-        return jax.tree.map(lambda x: jax.device_put(x, sharding), item)
-
-    def enqueue(n: int) -> None:
-        for item in itertools.islice(it, n):
-            queue.append(put(item))
-
-    enqueue(size)
-    while queue:
-        yield queue.popleft()
-        enqueue(1)
+    return _prefetch(
+        iterator,
+        lambda item: jax.tree.map(lambda x: jax.device_put(x, sharding), item),
+        size,
+    )
 
 
 def synthetic_token_stream(

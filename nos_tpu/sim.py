@@ -128,7 +128,142 @@ def _percentile(values: Sequence[float], q: float) -> float:
     return vs[idx]
 
 
-class WorkloadSim:
+class _TraceRunner:
+    """The shared trace engine: admits arrivals, restarts preempted jobs,
+    completes finished ones, runs one control round per tick, integrates the
+    utilization metrics, and assembles the report. Subclasses define the
+    workload shape through five hooks: `_submit`, `_complete`, `_preempted`,
+    `_evict_cleanup`, `_collect_bound`, and `_job_chips`."""
+
+    clock: VirtualClock
+    plane: "ControlPlane"
+    total_chips: int
+
+    def run(
+        self,
+        jobs: Sequence,
+        tick_s: float = 1.0,
+        max_s: float = 86_400.0,
+        measure_window: Optional[Tuple[float, float]] = None,
+    ) -> SimReport:
+        """Drive the trace to completion (or `max_s`). `measure_window`
+        bounds the steady-state utilization metric: a finite trace always has
+        a ramp (arrivals filling the mesh) and a drain tail (the last few
+        stragglers) that say nothing about scheduler quality — the north-star
+        target (>=85% on a *sustained* workload) is a steady-state property,
+        so `utilization_window` integrates only over [t0, t1)."""
+        records = {j.name: JobRecord(job=j) for j in jobs}
+        pending_arrivals = sorted(jobs, key=lambda j: (j.arrival_s, j.name))
+        running: Dict[str, JobRecord] = {}
+        last_progress_s = 0.0
+        used_chip_seconds = 0.0
+        used_chip_seconds_busy = 0.0
+        used_chip_seconds_window = 0.0
+        backlog_seconds = 0.0
+
+        while self.clock.t < max_s:
+            now = self.clock.t
+            # 1. Admit arrivals.
+            while pending_arrivals and pending_arrivals[0].arrival_s <= now:
+                job = pending_arrivals.pop(0)
+                self._submit(job)
+                records[job.name].submitted_s = now
+                last_progress_s = now
+            # 2. Restart preempted jobs: an evicted workload's controller
+            #    recreates it from scratch (scheduler._evict deletes pods;
+            #    for a gang, losing any member kills the whole mesh).
+            for name, rec in list(running.items()):
+                if self._preempted(rec.job):
+                    self._evict_cleanup(rec.job)
+                    rec.preemptions += 1
+                    rec.bound_s = None
+                    rec.node = None
+                    del running[name]
+                    self._submit(rec.job)
+                    rec.submitted_s = now
+            # 3. Complete finished jobs.
+            for name, rec in list(running.items()):
+                if rec.bound_s is not None and now >= rec.bound_s + rec.job.duration_s:
+                    self._complete(rec.job)
+                    rec.completed_s = now
+                    del running[name]
+                    last_progress_s = now
+            # 4. One control round (schedule -> partition -> schedule).
+            self.plane.tick()
+            # 5. Record new binds.
+            waiting = {
+                name: rec
+                for name, rec in records.items()
+                if rec.submitted_s is not None
+                and rec.bound_s is None
+                and rec.completed_s is None
+            }
+            if waiting:
+                for name, node in self._collect_bound(waiting).items():
+                    rec = records[name]
+                    rec.bound_s = now
+                    rec.node = node
+                    running[name] = rec
+                    last_progress_s = now
+            # 6. Integrate utilization over this tick. "Busy" ticks are those
+            #    with a standing backlog (some submitted job still unbound):
+            #    while demand outstrips supply, delivered chip-seconds over
+            #    available chip-seconds is the saturation utilization.
+            tick_used = sum(self._job_chips(rec.job) for rec in running.values())
+            used_chip_seconds += tick_used * tick_s
+            if any(
+                rec.submitted_s is not None and rec.bound_s is None
+                for rec in records.values()
+            ):
+                used_chip_seconds_busy += tick_used * tick_s
+                backlog_seconds += tick_s
+            if measure_window and measure_window[0] <= now < measure_window[1]:
+                used_chip_seconds_window += tick_used * tick_s
+            # Done once every job has completed.
+            if not pending_arrivals and not running and all(
+                r.completed_s is not None for r in records.values()
+            ):
+                break
+            # Stalled: the cluster is drained, no arrivals remain, and the
+            # leftover pending jobs have not bound through several re-plan
+            # windows — they can never fit (e.g. a sub-slice larger than any
+            # node mesh). Report them as unfinished instead of spinning to
+            # max_s.
+            if (
+                not pending_arrivals
+                and not running
+                and now - last_progress_s > 120.0
+            ):
+                break
+            self.clock.advance(tick_s)
+
+        horizon = max(self.clock.t, tick_s)
+        latencies = [r.latency_s for r in records.values() if r.latency_s is not None]
+        busy_window = max(backlog_seconds, tick_s)
+        if measure_window:
+            span = max(tick_s, min(measure_window[1], self.clock.t) - measure_window[0])
+            # min() clamps a one-tick double-count when a preemptor binds in
+            # the same tick its victim's record is still integrating.
+            utilization_window = min(
+                1.0, used_chip_seconds_window / (self.total_chips * span)
+            )
+        else:
+            utilization_window = used_chip_seconds_busy / (self.total_chips * busy_window)
+        return SimReport(
+            total_chips=self.total_chips,
+            jobs=list(records.values()),
+            utilization=used_chip_seconds_busy / (self.total_chips * busy_window),
+            utilization_total=used_chip_seconds / (self.total_chips * horizon),
+            utilization_window=utilization_window,
+            p50_latency_s=_percentile(latencies, 0.50),
+            p95_latency_s=_percentile(latencies, 0.95),
+            makespan_s=horizon,
+            completed=sum(1 for r in records.values() if r.completed_s is not None),
+            unfinished=sum(1 for r in records.values() if r.completed_s is None),
+        )
+
+
+class WorkloadSim(_TraceRunner):
     """Full control plane + node agents under a virtual clock."""
 
     def __init__(
@@ -185,132 +320,30 @@ class WorkloadSim:
             )
             self.plane.add_tpu_agent(node_name, client=FakeTpuClient(gen))
 
-    # -- trace execution -----------------------------------------------------
-    def run(
-        self,
-        jobs: Sequence[SimJob],
-        tick_s: float = 1.0,
-        max_s: float = 86_400.0,
-        measure_window: Optional[Tuple[float, float]] = None,
-    ) -> SimReport:
-        """Drive the trace to completion (or `max_s`). `measure_window`
-        bounds the steady-state utilization metric: a finite trace always has
-        a ramp (arrivals filling the mesh) and a drain tail (the last few
-        stragglers) that say nothing about scheduler quality — the north-star
-        target (≥85% on a *sustained* workload) is a steady-state property, so
-        `utilization_window` integrates only over [t0, t1)."""
-        records = {j.name: JobRecord(job=j) for j in jobs}
-        pending_arrivals = sorted(jobs, key=lambda j: (j.arrival_s, j.name))
-        running: Dict[str, JobRecord] = {}
-        last_progress_s = 0.0
-        used_chip_seconds = 0.0
-        used_chip_seconds_busy = 0.0
-        used_chip_seconds_window = 0.0
-        backlog_seconds = 0.0
+    # -- trace hooks ---------------------------------------------------------
+    def _job_chips(self, job: SimJob) -> int:
+        return _chips_of(job.request)
 
-        while self.clock.t < max_s:
-            now = self.clock.t
-            # 1. Admit arrivals.
-            while pending_arrivals and pending_arrivals[0].arrival_s <= now:
-                job = pending_arrivals.pop(0)
-                self._submit(job)
-                records[job.name].submitted_s = now
-                last_progress_s = now
-            # 2. Handle preemption evictions: a running pod that vanished was
-            #    a preemption victim; its workload controller recreates it
-            #    (scheduler._evict deletes the Pod object).
-            for name, rec in list(running.items()):
-                if self.plane.cluster.try_get("Pod", rec.job.namespace, name) is None:
-                    rec.preemptions += 1
-                    rec.bound_s = None
-                    rec.node = None
-                    del running[name]
-                    self._submit(rec.job)
-                    rec.submitted_s = now
-            # 3. Complete finished jobs.
-            for name, rec in list(running.items()):
-                if rec.bound_s is not None and now >= rec.bound_s + rec.job.duration_s:
-                    self._complete(rec.job)
-                    rec.completed_s = now
-                    del running[name]
-                    last_progress_s = now
-            # 4. One control round (schedule -> partition -> schedule).
-            self.plane.tick()
-            # 5. Record new binds.
-            for pod in self.plane.cluster.list("Pod"):
-                rec = records.get(pod.metadata.name)
-                if (
-                    rec is not None
-                    and rec.bound_s is None
-                    and pod.spec.node_name
-                    and pod.status.phase == PodPhase.RUNNING
-                ):
-                    rec.bound_s = now
-                    rec.node = pod.spec.node_name
-                    running[pod.metadata.name] = rec
-                    last_progress_s = now
-            # 6. Integrate utilization over this tick. "Busy" ticks are those
-            #    with a standing backlog (some submitted job still unbound):
-            #    while demand outstrips supply, delivered chip-seconds over
-            #    available chip-seconds is the saturation utilization.
-            tick_used = sum(
-                _chips_of(rec.job.request) for rec in running.values()
-            )
-            used_chip_seconds += tick_used * tick_s
-            if any(
-                rec.submitted_s is not None and rec.bound_s is None
-                for rec in records.values()
-            ):
-                used_chip_seconds_busy += tick_used * tick_s
-                backlog_seconds += tick_s
-            if measure_window and measure_window[0] <= now < measure_window[1]:
-                used_chip_seconds_window += tick_used * tick_s
-            # Done once every job has completed.
-            if not pending_arrivals and not running and all(
-                r.completed_s is not None for r in records.values()
-            ):
-                break
-            # Stalled: the cluster is drained, no arrivals remain, and the
-            # leftover pending jobs have not bound through several re-plan
-            # windows — they can never fit (e.g. a sub-slice larger than any
-            # node mesh). Report them as unfinished instead of spinning to
-            # max_s.
+    def _preempted(self, job: SimJob) -> bool:
+        return self.plane.cluster.try_get("Pod", job.namespace, job.name) is None
+
+    def _evict_cleanup(self, job: SimJob) -> None:
+        pass  # the evicted pod is already gone
+
+    def _collect_bound(self, waiting: Dict[str, JobRecord]) -> Dict[str, str]:
+        """name -> node for jobs that are now fully bound (one cluster list,
+        not a try_get per record)."""
+        bound: Dict[str, str] = {}
+        for pod in self.plane.cluster.list("Pod"):
+            rec = waiting.get(pod.metadata.name)
             if (
-                not pending_arrivals
-                and not running
-                and now - last_progress_s > 120.0
+                rec is not None
+                and pod.spec.node_name
+                and pod.status.phase == PodPhase.RUNNING
             ):
-                break
-            self.clock.advance(tick_s)
+                bound[pod.metadata.name] = pod.spec.node_name
+        return bound
 
-        horizon = max(self.clock.t, tick_s)
-        latencies = [
-            r.latency_s for r in records.values() if r.latency_s is not None
-        ]
-        busy_window = max(backlog_seconds, tick_s)
-        if measure_window:
-            span = max(tick_s, min(measure_window[1], self.clock.t) - measure_window[0])
-            # min() clamps a one-tick double-count when a preemptor binds in
-            # the same tick its victim's record is still integrating.
-            utilization_window = min(
-                1.0, used_chip_seconds_window / (self.total_chips * span)
-            )
-        else:
-            utilization_window = used_chip_seconds_busy / (self.total_chips * busy_window)
-        return SimReport(
-            total_chips=self.total_chips,
-            jobs=list(records.values()),
-            utilization=used_chip_seconds_busy / (self.total_chips * busy_window),
-            utilization_total=used_chip_seconds / (self.total_chips * horizon),
-            utilization_window=utilization_window,
-            p50_latency_s=_percentile(latencies, 0.50),
-            p95_latency_s=_percentile(latencies, 0.95),
-            makespan_s=horizon,
-            completed=sum(1 for r in records.values() if r.completed_s is not None),
-            unfinished=sum(1 for r in records.values() if r.completed_s is None),
-        )
-
-    # -- cluster mutations ---------------------------------------------------
     def _submit(self, job: SimJob) -> None:
         self.plane.cluster.create(
             Pod(
@@ -379,7 +412,7 @@ class GangJob:
     priority: int = 0
 
 
-class MultiHostSim:
+class MultiHostSim(_TraceRunner):
     """North-star scenario at its true shape: slice groups of host nodes
     (one Node per VM, local chips only), carved by the GroupPartitioner and
     consumed by gang workloads. Chip accounting is per gang (hosts x chips
@@ -436,130 +469,37 @@ class MultiHostSim:
         self._host_chips = next(iter(self.chips_per_host.values()))
         self.plane.start()
 
-    def run(
-        self,
-        jobs: Sequence[GangJob],
-        tick_s: float = 1.0,
-        max_s: float = 86_400.0,
-        measure_window: Optional[Tuple[float, float]] = None,
-    ) -> SimReport:
-        records: Dict[str, JobRecord] = {
-            j.name: JobRecord(job=SimJob(j.name, j.namespace, {}, j.arrival_s, j.duration_s, j.priority))
-            for j in jobs
-        }
-        gang_meta = {j.name: j for j in jobs}
-        pending_arrivals = sorted(jobs, key=lambda j: (j.arrival_s, j.name))
-        running: Dict[str, JobRecord] = {}
-        used_chip_seconds = 0.0
-        used_chip_seconds_busy = 0.0
-        used_chip_seconds_window = 0.0
-        backlog_seconds = 0.0
-        last_progress_s = 0.0
+    # -- trace hooks ---------------------------------------------------------
+    def _job_chips(self, job: GangJob) -> int:
+        return Profile.parse(job.topology).chips
 
-        def gang_chips(name: str) -> int:
-            g = gang_meta[name]
-            p = Profile.parse(g.topology)
-            return p.chips
+    def _members(self, job: GangJob):
+        return [
+            self.plane.cluster.try_get("Pod", job.namespace, f"{job.name}-{i}")
+            for i in range(job.hosts)
+        ]
 
-        while self.clock.t < max_s:
-            now = self.clock.t
-            while pending_arrivals and pending_arrivals[0].arrival_s <= now:
-                job = pending_arrivals.pop(0)
-                self._submit(job)
-                records[job.name].submitted_s = now
-                last_progress_s = now
-            # Preempted gangs: losing ANY member kills the whole mesh; the
-            # workload controller restarts the gang from scratch.
-            for name, rec in list(running.items()):
-                g = gang_meta[name]
-                alive = [
-                    self.plane.cluster.try_get("Pod", g.namespace, f"{name}-{i}")
-                    for i in range(g.hosts)
-                ]
-                if any(m is None for m in alive):
-                    for i, m in enumerate(alive):
-                        if m is not None:
-                            try:
-                                self.plane.cluster.delete(
-                                    "Pod", g.namespace, f"{name}-{i}"
-                                )
-                            except Exception:  # noqa: BLE001
-                                pass
-                    rec.preemptions += 1
-                    rec.bound_s = None
-                    rec.node = None
-                    del running[name]
-                    self._submit(g)
-                    rec.submitted_s = now
-            # Completions.
-            for name, rec in list(running.items()):
-                if rec.bound_s is not None and now >= rec.bound_s + rec.job.duration_s:
-                    self._complete(gang_meta[name])
-                    rec.completed_s = now
-                    del running[name]
-                    last_progress_s = now
-            self.plane.tick()
-            # A gang is bound when every member runs.
-            for name, rec in records.items():
-                if rec.bound_s is not None or rec.submitted_s is None:
-                    continue
-                g = gang_meta[name]
-                members = [
-                    self.plane.cluster.try_get("Pod", g.namespace, f"{name}-{i}")
-                    for i in range(g.hosts)
-                ]
-                if all(
-                    m is not None and m.status.phase == PodPhase.RUNNING
-                    for m in members
-                ):
-                    rec.bound_s = now
-                    rec.node = members[0].spec.node_name
-                    running[name] = rec
-                    last_progress_s = now
-            tick_used = sum(gang_chips(n) for n in running)
-            used_chip_seconds += tick_used * tick_s
-            if any(
-                r.submitted_s is not None and r.bound_s is None
-                for r in records.values()
-            ):
-                used_chip_seconds_busy += tick_used * tick_s
-                backlog_seconds += tick_s
-            if measure_window and measure_window[0] <= now < measure_window[1]:
-                used_chip_seconds_window += tick_used * tick_s
-            if not pending_arrivals and not running and all(
-                r.completed_s is not None for r in records.values()
-            ):
-                break
-            if (
-                not pending_arrivals
-                and not running
-                and now - last_progress_s > 120.0
-            ):
-                break
-            self.clock.advance(tick_s)
+    def _preempted(self, job: GangJob) -> bool:
+        return any(m is None for m in self._members(job))
 
-        horizon = max(self.clock.t, tick_s)
-        latencies = [r.latency_s for r in records.values() if r.latency_s is not None]
-        busy_window = max(backlog_seconds, tick_s)
-        if measure_window:
-            span = max(tick_s, min(measure_window[1], self.clock.t) - measure_window[0])
-            utilization_window = min(
-                1.0, used_chip_seconds_window / (self.total_chips * span)
-            )
-        else:
-            utilization_window = used_chip_seconds_busy / (self.total_chips * busy_window)
-        return SimReport(
-            total_chips=self.total_chips,
-            jobs=list(records.values()),
-            utilization=used_chip_seconds_busy / (self.total_chips * busy_window),
-            utilization_total=used_chip_seconds / (self.total_chips * horizon),
-            utilization_window=utilization_window,
-            p50_latency_s=_percentile(latencies, 0.50),
-            p95_latency_s=_percentile(latencies, 0.95),
-            makespan_s=horizon,
-            completed=sum(1 for r in records.values() if r.completed_s is not None),
-            unfinished=sum(1 for r in records.values() if r.completed_s is None),
-        )
+    def _evict_cleanup(self, job: GangJob) -> None:
+        for i, m in enumerate(self._members(job)):
+            if m is not None:
+                try:
+                    self.plane.cluster.delete("Pod", job.namespace, f"{job.name}-{i}")
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _collect_bound(self, waiting: Dict[str, JobRecord]) -> Dict[str, str]:
+        bound: Dict[str, str] = {}
+        for name, rec in waiting.items():
+            members = self._members(rec.job)
+            if all(
+                m is not None and m.status.phase == PodPhase.RUNNING
+                for m in members
+            ):
+                bound[name] = members[0].spec.node_name
+        return bound
 
     def _submit(self, job: GangJob) -> None:
         for i in range(job.hosts):
