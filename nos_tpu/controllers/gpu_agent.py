@@ -329,13 +329,38 @@ class GpuAgent:
                 resource = self.resource_of(p)
                 resources[resource] = resources.get(resource, 0.0) + n
 
+        desired_status = dict(ann.format_status(statuses))
+        if self.shared.last_parsed_plan_id is not None:
+            desired_status[constants.ANNOTATION_STATUS_PLAN] = (
+                self.shared.last_parsed_plan_id
+            )
+
+        def unchanged(node: Node) -> bool:
+            """Periodic reports must not churn the watch bus: skip the patch
+            when status annotations and exposed resources already match."""
+            current_status = {
+                k: v
+                for k, v in node.metadata.annotations.items()
+                if constants.ANNOTATION_STATUS_REGEX.match(k)
+                or k == constants.ANNOTATION_STATUS_PLAN
+            }
+            if current_status != desired_status:
+                return False
+            current_res = {
+                r: node.status.allocatable[r]
+                for r in node.status.allocatable
+                if constants.RESOURCE_MIG_REGEX.match(r)
+                or constants.RESOURCE_MPS_REGEX.match(r)
+            }
+            return current_res == {k: float(v) for k, v in resources.items()}
+
         def mutate(node: Node) -> None:
             ann.strip_status_annotations(node.metadata.annotations)
-            node.metadata.annotations.update(ann.format_status(statuses))
-            if self.shared.last_parsed_plan_id is not None:
-                node.metadata.annotations[constants.ANNOTATION_STATUS_PLAN] = (
-                    self.shared.last_parsed_plan_id
-                )
+            if self.shared.last_parsed_plan_id is None:
+                # A stale plan id from a previous agent run would otherwise
+                # survive every rewrite and keep unchanged() false forever.
+                node.metadata.annotations.pop(constants.ANNOTATION_STATUS_PLAN, None)
+            node.metadata.annotations.update(desired_status)
             for res in [
                 r
                 for r in node.status.allocatable
@@ -347,7 +372,11 @@ class GpuAgent:
                 node.status.allocatable[res] = n
 
         try:
-            self.cluster.patch("Node", "", self.node_name, mutate)
+            node = self.cluster.try_get("Node", "", self.node_name)
+            if node is None:
+                return
+            if not unchanged(node):
+                self.cluster.patch("Node", "", self.node_name, mutate)
         except NotFoundError:
             return
         self.shared.on_report()
