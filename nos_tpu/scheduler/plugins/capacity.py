@@ -146,7 +146,7 @@ class CapacityScheduling(PreFilterPlugin, ReservePlugin, PostFilterPlugin):
     def post_filter(
         self, state: CycleState, pod: Pod, nodes: List[NodeInfo]
     ) -> Tuple[Optional[str], Status]:
-        if not self._eligible_to_preempt(pod):
+        if not self._eligible_to_preempt(pod, nodes):
             return None, Status.unschedulable("pod not eligible to preempt")
         candidates: Dict[str, Tuple[List[Pod], int]] = {}
         for node in nodes:
@@ -178,11 +178,25 @@ class CapacityScheduling(PreFilterPlugin, ReservePlugin, PostFilterPlugin):
                 self.evict_fn(victim)
         return node_name, Status.success()
 
-    def _eligible_to_preempt(self, pod: Pod) -> bool:
+    def _eligible_to_preempt(self, pod: Pod, nodes: List[NodeInfo]) -> bool:
         """preemptor.PodEligibleToPreemptOthers analog (:394-466): a pod that
-        already nominated a node keeps waiting while its victims terminate."""
-        if pod.status.nominated_node_name:
-            return False
+        nominated a node waits ONLY while lower-priority victims on it are
+        still terminating. Once they are gone (eviction is immediate here),
+        the pod may preempt again — otherwise two preemptors nominated onto
+        the same node deadlock, each blocked by the other's assumed share
+        while an over-quota victim keeps running."""
+        nominated = pod.status.nominated_node_name
+        if not nominated:
+            return True
+        for node in nodes:
+            if node.name != nominated:
+                continue
+            for p in node.pods:
+                if (
+                    p.metadata.deletion_timestamp is not None
+                    and p.spec.priority < pod.spec.priority
+                ):
+                    return False  # victims still terminating: keep waiting
         return True
 
     def _select_victims_on_node(
