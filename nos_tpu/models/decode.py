@@ -31,12 +31,11 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict:
 
 
 def _attend_cache(q, cache_k, cache_v, n_rep: int, limit):
-    """q [B,nh,T,hd] against the cache [B,nkv,max,hd]. `limit` is a [T]
-    vector: query t attends to cache positions < limit[t] (causal-within-
-    chunk prefill uses start+arange(t)+1; single-token decode uses
-    [start+1]). Query heads are grouped against the un-repeated cache — the
-    cache is never materialized at n_heads width, which is the HBM saving
-    GQA exists for."""
+    """q [B,nh,T,hd] against the cache [B,nkv,max,hd]. `limit` is [T] (shared
+    across the batch: chunked prefill) or [B,T] (per-row: ragged decode);
+    query (b,t) attends to cache positions < limit[(b,)t]. Query heads are
+    grouped against the un-repeated cache — the cache is never materialized
+    at n_heads width, which is the HBM saving GQA exists for."""
     b, nh, t, hd = q.shape
     qg = q.reshape(b, nh // n_rep, n_rep, t, hd)  # [B, nkv, rep, T, hd]
     scale = hd ** -0.5
@@ -44,8 +43,9 @@ def _attend_cache(q, cache_k, cache_v, n_rep: int, limit):
         "bgrtd,bgsd->bgrts", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
     ) * scale
     idx = jnp.arange(cache_k.shape[2])
-    mask = idx[None, :] < jnp.reshape(limit, (-1, 1))  # [T, max]
-    scores = jnp.where(mask[None, None, None, :, :], scores, -jnp.inf)
+    limit = jnp.atleast_2d(limit)  # [B or 1, T]
+    mask = idx[None, None, :] < limit[:, :, None]  # [B1, T, max]
+    scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrts,bgsd->bgrtd", probs, cache_v.astype(jnp.float32))
     return out.reshape(b, nh, t, hd).astype(cache_v.dtype)
@@ -53,15 +53,25 @@ def _attend_cache(q, cache_k, cache_v, n_rep: int, limit):
 
 def _block_with_cache(x, p, cfg: GPTConfig, layer_cache, positions, start):
     """One transformer block writing its new K/V into the cache at `start`
-    and attending over everything cached so far. x: [B, T, h]."""
+    and attending over everything cached so far. x: [B, T, h]. `start` is a
+    scalar (whole batch at one offset: prefill / lockstep decode) or a [B]
+    vector (ragged decode: each row at its own position)."""
     b, t, h = x.shape
     nh, nkv = cfg.heads, cfg.n_kv
     y = _rmsnorm(x, p["ln1"])
     q, k_new, v_new = project_qkv(y, p, cfg, positions, repeat_kv=False)
-    cache_k = jax.lax.dynamic_update_slice(layer_cache["k"], k_new, (0, 0, start, 0))
-    cache_v = jax.lax.dynamic_update_slice(layer_cache["v"], v_new, (0, 0, start, 0))
-    # Causal within the new chunk: token j attends to cache[: start + j + 1].
-    limit = start + jnp.arange(t) + 1  # [T]
+    if jnp.ndim(start) == 0:
+        cache_k = jax.lax.dynamic_update_slice(layer_cache["k"], k_new, (0, 0, start, 0))
+        cache_v = jax.lax.dynamic_update_slice(layer_cache["v"], v_new, (0, 0, start, 0))
+        # Causal within the new chunk: token j attends to cache[: start+j+1].
+        limit = start + jnp.arange(t) + 1  # [T]
+    else:
+        write = jax.vmap(
+            lambda arr, new, pos: jax.lax.dynamic_update_slice(arr, new, (0, pos, 0))
+        )
+        cache_k = write(layer_cache["k"], k_new, start)
+        cache_v = write(layer_cache["v"], v_new, start)
+        limit = start[:, None] + jnp.arange(t) + 1  # [B, T]
     o = _attend_cache(q, cache_k, cache_v, nh // nkv, limit)
     o = o.transpose(0, 2, 1, 3).reshape(b, t, h)
     x = x + o @ p["wo"]
@@ -73,9 +83,12 @@ def _block_with_cache(x, p, cfg: GPTConfig, layer_cache, positions, start):
 def _forward_with_cache(params, tokens, cfg: GPTConfig, cache, start):
     b, t = tokens.shape
     x = params["tok_emb"][tokens]
-    positions = jnp.broadcast_to(
-        start + jnp.arange(t, dtype=jnp.int32), (b, t)
-    )
+    if jnp.ndim(start) == 0:
+        positions = jnp.broadcast_to(
+            start + jnp.arange(t, dtype=jnp.int32), (b, t)
+        )
+    else:
+        positions = start[:, None] + jnp.arange(t, dtype=jnp.int32)
     new_cache = {}
     for i in range(cfg.layers):
         x, new_cache[str(i)] = _block_with_cache(
@@ -100,6 +113,18 @@ def prefill(params, tokens, cfg: GPTConfig, max_len: int) -> Tuple[jnp.ndarray, 
 
 def decode_step(params, token, cfg: GPTConfig, cache, pos):
     """One token [B] at position `pos` -> (logits [B, vocab], new cache)."""
+    logits, cache = _forward_with_cache(params, token[:, None], cfg, cache, pos)
+    return logits[:, 0, :], cache
+
+
+# -- ragged (per-row position) decoding --------------------------------------
+def decode_step_ragged(params, token, cfg: GPTConfig, cache, pos):
+    """One token [B] with PER-ROW positions [B] -> (logits [B,vocab], cache).
+    Row b writes its K/V at pos[b] and attends to cache[:pos[b]+1]. This is
+    what continuous batching (DecodeServer) steps with: each slot sits at its
+    own position — slot 0 may be at token 90 while slot 1 just prefilled to
+    7. Shares the exact block code with prefill/lockstep decode (the vector
+    `start` path of _forward_with_cache), so the two can never drift."""
     logits, cache = _forward_with_cache(params, token[:, None], cfg, cache, pos)
     return logits[:, 0, :], cache
 

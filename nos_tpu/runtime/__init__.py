@@ -1,3 +1,4 @@
 """Workload runtime: the serving side of a carved sub-slice."""
 
+from nos_tpu.runtime.decode_server import DecodeServer  # noqa: F401
 from nos_tpu.runtime.slice_server import SliceServer  # noqa: F401

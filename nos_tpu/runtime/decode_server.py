@@ -1,0 +1,235 @@
+"""DecodeServer: continuous batching for autoregressive LLM serving.
+
+The SliceServer batches *one-shot* inferences; autoregressive decoding needs
+iteration-level scheduling instead (Orca-style continuous batching): the
+engine keeps a fixed set of batch lanes ("slots"), admits a waiting request
+into any free slot by prefilling its prompt into that slot's KV-cache lane,
+and steps ALL active slots together — one token per sequence per iteration,
+each at its own position (`decode_step_ragged`). Sequences finish and free
+their slot independently, so short requests are never held hostage by long
+ones and the MXU always sees the full active batch.
+
+TPU-shaped by construction: the cache is a static [n_slots, ...] allocation,
+prompts are padded to bucket lengths so XLA reuses compiled programs, and
+per-step host traffic is one tiny [n_slots] token fetch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import logging
+
+from nos_tpu.models.decode import _forward_with_cache, decode_step_ragged, init_cache
+from nos_tpu.models.gpt import GPTConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Slot:
+    active: bool = False
+    pos: int = 0
+    remaining: int = 0
+    tokens: List[int] = field(default_factory=list)
+    future: Optional[Future] = None
+
+
+class DecodeServer:
+    def __init__(
+        self,
+        params,
+        cfg: GPTConfig,
+        n_slots: int = 4,
+        max_len: int = 128,
+        prompt_buckets: Sequence[int] = (8, 16, 32),
+        eos_id: Optional[int] = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        # A bucket must fit in the cache; prompts longer than the largest
+        # bucket are rejected per request (never silently truncated).
+        self.prompt_buckets = sorted(b for b in prompt_buckets if b < max_len)
+        if not self.prompt_buckets:
+            raise ValueError(
+                f"no prompt bucket smaller than max_len={max_len}: {prompt_buckets}"
+            )
+        self.eos_id = eos_id
+        self.cache = init_cache(cfg, n_slots, max_len)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._slots = [_Slot() for _ in range(n_slots)]
+        self._last_tokens = np.zeros((n_slots,), dtype=np.int32)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.steps_run = 0
+
+        # Greedy sampling on device; prefill compiles once per prompt bucket
+        # (static padded shape), the ragged step once for all traffic.
+        def _step(params, token, cache, pos, active):
+            logits, new_cache = decode_step_ragged(params, token, cfg, cache, pos)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # Inactive lanes keep their cache untouched and emit token 0.
+            keep = active[:, None, None, None]
+            new_cache = jax.tree.map(
+                lambda new, old: jnp.where(keep, new, old)
+                if new.ndim == 4
+                else new,
+                new_cache,
+                cache,
+            )
+            return jnp.where(active, nxt, 0), new_cache
+
+        self._step_fn = jax.jit(_step)
+
+        # Prefill path: run the padded prompt, take logits at the true last
+        # prompt position, scatter the single-lane cache into the slot.
+        def _prefill_into(params, tokens, length, cache, slot):
+            lane = init_cache(cfg, 1, max_len)
+            logits, lane = _forward_with_cache(params, tokens, cfg, lane, 0)
+            first = jnp.argmax(logits[0, length - 1, :]).astype(jnp.int32)
+            cache = jax.tree.map(
+                lambda big, small: big.at[slot].set(small[0]), cache, lane
+            )
+            return first, cache
+
+        self._prefill_into = jax.jit(_prefill_into, static_argnames=())
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new: int = 16) -> Future:
+        fut: Future = Future()
+        if max_new <= 0:
+            fut.set_result([])
+            return fut
+        self._queue.put((list(prompt), max_new, fut))
+        return fut
+
+    def generate(self, prompt: Sequence[int], max_new: int = 16, timeout=None):
+        return self.submit(prompt, max_new).result(timeout=timeout)
+
+    # -- engine --------------------------------------------------------------
+    def start(self) -> "DecodeServer":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        # Never strand a client in Future.result(): fail everything still in
+        # flight or queued.
+        self._fail_outstanding(RuntimeError("DecodeServer stopped"))
+
+    def _fail_outstanding(self, exc: Exception) -> None:
+        for idx, slot in enumerate(self._slots):
+            if slot.active and slot.future is not None and not slot.future.done():
+                slot.future.set_exception(exc)
+            self._slots[idx] = _Slot()
+        while True:
+            try:
+                _, _, fut = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return self.prompt_buckets[-1]
+
+    def _admit(self) -> None:
+        for idx, slot in enumerate(self._slots):
+            if slot.active:
+                continue
+            try:
+                prompt, max_new, fut = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if len(prompt) >= self.max_len:
+                fut.set_exception(
+                    ValueError(f"prompt length {len(prompt)} >= max_len {self.max_len}")
+                )
+                continue
+            if len(prompt) > self.prompt_buckets[-1]:
+                fut.set_exception(
+                    ValueError(
+                        f"prompt length {len(prompt)} exceeds the largest "
+                        f"prompt bucket {self.prompt_buckets[-1]}"
+                    )
+                )
+                continue
+            bucket = self._bucket(len(prompt))
+            padded = np.zeros((1, bucket), dtype=np.int32)
+            padded[0, : len(prompt)] = prompt
+            first, self.cache = self._prefill_into(
+                self.params, jnp.asarray(padded), len(prompt), self.cache, idx
+            )
+            slot.active = True
+            slot.pos = len(prompt)
+            slot.remaining = max_new - 1
+            slot.tokens = [int(first)]
+            slot.future = fut
+            self._last_tokens[idx] = int(first)
+            self._finish_if_done(idx)
+
+    def _finish_if_done(self, idx: int) -> None:
+        slot = self._slots[idx]
+        done = (
+            slot.remaining <= 0
+            # slot.pos is the NEXT write index; a step at pos == max_len-1 is
+            # still valid (decode.generate's own bound).
+            or slot.pos >= self.max_len
+            or (self.eos_id is not None and slot.tokens and slot.tokens[-1] == self.eos_id)
+        )
+        if done and slot.active:
+            slot.future.set_result(list(slot.tokens))
+            self._slots[idx] = _Slot()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception as exc:  # noqa: BLE001
+                # The engine must outlive any single bad request/step: fail
+                # everything currently in flight (their cache state is no
+                # longer trustworthy) and keep serving.
+                logger.exception("decode engine step failed")
+                self._fail_outstanding(exc)
+
+    def _tick(self) -> None:
+        self._admit()
+        active = [s.active for s in self._slots]
+        if not any(active):
+            self._stop.wait(0.005)
+            return
+        pos = np.array([s.pos for s in self._slots], dtype=np.int32)
+        tokens, self.cache = self._step_fn(
+            self.params,
+            jnp.asarray(self._last_tokens),
+            self.cache,
+            jnp.asarray(pos),
+            jnp.asarray(active),
+        )
+        sampled = np.asarray(tokens)
+        self.steps_run += 1
+        for idx, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            tok = int(sampled[idx])
+            slot.tokens.append(tok)
+            slot.pos += 1
+            slot.remaining -= 1
+            self._last_tokens[idx] = tok
+            self._finish_if_done(idx)

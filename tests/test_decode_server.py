@@ -1,0 +1,141 @@
+"""Continuous batching (DecodeServer): iteration-level scheduling with
+per-slot KV caches. The bar is exactness — a request decoded while sharing
+the engine with other in-flight sequences must produce the SAME greedy
+tokens as decoding it alone."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.decode import decode_step, prefill
+from nos_tpu.models.gpt import GPTConfig, init_gpt
+from nos_tpu.runtime.decode_server import DecodeServer
+
+CFG = GPTConfig(vocab=97, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_gpt(jax.random.PRNGKey(0), CFG)
+
+
+def solo_greedy(params, prompt, max_new, max_len=64):
+    """Reference: batch-1 prefill + scalar decode loop, pure greedy."""
+    tokens = jnp.asarray([prompt], dtype=jnp.int32)
+    logits, cache = prefill(params, tokens, CFG, max_len)
+    out = [int(jnp.argmax(logits[0]))]
+    pos = len(prompt)
+    for _ in range(max_new - 1):
+        logits, cache = decode_step(
+            params, jnp.asarray([out[-1]], dtype=jnp.int32), CFG, cache, pos
+        )
+        out.append(int(jnp.argmax(logits[0])))
+        pos += 1
+    return out
+
+
+def test_single_request_matches_solo_decode(params):
+    server = DecodeServer(params, CFG, n_slots=2, max_len=64).start()
+    try:
+        prompt = [5, 11, 3, 42]
+        got = server.generate(prompt, max_new=6, timeout=120)
+        assert got == solo_greedy(params, prompt, 6)
+    finally:
+        server.stop()
+
+
+def test_concurrent_requests_are_isolated(params):
+    """Different prompts and lengths in flight together: every stream must
+    match its solo decode exactly (per-slot cache isolation + per-row
+    positions)."""
+    server = DecodeServer(params, CFG, n_slots=3, max_len=64).start()
+    prompts = [
+        [1, 2, 3],
+        [40, 41, 42, 43, 44, 45, 46],
+        [7],
+        [20, 21],
+        [9, 8, 7, 6, 5],
+    ]
+    news = [5, 7, 4, 6, 3]
+    results = [None] * len(prompts)
+    try:
+        def client(i):
+            results[i] = server.generate(prompts[i], max_new=news[i], timeout=120)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        server.stop()
+    for i, prompt in enumerate(prompts):
+        assert results[i] == solo_greedy(params, prompt, news[i]), f"stream {i}"
+
+
+def test_eos_frees_slot_early(params):
+    # Find what the model emits first for some prompt, use it as eos.
+    probe = solo_greedy(params, [3, 1, 4], 2)
+    server = DecodeServer(params, CFG, n_slots=1, max_len=64, eos_id=probe[0]).start()
+    try:
+        got = server.generate([3, 1, 4], max_new=10, timeout=120)
+        assert got == [probe[0]]  # stopped at eos immediately
+        # The freed slot serves the next request.
+        prompt = [12, 13]
+        assert server.generate(prompt, max_new=3, timeout=120) == solo_greedy(
+            params, prompt, 3
+        )
+    finally:
+        server.stop()
+
+
+def test_oversized_prompt_rejected(params):
+    server = DecodeServer(params, CFG, n_slots=1, max_len=16).start()
+    try:
+        fut = server.submit(list(range(20)), max_new=4)
+        with pytest.raises(ValueError):
+            fut.result(timeout=60)
+    finally:
+        server.stop()
+
+
+def test_cache_boundary_not_truncated(params):
+    """A sequence whose decode reaches the last cache slot must produce the
+    full requested tokens (writing at pos == max_len-1 is valid)."""
+    prompt = list(range(1, 29))  # 28 tokens, max_len 32: room for 4 steps
+    server = DecodeServer(
+        params, CFG, n_slots=1, max_len=32, prompt_buckets=(8, 16, 28)
+    ).start()
+    try:
+        got = server.generate(prompt, max_new=4, timeout=120)
+    finally:
+        server.stop()
+    assert got == solo_greedy(params, prompt, 4, max_len=32)
+    assert len(got) == 4
+
+
+def test_prompt_exceeding_buckets_rejected(params):
+    server = DecodeServer(
+        params, CFG, n_slots=1, max_len=64, prompt_buckets=(8,)
+    ).start()
+    try:
+        fut = server.submit(list(range(10)), max_new=4)
+        with pytest.raises(ValueError):
+            fut.result(timeout=60)
+        # The engine survived: a well-sized request still works.
+        assert server.generate([1, 2], max_new=2, timeout=120) == solo_greedy(
+            params, [1, 2], 2
+        )
+    finally:
+        server.stop()
+
+
+def test_max_new_zero_returns_empty(params):
+    server = DecodeServer(params, CFG, n_slots=1, max_len=32).start()
+    try:
+        assert server.generate([1, 2, 3], max_new=0, timeout=10) == []
+    finally:
+        server.stop()
