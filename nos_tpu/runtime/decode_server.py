@@ -52,7 +52,14 @@ class DecodeServer:
         max_len: int = 128,
         prompt_buckets: Sequence[int] = (8, 16, 32),
         eos_id: Optional[int] = None,
+        temperature: float = 0.0,
+        seed: int = 0,
     ):
+        """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
+        softmax sampling with a deterministic per-slot, per-step PRNG stream
+        (`fold_in(seed, slot_serial, step)`), so a request's output depends
+        only on its own stream — never on which other requests share the
+        batch."""
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -72,12 +79,31 @@ class DecodeServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.steps_run = 0
+        self.temperature = float(temperature)
+        self._base_key = jax.random.PRNGKey(seed)
+        # Per-slot sampling identity: (serial of the request in the slot,
+        # step within the request). Serials make streams independent of slot
+        # reuse order.
+        self._slot_serial = np.zeros((n_slots,), dtype=np.int64)
+        self._next_serial = 1
 
-        # Greedy sampling on device; prefill compiles once per prompt bucket
+        # Sampling on device; prefill compiles once per prompt bucket
         # (static padded shape), the ragged step once for all traffic.
-        def _step(params, token, cache, pos, active):
+        def _sample(logits, serial, step):
+            if self.temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keys = jax.vmap(
+                lambda s, t: jax.random.fold_in(
+                    jax.random.fold_in(self._base_key, s), t
+                )
+            )(serial, step)
+            return jax.vmap(
+                lambda k, l: jax.random.categorical(k, l / self.temperature)
+            )(keys, logits).astype(jnp.int32)
+
+        def _step(params, token, cache, pos, active, serial, step):
             logits, new_cache = decode_step_ragged(params, token, cfg, cache, pos)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = _sample(logits, serial, step)
             # Inactive lanes keep their cache untouched and emit token 0.
             keep = active[:, None, None, None]
             new_cache = jax.tree.map(
@@ -92,17 +118,22 @@ class DecodeServer:
         self._step_fn = jax.jit(_step)
 
         # Prefill path: run the padded prompt, take logits at the true last
-        # prompt position, scatter the single-lane cache into the slot.
-        def _prefill_into(params, tokens, length, cache, slot):
+        # prompt position (sampled as the request's step 0), scatter the
+        # single-lane cache into the slot.
+        def _prefill_into(params, tokens, length, cache, slot, serial):
             lane = init_cache(cfg, 1, max_len)
             logits, lane = _forward_with_cache(params, tokens, cfg, lane, 0)
-            first = jnp.argmax(logits[0, length - 1, :]).astype(jnp.int32)
+            first = _sample(
+                logits[0, length - 1, :][None, :],
+                jnp.asarray([serial]),
+                jnp.asarray([0]),
+            )[0]
             cache = jax.tree.map(
                 lambda big, small: big.at[slot].set(small[0]), cache, lane
             )
             return first, cache
 
-        self._prefill_into = jax.jit(_prefill_into, static_argnames=())
+        self._prefill_into = jax.jit(_prefill_into)
 
     # -- client side ---------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new: int = 16) -> Future:
@@ -173,8 +204,11 @@ class DecodeServer:
             bucket = self._bucket(len(prompt))
             padded = np.zeros((1, bucket), dtype=np.int32)
             padded[0, : len(prompt)] = prompt
+            serial = self._next_serial
+            self._next_serial += 1
+            self._slot_serial[idx] = serial
             first, self.cache = self._prefill_into(
-                self.params, jnp.asarray(padded), len(prompt), self.cache, idx
+                self.params, jnp.asarray(padded), len(prompt), self.cache, idx, serial
             )
             slot.active = True
             slot.pos = len(prompt)
@@ -215,12 +249,15 @@ class DecodeServer:
             self._stop.wait(0.005)
             return
         pos = np.array([s.pos for s in self._slots], dtype=np.int32)
+        step = np.array([len(s.tokens) for s in self._slots], dtype=np.int64)
         tokens, self.cache = self._step_fn(
             self.params,
             jnp.asarray(self._last_tokens),
             self.cache,
             jnp.asarray(pos),
             jnp.asarray(active),
+            jnp.asarray(self._slot_serial),
+            jnp.asarray(step),
         )
         sampled = np.asarray(tokens)
         self.steps_run += 1

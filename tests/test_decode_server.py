@@ -139,3 +139,31 @@ def test_max_new_zero_returns_empty(params):
         assert server.generate([1, 2, 3], max_new=0, timeout=10) == []
     finally:
         server.stop()
+
+
+def test_sampled_stream_independent_of_batchmates(params):
+    """Temperature sampling uses a per-request PRNG stream (serial + step):
+    a request's tokens must be identical whether it runs alone or alongside
+    other requests."""
+    prompt = [4, 9, 2]
+    # Alone (serial 1 in a fresh server).
+    solo_server = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, temperature=0.8, seed=7
+    ).start()
+    try:
+        alone = solo_server.generate(prompt, max_new=6, timeout=120)
+    finally:
+        solo_server.stop()
+    # With a batchmate in flight — same serial (first submit), same seed.
+    busy_server = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, temperature=0.8, seed=7
+    ).start()
+    try:
+        fut = busy_server.submit(prompt, max_new=6)
+        other = busy_server.submit([30, 31, 32, 33], max_new=8)
+        together = fut.result(timeout=120)
+        other.result(timeout=120)
+    finally:
+        busy_server.stop()
+    assert together == alone
+    assert len(alone) == 6
