@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all test test-tpu native bench dryrun demo simulate clean
+.PHONY: all test test-tpu native bench dryrun demo simulate example clean
 
 all: native test
 
@@ -34,6 +34,11 @@ demo:
 # North-star capacity simulation (virtual clock, fake device layer).
 simulate:
 	JAX_PLATFORMS=cpu $(PY) -m nos_tpu.cli simulate
+
+# Carve -> bind -> mesh -> train -> serve, in one script.
+example:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) examples/end_to_end.py
 
 clean:
 	$(MAKE) -C nos_tpu/tpulib/native clean
