@@ -22,6 +22,13 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+# Env vars alone can lose to a site-installed accelerator plugin (the same
+# guard __graft_entry__.py and tests/conftest.py use): flip the config before
+# the backend initializes.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 from jax.sharding import PartitionSpec as P
 
 from nos_tpu import constants
