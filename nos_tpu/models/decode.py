@@ -65,6 +65,7 @@ def _block_with_cache(x, p, cfg: GPTConfig, layer_cache, positions, start):
         cache_v = jax.lax.dynamic_update_slice(layer_cache["v"], v_new, (0, 0, start, 0))
         # Causal within the new chunk: token j attends to cache[: start+j+1].
         limit = start + jnp.arange(t) + 1  # [T]
+        limit_b = jnp.broadcast_to(start + 1, (b,))  # per-row view for t==1
     else:
         write = jax.vmap(
             lambda arr, new, pos: jax.lax.dynamic_update_slice(arr, new, (0, pos, 0))
@@ -72,7 +73,19 @@ def _block_with_cache(x, p, cfg: GPTConfig, layer_cache, positions, start):
         cache_k = write(layer_cache["k"], k_new, start)
         cache_v = write(layer_cache["v"], v_new, start)
         limit = start[:, None] + jnp.arange(t) + 1  # [B, T]
-    o = _attend_cache(q, cache_k, cache_v, nh // nkv, limit)
+        limit_b = start + 1
+    if t == 1:
+        # The serving hot path — lockstep (generate) and ragged (DecodeServer)
+        # single-token steps BOTH go through the cached-attention kernel
+        # (Pallas on TPU, XLA reference elsewhere), so the two decode paths
+        # stay numerically identical to each other on every backend.
+        from nos_tpu.ops.decode_attention import decode_attention
+
+        o = decode_attention(
+            q[:, :, 0, :], cache_k, cache_v, limit_b.astype(jnp.int32)
+        )[:, :, None, :]
+    else:
+        o = _attend_cache(q, cache_k, cache_v, nh // nkv, limit)
     o = o.transpose(0, 2, 1, 3).reshape(b, t, h)
     x = x + o @ p["wo"]
     z = _rmsnorm(x, p["ln2"])
@@ -124,7 +137,9 @@ def decode_step_ragged(params, token, cfg: GPTConfig, cache, pos):
     what continuous batching (DecodeServer) steps with: each slot sits at its
     own position — slot 0 may be at token 90 while slot 1 just prefilled to
     7. Shares the exact block code with prefill/lockstep decode (the vector
-    `start` path of _forward_with_cache), so the two can never drift."""
+    `start` path of _forward_with_cache), and every single-token step —
+    lockstep or ragged — attends through the same cached-attention op, so the
+    decode paths cannot drift from each other on any backend."""
     logits, cache = _forward_with_cache(params, token[:, None], cfg, cache, pos)
     return logits[:, 0, :], cache
 

@@ -1,0 +1,107 @@
+"""Cached decode attention as a Pallas TPU kernel.
+
+The serving-side hot op: one query token per sequence attending over its KV
+cache. At decode time the cost is HBM reads of the cache, and the XLA path
+materializes an f32 score tensor [B, nkv, rep, 1, max] plus full-width
+up-casts of K/V; the kernel instead streams each (batch, kv-head) cache
+through VMEM once, computes the masked softmax in f32 on the fly, and never
+round-trips scores through HBM. Grouped-query layout is native: the `rep`
+query heads of one KV head form the kernel's row block, so the cache is read
+once per KV head (the HBM saving GQA exists for).
+
+Same contract as the flash kernel: Pallas on TPU, XLA reference elsewhere,
+one signature (`decode_attention(q, cache_k, cache_v, limit)`); exact up to
+dtype rounding against the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _reference(q, cache_k, cache_v, limit):
+    """q [B,nh,hd]; cache [B,nkv,max,hd]; limit [B] -> [B,nh,hd]."""
+    b, nh, hd = q.shape
+    nkv = cache_k.shape[1]
+    rep = nh // nkv
+    qg = q.reshape(b, nkv, rep, hd)
+    scale = hd ** -0.5
+    s = jnp.einsum(
+        "bgrd,bgsd->bgrs", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
+    ) * scale
+    idx = jnp.arange(cache_k.shape[2])
+    mask = idx[None, :] < limit[:, None]  # [B, max]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrs,bgsd->bgrd", p, cache_v.astype(jnp.float32))
+    return o.reshape(b, nh, hd).astype(q.dtype)
+
+
+def _pallas(q, cache_k, cache_v, limit, interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    b, nh, hd = q.shape
+    nkv, max_len = cache_k.shape[1], cache_k.shape[2]
+    rep = nh // nkv
+    # Sublane-pad the row block (rep is often < 8).
+    rep_p = max(8, rep)
+    qg = q.reshape(b, nkv, rep, hd)
+    if rep_p != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_p - rep), (0, 0)))
+    scale = hd ** -0.5
+    limit2 = limit.astype(jnp.int32).reshape(b, 1)
+
+    def kernel(lim_ref, q_ref, k_ref, v_ref, o_ref):
+        # The whole [B,1] limit array is resident (TPU block shapes must tile
+        # 8x128 or match the array); index the row for this program.
+        lim = lim_ref[pl.program_id(0), 0]
+        qf = q_ref[0, 0].astype(jnp.float32)  # [rep_p, hd]
+        kf = k_ref[0, 0].astype(jnp.float32)  # [max, hd]
+        s = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [rep_p, max]
+        idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx < lim, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+        o_ref[0, 0] = jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((b, 1), lambda i, g: (0, 0)),  # limit [B,1], whole array
+            pl.BlockSpec((1, 1, rep_p, hd), lambda i, g: (i, g, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, hd), lambda i, g: (i, g, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, hd), lambda i, g: (i, g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep_p, hd), lambda i, g: (i, g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, rep_p, hd), q.dtype),
+        interpret=interpret,
+    )(limit2, qg, cache_k, cache_v)
+    return out[:, :, :rep, :].reshape(b, nh, hd)
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("NOS_TPU_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def decode_attention(q, cache_k, cache_v, limit):
+    """Single-token cached attention: q [B,nh,hd] over caches [B,nkv,max,hd]
+    with per-row attention limits [B]. Pallas kernel on TPU, XLA reference
+    elsewhere."""
+    if _use_pallas():
+        return _pallas(q, cache_k, cache_v, limit)
+    return _reference(q, cache_k, cache_v, limit)

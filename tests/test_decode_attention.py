@@ -1,0 +1,51 @@
+"""Cached decode attention: the Pallas kernel body (interpret mode in CI)
+must match the XLA reference, which must match the general _attend_cache
+path the prefill uses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.decode import _attend_cache
+from nos_tpu.ops.decode_attention import _pallas, _reference
+
+
+def _inputs(b=3, nkv=2, rep=4, maxl=64, hd=32, seed=0):
+    key = jax.random.PRNGKey(seed)
+    q = jax.random.normal(key, (b, nkv * rep, hd), jnp.bfloat16)
+    ck = jax.random.normal(jax.random.fold_in(key, 1), (b, nkv, maxl, hd), jnp.bfloat16)
+    cv = jax.random.normal(jax.random.fold_in(key, 2), (b, nkv, maxl, hd), jnp.bfloat16)
+    limit = jnp.array([1, maxl // 3, maxl][:b])
+    return q, ck, cv, limit
+
+
+def test_kernel_matches_reference_interpret_mode():
+    q, ck, cv, limit = _inputs()
+    ref = _reference(q, ck, cv, limit)
+    out = _pallas(q, ck, cv, limit, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_reference_matches_general_attend_cache():
+    q, ck, cv, limit = _inputs()
+    b, nh, hd = q.shape
+    ref = _reference(q, ck, cv, limit)
+    general = _attend_cache(
+        q[:, :, None, :], ck, cv, nh // ck.shape[1], limit[:, None]
+    )[:, :, 0, :]
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32), np.asarray(general, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_kernel_handles_uneven_rep_padding():
+    # rep=2 pads the row block to the 8-sublane minimum.
+    q, ck, cv, limit = _inputs(b=2, nkv=3, rep=2, maxl=32, hd=16)
+    ref = _reference(q, ck, cv, limit[:2])
+    out = _pallas(q, ck, cv, limit[:2], interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2
+    )
