@@ -11,7 +11,14 @@ single-image YOLOS-small-class detector inferences in a closed loop (exactly
 the reference's polling pods); the SliceServer micro-batches the concurrent
 requests into MXU-shaped executions — the sharing strategy a systolic-array
 machine rewards, where MPS/time-slicing on GPU merely interleaves. Reported
-value = mean per-request latency observed by the clients.
+value = per-request latency observed by the clients.
+
+Robustness: the chip is reached over a remote-dispatch tunnel whose transient
+failures (e.g. "remote_compile: read body: response body closed") can kill a
+single run, and whose health adds 0.07–0.21s of run-to-run variance. So this
+benchmark (a) retries warmup and each trial with backoff on transient runtime
+errors, (b) runs TRIALS independent trials and reports the MEDIAN trial mean,
+and (c) exits non-zero only when every attempt of every trial failed.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -19,28 +26,54 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import statistics
+import sys
 import threading
 import time
+import traceback
 
 MPS_BASELINE_7PODS_S = 0.31982  # BASELINE.md, MPS, 7 pods
 N_WORKLOADS = 7
 WARMUP_REQUESTS = 3
 MEASURE_REQUESTS = 30
+TRIALS = 3
+MAX_ATTEMPTS_PER_STEP = 4  # warmup or trial: retries on transient errors
+BACKOFF_S = 2.0
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
-    from nos_tpu.models.vit import ViTConfig, init_vit, vit_detect
+
+def _retry(step_name: str, fn):
+    """Run fn() with retry-with-backoff on any runtime error.
+
+    Remote-dispatch tunnel flakes surface as JaxRuntimeError (and
+    occasionally other transport-level exceptions) from deep inside
+    dispatch; all are transient from this benchmark's point of view, so
+    retry uniformly and only give up after MAX_ATTEMPTS_PER_STEP.
+    """
+    last = None
+    for attempt in range(1, MAX_ATTEMPTS_PER_STEP + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — deliberate: see docstring
+            last = e
+            _log(f"{step_name}: attempt {attempt}/{MAX_ATTEMPTS_PER_STEP} "
+                 f"failed: {type(e).__name__}: {e}")
+            if attempt < MAX_ATTEMPTS_PER_STEP:
+                time.sleep(BACKOFF_S * attempt)
+    raise last
+
+
+def _build_server(jax, jnp, cfg, params):
     from nos_tpu.runtime.slice_server import SliceServer
-
-    cfg = ViTConfig()  # YOLOS-small class: 384 hidden, 12 layers, 6 heads
-    params = init_vit(jax.random.PRNGKey(0), cfg)
 
     # Serve the full detector (labels/scores/boxes postprocessed on device):
     # what crosses the host link per request is the detection set, not raw
     # logits, and the fetch pipeline overlaps transfers with the next batch.
+    from nos_tpu.models.vit import vit_detect
+
     server = SliceServer(
         lambda im: vit_detect(params, im, cfg),
         max_batch=N_WORKLOADS,
@@ -50,21 +83,31 @@ def main() -> None:
     example = jax.random.uniform(
         jax.random.PRNGKey(0), (cfg.image_size, cfg.image_size, 3), jnp.float32
     )
-    server.warmup(example)
+    _retry("warmup", lambda: server.warmup(example))
     server.start()
+    return server
 
+
+def _run_trial(jax, jnp, cfg, server) -> float:
+    """One full trial: 7 closed-loop clients, returns mean latency (s)."""
     latencies = [[] for _ in range(N_WORKLOADS)]
+    errors = []
 
     def workload(i: int) -> None:
-        image = jax.random.uniform(
-            jax.random.PRNGKey(i), (cfg.image_size, cfg.image_size, 3), jnp.float32
-        )
-        for _ in range(WARMUP_REQUESTS):
-            server.infer(image, timeout=60)
-        for _ in range(MEASURE_REQUESTS):
-            t0 = time.perf_counter()
-            server.infer(image, timeout=60)
-            latencies[i].append(time.perf_counter() - t0)
+        try:
+            image = jax.random.uniform(
+                jax.random.PRNGKey(i),
+                (cfg.image_size, cfg.image_size, 3),
+                jnp.float32,
+            )
+            for _ in range(WARMUP_REQUESTS):
+                server.infer(image, timeout=120)
+            for _ in range(MEASURE_REQUESTS):
+                t0 = time.perf_counter()
+                server.infer(image, timeout=120)
+                latencies[i].append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — re-raised by the trial below
+            errors.append(e)
 
     threads = [
         threading.Thread(target=workload, args=(i,)) for i in range(N_WORKLOADS)
@@ -73,18 +116,70 @@ def main() -> None:
         t.start()
     for t in threads:
         t.join()
-    server.stop()
-
+    if errors:
+        raise errors[0]
     all_lat = [l for per in latencies for l in per]
-    avg_inference_s = sum(all_lat) / len(all_lat)
+    return sum(all_lat) / len(all_lat)
 
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.vit import ViTConfig, init_vit
+
+    cfg = ViTConfig()  # YOLOS-small class: 384 hidden, 12 layers, 6 heads
+    params = init_vit(jax.random.PRNGKey(0), cfg)
+
+    # Built lazily on first use and after any failure: a trial error stops
+    # the (possibly wedged) server and clears the slot, so the NEXT attempt
+    # rebuilds — never runs against a stopped server, and a failed rebuild
+    # is itself retried on the following attempt. Warmup inside
+    # _build_server carries the only inner retry (dispatch is the flaky
+    # step); construction itself is not retried.
+    state = {"server": None}
+
+    trial_means = []
+    for trial in range(1, TRIALS + 1):
+        def attempt():
+            if state["server"] is None:
+                state["server"] = _build_server(jax, jnp, cfg, params)
+            try:
+                return _run_trial(jax, jnp, cfg, state["server"])
+            except Exception:
+                try:
+                    state["server"].stop()
+                except Exception:  # noqa: BLE001
+                    pass
+                state["server"] = None
+                raise
+
+        try:
+            mean_s = _retry(f"trial {trial}", attempt)
+            trial_means.append(mean_s)
+            _log(f"trial {trial}/{TRIALS}: mean {mean_s:.4f}s")
+        except Exception:  # noqa: BLE001
+            _log(f"trial {trial}/{TRIALS}: exhausted retries, skipping")
+            traceback.print_exc(file=sys.stderr)
+
+    if state["server"] is not None:
+        try:
+            state["server"].stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+    if not trial_means:
+        _log("every trial failed — no result")
+        sys.exit(1)
+
+    value = statistics.median(trial_means)
     print(
         json.dumps(
             {
                 "metric": "avg_inference_time_7_workloads_sharing_one_chip",
-                "value": round(avg_inference_s, 6),
+                "value": round(value, 6),
                 "unit": "s",
-                "vs_baseline": round(MPS_BASELINE_7PODS_S / avg_inference_s, 3),
+                "vs_baseline": round(MPS_BASELINE_7PODS_S / value, 3),
             }
         )
     )

@@ -212,7 +212,17 @@ class GroupPartitioner:
             current = group.current_subslices(node_has_workload)
             current_ids = {s.id for s in current}
             if {s.id for s in desired} == current_ids:
-                continue  # no change
+                # No patch needed — but demand this group's existing FREE
+                # carves already satisfy must not be re-counted against later
+                # groups (they would carve duplicates for the same gangs):
+                # absorb them exactly as if they were newly carved.
+                satisfied: Dict[Profile, int] = {}
+                for s in current:
+                    if not s.in_use and s.profile in demand:
+                        satisfied[s.profile] = satisfied.get(s.profile, 0) + 1
+                if satisfied:
+                    self._absorb(items, satisfied)
+                continue
             self._actuate(group, desired, plan_id)
             planned_any = True
             # Satisfied demand is satisfied once; don't double-carve on the

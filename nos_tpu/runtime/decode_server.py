@@ -201,6 +201,19 @@ class DecodeServer:
                     )
                 )
                 continue
+            if len(prompt) + max_new - 1 > self.max_len:
+                # The request cannot complete inside the cache window —
+                # reject it rather than silently resolve with fewer tokens
+                # than asked for (the generation finishing at pos == max_len
+                # with remaining == 0 is the exact boundary, hence the -1).
+                fut.set_exception(
+                    ValueError(
+                        f"prompt length {len(prompt)} + max_new {max_new} "
+                        f"exceeds max_len {self.max_len}: output would be "
+                        f"truncated"
+                    )
+                )
+                continue
             bucket = self._bucket(len(prompt))
             padded = np.zeros((1, bucket), dtype=np.int32)
             padded[0, : len(prompt)] = prompt

@@ -57,6 +57,10 @@ class Scheduler:
             request_fn=self.calculator.compute_pod_request,
         )
         self.capacity.framework = self.framework
+        # Pass-level node snapshot, kept coherent by binds AND evictions so
+        # later pods in the same pass (incl. the preemptor on its nominated
+        # node) don't filter against stale occupancy.
+        self._pass_nodes: Optional[List[NodeInfo]] = None
 
     # -- cluster views -------------------------------------------------------
     def node_infos(self) -> List[NodeInfo]:
@@ -110,6 +114,7 @@ class Scheduler:
         pending = self.pending_pods()
         self.capacity.nominated_pods = [p for p in pending if p.status.nominated_node_name]
         nodes = self.node_infos()
+        self._pass_nodes = nodes
         # Gangs are scheduling UNITS interleaved with single pods in priority
         # order (a gang handled before higher-priority singles would consume
         # shared quota out of turn). A gang's priority is its best member's.
@@ -268,6 +273,13 @@ class Scheduler:
                 continue
             by_subslice.setdefault(sid, []).append(node)
             slice_group_of[sid] = node.labels.get(C.LABEL_TPU_SLICE, "")
+        # Drop ids whose host set is not one contiguous block (see
+        # _hosts_contiguous) — binding onto them would tear the gang's mesh.
+        by_subslice = {
+            sid: hosts
+            for sid, hosts in by_subslice.items()
+            if self._hosts_contiguous(hosts)
+        }
         if count > 1:
             return self._try_place_multislice_gang(
                 gang_name, pods, by_subslice, slice_group_of, count
@@ -290,6 +302,35 @@ class Scheduler:
                 )
             return result
         return None
+
+    @staticmethod
+    def _hosts_contiguous(hosts: List[NodeInfo]) -> bool:
+        """True iff the hosts' coord labels form one dense axis-aligned block
+        (unknown coords => trust the label grouping, e.g. single-host tests)."""
+        from nos_tpu import constants as C
+        from nos_tpu.tpu.slice_group import parse_host_coord
+
+        coords = []
+        for h in hosts:
+            raw = h.labels.get(C.LABEL_TPU_HOST_COORD)
+            if raw is None:
+                return True
+            try:
+                coords.append(parse_host_coord(raw))
+            except ValueError:
+                # One mislabeled host must not take down the scheduling pass
+                # (same posture as GroupPartitioner's from_nodes guard):
+                # treat its sub-slice as unusable.
+                return False
+        rank = len(coords[0])
+        if any(len(c) != rank for c in coords):
+            return False
+        lo = tuple(min(c[i] for c in coords) for i in range(rank))
+        hi = tuple(max(c[i] for c in coords) for i in range(rank))
+        volume = 1
+        for a, b in zip(lo, hi):
+            volume *= b - a + 1
+        return volume == len(set(coords)) == len(coords)
 
     def _reserve_chunk(
         self, state: CycleState, chunk: List[Pod], hosts: List[NodeInfo]
@@ -474,7 +515,16 @@ class Scheduler:
             self.cluster.patch("Pod", pod.metadata.namespace, pod.metadata.name, mutate)
             pod.status.nominated_node_name = node_name
         except NotFoundError:
-            pass
+            return
+        # Later pods in the SAME pass must account for this nomination — the
+        # eviction already freed the victim's occupancy in the pass snapshot,
+        # and without this the freed capacity looks up for grabs, starving
+        # the preemptor in a re-preemption loop.
+        if all(
+            p.metadata.namespaced_name != pod.metadata.namespaced_name
+            for p in self.capacity.nominated_pods
+        ):
+            self.capacity.nominated_pods.append(pod)
 
     def _evict(self, victim: Pod) -> None:
         """Preemption eviction: delete the pod (workload controllers recreate)."""
@@ -482,3 +532,20 @@ class Scheduler:
             self.cluster.delete("Pod", victim.metadata.namespace, victim.metadata.name)
         except NotFoundError:
             pass
+        # Mirror what _bind_assignment does for binds: the snapshot must stop
+        # showing the victim's occupancy or the preemptor waits an extra pass.
+        if self._pass_nodes is not None and victim.spec.node_name:
+            for info in self._pass_nodes:
+                if info.name != victim.spec.node_name:
+                    continue
+                before = len(info.pods)
+                info.pods = [
+                    p
+                    for p in info.pods
+                    if p.metadata.namespaced_name != victim.metadata.namespaced_name
+                ]
+                if len(info.pods) != before:
+                    info.requested = info.requested.subtract_non_negative(
+                        self.calculator.compute_pod_request(victim)
+                    )
+                break

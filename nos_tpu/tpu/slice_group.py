@@ -77,9 +77,19 @@ def chip_to_host_block(profile: Profile, host: Shape) -> Optional[Shape]:
     return Shape(tuple(dims))
 
 
-def subslice_id_for(slice_id: str, profile: Profile, host_origin: Coord) -> str:
-    """Deterministic sub-slice id: same carve -> same id across replans."""
-    key = f"{slice_id}/{profile.name}@{format_host_coord(host_origin)}"
+def subslice_id_for(
+    slice_id: str, profile: Profile, host_origin: Coord, host_dims: Coord
+) -> str:
+    """Deterministic sub-slice id: same carve -> same id across replans.
+
+    The ORIENTED host footprint is part of the identity: a replan that
+    places the same profile at the same origin rotated covers a different
+    host set, and reusing the id would let a gang bind onto a mix of the
+    old and new footprints during the ack window."""
+    key = (
+        f"{slice_id}/{profile.name}@{format_host_coord(host_origin)}"
+        f"x{format_host_coord(host_dims)}"
+    )
     return f"{slice_id}-{hashlib.sha1(key.encode()).hexdigest()[:8]}"
 
 
@@ -256,7 +266,9 @@ class SliceGroup:
             ]
             result.append(
                 SubSlice(
-                    id=subslice_id_for(self.slice_id, chip_profile, pl.origin),
+                    id=subslice_id_for(
+                        self.slice_id, chip_profile, pl.origin, pl.dims
+                    ),
                     profile=chip_profile,
                     host_origin=pl.origin,
                     host_dims=pl.dims,

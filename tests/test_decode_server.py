@@ -133,6 +133,25 @@ def test_prompt_exceeding_buckets_rejected(params):
         server.stop()
 
 
+def test_request_that_cannot_complete_is_rejected(params):
+    """prompt + max_new overflowing the cache window must be REJECTED, not
+    silently resolved with fewer tokens than requested."""
+    server = DecodeServer(
+        params, CFG, n_slots=1, max_len=16, prompt_buckets=(8, 10)
+    ).start()
+    try:
+        fut = server.submit(list(range(1, 11)), max_new=10)  # 10+10-1 > 16
+        with pytest.raises(ValueError, match="truncated"):
+            fut.result(timeout=60)
+        # Exactly-fitting request still completes in full (boundary: 10+7-1 == 16).
+        prompt = list(range(1, 11))
+        got = server.generate(prompt, max_new=7, timeout=120)
+        assert len(got) == 7
+        assert got == solo_greedy(params, prompt, 7, max_len=16)
+    finally:
+        server.stop()
+
+
 def test_max_new_zero_returns_empty(params):
     server = DecodeServer(params, CFG, n_slots=1, max_len=32).start()
     try:

@@ -416,3 +416,87 @@ def test_malformed_group_does_not_block_others():
         assert phase == PodPhase.RUNNING
         node = plane.cluster.get("Node", "", host)
         assert node.metadata.labels[constants.LABEL_TPU_SLICE] == "good"
+
+
+def test_subslice_id_depends_on_orientation():
+    """A replan placing the same profile at the same origin ROTATED must mint
+    a new id: reusing it would let a gang bind onto a mix of the old and new
+    host footprints during the ack window (advisor finding, round 1)."""
+    from nos_tpu.tpu.profile import Profile
+    from nos_tpu.tpu.shape import Shape
+    from nos_tpu.tpu.slice_group import subslice_id_for
+
+    p = Profile(Shape((4, 8)))
+    a = subslice_id_for("s0", p, (0, 0), (2, 4))
+    b = subslice_id_for("s0", p, (0, 0), (4, 2))
+    assert a != b
+    # Same carve -> same id across replans (determinism unchanged).
+    assert a == subslice_id_for("s0", p, (0, 0), (2, 4))
+
+
+def test_gang_refuses_non_contiguous_host_set():
+    """Hosts sharing one subslice-id label whose coords do NOT form one dense
+    block (stale label mix) must not receive a gang."""
+    plane, clock = build_plane()
+    make_group(plane, slice_id="s0")
+    # Forge a half-acknowledged replan: four hosts carry the same subslice-id
+    # but their coords are two disjoint 1x2 strips (not one 2x2 block).
+    for name, sid in [
+        ("s0-host-0-0", "s0-stale"),
+        ("s0-host-0-1", "s0-stale"),
+        ("s0-host-3-0", "s0-stale"),
+        ("s0-host-3-1", "s0-stale"),
+    ]:
+        def mutate(n, sid=sid):
+            n.metadata.labels[constants.LABEL_TPU_SUBSLICE_ID] = sid
+            n.metadata.labels[constants.LABEL_TPU_SUBSLICE_TOPOLOGY] = "4x4"
+
+        plane.cluster.patch("Node", "", name, mutate)
+    submit_gang(plane, "g", "ml", "4x4", size=4)
+    result = plane.scheduler.schedule_pending()
+    assert len(result["bound"]) == 0
+    assert len(result["unschedulable"]) == 4
+
+
+def test_existing_free_carve_absorbs_demand_before_next_group():
+    """Demand already satisfiable by a group's existing free carve must not
+    leak to the next group (duplicate carving, advisor finding round 1): a
+    no-change group still absorbs what its free sub-slices cover."""
+    from nos_tpu.tpu.profile import Profile
+    from nos_tpu.tpu.shape import Shape
+    from nos_tpu.tpu.slice_group import subslice_id_for
+
+    plane, clock = build_plane()
+    make_group(plane, slice_id="s0", global_topo="4x4", grid=(2, 2))
+    make_group(plane, slice_id="s1", global_topo="4x4", grid=(2, 2))
+    submit_gang(plane, "g", "ml", "4x4", size=4)
+    # Pass 1: nothing carved yet -> gang goes unschedulable into the batcher.
+    assert plane.scheduler.schedule_pending()["unschedulable"]
+    # A free 4x4 carve appears on s0 (e.g. left by a completed workload),
+    # fully acknowledged.
+    sid = subslice_id_for("s0", Profile(Shape((4, 4))), (0, 0), (2, 2))
+    for r in range(2):
+        for c in range(2):
+            def mutate(n):
+                a = n.metadata.annotations
+                a[constants.ANNOTATION_SPEC_SUBSLICE_ID] = sid
+                a[constants.ANNOTATION_SPEC_SUBSLICE_TOPOLOGY] = "4x4"
+                a[constants.ANNOTATION_SPEC_PLAN] = "p-prior"
+                a[constants.ANNOTATION_STATUS_PLAN] = "p-prior"
+
+            plane.cluster.patch("Node", "", f"s0-host-{r}-{c}", mutate)
+    clock.t += 61.0
+    plane.group_partitioner.process_batch_if_ready()
+    # s1 must stay untouched: s0's free carve already covers the demand.
+    for r in range(2):
+        for c in range(2):
+            node = plane.cluster.get("Node", "", f"s1-host-{r}-{c}")
+            assert (
+                constants.ANNOTATION_SPEC_SUBSLICE_ID
+                not in node.metadata.annotations
+            ), "duplicate carve on s1"
+    # And the gang lands on s0's carve once the agents have acked.
+    result = plane.scheduler.schedule_pending()
+    assert len(result["bound"]) == 4
+    for host, phase in gang_nodes(plane, "ml", "g", 4):
+        assert phase == PodPhase.RUNNING and host.startswith("s0-")

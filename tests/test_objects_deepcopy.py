@@ -1,0 +1,101 @@
+"""Regression guard for the hand-rolled deepcopy() methods.
+
+The in-memory cluster's value semantics rest entirely on api/objects.py's
+manual copies (fast path — generic copy.deepcopy dominated control rounds).
+The risk: a field added to any of these dataclasses but not to its deepcopy()
+is silently dropped/aliased on every store/read. These tests auto-populate
+EVERY dataclass field via reflection, so new fields are covered the moment
+they are declared.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import typing
+
+import pytest
+
+from nos_tpu.api import objects
+from nos_tpu.api.resources import ResourceList
+
+_counter = [0]
+
+
+def _fresh(t, name: str):
+    """A distinctive, non-default value for a field of type t."""
+    _counter[0] += 1
+    n = _counter[0]
+    origin = typing.get_origin(t)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        return _fresh(args[0], name)
+    if t is str:
+        return f"{name}-{n}"
+    if t is int:
+        return 100 + n
+    if t is float:
+        return 0.5 + n
+    if t is bool:
+        return True
+    if t is ResourceList:
+        return ResourceList({f"res-{name}": float(n)})
+    if origin in (dict, typing.Dict):
+        kt, vt = typing.get_args(t)
+        return {_fresh(kt, name + "k"): _fresh(vt, name + "v")}
+    if origin in (list, typing.List):
+        (et,) = typing.get_args(t)
+        return [_fresh(et, name + "e"), _fresh(et, name + "e")]
+    if dataclasses.is_dataclass(t):
+        return _populate(t)
+    raise AssertionError(f"unhandled field type {t!r} for {name}")
+
+
+def _populate(cls):
+    """Instance of a dataclass with every field set to a distinctive value."""
+    hints = typing.get_type_hints(cls)
+    kwargs = {f.name: _fresh(hints[f.name], f.name) for f in dataclasses.fields(cls)}
+    return cls(**kwargs)
+
+
+COPYABLE = [objects.Pod, objects.Node, objects.ConfigMap, objects.PodDisruptionBudget]
+
+
+@pytest.mark.parametrize("cls", COPYABLE, ids=lambda c: c.__name__)
+def test_deepcopy_preserves_every_field(cls):
+    obj = _populate(cls)
+    assert obj.deepcopy() == copy.deepcopy(obj), (
+        f"{cls.__name__}.deepcopy() drops or mangles a field — it must be "
+        f"updated for newly added fields"
+    )
+
+
+@pytest.mark.parametrize("cls", COPYABLE, ids=lambda c: c.__name__)
+def test_deepcopy_does_not_alias(cls):
+    obj = _populate(cls)
+    dup = obj.deepcopy()
+    # Mutating every mutable container in the copy must leave the original
+    # untouched.
+    def scramble(o):
+        for f in dataclasses.fields(o):
+            v = getattr(o, f.name)
+            if isinstance(v, dict):
+                v["__scrambled__"] = "yes"
+            elif isinstance(v, list):
+                v.append("__scrambled__")
+            elif dataclasses.is_dataclass(v):
+                scramble(v)
+
+    snapshot = copy.deepcopy(obj)
+    scramble(dup)
+    assert obj == snapshot, f"{cls.__name__}.deepcopy() aliases a container"
+
+
+def test_every_kinded_object_is_guarded():
+    """Any new KIND-carrying object must join COPYABLE above."""
+    kinded = {
+        cls
+        for cls in vars(objects).values()
+        if dataclasses.is_dataclass(cls) and hasattr(cls, "KIND")
+    }
+    assert kinded == set(COPYABLE)

@@ -269,3 +269,63 @@ def test_composite_quota_spans_namespaces():
     # ns-b shares the same budget: 3+2 > max 4 -> rejected.
     cluster.create(make_pod("p2", "ns-b", {"cpu": 2}))
     assert s.schedule_pending()["unschedulable"] == ["ns-b/p2"]
+
+
+def test_eviction_updates_pass_snapshot_for_later_pods():
+    """Mid-pass preemption must free the victim's occupancy in the pass-level
+    node snapshot: a later pod in the SAME pass that fits only thanks to the
+    eviction (beyond what the preemptor's nomination reserves) binds
+    immediately instead of waiting an extra pass (advisor finding, round 1)."""
+    cluster = Cluster()
+    cluster.create(make_node("n1", {"cpu": 8}))
+    cluster.create(build_eq("ns-a", "qa", min={"cpu": 6}))
+    cluster.create(build_eq("ns-b", "qb", min={"cpu": 1}))
+    victim = make_pod(
+        "borrower",
+        "ns-b",
+        {"cpu": 7},
+        labels={constants.LABEL_CAPACITY: constants.CAPACITY_OVER_QUOTA},
+        phase=PodPhase.RUNNING,
+    )
+    victim.spec.node_name = "n1"
+    cluster.create(victim)
+    # High-priority claimant preempts; a small low-priority pod follows in
+    # the same pass. After eviction: 8 total - 6 nominated = 2 available.
+    cluster.create(make_pod("claimant", "ns-a", {"cpu": 6}, priority=10))
+    cluster.create(make_pod("tail", "ns-a", {"cpu": 1}, priority=0))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()
+    assert result["nominated"] == ["ns-a/claimant"]
+    assert cluster.try_get("Pod", "ns-b", "borrower") is None
+    # The fix: "tail" binds in the same pass (stale snapshot would show the
+    # victim's 7 cpu and reject it).
+    assert ("ns-a/tail", "n1") in result["bound"]
+    # The nominated claimant still lands next pass — its reservation held.
+    assert s.schedule_pending()["bound"] == [("ns-a/claimant", "n1")]
+
+
+def test_malformed_host_coord_does_not_crash_pass():
+    """A garbage host-coord label on a sub-slice host must not abort the
+    scheduling pass — the sub-slice is skipped, other pods still schedule."""
+    cluster = Cluster()
+    bad = make_node(
+        "bad-host",
+        {"cpu": 4, "google.com/tpu": 4},
+        labels={
+            constants.LABEL_TPU_SUBSLICE_ID: "s0-x",
+            constants.LABEL_TPU_SUBSLICE_TOPOLOGY: "2x2",
+            constants.LABEL_TPU_HOST_COORD: "3,x",
+        },
+    )
+    cluster.create(bad)
+    cluster.create(make_node("plain", {"cpu": 4}))
+    gang_pod = make_pod("g-0", "ns", {"google.com/tpu": 4})
+    gang_pod.metadata.labels[constants.LABEL_GANG] = "g"
+    gang_pod.metadata.labels[constants.LABEL_GANG_SIZE] = "1"
+    gang_pod.spec.node_selector = {constants.LABEL_TPU_SUBSLICE_TOPOLOGY: "2x2"}
+    cluster.create(gang_pod)
+    cluster.create(make_pod("single", "ns", {"cpu": 2}))
+    s = Scheduler(cluster)
+    result = s.schedule_pending()  # must not raise
+    assert ("ns/single", "plain") in result["bound"]
+    assert "ns/g-0" in result["unschedulable"]
