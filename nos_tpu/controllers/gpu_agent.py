@@ -20,7 +20,7 @@ from nos_tpu.api.objects import Node
 from nos_tpu.api.resources import compute_pod_request
 from nos_tpu.cluster.client import Cluster, Event, EventType, NotFoundError
 from nos_tpu.controllers.tpu_agent import SharedState, dict_spec
-from nos_tpu.gpu.mig import MigProfile, geometry_allowed
+from nos_tpu.gpu.mig import MigProfile, geometry_feasible
 from nos_tpu.gpu.mps import MpsGpu, MpsProfile
 from nos_tpu.tpulib.interface import TpuLibError
 from nos_tpu.util import pod as podutil
@@ -107,7 +107,12 @@ class FakeGpuDeviceClient:
 
 def mig_validator(model: str) -> Callable[[int, Dict[str, int]], bool]:
     def validate(gpu_index: int, geometry: Dict[str, int]) -> bool:
-        return geometry_allowed(model, {MigProfile.parse(p): n for p, n in geometry.items()})
+        # NVML semantics: devices are created one at a time, so every
+        # INTERMEDIATE state must pass — feasibility (sub-multiset of an
+        # allowed geometry), not full-menu membership.
+        return geometry_feasible(
+            model, {MigProfile.parse(p): n for p, n in geometry.items()}
+        )
 
     return validate
 

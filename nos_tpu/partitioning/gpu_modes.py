@@ -11,6 +11,7 @@ MPS via the device-plugin ConfigMap + node label flip
 from __future__ import annotations
 
 import json
+import logging
 from typing import Callable, Dict, List, Mapping, Optional
 
 from nos_tpu import constants
@@ -18,9 +19,12 @@ from nos_tpu.api import annotations as ann
 from nos_tpu.api.objects import ConfigMap, Node, Pod
 from nos_tpu.api.resources import ResourceList, compute_pod_request
 from nos_tpu.cluster.client import Cluster, NotFoundError
-from nos_tpu.gpu.mig import KNOWN_MIG_MODELS, MigGpu, MigProfile
+from nos_tpu.gpu.mig import MigGpu, MigProfile
+from nos_tpu.gpu.mig import model_known as mig_model_known
 from nos_tpu.gpu.mps import MpsGpu, MpsProfile
 from nos_tpu.partitioning.core.interface import NodeInfo, NodePartitioning
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -222,18 +226,29 @@ class MigSnapshotTaker:
             if not is_node_device_healthy(node):
                 continue
             model, count, _ = _gfd(node)
-            if model not in KNOWN_MIG_MODELS or count < 1:
+            if not mig_model_known(model) or count < 1:
                 continue
             per_gpu = _node_status_geometry(node, lambda n: MigProfile.parse(n))
-            gpus = [
-                MigGpu(
-                    model,
-                    idx,
-                    per_gpu.get(idx, {}).get("geometry"),
-                    per_gpu.get(idx, {}).get("used"),
+            try:
+                gpus = [
+                    MigGpu(
+                        model,
+                        idx,
+                        per_gpu.get(idx, {}).get("geometry"),
+                        per_gpu.get(idx, {}).get("used"),
+                    )
+                    for idx in range(count)
+                ]
+            except ValueError:
+                # A node reporting a geometry the current menus consider
+                # impossible (stale annotations, tables changed under it)
+                # must not take down planning for the whole cluster.
+                logger.exception(
+                    "mig snapshot: node %s reports an infeasible geometry, "
+                    "skipping it this cycle",
+                    node.metadata.name,
                 )
-                for idx in range(count)
-            ]
+                continue
             name = node.metadata.name
             nodes[name] = GpuNode(
                 name=name,

@@ -393,3 +393,127 @@ def test_distinct_permutations_dedupes_and_orders():
     perms = list(distinct_permutations(["b", "a", "a"]))
     assert perms == [["a", "a", "b"], ["a", "b", "a"], ["b", "a", "a"]]
     assert list(distinct_permutations([])) == [[]]
+
+
+# -- known-geometry table parity (known_configs.go:25-142) --------------------
+def test_default_known_geometries_match_reference_tables():
+    """The default menus must equal the reference's published tables EXACTLY
+    (including upstream's idiosyncratic 80GB rows): the planner admits only
+    menu geometries, so any divergence changes planning behavior."""
+    from nos_tpu.gpu.mig import allowed_geometries
+
+    def menu(model):
+        table = allowed_geometries(model)
+        assert table is not None, model
+        return sorted(
+            tuple(sorted((p.name, n) for p, n in g.items())) for g in table
+        )
+
+    assert menu("A30") == sorted(
+        [
+            (("4g.24gb", 1),),
+            (("2g.12gb", 2),),
+            (("1g.6gb", 2), ("2g.12gb", 1)),
+            (("1g.6gb", 4),),
+        ]
+    )
+    a100_40 = sorted(
+        [
+            (("7g.40gb", 1),),
+            (("1g.5gb", 1), ("2g.10gb", 1), ("4g.20gb", 1)),
+            (("1g.5gb", 3), ("4g.20gb", 1)),
+            (("3g.20gb", 2),),
+            (("1g.5gb", 1), ("2g.10gb", 1), ("3g.20gb", 1)),
+            (("1g.5gb", 3), ("3g.20gb", 1)),
+            (("2g.10gb", 2), ("3g.20gb", 1)),
+            (("1g.5gb", 2), ("2g.10gb", 1), ("3g.20gb", 1)),
+            (("1g.5gb", 1), ("2g.10gb", 3)),
+            (("1g.5gb", 3), ("2g.10gb", 2)),
+            (("1g.5gb", 5), ("2g.10gb", 1)),
+            (("1g.5gb", 7),),
+        ]
+    )
+    assert menu("NVIDIA-A100-40GB-SXM4") == a100_40
+    # GFD product-label spellings resolve to the same menu.
+    assert menu(A100_40) == a100_40
+    assert menu("NVIDIA-A100-80GB-PCIe") == sorted(
+        [
+            (("7g.79gb", 1),),
+            (("1g.10gb", 1), ("2g.20gb", 1), ("4g.40gb", 1)),
+            (("1g.10gb", 3), ("4g.40gb", 1)),
+            (("3g.40gb", 2),),
+            (("1g.10gb", 1), ("2g.20gb", 1), ("3g.40gb", 1)),
+            (("1g.10gb", 3), ("3g.40gb", 1)),
+            (("2g.20gb", 2), ("3g.20gb", 1)),
+            (("1g.10gb", 2), ("2g.10gb", 1), ("3g.40gb", 1)),
+            (("1g.10gb", 1), ("2g.20gb", 3)),
+            (("1g.10gb", 3), ("2g.20gb", 2)),
+            (("1g.10gb", 5), ("2g.20gb", 1)),
+            (("1g.10gb", 7),),
+        ]
+    )
+
+
+def test_menu_update_geometry_picks_most_providing_candidate():
+    """Menu-driven UpdateGeometryFor (gpu.go:141-193): the chosen geometry is
+    the one providing the most missing required profiles, applied whole."""
+    gpu = MigGpu(A100_40, 0)
+    assert gpu.update_geometry_for({P("1g.5gb"): 4})
+    # Several menu entries provide all 4 (a tie the reference breaks by map
+    # order); what matters is the requirement is fully provided and the
+    # geometry is a menu entry.
+    assert gpu.geometry.get(P("1g.5gb"), 0) >= 4
+    assert geometry_allowed(A100_40, gpu.geometry)
+    # With a used slice pinned, only candidates containing it qualify.
+    gpu2 = MigGpu(A100_40, 0, {P("3g.20gb"): 1}, used={P("3g.20gb"): 1})
+    assert gpu2.update_geometry_for({P("2g.10gb"): 2})
+    assert gpu2.geometry == {P("2g.10gb"): 2, P("3g.20gb"): 1}
+
+
+def test_geometry_feasible_accepts_partial_states():
+    from nos_tpu.gpu.mig import geometry_feasible
+
+    # {1g.5gb: 2} is not a menu entry but is a sub-multiset of {1g.5gb: 7}.
+    assert geometry_feasible(A100_40, {P("1g.5gb"): 2})
+    assert not geometry_allowed(A100_40, {P("1g.5gb"): 2})
+    # 8x 1g.5gb exceeds every menu entry.
+    assert not geometry_feasible(A100_40, {P("1g.5gb"): 8})
+
+
+def test_spec_menus_agree_with_tables():
+    """Every profile a model's fallback spec menu advertises must appear in
+    that model's geometry table (a menu/table disagreement makes requests
+    parse as known but never carvable — e.g. 7g.80gb vs NVML's 7g.79gb)."""
+    from nos_tpu.gpu.mig import KNOWN_MIG_MODELS, allowed_geometries
+
+    for model, spec in KNOWN_MIG_MODELS.items():
+        table = allowed_geometries(model)
+        if table is None:
+            continue
+        in_tables = {p for g in table for p in g}
+        for p in spec.menu():
+            assert p in in_tables, f"{model}: {p.name} not carvable by any table entry"
+
+
+def test_infeasible_node_geometry_skipped_not_fatal():
+    """A node whose status annotations report a geometry the current menus
+    consider impossible is skipped with a log — planning continues for the
+    healthy nodes."""
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    mig_node(cluster, name="stale")
+    mig_node(cluster, name="healthy")
+    # 8x 1g.5gb exceeds every A100-40 menu row -> infeasible status.
+    cluster.patch(
+        "Node",
+        "",
+        "stale",
+        lambda n: n.metadata.annotations.update(
+            {"tpu.nos/status-dev-0-1g.5gb-free": "8"}
+        ),
+    )
+    snap = MigSnapshotTaker().take_snapshot(state)
+    names = {n.name for n in snap.get_candidate_nodes()}
+    assert "healthy" in names
+    assert "stale" not in names
