@@ -41,16 +41,40 @@ def _obs(manager_cfg) -> ObservabilityServer:
     return server
 
 
+def _make_cluster(args):
+    """Pick the control-plane backend: --kubeconfig (or $KUBECONFIG when
+    --kube is passed) selects the real-Kubernetes client; default is the
+    in-process bus (useful for demos/tests, reference binaries always talk to
+    a real API server)."""
+    kubeconfig = getattr(args, "kubeconfig", None)
+    if kubeconfig or getattr(args, "kube", False):
+        from nos_tpu.cluster.kube import KubeCluster
+
+        cluster = KubeCluster(kubeconfig_path=kubeconfig)
+        print(f"cluster backend: kubernetes @ {cluster.config.server}")
+        return cluster
+    from nos_tpu.cluster import Cluster
+
+    return Cluster()
+
+
 def cmd_operator(args) -> int:
     cfg = load_config(OperatorConfig, args.config)
     setup_logging(cfg.manager.log_level)
     from nos_tpu.api.webhooks import install_quota_webhooks
-    from nos_tpu.cluster import Cluster
     from nos_tpu.controllers.quota import QuotaReconciler
     from nos_tpu.scheduler.resource_calculator import ResourceCalculator
 
-    cluster = Cluster()
+    cluster = _make_cluster(args)
     install_quota_webhooks(cluster)
+    webhook_registry = getattr(cluster, "webhooks", None)
+    if webhook_registry:
+        # Kube backend: hooks are enforced via the AdmissionReview server (the
+        # manager's webhook endpoint), not in-process.
+        from nos_tpu.cluster.webhook_server import AdmissionWebhookServer
+
+        hooks = AdmissionWebhookServer(webhook_registry).start()
+        print(f"admission webhooks: {hooks.url}")
     calc = ResourceCalculator(cfg.tpu_chip_memory_gb, cfg.nvidia_gpu_memory_gb)
     QuotaReconciler(cluster, calc).start_watching()
     _obs(cfg.manager)
@@ -61,10 +85,9 @@ def cmd_operator(args) -> int:
 def cmd_scheduler(args) -> int:
     cfg = load_config(SchedulerConfig, args.config)
     setup_logging(cfg.manager.log_level)
-    from nos_tpu.cluster import Cluster
     from nos_tpu.system import build_scheduler
 
-    scheduler = build_scheduler(Cluster(), cfg)
+    scheduler = build_scheduler(_make_cluster(args), cfg)
     _obs(cfg.manager)
     print(f"scheduler '{cfg.scheduler_name}' running; ctrl-c to exit")
     while True:
@@ -77,11 +100,10 @@ def cmd_scheduler(args) -> int:
 def cmd_partitioner(args) -> int:
     cfg = load_config(PartitionerConfig, args.config)
     setup_logging(cfg.manager.log_level)
-    from nos_tpu.cluster import Cluster
     from nos_tpu.partitioning.state import ClusterState
     from nos_tpu.system import build_partitioner_controllers, build_scheduler
 
-    cluster = Cluster()
+    cluster = _make_cluster(args)
     state = ClusterState()
     state.start_watching(cluster)
     scheduler = build_scheduler(cluster)
@@ -105,9 +127,7 @@ def cmd_tpu_agent(args) -> int:
     if not node_name:
         print("--node or $NODE_NAME required", file=sys.stderr)
         return 2
-    from nos_tpu.cluster import Cluster
-
-    cluster = Cluster()
+    cluster = _make_cluster(args)
     if args.host_mode:
         # Member host of a multi-host slice group: acknowledge sub-slice
         # assignments instead of carving local chips.
@@ -145,10 +165,9 @@ def cmd_gpu_agent(args) -> int:
     if not node_name:
         print("--node or $NODE_NAME required", file=sys.stderr)
         return 2
-    from nos_tpu.cluster import Cluster
     from nos_tpu.system import build_gpu_agent
 
-    cluster = Cluster()
+    cluster = _make_cluster(args)
     agent = build_gpu_agent(
         cluster, node_name, args.mode, args.gpus, args.model or args.memory_gb
     )
@@ -165,12 +184,34 @@ def cmd_gpu_agent(args) -> int:
 
 def cmd_telemetry(args) -> int:
     setup_logging("INFO")
-    from nos_tpu.cluster import Cluster
     from nos_tpu.telemetry import export
 
-    report = export(Cluster(), share_telemetry=args.share)
+    report = export(_make_cluster(args), share_telemetry=args.share)
     print("telemetry:", report)
     return 0
+
+
+def cmd_apiserver(args) -> int:
+    """Run the Kubernetes API-server emulator as a standalone local control
+    plane (the kind-cluster analog for environments without Docker): serves
+    the k8s REST surface over HTTP, loads the CRDs implicitly, and writes a
+    kubeconfig the other binaries can point at with --kubeconfig."""
+    setup_logging("INFO")
+    from nos_tpu.cluster.apiserver import ClusterAPIServer
+
+    server = ClusterAPIServer(port=args.port).start()
+    print(f"apiserver: {server.url}")
+    if args.write_kubeconfig:
+        server.write_kubeconfig(args.write_kubeconfig)
+        print(f"kubeconfig: {args.write_kubeconfig}")
+    if args.webhook_url:
+        for kind in ("ElasticQuota", "CompositeElasticQuota"):
+            server.add_remote_webhook(kind, args.webhook_url)
+        print(f"forwarding EQ/CEQ admission to {args.webhook_url}")
+    try:
+        return _wait(args)
+    finally:
+        server.stop()
 
 
 def cmd_demo(args) -> int:
@@ -345,6 +386,16 @@ def main(argv=None) -> int:
     def common(p):
         p.add_argument("--config", default=None, help="component config file (YAML/JSON)")
         p.add_argument("--once", action="store_true", help="run one cycle and exit")
+        p.add_argument(
+            "--kubeconfig",
+            default=None,
+            help="run against a real Kubernetes API server via this kubeconfig",
+        )
+        p.add_argument(
+            "--kube",
+            action="store_true",
+            help="use the Kubernetes backend with $KUBECONFIG / in-cluster config",
+        )
 
     common(sub.add_parser("operator"))
     common(sub.add_parser("scheduler"))
@@ -366,6 +417,16 @@ def main(argv=None) -> int:
     p_gpu.add_argument("--memory-gb", type=int, default=40)
     p_tel = sub.add_parser("telemetry")
     p_tel.add_argument("--share", action="store_true")
+    p_tel.add_argument("--kubeconfig", default=None)
+    p_api = sub.add_parser("apiserver", help="local k8s API-server emulator")
+    p_api.add_argument("--port", type=int, default=8001)
+    p_api.add_argument("--once", action="store_true")
+    p_api.add_argument(
+        "--write-kubeconfig", default=None, help="write a kubeconfig for this server"
+    )
+    p_api.add_argument(
+        "--webhook-url", default=None, help="forward EQ/CEQ admission reviews here"
+    )
     sub.add_parser("demo")
     p_sim = sub.add_parser("simulate", help="north-star capacity simulation")
     p_sim.add_argument("--nodes", type=int, default=4)
@@ -402,6 +463,7 @@ def main(argv=None) -> int:
         "tpu-agent": cmd_tpu_agent,
         "gpu-agent": cmd_gpu_agent,
         "telemetry": cmd_telemetry,
+        "apiserver": cmd_apiserver,
         "demo": cmd_demo,
         "simulate": cmd_simulate,
     }

@@ -176,17 +176,20 @@ def model_spec(model: str) -> Optional[MigModelSpec]:
 def allowed_geometries(model: str) -> Optional[List[Geometry]]:
     """The model's geometry menu: config override > exact default table >
     None (caller falls back to the slots+memory generator)."""
-    if model in _overrides:
-        return list(_overrides[model])
     canon = MODEL_ALIASES.get(model, model)
+    for key in (model, canon):
+        if key in _overrides:
+            return list(_overrides[key])
     table = DEFAULT_KNOWN_GEOMETRIES.get(canon)
     return list(table) if table is not None else None
 
 
 def model_known(model: str) -> bool:
+    canon = MODEL_ALIASES.get(model, model)
     return (
         model in _overrides
-        or MODEL_ALIASES.get(model, model) in DEFAULT_KNOWN_GEOMETRIES
+        or canon in _overrides
+        or canon in DEFAULT_KNOWN_GEOMETRIES
         or model in KNOWN_MIG_MODELS
     )
 
@@ -345,22 +348,31 @@ class MigGpu:
         geometry, count how many MISSING required profiles it would provide
         beyond current free devices (capped per profile at the requirement),
         skip candidates that would delete used devices, take the best."""
-        free = self.free
         best: Optional[Geometry] = None
-        best_provided = 0
+        best_key: Optional[tuple] = None
         for candidate in table:
             if not self.can_apply_geometry(candidate):
                 continue
-            provided = 0
-            for p, n in required.items():
-                if free.get(p, 0) >= n:
-                    continue  # already provided, nothing to do
-                provided += max(0, min(candidate.get(p, 0) - self.used.get(p, 0), n))
-            if provided > best_provided:
-                best, best_provided = candidate, provided
+            # Applying replaces the whole geometry, so score what the
+            # candidate provides POST-apply (current free devices only
+            # survive if the candidate re-includes them); tie-break toward
+            # preserving the current carve to minimize device churn.
+            provided = sum(
+                min(max(candidate.get(p, 0) - self.used.get(p, 0), 0), n)
+                for p, n in required.items()
+            )
+            preserved = sum(
+                min(candidate.get(p, 0), g) for p, g in self.geometry.items()
+            )
+            key = (provided, preserved)
+            if provided > 0 and (best_key is None or key > best_key):
+                best, best_key = candidate, key
         if best is None:
             return False
-        self.geometry = {p: n for p, n in best.items() if n > 0}
+        new_geometry = {p: n for p, n in best.items() if n > 0}
+        if new_geometry == self.geometry:
+            return False  # the best menu row is the current carve: no-op
+        self.geometry = new_geometry
         return True
 
     def mark_used(self, profile: MigProfile, count: int = 1) -> None:

@@ -470,6 +470,44 @@ def test_menu_update_geometry_picks_most_providing_candidate():
     assert gpu2.geometry == {P("2g.10gb"): 2, P("3g.20gb"): 1}
 
 
+def test_menu_update_noop_when_best_row_is_current_carve():
+    """When the best admissible menu row IS the current geometry, the update
+    reports no change — returning True here made the planner re-simulate an
+    unchanged node every cycle instead of pruning the candidate."""
+    gpu = MigGpu(A100_40, 0, {P("1g.5gb"): 7}, used={P("1g.5gb"): 5})
+    # Demand exceeds what any row containing the 5 used slices can add:
+    # {1g.5gb:7} is the only admissible row and it's already applied.
+    assert not gpu.update_geometry_for({P("1g.5gb"): 9})
+    assert gpu.geometry == {P("1g.5gb"): 7}
+
+
+def test_menu_update_does_not_destroy_required_free_devices():
+    """Scoring accounts for the fact that applying a menu row REPLACES the
+    geometry: a row that provides one missing profile by destroying free
+    devices of another required profile must lose to a row providing both."""
+    gpu = MigGpu(A100_40, 0, {P("1g.5gb"): 7})
+    assert gpu.update_geometry_for({P("1g.5gb"): 2, P("3g.20gb"): 1})
+    assert gpu.geometry.get(P("1g.5gb"), 0) >= 2
+    assert gpu.geometry.get(P("3g.20gb"), 0) >= 1
+    assert geometry_allowed(A100_40, gpu.geometry)
+
+
+def test_geometry_override_honored_under_alias():
+    """An override keyed by the canonical table name must apply to nodes
+    whose GFD label is an alias spelling (and vice versa)."""
+    set_known_geometries("A30", [{"1g.6gb": 1}])
+    try:
+        assert geometry_allowed("NVIDIA-A30", {P("1g.6gb"): 1})
+        assert not geometry_allowed("NVIDIA-A30", {P("1g.6gb"): 4})
+    finally:
+        clear_known_geometry_overrides()
+    set_known_geometries("NVIDIA-A100-PCIE-40GB", [{"1g.5gb": 2}])
+    try:
+        assert not geometry_allowed("NVIDIA-A100-PCIE-40GB", {P("1g.5gb"): 7})
+    finally:
+        clear_known_geometry_overrides()
+
+
 def test_geometry_feasible_accepts_partial_states():
     from nos_tpu.gpu.mig import geometry_feasible
 

@@ -1,0 +1,425 @@
+"""The real-Kubernetes backend stack: wire codec, API-server emulator, the
+stdlib HTTP KubeCluster client, watch informers, admission webhooks over
+AdmissionReview, and the quota reconciler running unmodified over HTTP.
+
+This is the envtest analog (reference
+internal/controllers/elasticquota/suite_int_test.go:53-105: real API server,
+reconcilers in a manager goroutine, asserts over the API): here the API server
+is the HTTP emulator over the in-memory bus, and every byte between the
+controllers and the store crosses a real socket. A true-cluster smoke test at
+the bottom is gated on NOS_E2E_KUBECONFIG.
+"""
+
+import os
+import time
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.objects import (
+    ConfigMap,
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodCondition,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.api.quota_types import build_composite_eq, build_eq
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.api.webhooks import install_quota_webhooks
+from nos_tpu.cluster.apiserver import ClusterAPIServer
+from nos_tpu.cluster.client import (
+    AdmissionError,
+    AlreadyExistsError,
+    Cluster,
+    ConflictError,
+    EventType,
+    NotFoundError,
+)
+from nos_tpu.cluster.kube import KubeCluster, KubeConfig, compute_merge_patch
+from nos_tpu.cluster.serialize import KINDS, from_wire, to_wire
+from nos_tpu.cluster.webhook_server import AdmissionWebhookServer
+from nos_tpu.controllers.quota import QuotaReconciler
+
+
+def wait_for(cond, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_pod(name, ns="default", phase=PodPhase.RUNNING, cpu=1.0, tpu=0.0, node=""):
+    res = ResourceList.of({"cpu": cpu})
+    if tpu:
+        res[constants.RESOURCE_TPU] = tpu
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels={"app": name}),
+        spec=PodSpec(containers=[Container("main", res)], node_name=node),
+        status=PodStatus(phase=phase),
+    )
+
+
+# -- wire codec --------------------------------------------------------------
+class TestSerialize:
+    def full_objects(self):
+        pod = make_pod("p1", tpu=4, node="host-0")
+        pod.metadata.annotations["tpu.nos/spec-partitioning-plan"] = "42"
+        pod.spec.priority = 100
+        pod.spec.overhead = ResourceList.of({"cpu": "100m"})
+        pod.spec.node_selector = {"pool": "tpu"}
+        pod.spec.init_containers = [Container("init", ResourceList.of({"cpu": 2}))]
+        pod.spec.scheduler_name = "nos-scheduler"
+        pod.status.conditions = [PodCondition("PodScheduled", "False", "Unschedulable")]
+        pod.status.nominated_node_name = "host-1"
+        pod.owner_references = [OwnerReference("Job", "trainer")]
+        pod.metadata.creation_timestamp = 1700000000.123456
+        node = Node(
+            metadata=ObjectMeta(name="host-0", labels={"tpu.nos/partitioning": "tpu"}),
+            status=NodeStatus(
+                capacity=ResourceList.of({"cpu": 8, constants.RESOURCE_TPU: 8}),
+                allocatable=ResourceList.of({"cpu": "7500m", constants.RESOURCE_TPU: 8}),
+            ),
+        )
+        cm = ConfigMap(
+            metadata=ObjectMeta(name="dp-config", namespace="kube-system"),
+            data={"config.yaml": "a: 1\n"},
+        )
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="default"),
+            spec=PodDisruptionBudgetSpec(selector={"app": "x"}, min_available=2),
+        )
+        eq = build_eq("team-a", "quota-a", min={"cpu": 4, constants.RESOURCE_TPU: 8})
+        eq.status.used = ResourceList.of({"cpu": "1500m"})
+        ceq = build_composite_eq("shared", ["team-a", "team-b"], min={"cpu": 10}, max={"cpu": 20})
+        return [pod, node, cm, pdb, eq, ceq]
+
+    def test_round_trip_all_kinds(self):
+        for obj in self.full_objects():
+            obj.metadata.resource_version = 7
+            wire = to_wire(obj)
+            back = from_wire(wire)
+            assert to_wire(back) == wire, f"{type(obj).__name__} not stable"
+            assert back.metadata.name == obj.metadata.name
+            assert back.metadata.resource_version == 7
+
+    def test_pod_semantic_round_trip(self):
+        pod = self.full_objects()[0]
+        back = from_wire(to_wire(pod))
+        assert back.spec.containers[0].resources == pod.spec.containers[0].resources
+        assert back.spec.overhead.get_q("cpu") == pytest.approx(0.1)
+        assert back.spec.priority == 100
+        assert back.owner_references[0].kind == "Job"
+        assert back.status.conditions[0].reason == "Unschedulable"
+        assert back.metadata.creation_timestamp == pytest.approx(1700000000.123456, abs=1e-5)
+
+    def test_quantity_spellings(self):
+        rl = ResourceList.of({"cpu": "250m", "memory": "2Gi", constants.RESOURCE_TPU: 4})
+        wire = to_wire(Node(metadata=ObjectMeta(name="n"), status=NodeStatus(capacity=rl)))
+        cap = wire["status"]["capacity"]
+        assert cap["cpu"] == "250m"
+        assert cap["memory"] == str(2 * 2**30)
+        back = from_wire(wire)
+        assert back.status.capacity == rl
+
+    def test_merge_patch_computation(self):
+        old = {"a": 1, "b": {"x": 1, "y": 2}, "c": [1, 2]}
+        new = {"a": 1, "b": {"x": 9}, "c": [1, 2, 3], "d": "new"}
+        patch = compute_merge_patch(old, new)
+        assert patch == {"b": {"x": 9, "y": None}, "c": [1, 2, 3], "d": "new"}
+        assert compute_merge_patch(old, old) is None
+
+
+# -- emulator + client -------------------------------------------------------
+@pytest.fixture()
+def api():
+    server = ClusterAPIServer().start()
+    kube = KubeCluster(KubeConfig(server=server.url))
+    yield server, kube
+    kube.close()
+    server.stop()
+
+
+class TestKubeClusterCrud:
+    def test_create_get_list_delete(self, api):
+        _, kube = api
+        stored = kube.create(make_pod("p1"))
+        assert stored.metadata.resource_version > 0
+        got = kube.get("Pod", "default", "p1")
+        assert got.spec.containers[0].resources.get_q("cpu") == 1.0
+        kube.create(make_pod("p2", ns="other"))
+        assert [p.metadata.name for p in kube.list("Pod")] == ["p1", "p2"]
+        assert [p.metadata.name for p in kube.list("Pod", namespace="other")] == ["p2"]
+        assert [p.metadata.name for p in kube.list("Pod", label_selector={"app": "p2"})] == ["p2"]
+        kube.delete("Pod", "default", "p1")
+        assert kube.try_get("Pod", "default", "p1") is None
+        with pytest.raises(NotFoundError):
+            kube.get("Pod", "default", "p1")
+        with pytest.raises(NotFoundError):
+            kube.delete("Pod", "default", "p1")
+
+    def test_create_conflict(self, api):
+        _, kube = api
+        kube.create(make_pod("dup"))
+        with pytest.raises(AlreadyExistsError):
+            kube.create(make_pod("dup"))
+
+    def test_update_occ_conflict(self, api):
+        _, kube = api
+        kube.create(make_pod("p"))
+        a = kube.get("Pod", "default", "p")
+        b = kube.get("Pod", "default", "p")
+        a.spec.node_name = "host-a"
+        kube.update(a)
+        b.spec.node_name = "host-b"
+        with pytest.raises(ConflictError):
+            kube.update(b)
+
+    def test_cluster_scoped_node(self, api):
+        _, kube = api
+        node = Node(metadata=ObjectMeta(name="host-0"))
+        node.status.capacity = ResourceList.of({"cpu": 8})
+        kube.create(node)
+        got = kube.get("Node", "", "host-0")
+        assert got.status.capacity.get_q("cpu") == 8.0
+        assert [n.metadata.name for n in kube.list("Node")] == ["host-0"]
+
+    def test_patch_annotations(self, api):
+        _, kube = api
+        kube.create(Node(metadata=ObjectMeta(name="host-0")))
+
+        def annotate(n):
+            n.metadata.annotations["tpu.nos/spec-partitioning-plan"] = "plan-1"
+
+        stored = kube.patch("Node", "", "host-0", annotate)
+        assert stored.metadata.annotations["tpu.nos/spec-partitioning-plan"] == "plan-1"
+        # no-op patch issues no write: rv unchanged
+        again = kube.patch("Node", "", "host-0", annotate)
+        assert again.metadata.resource_version == stored.metadata.resource_version
+
+    def test_status_subresource_isolation(self, api):
+        server, kube = api
+        eq = build_eq("team-a", "quota", min={"cpu": 4})
+        kube.create(eq)
+
+        # a spec-only patch must not clobber independently-written status
+        def set_used(q):
+            q.status.used = ResourceList.of({"cpu": 2})
+
+        kube.patch("ElasticQuota", "team-a", "quota", set_used)
+
+        def bump_min(q):
+            q.spec.min = ResourceList.of({"cpu": 8})
+
+        kube.patch("ElasticQuota", "team-a", "quota", bump_min)
+        got = kube.get("ElasticQuota", "team-a", "quota")
+        assert got.spec.min.get_q("cpu") == 8.0
+        assert got.status.used.get_q("cpu") == 2.0
+
+    def test_patch_retries_past_conflicting_writer(self, api):
+        """RMW patch converges when another writer races it (bounded retry on
+        409, reference controller-runtime client does the same)."""
+        server, kube = api
+        kube.create(Node(metadata=ObjectMeta(name="n")))
+        hits = {"n": 0}
+
+        def slow_patch(n):
+            hits["n"] += 1
+            if hits["n"] == 1:
+                # sneak a competing write in between GET and PATCH
+                server.cluster.patch(
+                    "Node", "", "n",
+                    lambda o: o.metadata.labels.__setitem__("racer", "yes"),
+                )
+            n.metadata.labels["mine"] = "yes"
+
+        kube.patch("Node", "", "n", slow_patch)
+        got = kube.get("Node", "", "n")
+        assert got.metadata.labels == {"racer": "yes", "mine": "yes"}
+        assert hits["n"] == 2
+
+
+class TestKubeWatch:
+    def test_watch_add_modify_delete_with_old_obj(self, api):
+        _, kube = api
+        kube.create(make_pod("existing"))
+        events = []
+        unsub = kube.watch("Pod", events.append)
+        wait_for(lambda: len(events) >= 1, msg="replay ADDED")
+        assert events[0].type == EventType.ADDED
+        assert events[0].obj.metadata.name == "existing"
+
+        kube.patch(
+            "Pod", "default", "existing",
+            lambda p: setattr(p.status, "phase", PodPhase.SUCCEEDED),
+        )
+        wait_for(
+            lambda: any(e.type == EventType.MODIFIED for e in events), msg="MODIFIED"
+        )
+        mod = next(e for e in events if e.type == EventType.MODIFIED)
+        assert mod.obj.status.phase == PodPhase.SUCCEEDED
+        assert mod.old_obj is not None and mod.old_obj.status.phase == PodPhase.RUNNING
+
+        kube.delete("Pod", "default", "existing")
+        wait_for(
+            lambda: any(e.type == EventType.DELETED for e in events), msg="DELETED"
+        )
+        unsub()
+        n = len(events)
+        kube.create(make_pod("after-unsub"))
+        time.sleep(0.2)
+        assert len(events) == n
+
+    def test_watch_without_replay(self, api):
+        _, kube = api
+        kube.create(make_pod("pre"))
+        events = []
+        kube.watch("Pod", events.append, replay=False)
+        # replay suppressed: only live events arrive
+        kube.create(make_pod("live"))
+        wait_for(lambda: any(e.obj.metadata.name == "live" for e in events), msg="live event")
+        assert not any(e.obj.metadata.name == "pre" and e.type == EventType.ADDED for e in events)
+
+
+# -- admission over AdmissionReview ------------------------------------------
+class TestWebhooksOverHttp:
+    @pytest.fixture()
+    def stack(self):
+        server = ClusterAPIServer().start()
+        kube = KubeCluster(KubeConfig(server=server.url))
+        install_quota_webhooks(kube)  # populates kube.webhooks registry
+        hook_server = AdmissionWebhookServer(kube.webhooks).start()
+        server.add_remote_webhook("ElasticQuota", hook_server.url)
+        server.add_remote_webhook("CompositeElasticQuota", hook_server.url)
+        yield server, kube
+        hook_server.stop()
+        kube.close()
+        server.stop()
+
+    def test_one_eq_per_namespace(self, stack):
+        _, kube = stack
+        kube.create(build_eq("team-a", "first", min={"cpu": 1}))
+        with pytest.raises(AdmissionError, match="already has ElasticQuota"):
+            kube.create(build_eq("team-a", "second", min={"cpu": 1}))
+        # other namespaces unaffected
+        kube.create(build_eq("team-b", "first", min={"cpu": 1}))
+
+    def test_eq_ceq_overlap_rejected(self, stack):
+        _, kube = stack
+        kube.create(build_composite_eq("shared", ["team-x", "team-y"], min={"cpu": 4}))
+        with pytest.raises(AdmissionError, match="claimed by CompositeElasticQuota"):
+            kube.create(build_eq("team-x", "q", min={"cpu": 1}))
+
+    def test_min_exceeding_max_rejected(self, stack):
+        _, kube = stack
+        with pytest.raises(AdmissionError, match="exceeds max"):
+            kube.create(build_eq("team-a", "bad", min={"cpu": 8}, max={"cpu": 4}))
+
+
+# -- the reconciler, unmodified, over HTTP ------------------------------------
+class TestQuotaReconcilerOverHttp:
+    @pytest.fixture()
+    def stack(self):
+        server = ClusterAPIServer().start()
+        kube = KubeCluster(KubeConfig(server=server.url))
+        rec = QuotaReconciler(kube)
+        rec.start_watching()
+        yield server, kube, rec
+        rec.stop()
+        kube.close()
+        server.stop()
+
+    def test_eq_labels_and_used_over_http(self, stack):
+        _, kube, _ = stack
+        kube.create(build_eq("team-a", "quota", min={"cpu": 2}))
+        kube.create(make_pod("a1", ns="team-a", cpu=1.5, node="host-0"))
+        kube.create(make_pod("a2", ns="team-a", cpu=1.5, node="host-0"))
+
+        def settled():
+            eq = kube.get("ElasticQuota", "team-a", "quota")
+            if eq.status.used.get_q("cpu") != 3.0:
+                return False
+            labels = {
+                p.metadata.name: p.metadata.labels.get(constants.LABEL_CAPACITY)
+                for p in kube.list("Pod", namespace="team-a")
+            }
+            return set(labels.values()) == {
+                constants.CAPACITY_IN_QUOTA,
+                constants.CAPACITY_OVER_QUOTA,
+            }
+
+        wait_for(settled, msg="EQ reconciled over HTTP")
+
+    def test_pod_completion_releases_quota(self, stack):
+        _, kube, _ = stack
+        kube.create(build_eq("team-a", "quota", min={"cpu": 2}))
+        kube.create(make_pod("a1", ns="team-a", cpu=1.5, node="host-0"))
+        wait_for(
+            lambda: kube.get("ElasticQuota", "team-a", "quota").status.used.get_q("cpu") == 1.5,
+            msg="used=1.5",
+        )
+        kube.patch(
+            "Pod", "team-a", "a1",
+            lambda p: setattr(p.status, "phase", PodPhase.SUCCEEDED),
+        )
+        wait_for(
+            lambda: kube.get("ElasticQuota", "team-a", "quota").status.used.get_q("cpu") == 0.0,
+            msg="used released",
+        )
+
+    def test_ceq_deletes_overlapping_eq_over_http(self, stack):
+        _, kube, _ = stack
+        kube.create(build_eq("team-a", "old-quota", min={"cpu": 1}))
+        kube.create(build_composite_eq("shared", ["team-a", "team-b"], min={"cpu": 4}))
+        wait_for(
+            lambda: kube.try_get("ElasticQuota", "team-a", "old-quota") is None,
+            msg="overlapped EQ deleted",
+        )
+
+
+# -- the CLI apiserver command (make cluster backbone) ------------------------
+class TestApiserverCli:
+    def test_apiserver_subprocess_with_kubeconfig(self, tmp_path):
+        import subprocess
+        import sys
+
+        kubeconfig = str(tmp_path / "kubeconfig")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "nos_tpu.cli", "apiserver",
+                "--port", "0", "--write-kubeconfig", kubeconfig,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            wait_for(lambda: os.path.exists(kubeconfig), msg="kubeconfig written")
+            kube = KubeCluster(kubeconfig_path=kubeconfig)
+            kube.create(Node(metadata=ObjectMeta(name="cli-node")))
+            assert kube.get("Node", "", "cli-node").metadata.name == "cli-node"
+            kube.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# -- true-cluster smoke test (requires a live kubeconfig) ---------------------
+@pytest.mark.skipif(
+    not os.environ.get("NOS_E2E_KUBECONFIG"),
+    reason="set NOS_E2E_KUBECONFIG to a kubeconfig for a live cluster",
+)
+class TestLiveCluster:
+    def test_nodes_listable(self):
+        kube = KubeCluster(kubeconfig_path=os.environ["NOS_E2E_KUBECONFIG"])
+        nodes = kube.list("Node")
+        assert isinstance(nodes, list)
