@@ -67,6 +67,10 @@ class Container:
 class OwnerReference:
     kind: str = ""
     name: str = ""
+    # Required by a real API server's ValidateOwnerReferences; defaulted on
+    # the wire (serialize.py) when unset so emulator-only callers stay terse.
+    api_version: str = ""
+    uid: str = ""
 
 
 @dataclass
@@ -133,7 +137,8 @@ class Pod:
                 nominated_node_name=self.status.nominated_node_name,
             ),
             owner_references=[
-                OwnerReference(o.kind, o.name) for o in self.owner_references
+                OwnerReference(o.kind, o.name, o.api_version, o.uid)
+                for o in self.owner_references
             ],
         )
 

@@ -146,7 +146,9 @@ def cmd_tpu_agent(args) -> int:
 
     from nos_tpu.system import build_tpu_agent
 
-    agent = build_tpu_agent(cluster, node_name, cfg)
+    agent = build_tpu_agent(
+        cluster, node_name, cfg, pod_resources_socket=args.pod_resources_socket
+    )
     agent.startup()
     agent.start_watching()
     _obs(cfg.manager)
@@ -169,7 +171,12 @@ def cmd_gpu_agent(args) -> int:
 
     cluster = _make_cluster(args)
     agent = build_gpu_agent(
-        cluster, node_name, args.mode, args.gpus, args.model or args.memory_gb
+        cluster,
+        node_name,
+        args.mode,
+        args.gpus,
+        args.model or args.memory_gb,
+        pod_resources_socket=args.pod_resources_socket,
     )
     agent.startup()
     agent.start_watching()
@@ -404,6 +411,11 @@ def main(argv=None) -> int:
     common(p_tpu)
     p_tpu.add_argument("--node", default=None)
     p_tpu.add_argument(
+        "--pod-resources-socket",
+        default=None,
+        help="kubelet pod-resources gRPC socket for device accounting",
+    )
+    p_tpu.add_argument(
         "--host-mode",
         action="store_true",
         help="run as a multi-host slice-group member (ack sub-slice assignments)",
@@ -411,6 +423,11 @@ def main(argv=None) -> int:
     p_gpu = sub.add_parser("gpu-agent")
     common(p_gpu)
     p_gpu.add_argument("--node", default=None)
+    p_gpu.add_argument(
+        "--pod-resources-socket",
+        default=None,
+        help="kubelet pod-resources gRPC socket for device accounting",
+    )
     p_gpu.add_argument("--mode", choices=["mig", "mps"], default="mig")
     p_gpu.add_argument("--gpus", type=int, default=1)
     p_gpu.add_argument("--model", default="NVIDIA-A100-PCIE-40GB")

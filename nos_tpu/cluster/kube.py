@@ -32,7 +32,7 @@ import ssl
 import tempfile
 import threading
 import time
-from http.client import HTTPConnection, HTTPSConnection
+from http.client import HTTPConnection, HTTPException, HTTPSConnection
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import quote, urlparse
 
@@ -145,12 +145,23 @@ class KubeConfig:
         elif self.ca_file:
             ctx.load_verify_locations(cafile=self.ca_file)
         cert_file, key_file = self.client_cert_file, self.client_key_file
+        materialized: list = []
         if self.client_cert_data and self.client_key_data:
-            # ssl wants files; materialize the -data variants.
+            # ssl wants files; materialize the -data variants, then unlink —
+            # load_cert_chain reads eagerly, and key material must not linger
+            # in /tmp.
             cert_file = self._tmp(base64.b64decode(self.client_cert_data))
             key_file = self._tmp(base64.b64decode(self.client_key_data))
-        if cert_file and key_file:
-            ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
+            materialized = [cert_file, key_file]
+        try:
+            if cert_file and key_file:
+                ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
+        finally:
+            for path in materialized:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         return ctx
 
     @staticmethod
@@ -340,6 +351,7 @@ class KubeCluster:
         self._ssl = self.config.ssl_context()
         self._informers: Dict[str, _Informer] = {}
         self._informer_lock = threading.Lock()
+        self._local = threading.local()  # persistent per-thread connection
         self.webhooks: Dict[str, List[Callable[[str, Any, Optional[Any]], None]]] = {}
 
     # -- transport -----------------------------------------------------------
@@ -363,17 +375,34 @@ class KubeCluster:
         body: Optional[Dict[str, Any]] = None,
         content_type: str = "application/json",
     ) -> Dict[str, Any]:
-        conn = self._connect()
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            conn.request(method, path, body=payload, headers=self._headers(content_type))
-            resp = conn.getresponse()
-            raw = resp.read()
+        """One REST exchange over a persistent per-thread connection (a fresh
+        TCP+TLS handshake per call would triple the cost of every patch on
+        the reconcile hot path); a dead keep-alive connection gets one retry
+        on a fresh one."""
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = self._headers(content_type)
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            if conn is None:
+                conn = self._connect()
+                self._local.conn = conn
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (OSError, HTTPException):
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                if attempt:
+                    raise
+                continue
             if resp.status >= 400:
                 self._raise_for(resp.status, raw)
             return json.loads(raw) if raw else {}
-        finally:
-            conn.close()
+        raise RuntimeError("unreachable")
 
     def _open_stream(self, path: str):
         conn = self._connect()
@@ -557,7 +586,13 @@ class KubeCluster:
                 inf = _Informer(self, info)
                 self._informers[kind] = inf
                 inf.thread.start()
-            inf.synced.wait(timeout=30)
+        # Wait for cache sync outside the lock: informers are independent and
+        # an unreachable API server must not serialize other registrations.
+        if not inf.synced.wait(timeout=30):
+            logger.warning(
+                "informer for %s not synced after 30s; proceeding with empty cache",
+                kind,
+            )
         inf.add_handler(handler, replay)
 
         def unsubscribe() -> None:

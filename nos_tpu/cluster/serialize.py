@@ -112,9 +112,11 @@ def meta_from_wire(data: Dict[str, Any]) -> ObjectMeta:
     try:
         rv = int(rv_raw)
     except (TypeError, ValueError):
-        # Real API servers hand out opaque strings; keep ordering-compatible
-        # best effort by hashing into an int (only used for OCC echo-back).
-        rv = abs(hash(str(rv_raw))) % (2**31)
+        # Real API servers hand out opaque strings; preserve them verbatim so
+        # the optimistic-concurrency echo-back still matches server state
+        # (the field is typed int for the in-memory bus, but only equality
+        # ever matters).
+        rv = str(rv_raw)
     deletion = data.get("deletionTimestamp")
     return ObjectMeta(
         name=data.get("name") or "",
@@ -142,12 +144,31 @@ def _container_from_wire(d: Dict[str, Any]) -> Container:
     return Container(name=d.get("name", "main"), resources=resources_from_wire(requests))
 
 
+_OWNER_API_VERSIONS = {
+    "DaemonSet": "apps/v1",
+    "Deployment": "apps/v1",
+    "ReplicaSet": "apps/v1",
+    "StatefulSet": "apps/v1",
+    "Job": "batch/v1",
+    "CronJob": "batch/v1",
+}
+
+
+def _owner_ref_to_wire(o: OwnerReference) -> Dict[str, Any]:
+    # apiVersion and uid are required by a real API server's owner-reference
+    # validation; default them when the in-process caller didn't care.
+    return {
+        "apiVersion": o.api_version or _OWNER_API_VERSIONS.get(o.kind, "v1"),
+        "kind": o.kind,
+        "name": o.name,
+        "uid": o.uid or f"uid-{o.kind.lower()}-{o.name}",
+    }
+
+
 def pod_to_wire(pod: Pod) -> Dict[str, Any]:
     meta = meta_to_wire(pod.metadata)
     if pod.owner_references:
-        meta["ownerReferences"] = [
-            {"kind": o.kind, "name": o.name} for o in pod.owner_references
-        ]
+        meta["ownerReferences"] = [_owner_ref_to_wire(o) for o in pod.owner_references]
     spec: Dict[str, Any] = {
         "containers": [_container_to_wire(c) for c in pod.spec.containers],
         "schedulerName": pod.spec.scheduler_name,
@@ -209,7 +230,12 @@ def pod_from_wire(data: Dict[str, Any]) -> Pod:
             nominated_node_name=status_raw.get("nominatedNodeName", ""),
         ),
         owner_references=[
-            OwnerReference(kind=o.get("kind", ""), name=o.get("name", ""))
+            OwnerReference(
+                kind=o.get("kind", ""),
+                name=o.get("name", ""),
+                api_version=o.get("apiVersion", ""),
+                uid=o.get("uid", ""),
+            )
             for o in meta_raw.get("ownerReferences") or []
         ],
     )

@@ -136,6 +136,7 @@ class GpuAgent:
         parse_profile: Callable[[str], Optional[object]] = MigProfile.from_resource,
         resource_of: Callable[[str], str] = lambda p: f"{constants.RESOURCE_MIG_PREFIX}{p}",
         plugin_client: Optional[object] = None,
+        pod_resources_lister: Optional[object] = None,
     ):
         self.cluster = cluster
         self.node_name = node_name
@@ -143,6 +144,7 @@ class GpuAgent:
         self.parse_profile = parse_profile
         self.resource_of = resource_of
         self.plugin_client = plugin_client
+        self.pod_resources_lister = pod_resources_lister
         self.shared = SharedState()
         self._apply_changed = False
         self._unsub = None
@@ -172,7 +174,11 @@ class GpuAgent:
 
     def pod_resources(self):
         """Device accounting view (kubelet pod-resources API seam,
-        resource/client.go:26-87)."""
+        resource/client.go:26-87). On a real node this is the kubelet gRPC
+        socket client (cluster/pod_resources_grpc.py); in-process it derives
+        from the device client."""
+        if self.pod_resources_lister is not None:
+            return self.pod_resources_lister
         from nos_tpu.cluster.pod_resources import GpuPodResources
 
         return GpuPodResources(self.client, self.resource_of)

@@ -63,11 +63,18 @@ class SharedState:
 
 
 class TpuAgent:
-    def __init__(self, cluster: Cluster, node_name: str, client: TpuClient):
+    def __init__(
+        self,
+        cluster: Cluster,
+        node_name: str,
+        client: TpuClient,
+        pod_resources_lister=None,
+    ):
         self.cluster = cluster
         self.node_name = node_name
         self.client = client
         self.shared = SharedState()
+        self.pod_resources_lister = pod_resources_lister
         self._unsub = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -100,7 +107,11 @@ class TpuAgent:
 
     def pod_resources(self):
         """Device accounting view (kubelet pod-resources API seam,
-        resource/client.go:26-87)."""
+        resource/client.go:26-87). On a real node this is the kubelet gRPC
+        socket client (cluster/pod_resources_grpc.py); in-process it derives
+        from the TpuClient's carved slices."""
+        if self.pod_resources_lister is not None:
+            return self.pod_resources_lister
         from nos_tpu.cluster.pod_resources import TpuPodResources
 
         return TpuPodResources(self.client)

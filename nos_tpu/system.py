@@ -122,9 +122,12 @@ def build_tpu_agent(
     node_name: str,
     config: Optional[AgentConfig] = None,
     client=None,
+    pod_resources_socket: Optional[str] = None,
 ) -> TpuAgent:
     """Node agent with the best available device backend: native tpuslice if
-    it builds, else the pure-Python fake (the build-tag seam)."""
+    it builds, else the pure-Python fake (the build-tag seam). With
+    `pod_resources_socket`, device accounting comes from the kubelet
+    pod-resources gRPC socket instead of the in-process client."""
     config = config or AgentConfig()
     if client is None:
         node = cluster.get("Node", "", node_name)
@@ -141,7 +144,16 @@ def build_tpu_agent(
                 logger.warning("native tpuslice unavailable; using fake backend")
         if client is None:
             client = FakeTpuClient(topology)
-    return TpuAgent(cluster, node_name, client)
+    lister = _pod_resources_lister(pod_resources_socket)
+    return TpuAgent(cluster, node_name, client, pod_resources_lister=lister)
+
+
+def _pod_resources_lister(socket_path: Optional[str]):
+    if not socket_path:
+        return None
+    from nos_tpu.cluster.pod_resources_grpc import KubeletPodResourcesClient
+
+    return KubeletPodResourcesClient(socket_path)
 
 
 class ControlPlane:
@@ -278,6 +290,7 @@ def build_gpu_agent(
     gpu_count: int,
     model_or_memory,
     with_fake_device_plugin: bool = True,
+    pod_resources_socket: Optional[str] = None,
 ) -> GpuAgent:
     """MIG/MPS node agent over the fake device layer (real NVML/CUDA-MPS
     backends would slot in behind the same client interface). By default a
@@ -289,9 +302,16 @@ def build_gpu_agent(
     if with_fake_device_plugin:
         ensure_fake_daemonset(cluster).ensure_pod(node_name)
     plugin_client = DevicePluginClient(cluster)
+    lister = _pod_resources_lister(pod_resources_socket)
     if mode == constants.KIND_MIG:
         client = FakeGpuDeviceClient(gpu_count, mig_validator(model_or_memory))
-        return GpuAgent(cluster, node_name, client, plugin_client=plugin_client)
+        return GpuAgent(
+            cluster,
+            node_name,
+            client,
+            plugin_client=plugin_client,
+            pod_resources_lister=lister,
+        )
     client = FakeGpuDeviceClient(gpu_count, mps_validator(int(model_or_memory)))
     return GpuAgent(
         cluster,
@@ -300,4 +320,5 @@ def build_gpu_agent(
         parse_profile=MpsProfile.from_resource,
         resource_of=lambda p: f"nvidia.com/gpu-{p}",
         plugin_client=plugin_client,
+        pod_resources_lister=lister,
     )
