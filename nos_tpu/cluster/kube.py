@@ -383,11 +383,14 @@ class KubeCluster:
         headers = self._headers(content_type)
         for attempt in (0, 1):
             conn = getattr(self._local, "conn", None)
+            reused = conn is not None
             if conn is None:
                 conn = self._connect()
                 self._local.conn = conn
+            sent = False
             try:
                 conn.request(method, path, body=payload, headers=headers)
+                sent = True
                 resp = conn.getresponse()
                 raw = resp.read()
             except (OSError, HTTPException):
@@ -396,7 +399,13 @@ class KubeCluster:
                     conn.close()
                 except Exception:  # noqa: BLE001
                     pass
-                if attempt:
+                # Retry only when it cannot double-apply: idempotent reads, or
+                # a send-phase failure on a stale keep-alive connection (the
+                # request never reached the server). A non-idempotent request
+                # that died after send may already be committed server-side —
+                # surface the error instead of re-sending it.
+                safe = method == "GET" or (reused and not sent)
+                if attempt or not safe:
                     raise
                 continue
             if resp.status >= 400:

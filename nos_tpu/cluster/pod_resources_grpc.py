@@ -40,6 +40,10 @@ _WIRE_I32 = 5
 
 # -- protobuf wire codec -----------------------------------------------------
 def encode_varint(value: int) -> bytes:
+    if value < 0:
+        # proto3 would two's-complement this into 10 bytes; nothing in the
+        # pod-resources API carries negatives, so refuse rather than loop.
+        raise ValueError("negative varints are not supported")
     out = bytearray()
     while True:
         bits = value & 0x7F

@@ -16,8 +16,10 @@ from typing import List, Optional
 from nos_tpu import constants
 from nos_tpu.api import annotations as ann
 from nos_tpu.api.objects import Pod
+from nos_tpu.api.resources import compute_pod_request
 from nos_tpu.cluster.client import Cluster, Event, EventType
 from nos_tpu.partitioning.core import Actuator, Planner
+from nos_tpu.partitioning.core.planner import PartitioningPlan
 from nos_tpu.partitioning.core.interface import (
     NodePartitioning,
     Partitioner,
@@ -43,6 +45,7 @@ class PartitionerController:
         batch_timeout_s: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S,
         batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
         resync_s: float = constants.DEFAULT_PARTITIONER_RESYNC_S,
+        enable_consolidation: bool = True,
         now=None,
     ):
         self.cluster = cluster
@@ -57,6 +60,7 @@ class PartitionerController:
         kwargs = {"now": now} if now is not None else {}
         self.batcher: Batcher[Pod] = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
         self.resync_s = resync_s
+        self.enable_consolidation = enable_consolidation
         self._last_cycle_at = self._now()
         self._unsub = None
         self._stop = threading.Event()
@@ -117,8 +121,207 @@ class PartitionerController:
         snapshot = self.snapshot_taker.take_snapshot(self.state)
         plan = self.planner.plan(snapshot, pods)
         self.actuator.apply(plan)
+        if self.enable_consolidation:
+            self._consolidate(snapshot, pods, plan.placed)
         self._last_cycle_at = self._now()
         return True
+
+    # -- consolidation (defragmentation preemption) --------------------------
+    # The reference never migrates running pods: a pending MIG profile that no
+    # GPU can host simply waits. On a TPU mesh that policy strands the north
+    # star: a pod-sized slice (e.g. 8x8 on a v5e-64 host) binds only when a
+    # node drains *naturally*, idling an entire mesh for the duration of its
+    # longest straggler. Consolidation drains one node deliberately: pick the
+    # cheapest node whose movable pods all provably fit elsewhere RIGHT NOW,
+    # evict them (their controllers resubmit; the scheduler rebinds into the
+    # verified free capacity), and plan the re-carve. One node per cycle, only
+    # while the plan handshake is idle, so convergence stays monotone.
+    def _consolidate(self, snapshot, pods: List[Pod], placed: set) -> bool:
+        spec = snapshot.slice_spec
+        stranded = []
+        for pod in pods:
+            if pod.metadata.namespaced_name in placed:
+                continue
+            slice_req = spec.pod_slice_request(pod)
+            if not slice_req:
+                continue
+            if not snapshot.get_lacking_slices(pod):
+                continue  # cluster can already host it; not stranded
+            chips = sum(spec.slice_weight(k) * v for k, v in slice_req.items())
+            stranded.append(
+                (-chips, pod.metadata.creation_timestamp, pod.metadata.namespaced_name, pod)
+            )
+        stranded.sort(key=lambda s: s[:3])
+        # Largest-first, bounded attempts: during full saturation every
+        # what-if fails (nowhere for victims to go) and the packing calls are
+        # the planner's most expensive operation.
+        for *_, pod in stranded[:3]:
+            if self._consolidate_for(snapshot, pod):
+                return True
+        return False
+
+    @staticmethod
+    def _tpu_chips(spec, rl) -> float:
+        """Chip-weight of a resource list: slice resources by their profile
+        size plus whole-chip requests."""
+        return sum(
+            spec.slice_weight(k) * v for k, v in rl.items() if spec.is_slice_resource(k)
+        ) + rl.get(constants.RESOURCE_TPU, 0.0)
+
+    def _free_chips(self, spec, node) -> float:
+        return self._tpu_chips(spec, node.node_info().free)
+
+    def _consolidate_for(self, snapshot, pod: Pod) -> bool:
+        spec = snapshot.slice_spec
+        lacking = dict(spec.pod_slice_request(pod))
+        free_by_node = {
+            name: self._free_chips(spec, node) for name, node in snapshot.nodes.items()
+        }
+        total_free = sum(free_by_node.values())
+        candidates = []  # (displaced_chips, node_name, drained_node, victims)
+        for name in sorted(snapshot.nodes):
+            node = snapshot.nodes[name]
+            if not hasattr(node, "evict_pod"):
+                continue  # node type is not consolidation-capable
+            victims = [p for p in node.pods if self._movable(spec, p, pod)]
+            if not victims:
+                continue
+            # Cheap bound before any packing: the victims' chips must fit in
+            # the OTHER nodes' free capacity, or the what-if cannot succeed.
+            displaced_lb = sum(
+                self._tpu_chips(spec, compute_pod_request(p)) for p in victims
+            )
+            if displaced_lb > total_free - free_by_node[name] + 1e-9:
+                continue
+            result = self._drain_plan(spec, node, pod, victims, lacking)
+            if result is None:
+                continue
+            drained, kept_victims = result
+            displaced = sum(
+                self._tpu_chips(spec, compute_pod_request(p)) for p in kept_victims
+            )
+            candidates.append((displaced, len(kept_victims), name, drained, kept_victims))
+        candidates.sort(key=lambda c: (c[0], c[1], c[2]))
+        for _, _, name, drained, victims in candidates:
+            if not self._victims_fit_elsewhere(snapshot, name, victims):
+                continue
+            plan = PartitioningPlan(state={name: drained.partitioning()})
+            logger.info(
+                "consolidation: draining %s (%d victims) to host %s (plan %s)",
+                name,
+                len(victims),
+                pod.metadata.namespaced_name,
+                plan.id,
+            )
+            for victim in victims:
+                self._evict(victim)
+            self.actuator.apply(plan)
+            from nos_tpu.observability import metrics
+
+            metrics.inc("nos_tpu_consolidations", kind=self.kind)
+            return True
+        return False
+
+    def _movable(self, spec, victim: Pod, preemptor: Pod) -> bool:
+        """A victim is movable when it holds TPU capacity the carve needs,
+        does not outrank the preemptor, and is not part of a gang (multi-host
+        membership is the GroupPartitioner's domain)."""
+        if victim.metadata.deletion_timestamp is not None:
+            return False
+        if victim.spec.priority > preemptor.spec.priority:
+            return False
+        if podutil.gang_of(victim) is not None:
+            return False
+        req = compute_pod_request(victim)
+        return req.get(constants.RESOURCE_TPU, 0.0) > 0 or any(
+            v > 0 and spec.is_slice_resource(k) for k, v in req.items()
+        )
+
+    def _drain_plan(self, spec, node, pod: Pod, victims: List[Pod], lacking: dict):
+        """Full drain first; then reprieve victims (largest displaced work
+        first) that the carve can spare — the preemption reprieve loop
+        (capacity_scheduling.go:610-673) transplanted to geometry."""
+
+        def try_drain(victim_set: List[Pod]):
+            drained = node.clone()
+            try:
+                for v in victim_set:
+                    drained.evict_pod(v)
+            except (ValueError, KeyError):
+                return None
+            # May be a no-op when eviction alone frees an already-carved
+            # slice of the right shape — schedulability is the real gate.
+            drained.update_geometry_for(dict(lacking))
+            if not self.planner.can_schedule(pod, drained):
+                return None
+            return drained
+
+        drained = try_drain(victims)
+        if drained is None:
+            return None
+        kept = list(victims)
+        for v in sorted(
+            victims,
+            key=lambda p: -self._tpu_chips(spec, compute_pod_request(p)),
+        ):
+            spared = [w for w in kept if w is not v]
+            if not spared:
+                continue  # an empty eviction set means no consolidation at all
+            trial = try_drain(spared)
+            if trial is not None:
+                kept = spared
+                drained = trial
+        if not kept:
+            return None  # nothing to evict means the normal planner suffices
+        return drained, kept
+
+    def _victims_fit_elsewhere(self, snapshot, drained_name: str, victims: List[Pod]) -> bool:
+        """Every victim must provably rebind into the OTHER nodes' capacity
+        right now (carving allowed) — this is what makes consolidation a
+        migration rather than a preemption cascade."""
+        spec = snapshot.slice_spec
+        others = {
+            n: node.clone() for n, node in snapshot.nodes.items() if n != drained_name
+        }
+        for victim in sorted(
+            victims,
+            key=lambda p: -sum(
+                spec.slice_weight(k) * v
+                for k, v in compute_pod_request(p).items()
+                if spec.is_slice_resource(k)
+            ),
+        ):
+            vcopy = victim.deepcopy()
+            vcopy.spec.node_name = ""
+            vcopy.status.nominated_node_name = ""
+            placed = False
+            for name in sorted(others):
+                node = others[name]
+                if self.planner.can_schedule(vcopy, node):
+                    node.add_pod(vcopy)
+                    placed = True
+                    break
+                trial = node.clone()
+                if trial.update_geometry_for(
+                    dict(spec.pod_slice_request(vcopy))
+                ) and self.planner.can_schedule(vcopy, trial):
+                    trial.add_pod(vcopy)
+                    others[name] = trial
+                    placed = True
+                    break
+            if not placed:
+                return False
+        return True
+
+    def _evict(self, victim: Pod) -> None:
+        """Eviction = deletion; the workload controller resubmits
+        (scheduler._evict semantics)."""
+        from nos_tpu.cluster.client import NotFoundError
+
+        try:
+            self.cluster.delete("Pod", victim.metadata.namespace, victim.metadata.name)
+        except NotFoundError:
+            pass
 
     def _resync_due(self) -> bool:
         """The reference requeues its reconcile every 10s while pods stay

@@ -33,12 +33,15 @@ logger = logging.getLogger(__name__)
 @dataclass
 class PartitioningPlan:
     """Desired state + unique plan id (reference uses a unix timestamp,
-    planner.go:31-45; we add entropy so two plans in one second differ)."""
+    planner.go:31-45; we add entropy so two plans in one second differ).
+    `placed` records which candidate pods the plan's simulation scheduled —
+    the consolidation pass only considers the leftovers."""
 
     state: PartitioningState
     id: str = field(
         default_factory=lambda: f"{int(time.time())}-{uuid.uuid4().hex[:8]}"
     )
+    placed: set = field(default_factory=set)
 
 
 class Planner:
@@ -79,7 +82,7 @@ class Planner:
         state: PartitioningState = {
             name: n.partitioning() for name, n in snapshot.nodes.items()
         }
-        return PartitioningPlan(state=state)
+        return PartitioningPlan(state=state, placed=placed_keys)
 
     # -- internals (planner.go:151-203) -------------------------------------
     def _try_add_pod(self, snapshot: Snapshot, pod: Pod, node: PartitionableNode) -> bool:
@@ -101,3 +104,8 @@ class Planner:
         # The simulated scheduler may be permissive; enforce plain resource fit
         # so add_pod never overcommits a node.
         return compute_pod_request(pod).fits_in(info.free)
+
+    def can_schedule(self, pod: Pod, node: PartitionableNode) -> bool:
+        """Public feasibility check (PreFilter + Filter + plain fit) for the
+        consolidation pass's what-if placements."""
+        return self._can_schedule(pod, node)

@@ -159,6 +159,23 @@ class TpuNode:
         self.pods.append(pod)
         self.requested = self.requested.add(request)
 
+    def evict_pod(self, pod: Pod) -> None:
+        """What-if removal of a bound pod: release its slices (and their
+        pinned placements) so a consolidation re-carve can plan through the
+        freed region. The presence of this hook is what marks a node type as
+        consolidation-capable (the controller checks for it)."""
+        request = compute_pod_request(pod)
+        for resource_name, qty in request.items():
+            profile = Profile.from_resource(resource_name)
+            if profile is not None and qty > 0:
+                self.mesh.release(profile, int(round(qty)))
+        self.pods = [
+            p
+            for p in self.pods
+            if p.metadata.namespaced_name != pod.metadata.namespaced_name
+        ]
+        self.requested = self.requested.subtract(request).non_zero()
+
     def has_free_capacity(self) -> bool:
         return self.mesh.has_free_capacity()
 

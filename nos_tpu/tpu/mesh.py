@@ -197,6 +197,25 @@ class TpuMesh:
         if self.used[profile] == 0:
             del self.used[profile]
 
+    def release(self, profile: Profile, count: int = 1) -> None:
+        """Release `count` in-use slices of `profile` AND unpin their physical
+        placements, so a what-if re-carve may move through the freed region
+        (consolidation: the planner evicts the pods that held them). Any
+        pinned block with the profile's oriented dims corresponds to some
+        used slice of that profile, so unpinning any matching one is sound."""
+        self.mark_unused(profile, count)
+        if self.pinned is None:
+            return
+        target = tuple(sorted(profile.shape.dims))
+        removed = 0
+        kept: List[Pin] = []
+        for origin, dims in self.pinned:
+            if removed < count and tuple(sorted(dims)) == target:
+                removed += 1
+                continue
+            kept.append((origin, dims))
+        self.pinned = kept
+
     # -- resource views ----------------------------------------------------
     def as_resources(self) -> Dict[str, int]:
         """Extended resources this geometry exposes (allocatable scalars,

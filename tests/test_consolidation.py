@@ -1,0 +1,277 @@
+"""Consolidation (defragmentation) preemption.
+
+The reference never migrates running pods; on TPU meshes that strands
+pod-sized sub-slices behind a node's longest straggler (the north-star
+drain-tail). The partitioner's consolidation pass drains the cheapest node
+whose movable pods all provably fit elsewhere, evicts them, and plans the
+re-carve (controllers/partitioner.py _consolidate).
+"""
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster import Cluster
+from nos_tpu.controllers.partitioner import PartitionerController
+from nos_tpu.controllers.tpu_agent import TpuAgent
+from nos_tpu.partitioning.core.interface import FitSimScheduler
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.tpu_mode import TpuSnapshotTaker, TpuPartitioner
+from nos_tpu.tpu import Profile, Topology, TpuMesh
+from nos_tpu.tpulib import FakeTpuClient
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_node(name, topo="4x4"):
+    chips = 1
+    for d in topo.split("x"):
+        chips *= int(d)
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_PARTITIONING: constants.KIND_TPU,
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: topo,
+            },
+        ),
+        status=NodeStatus(
+            allocatable=ResourceList.of({"cpu": 64, "google.com/tpu": chips})
+        ),
+    )
+
+
+def pending_pod(name, profile, ns="ml", priority=0):
+    p = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceList.of({f"google.com/tpu-{profile}": 1}))
+            ],
+            scheduler_name=constants.SCHEDULER_NAME,
+            priority=priority,
+        ),
+    )
+    p.status.phase = PodPhase.PENDING
+    p.status.conditions.append(
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    return p
+
+
+def bound_pod(name, profile, node, ns="ml", priority=0):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceList.of({f"google.com/tpu-{profile}": 1}))
+            ],
+            node_name=node,
+            priority=priority,
+        ),
+        status=__import__("nos_tpu.api.objects", fromlist=["PodStatus"]).PodStatus(
+            phase=PodPhase.RUNNING
+        ),
+    )
+
+
+class Env:
+    def __init__(self, topos):
+        self.cluster = Cluster()
+        self.state = ClusterState()
+        self.state.start_watching(self.cluster)
+        self.clock = FakeClock()
+        self.agents = {}
+        for name, topo in topos.items():
+            self.cluster.create(make_node(name, topo))
+            agent = TpuAgent(
+                self.cluster, name, FakeTpuClient(Topology.parse("v5e", topo))
+            )
+            agent.startup()
+            agent.start_watching()
+            self.agents[name] = agent
+        self.controller = PartitionerController(
+            cluster=self.cluster,
+            state=self.state,
+            kind=constants.KIND_TPU,
+            snapshot_taker=TpuSnapshotTaker(),
+            partitioner=TpuPartitioner(self.cluster),
+            sim_scheduler=FitSimScheduler(),
+            batch_timeout_s=10,
+            batch_idle_s=2,
+            now=self.clock,
+        )
+        self.controller.start_watching()
+
+    def carve_and_bind(self, node, profile, pod_name, priority=0):
+        """Carve one `profile` slice on `node` via the spec protocol, then
+        bind a pod to it (agents apply + report synchronously on the bus)."""
+        existing = __import__("nos_tpu.api.annotations", fromlist=["parse_spec"])
+
+        def mutate(n):
+            key = f"{constants.DOMAIN}/spec-dev-0-{profile}"
+            current = int(n.metadata.annotations.get(key, "0"))
+            n.metadata.annotations[key] = str(current + 1)
+            n.metadata.annotations[constants.ANNOTATION_SPEC_PLAN] = (
+                f"seed-{node}-{pod_name}"
+            )
+
+        self.cluster.patch("Node", "", node, mutate)
+        pod = bound_pod(pod_name, profile, node, priority=priority)
+        self.cluster.create(pod)
+        self.agents[node].report()
+        return pod
+
+    def run_cycle(self):
+        self.clock.t += 61
+        return self.controller.process_batch_if_ready()
+
+    def node(self, name):
+        return self.cluster.get("Node", "", name)
+
+    def pod_exists(self, name, ns="ml"):
+        return self.cluster.try_get("Pod", ns, name) is not None
+
+
+def test_consolidation_drains_cheapest_node_for_stranded_slice():
+    """Two 4x4 nodes each pinned by one 1x1 pod; a pending 4x4 (whole-mesh)
+    profile fits nowhere. Consolidation must evict exactly one pinned pod
+    (which provably fits on the other node) and re-carve its node."""
+    env = Env({"a": "4x4", "b": "4x4"})
+    env.carve_and_bind("a", "1x1", "small-a")
+    env.carve_and_bind("b", "1x1", "small-b")
+    env.cluster.create(pending_pod("big", "4x4"))
+    assert env.run_cycle()
+
+    evicted = [n for n in ("small-a", "small-b") if not env.pod_exists(n)]
+    assert len(evicted) == 1, "exactly one victim should be displaced"
+    drained = "a" if evicted == ["small-a"] else "b"
+    spec = env.node(drained).metadata.annotations
+    assert spec.get(f"{constants.DOMAIN}/spec-dev-0-4x4") == "1"
+    # The agent applied the re-carve synchronously (victim already deleted).
+    assert env.node(drained).status.allocatable.get("google.com/tpu-4x4") == 1.0
+
+
+def test_consolidation_when_eviction_alone_frees_the_slices():
+    """No re-carve needed: node a already carries two 2x2 slices, one held by
+    a movable victim; the pending pod needs BOTH colocated. Schedulability,
+    not a geometry change, is the gate (a changed-flag gate silently skipped
+    this case: update_geometry_for is a no-op on the drained node)."""
+    env = Env({"a": "2x4", "b": "2x2"})
+    env.carve_and_bind("a", "2x2", "holder-a")
+
+    def second_slice(n):
+        n.metadata.annotations[f"{constants.DOMAIN}/spec-dev-0-2x2"] = "2"
+        n.metadata.annotations[constants.ANNOTATION_SPEC_PLAN] = "seed-a-2"
+
+    env.cluster.patch("Node", "", "a", second_slice)
+    env.agents["a"].report()
+
+    pod = Pod(
+        metadata=ObjectMeta(name="pair", namespace="ml"),
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceList.of({"google.com/tpu-2x2": 2}))
+            ],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+    pod.status.phase = PodPhase.PENDING
+    pod.status.conditions.append(
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    env.cluster.create(pod)
+    assert env.run_cycle()
+
+    assert not env.pod_exists("holder-a"), "the slice holder should be migrated"
+    env.agents["a"].report()
+    status = env.node("a").metadata.annotations
+    assert status.get(f"{constants.DOMAIN}/status-dev-0-2x2-free") == "2"
+    # the displaced holder provably fits on b (identity 2x2 carve)
+
+
+def test_no_consolidation_when_victims_cannot_rebind():
+    """Node b is fully held by a 4x4 pod; node a is pinned by a 1x1. Draining
+    a would strand its victim (no room on b), draining b would strand the 4x4
+    (a's pin blocks the only 4x4 window) — consolidation must do nothing."""
+    env = Env({"a": "4x4", "b": "4x4"})
+    env.carve_and_bind("a", "1x1", "small-a")
+    env.carve_and_bind("b", "4x4", "big-b")
+    env.cluster.create(pending_pod("big", "4x4"))
+    env.run_cycle()
+
+    assert env.pod_exists("small-a")
+    assert env.pod_exists("big-b")
+    assert env.node("a").metadata.annotations.get(
+        f"{constants.DOMAIN}/spec-dev-0-4x4"
+    ) is None
+
+
+def test_consolidation_respects_priority():
+    """A victim outranking the stranded pod is immovable."""
+    env = Env({"a": "4x4", "b": "4x4"})
+    env.carve_and_bind("a", "1x1", "vip-a", priority=100)
+    env.carve_and_bind("b", "1x1", "vip-b", priority=100)
+    env.cluster.create(pending_pod("big", "4x4", priority=0))
+    env.run_cycle()
+
+    assert env.pod_exists("vip-a")
+    assert env.pod_exists("vip-b")
+
+
+def test_consolidation_never_touches_gang_members():
+    env = Env({"a": "4x4", "b": "4x4"})
+    pod_a = env.carve_and_bind("a", "1x1", "gang-a")
+    env.cluster.patch(
+        "Pod", "ml", "gang-a",
+        lambda p: p.metadata.labels.__setitem__(constants.LABEL_GANG, "g1"),
+    )
+    env.carve_and_bind("b", "1x1", "gang-b")
+    env.cluster.patch(
+        "Pod", "ml", "gang-b",
+        lambda p: p.metadata.labels.__setitem__(constants.LABEL_GANG, "g1"),
+    )
+    env.cluster.create(pending_pod("big", "4x4"))
+    env.run_cycle()
+    assert env.pod_exists("gang-a")
+    assert env.pod_exists("gang-b")
+
+
+def test_mesh_release_unpins_matching_placement():
+    """release() frees the slice AND its pinned footprint so a re-carve can
+    move through the region (the consolidation what-if primitive)."""
+    topo = Topology.parse("v5e", "4x4")
+    p22 = Profile.parse("2x2")
+    p44 = Profile.parse("4x4")
+    mesh = TpuMesh(topo, {p22: 1}, {p22: 1}, pinned=[((0, 0), (2, 2))])
+    assert not mesh.update_geometry_for({p44: 1})  # pinned 2x2 blocks it
+    mesh.release(p22)
+    assert mesh.used == {}
+    assert mesh.pinned == []
+    assert mesh.update_geometry_for({p44: 1})
+    assert mesh.geometry == {p44: 1}
+
+
+def test_mesh_release_requires_used_slice():
+    topo = Topology.parse("v5e", "4x4")
+    p22 = Profile.parse("2x2")
+    mesh = TpuMesh(topo, {p22: 1})
+    with pytest.raises(ValueError):
+        mesh.release(p22)

@@ -107,6 +107,10 @@ class TestWireCodec:
         with pytest.raises(ValueError):
             decode_fields(b"\x0a\xff")  # length-delimited claiming 255 bytes
 
+    def test_encode_rejects_negative_varint(self):
+        with pytest.raises(ValueError):
+            encode_varint(-1)  # would two's-complement-loop forever otherwise
+
 
 # -- client against fake kubelet ----------------------------------------------
 @pytest.fixture()
