@@ -181,7 +181,7 @@ class PartitionerController:
         candidates = []  # (displaced_chips, node_name, drained_node, victims)
         for name in sorted(snapshot.nodes):
             node = snapshot.nodes[name]
-            if not hasattr(node, "evict_pod"):
+            if not hasattr(node, "evict_pods"):
                 continue  # node type is not consolidation-capable
             victims = [p for p in node.pods if self._movable(spec, p, pod)]
             if not victims:
@@ -203,13 +203,24 @@ class PartitionerController:
             candidates.append((displaced, len(kept_victims), name, drained, kept_victims))
         candidates.sort(key=lambda c: (c[0], c[1], c[2]))
         for _, _, name, drained, victims in candidates:
-            if not self._victims_fit_elsewhere(snapshot, name, victims):
+            rebind_carves = self._victims_fit_elsewhere(snapshot, name, victims)
+            if rebind_carves is None:
                 continue
-            plan = PartitioningPlan(state={name: drained.partitioning()})
+            # The plan carries the drained node AND every re-carve the rebind
+            # proof relied on — otherwise the "victims provably rebind"
+            # guarantee would hinge on a future cycle reproducing the carve
+            # before other arrivals claim those chips.
+            state = {name: drained.partitioning()}
+            state.update(
+                {n: other.partitioning() for n, other in rebind_carves.items()}
+            )
+            plan = PartitioningPlan(state=state)
             logger.info(
-                "consolidation: draining %s (%d victims) to host %s (plan %s)",
+                "consolidation: draining %s (%d victims, %d rebind carves) "
+                "to host %s (plan %s)",
                 name,
                 len(victims),
+                len(rebind_carves),
                 pod.metadata.namespaced_name,
                 plan.id,
             )
@@ -245,8 +256,9 @@ class PartitionerController:
         def try_drain(victim_set: List[Pod]):
             drained = node.clone()
             try:
-                for v in victim_set:
-                    drained.evict_pod(v)
+                # Batched: pin release is only exact when a profile's in-use
+                # slices are freed in full (see TpuNode.evict_pods).
+                drained.evict_pods(victim_set)
             except (ValueError, KeyError):
                 return None
             # May be a no-op when eviction alone frees an already-carved
@@ -275,21 +287,20 @@ class PartitionerController:
             return None  # nothing to evict means the normal planner suffices
         return drained, kept
 
-    def _victims_fit_elsewhere(self, snapshot, drained_name: str, victims: List[Pod]) -> bool:
+    def _victims_fit_elsewhere(self, snapshot, drained_name: str, victims: List[Pod]):
         """Every victim must provably rebind into the OTHER nodes' capacity
         right now (carving allowed) — this is what makes consolidation a
-        migration rather than a preemption cascade."""
+        migration rather than a preemption cascade. Returns the re-carved
+        nodes the proof relied on ({} when none were needed), or None when
+        some victim cannot rebind."""
         spec = snapshot.slice_spec
         others = {
             n: node.clone() for n, node in snapshot.nodes.items() if n != drained_name
         }
+        carved: dict = {}
         for victim in sorted(
             victims,
-            key=lambda p: -sum(
-                spec.slice_weight(k) * v
-                for k, v in compute_pod_request(p).items()
-                if spec.is_slice_resource(k)
-            ),
+            key=lambda p: -self._tpu_chips(spec, compute_pod_request(p)),
         ):
             vcopy = victim.deepcopy()
             vcopy.spec.node_name = ""
@@ -307,11 +318,12 @@ class PartitionerController:
                 ) and self.planner.can_schedule(vcopy, trial):
                     trial.add_pod(vcopy)
                     others[name] = trial
+                    carved[name] = trial
                     placed = True
                     break
             if not placed:
-                return False
-        return True
+                return None
+        return carved
 
     def _evict(self, victim: Pod) -> None:
         """Eviction = deletion; the workload controller resubmits

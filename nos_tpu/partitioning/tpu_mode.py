@@ -159,22 +159,33 @@ class TpuNode:
         self.pods.append(pod)
         self.requested = self.requested.add(request)
 
-    def evict_pod(self, pod: Pod) -> None:
-        """What-if removal of a bound pod: release its slices (and their
-        pinned placements) so a consolidation re-carve can plan through the
-        freed region. The presence of this hook is what marks a node type as
+    def evict_pods(self, pods: List[Pod]) -> None:
+        """What-if removal of bound pods: release their slices (and pinned
+        placements) so a consolidation re-carve can plan through the freed
+        region. Batched so per-profile counts aggregate: pins carry no pod
+        identity, and TpuMesh.release only unpins when a profile's in-use
+        slices are released IN FULL — a partial release stays used+pinned
+        (conservative: the model under-frees, never certifies a carve the
+        agent would refuse). The presence of this hook marks a node type as
         consolidation-capable (the controller checks for it)."""
-        request = compute_pod_request(pod)
-        for resource_name, qty in request.items():
-            profile = Profile.from_resource(resource_name)
-            if profile is not None and qty > 0:
-                self.mesh.release(profile, int(round(qty)))
-        self.pods = [
-            p
-            for p in self.pods
-            if p.metadata.namespaced_name != pod.metadata.namespaced_name
-        ]
-        self.requested = self.requested.subtract(request).non_zero()
+        per_profile: Dict[Profile, int] = {}
+        total = ResourceList()
+        names = set()
+        for pod in pods:
+            request = compute_pod_request(pod)
+            total = total.add(request)
+            names.add(pod.metadata.namespaced_name)
+            for resource_name, qty in request.items():
+                profile = Profile.from_resource(resource_name)
+                if profile is not None and qty > 0:
+                    per_profile[profile] = per_profile.get(profile, 0) + int(round(qty))
+        for profile, count in per_profile.items():
+            self.mesh.release(profile, count)
+        self.pods = [p for p in self.pods if p.metadata.namespaced_name not in names]
+        self.requested = self.requested.subtract(total).non_zero()
+
+    def evict_pod(self, pod: Pod) -> None:
+        self.evict_pods([pod])
 
     def has_free_capacity(self) -> bool:
         return self.mesh.has_free_capacity()

@@ -197,15 +197,25 @@ class TpuMesh:
         if self.used[profile] == 0:
             del self.used[profile]
 
-    def release(self, profile: Profile, count: int = 1) -> None:
-        """Release `count` in-use slices of `profile` AND unpin their physical
+    def release(self, profile: Profile, count: int = 1) -> bool:
+        """Release in-use slices of `profile` AND unpin their physical
         placements, so a what-if re-carve may move through the freed region
-        (consolidation: the planner evicts the pods that held them). Any
-        pinned block with the profile's oriented dims corresponds to some
-        used slice of that profile, so unpinning any matching one is sound."""
+        (consolidation: the planner evicts the pods that held them).
+
+        Pins carry no pod identity, so unpinning is only sound when `count`
+        equals ALL in-use slices of the profile — then every dims-matching
+        pin provably belongs to a released slice. A partial release is
+        ambiguous (unpinning the wrong block would certify re-carves the
+        agent must refuse); it is left fully pinned-and-used and reported as
+        False so callers model the region conservatively."""
+        held = self.used.get(profile, 0)
+        if count > held:
+            raise ValueError(f"cannot release {count}x{profile}: only {held} used")
+        if self.pinned is not None and count < held:
+            return False  # ambiguous pin ownership: keep used + pinned
         self.mark_unused(profile, count)
         if self.pinned is None:
-            return
+            return True
         target = tuple(sorted(profile.shape.dims))
         removed = 0
         kept: List[Pin] = []
@@ -215,6 +225,7 @@ class TpuMesh:
                 continue
             kept.append((origin, dims))
         self.pinned = kept
+        return True
 
     # -- resource views ----------------------------------------------------
     def as_resources(self) -> Dict[str, int]:

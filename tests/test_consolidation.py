@@ -275,3 +275,44 @@ def test_mesh_release_requires_used_slice():
     mesh = TpuMesh(topo, {p22: 1})
     with pytest.raises(ValueError):
         mesh.release(p22)
+
+
+def test_mesh_partial_release_stays_pinned_and_used():
+    """Pins carry no pod identity: releasing SOME of a profile's in-use
+    slices cannot know which pinned block freed, so the model must stay
+    fully pinned-and-used (unpinning the wrong block would certify re-carves
+    the agent refuses — e.g. unpinning a high-priority pod's footprint)."""
+    topo = Topology.parse("v5e", "4x4")
+    p22 = Profile.parse("2x2")
+    p24 = Profile.parse("2x4")
+    pins = [((0, 0), (2, 2)), ((0, 2), (2, 2)), ((2, 2), (2, 2))]
+    mesh = TpuMesh(topo, {p22: 3}, {p22: 3}, pinned=pins)
+    assert mesh.release(p22, 1) is False  # ambiguous: 1 of 3
+    assert mesh.used == {p22: 3}
+    assert len(mesh.pinned) == 3
+    # A 2x4 carve must still be refused: the remaining pins of the true
+    # holders could be any two of the three blocks.
+    assert not mesh.update_geometry_for({p24: 1})
+    # Releasing the profile in full is exact.
+    assert mesh.release(p22, 3) is True
+    assert mesh.used == {} and mesh.pinned == []
+
+
+def test_consolidation_actuates_rebind_carves():
+    """The carve that PROVES a victim rebinds elsewhere must ship in the
+    same plan — otherwise the migration guarantee hinges on a later cycle
+    reproducing it before other arrivals claim the chips."""
+    env = Env({"a": "4x4", "b": "4x4"})
+    env.carve_and_bind("a", "1x1", "small-a")
+    env.carve_and_bind("b", "1x1", "small-b")
+    env.cluster.create(pending_pod("big", "4x4"))
+    assert env.run_cycle()
+
+    evicted = [n for n in ("small-a", "small-b") if not env.pod_exists(n)]
+    assert len(evicted) == 1
+    drained = "a" if evicted == ["small-a"] else "b"
+    survivor = "b" if drained == "a" else "a"
+    # The survivor node's spec gained the 1x1 slice the displaced victim
+    # needs to rebind (its own original 1x1 is still held by its own pod).
+    spec = env.node(survivor).metadata.annotations
+    assert spec.get(f"{constants.DOMAIN}/spec-dev-0-1x1") == "2"
