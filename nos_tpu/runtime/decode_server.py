@@ -9,18 +9,29 @@ each at its own position (`decode_step_ragged`). Sequences finish and free
 their slot independently, so short requests are never held hostage by long
 ones and the MXU always sees the full active batch.
 
-TPU-shaped by construction: the cache is a static [n_slots, ...] allocation,
-prompts are padded to bucket lengths so XLA reuses compiled programs, and
-per-step host traffic is one tiny [n_slots] token fetch.
+TPU-shaped by construction:
+  - the cache is a static [n_slots, ...] allocation and prompts are padded to
+    bucket lengths, so XLA reuses compiled programs;
+  - the token loop is DEVICE-RESIDENT: each step's sampled tokens feed the
+    next step directly on device, and prefill scatters its first token into
+    the device-side token vector, so neither admission nor steady-state
+    decoding blocks on a host round trip. Tokens materialize on the host
+    lazily — when a sequence's deterministic countdown finishes (or, with an
+    eos_id, on a short pipeline delay) — which matters enormously when the
+    chip is network-attached: dispatch pipelining hides the per-step RTT
+    that would otherwise serialize every token;
+  - the step donates its cache buffer, so a deep dispatch pipeline keeps a
+    single cache allocation in flight.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Deque, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,12 +45,39 @@ from nos_tpu.models.gpt import GPTConfig
 logger = logging.getLogger(__name__)
 
 
+class _TokRef:
+    """One dispatched step's token vector (or a prefill's scalar first
+    token); materializes to numpy once, on first host need."""
+
+    __slots__ = ("_arr", "_np")
+
+    def __init__(self, arr):
+        self._arr = arr
+        self._np = None
+
+    def np(self):
+        if self._np is None:
+            self._np = np.asarray(self._arr)
+            self._arr = None
+        return self._np
+
+    def is_ready(self) -> bool:
+        if self._np is not None:
+            return True
+        probe = getattr(self._arr, "is_ready", None)
+        return bool(probe()) if probe is not None else True
+
+
 @dataclass
 class _Slot:
     active: bool = False
-    pos: int = 0
-    remaining: int = 0
-    tokens: List[int] = field(default_factory=list)
+    pos: int = 0  # next cache write index (dispatched, not materialized)
+    remaining: int = 0  # generated tokens still to dispatch
+    # Token sources in generation order: (ref, lane, row) — lane None = the
+    # prefill's scalar first token; row = the step's index within its
+    # macro-dispatch window.
+    refs: List[Tuple[_TokRef, Optional[int], Optional[int]]] = field(default_factory=list)
+    eos_scanned: int = 0
     future: Optional[Future] = None
 
 
@@ -54,12 +92,27 @@ class DecodeServer:
         eos_id: Optional[int] = None,
         temperature: float = 0.0,
         seed: int = 0,
+        pipeline_depth: int = 16,
+        steps_per_dispatch: int = 1,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
         softmax sampling with a deterministic per-slot, per-step PRNG stream
         (`fold_in(seed, slot_serial, step)`), so a request's output depends
         only on its own stream — never on which other requests share the
-        batch."""
+        batch.
+
+        `pipeline_depth` bounds how many decode dispatches may be in flight
+        on the device before the engine materializes the oldest. With an
+        `eos_id` the effective depth is clamped to 2: termination depends on
+        token VALUES, so deep pipelining would only waste post-EOS steps
+        (the late-detected extras are discarded; outputs are unaffected).
+
+        `steps_per_dispatch` (K) runs K decode iterations inside ONE jitted
+        call (lax.scan), so a network-attached chip pays one dispatch round
+        trip per K tokens instead of per token — the decisive knob when the
+        link RTT, not the step execution, bounds throughput. Admission and
+        EOS reaction granularity become K steps; greedy outputs are
+        bit-identical for any K (same math, same order)."""
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -72,10 +125,12 @@ class DecodeServer:
                 f"no prompt bucket smaller than max_len={max_len}: {prompt_buckets}"
             )
         self.eos_id = eos_id
+        self.pipeline_depth = max(1, pipeline_depth if eos_id is None else min(pipeline_depth, 2))
         self.cache = init_cache(cfg, n_slots, max_len)
         self._queue: "queue.Queue" = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
-        self._last_tokens = np.zeros((n_slots,), dtype=np.int32)
+        self._last_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
+        self._inflight: Deque[_TokRef] = deque()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.steps_run = 0
@@ -101,26 +156,46 @@ class DecodeServer:
                 lambda k, l: jax.random.categorical(k, l / self.temperature)
             )(keys, logits).astype(jnp.int32)
 
-        def _step(params, token, cache, pos, active, serial, step):
-            logits, new_cache = decode_step_ragged(params, token, cfg, cache, pos)
-            nxt = _sample(logits, serial, step)
-            # Inactive lanes keep their cache untouched and emit token 0.
-            keep = active[:, None, None, None]
-            new_cache = jax.tree.map(
-                lambda new, old: jnp.where(keep, new, old)
-                if new.ndim == 4
-                else new,
-                new_cache,
-                cache,
-            )
-            return jnp.where(active, nxt, 0), new_cache
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        K = self.steps_per_dispatch
 
-        self._step_fn = jax.jit(_step)
+        def _macro(params, token, cache, pos0, active, serial, step0, steps_left):
+            """K ragged decode iterations in one program. Per iteration k a
+            lane participates iff it is active, still owes tokens
+            (k < steps_left), and stays inside the cache window; lanes that
+            finish mid-window coast (cache untouched, token held)."""
+
+            def body(carry, k):
+                token, cache = carry
+                pos_k = pos0 + k
+                mask = active & (k < steps_left) & (pos_k < max_len)
+                logits, new_cache = decode_step_ragged(params, token, cfg, cache, pos_k)
+                nxt = _sample(logits, serial, step0 + k)
+                keep = mask[:, None, None, None]
+                new_cache = jax.tree.map(
+                    lambda new, old: jnp.where(keep, new, old)
+                    if new.ndim == 4
+                    else new,
+                    new_cache,
+                    cache,
+                )
+                out_token = jnp.where(mask, nxt, token)
+                return (out_token, new_cache), jnp.where(mask, nxt, 0)
+
+            (final_token, cache), toks = jax.lax.scan(
+                body, (token, cache), jnp.arange(K)
+            )
+            return final_token, toks, cache  # toks: [K, n_slots]
+
+        # Donate the cache: with pipeline_depth dispatches in flight,
+        # donation keeps one cache allocation alive instead of depth of them.
+        self._step_fn = jax.jit(_macro, donate_argnums=(2,))
 
         # Prefill path: run the padded prompt, take logits at the true last
         # prompt position (sampled as the request's step 0), scatter the
-        # single-lane cache into the slot.
-        def _prefill_into(params, tokens, length, cache, slot, serial):
+        # single-lane cache into the slot and the first token into the
+        # device-resident token vector (no host materialization on admit).
+        def _prefill_into(params, tokens, length, cache, last, slot, serial):
             lane = init_cache(cfg, 1, max_len)
             logits, lane = _forward_with_cache(params, tokens, cfg, lane, 0)
             first = _sample(
@@ -131,9 +206,9 @@ class DecodeServer:
             cache = jax.tree.map(
                 lambda big, small: big.at[slot].set(small[0]), cache, lane
             )
-            return first, cache
+            return first, cache, last.at[slot].set(first)
 
-        self._prefill_into = jax.jit(_prefill_into)
+        self._prefill_into = jax.jit(_prefill_into, donate_argnums=(3, 4))
 
     # -- client side ---------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new: int = 16) -> Future:
@@ -163,9 +238,10 @@ class DecodeServer:
 
     def _fail_outstanding(self, exc: Exception) -> None:
         for idx, slot in enumerate(self._slots):
-            if slot.active and slot.future is not None and not slot.future.done():
+            if slot.future is not None and not slot.future.done():
                 slot.future.set_exception(exc)
             self._slots[idx] = _Slot()
+        self._inflight.clear()
         while True:
             try:
                 _, _, fut = self._queue.get_nowait()
@@ -173,6 +249,12 @@ class DecodeServer:
                 break
             if not fut.done():
                 fut.set_exception(exc)
+
+    def _reset_device_state(self) -> None:
+        """After an engine error the donated cache chain is untrustworthy;
+        start from a fresh allocation."""
+        self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
+        self._last_dev = jnp.zeros((self.n_slots,), dtype=jnp.int32)
 
     def _bucket(self, n: int) -> int:
         for b in self.prompt_buckets:
@@ -220,29 +302,78 @@ class DecodeServer:
             serial = self._next_serial
             self._next_serial += 1
             self._slot_serial[idx] = serial
-            first, self.cache = self._prefill_into(
-                self.params, jnp.asarray(padded), len(prompt), self.cache, idx, serial
+            # Dispatch only: the slot is decodable immediately because the
+            # first token lives in the device token vector; nothing blocks.
+            first, self.cache, self._last_dev = self._prefill_into(
+                self.params,
+                jnp.asarray(padded),
+                len(prompt),
+                self.cache,
+                self._last_dev,
+                idx,
+                serial,
             )
             slot.active = True
             slot.pos = len(prompt)
             slot.remaining = max_new - 1
-            slot.tokens = [int(first)]
+            slot.refs = [(_TokRef(first), None, None)]
+            slot.eos_scanned = 0
             slot.future = fut
-            self._last_tokens[idx] = int(first)
             self._finish_if_done(idx)
 
+    @staticmethod
+    def _token_at(ref: _TokRef, lane: Optional[int], row: Optional[int]) -> int:
+        arr = ref.np()
+        if lane is None:
+            return int(arr)
+        return int(arr[row, lane])
+
+    def _materialize_tokens(self, slot: _Slot) -> List[int]:
+        return [self._token_at(ref, lane, row) for ref, lane, row in slot.refs]
+
+    def _finalize(self, slot: _Slot) -> List[int]:
+        """Materialize the output, truncated at EOS: the countdown can fire
+        before a late EOS was scanned (pipelined detection), so the cut is
+        applied at resolution time regardless of which path finishes."""
+        tokens = self._materialize_tokens(slot)
+        if self.eos_id is not None and self.eos_id in tokens:
+            tokens = tokens[: tokens.index(self.eos_id) + 1]
+        return tokens
+
     def _finish_if_done(self, idx: int) -> None:
+        """Deterministic completion: the countdown and the cache bound are
+        known at dispatch time (slot.pos is the NEXT write index; a step at
+        pos == max_len-1 is still valid, decode.generate's own bound)."""
         slot = self._slots[idx]
-        done = (
-            slot.remaining <= 0
-            # slot.pos is the NEXT write index; a step at pos == max_len-1 is
-            # still valid (decode.generate's own bound).
-            or slot.pos >= self.max_len
-            or (self.eos_id is not None and slot.tokens and slot.tokens[-1] == self.eos_id)
-        )
-        if done and slot.active:
-            slot.future.set_result(list(slot.tokens))
+        if not slot.active:
+            return
+        if slot.remaining <= 0 or slot.pos >= self.max_len:
+            slot.future.set_result(self._finalize(slot))
             self._slots[idx] = _Slot()
+
+    def _scan_eos(self) -> None:
+        """With an eos_id, sequence termination depends on token values; scan
+        refs that have materialized (the depth clamp bounds the lag). Tokens
+        dispatched after a late-detected EOS are discarded — the lane's cache
+        garbage is overwritten by the next prefill."""
+        if self.eos_id is None:
+            return
+        for idx, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            while slot.eos_scanned < len(slot.refs):
+                ref, lane, row = slot.refs[slot.eos_scanned]
+                if not ref.is_ready():
+                    # Bounded lag: the depth clamp (<= 2 with eos_id) forces
+                    # materialization via backpressure within two ticks.
+                    break
+                token = self._token_at(ref, lane, row)
+                slot.eos_scanned += 1
+                if token == self.eos_id:
+                    slot.refs = slot.refs[: slot.eos_scanned]
+                    slot.future.set_result(self._finalize(slot))
+                    self._slots[idx] = _Slot()
+                    break
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -254,32 +385,47 @@ class DecodeServer:
                 # longer trustworthy) and keep serving.
                 logger.exception("decode engine step failed")
                 self._fail_outstanding(exc)
+                self._reset_device_state()
 
     def _tick(self) -> None:
         self._admit()
+        self._scan_eos()
         active = [s.active for s in self._slots]
         if not any(active):
             self._stop.wait(0.005)
             return
+        K = self.steps_per_dispatch
         pos = np.array([s.pos for s in self._slots], dtype=np.int32)
-        step = np.array([len(s.tokens) for s in self._slots], dtype=np.int64)
-        tokens, self.cache = self._step_fn(
+        step = np.array(
+            [len(s.refs) for s in self._slots], dtype=np.int64
+        )  # tokens generated so far = the request's PRNG step index
+        steps_left = np.array(
+            [s.remaining if s.active else 0 for s in self._slots], dtype=np.int32
+        )
+        last, toks, self.cache = self._step_fn(
             self.params,
-            jnp.asarray(self._last_tokens),
+            self._last_dev,
             self.cache,
             jnp.asarray(pos),
             jnp.asarray(active),
             jnp.asarray(self._slot_serial),
             jnp.asarray(step),
+            jnp.asarray(steps_left),
         )
-        sampled = np.asarray(tokens)
+        self._last_dev = last
+        ref = _TokRef(toks)
+        self._inflight.append(ref)
         self.steps_run += 1
         for idx, slot in enumerate(self._slots):
             if not slot.active:
                 continue
-            tok = int(sampled[idx])
-            slot.tokens.append(tok)
-            slot.pos += 1
-            slot.remaining -= 1
-            self._last_tokens[idx] = tok
+            executed = min(K, slot.remaining, self.max_len - slot.pos)
+            for k in range(executed):
+                slot.refs.append((ref, idx, k))
+            slot.pos += executed
+            slot.remaining -= executed
             self._finish_if_done(idx)
+        # Backpressure: bound the device dispatch queue; materializing the
+        # oldest in-flight dispatch is (amortized) already-complete work.
+        while len(self._inflight) > self.pipeline_depth:
+            self._inflight.popleft().np()

@@ -186,3 +186,46 @@ def test_sampled_stream_independent_of_batchmates(params):
         busy_server.stop()
     assert together == alone
     assert len(alone) == 6
+
+
+def test_macro_step_bit_identical_to_single_step(params):
+    """steps_per_dispatch=K runs K iterations per jitted call (one dispatch
+    round trip per K tokens on a network-attached chip); greedy outputs must
+    be bit-identical to K=1 for ragged, concurrent traffic."""
+    server1 = DecodeServer(params, CFG, n_slots=3, max_len=64).start()
+    serverK = DecodeServer(
+        params, CFG, n_slots=3, max_len=64, steps_per_dispatch=4
+    ).start()
+    try:
+        prompts = [[5, 11, 3], [7], [2, 4, 6, 8, 10]]
+        lens = [9, 17, 6]  # deliberately not multiples of K
+        want = [
+            server1.submit(p, max_new=n) for p, n in zip(prompts, lens)
+        ]
+        got = [
+            serverK.submit(p, max_new=n) for p, n in zip(prompts, lens)
+        ]
+        for w, g in zip(want, got):
+            assert g.result(timeout=120) == w.result(timeout=120)
+    finally:
+        server1.stop()
+        serverK.stop()
+
+
+def test_macro_step_with_eos(params):
+    """EOS inside a macro window: detection lags at most K + pipeline steps,
+    and the resolved output is still truncated exactly at the EOS token."""
+    probe = DecodeServer(params, CFG, n_slots=1, max_len=64).start()
+    try:
+        tokens = probe.generate([5, 11, 3], max_new=12, timeout=120)
+    finally:
+        probe.stop()
+    eos = tokens[4]  # make the 5th generated token terminal
+    server = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, eos_id=eos, steps_per_dispatch=4
+    ).start()
+    try:
+        got = server.generate([5, 11, 3], max_new=12, timeout=120)
+        assert got == tokens[: tokens.index(eos) + 1]
+    finally:
+        server.stop()
