@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: all test test-tpu native bench dryrun demo simulate example clean
+.PHONY: all test test-tpu native bench dryrun demo simulate example clean \
+	render cluster kind-cluster docker-build
 
 all: native test
 
@@ -39,6 +40,28 @@ simulate:
 example:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) examples/end_to_end.py
+
+# Render the Helm chart (works without helm: hack/render_chart.py speaks the
+# compatible template subset; with helm installed `helm template` agrees).
+render:
+	$(PY) hack/render_chart.py helm-charts/nos-tpu
+
+# Local control plane without Docker/kind: the in-tree API-server emulator +
+# a kubeconfig at ./kubeconfig. Point the binaries at it with --kubeconfig.
+cluster:
+	$(PY) -m nos_tpu.cli apiserver --port 8001 --write-kubeconfig ./kubeconfig
+
+# Real 3-node kind cluster (requires kind + docker on the host).
+kind-cluster:
+	kind create cluster --name nos-tpu --config hack/kind/cluster.yaml
+	kubectl apply -f deploy/crds.yaml
+
+# Component images (reference Makefile docker-build analog; requires docker).
+COMPONENTS := operator scheduler partitioner tpuagent gpuagent telemetry
+docker-build:
+	for c in $(COMPONENTS); do \
+		docker build -t nos-tpu-$$c:latest -f build/$$c/Dockerfile . || exit 1 ; \
+	done
 
 clean:
 	$(MAKE) -C nos_tpu/tpulib/native clean

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Render the nos-tpu Helm chart without helm.
+
+The image this repo builds in has no helm binary, so the chart's templates
+are written in a *compatible subset* of Go template syntax that both real
+`helm template` and this renderer understand:
+
+  {{ .Values.some.path }}                 value substitution
+  {{ .Values.x | default "y" }}           default for empty/missing
+  {{ .Values.x | quote }}                 JSON-quoted substitution
+  {{ .Release.Name }} / .Release.Namespace / .Chart.AppVersion / .Chart.Name
+  {{- if .Values.flag }} ... {{- end }}   truthiness-gated blocks (nestable)
+  {{- toYaml .Values.x | nindent N }}     literal YAML re-indent
+
+Usage: python hack/render_chart.py [chart_dir] [--set a.b=c ...]
+Prints the multi-document YAML stream (the `helm template` output analog).
+Tests drive render_chart() directly (tests/test_packaging.py).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Tuple
+
+import yaml
+
+_EXPR = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _lookup(ctx: Dict[str, Any], path: str) -> Any:
+    cur: Any = ctx
+    for seg in path.lstrip(".").split("."):
+        if not seg:
+            continue
+        if isinstance(cur, dict) and seg in cur:
+            cur = cur[seg]
+        else:
+            return None
+    return cur
+
+
+def _eval_expr(expr: str, ctx: Dict[str, Any]) -> Tuple[Any, int]:
+    """Evaluate one pipeline expression; returns (value, nindent or -1)."""
+    parts = [p.strip() for p in expr.split("|")]
+    head = parts[0]
+    if head.startswith("toYaml"):
+        value = _lookup(ctx, head.split(None, 1)[1])
+    elif head.startswith('"') and head.endswith('"'):
+        value = head[1:-1]
+    else:
+        value = _lookup(ctx, head)
+    nindent = -1
+    for op in parts[1:]:
+        if op.startswith("default"):
+            arg = op.split(None, 1)[1].strip()
+            fallback, _ = _eval_expr(arg, ctx)
+            if value in (None, ""):
+                value = fallback
+        elif op == "quote":
+            value = json.dumps("" if value is None else str(value))
+        elif op.startswith("nindent"):
+            nindent = int(op.split(None, 1)[1])
+        else:
+            raise ValueError(f"unsupported template op {op!r}")
+    return value, nindent
+
+
+def _render_line(line: str, ctx: Dict[str, Any]) -> str:
+    def sub(match: re.Match) -> str:
+        value, nindent = _eval_expr(match.group(1), ctx)
+        if nindent >= 0:
+            dumped = yaml.safe_dump(value, default_flow_style=False).rstrip()
+            pad = " " * nindent
+            return "\n" + "\n".join(pad + l for l in dumped.splitlines())
+        return "" if value is None else str(value)
+
+    return _EXPR.sub(sub, line)
+
+
+def render_template(text: str, ctx: Dict[str, Any]) -> str:
+    """Render one template file: resolve if/end blocks, then substitute."""
+    out: List[str] = []
+    stack: List[bool] = []  # emit state per nested if
+    for line in text.splitlines():
+        stripped = line.strip()
+        m = _EXPR.fullmatch(stripped)
+        directive = m.group(1).strip() if m else None
+        if directive is not None and directive.startswith("if "):
+            value, _ = _eval_expr(directive[3:].strip(), ctx)
+            stack.append(bool(value))
+            continue
+        if directive == "else":
+            if not stack:
+                raise ValueError("else without if")
+            stack[-1] = not stack[-1]
+            continue
+        if directive == "end":
+            if not stack:
+                raise ValueError("end without if")
+            stack.pop()
+            continue
+        if all(stack):
+            out.append(_render_line(line, ctx))
+    if stack:
+        raise ValueError("unclosed if block")
+    return "\n".join(out) + "\n"
+
+
+def _deep_set(values: Dict[str, Any], dotted: str, value: str) -> None:
+    keys = dotted.split(".")
+    cur = values
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    cur[keys[-1]] = yaml.safe_load(value)
+
+
+def render_chart(
+    chart_dir: str,
+    release_name: str = "nos-tpu",
+    namespace: str = "nos-system",
+    overrides: Dict[str, str] | None = None,
+) -> Dict[str, str]:
+    """Render every template; returns {relative template path: rendered text}."""
+    chart = Path(chart_dir)
+    with open(chart / "Chart.yaml") as f:
+        chart_meta = yaml.safe_load(f)
+    with open(chart / "values.yaml") as f:
+        values = yaml.safe_load(f) or {}
+    for dotted, v in (overrides or {}).items():
+        _deep_set(values, dotted, v)
+    ctx = {
+        "Values": values,
+        "Chart": {
+            "Name": chart_meta.get("name", ""),
+            "AppVersion": chart_meta.get("appVersion", ""),
+            "Version": chart_meta.get("version", ""),
+        },
+        "Release": {"Name": release_name, "Namespace": namespace},
+    }
+    rendered: Dict[str, str] = {}
+    for path in sorted((chart / "templates").rglob("*.yaml")):
+        text = render_template(path.read_text(), ctx)
+        if text.strip():
+            rendered[str(path.relative_to(chart / "templates"))] = text
+    return rendered
+
+
+def main(argv: List[str]) -> int:
+    chart_dir = "helm-charts/nos-tpu"
+    overrides: Dict[str, str] = {}
+    args = iter(argv)
+    for a in args:
+        if a == "--set":
+            k, _, v = next(args).partition("=")
+            overrides[k] = v
+        else:
+            chart_dir = a
+    rendered = render_chart(chart_dir, overrides=overrides)
+    for name, text in rendered.items():
+        print(f"---\n# Source: {name}\n{text.rstrip()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

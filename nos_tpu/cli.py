@@ -435,6 +435,7 @@ def main(argv=None) -> int:
     p_tel = sub.add_parser("telemetry")
     p_tel.add_argument("--share", action="store_true")
     p_tel.add_argument("--kubeconfig", default=None)
+    p_tel.add_argument("--kube", action="store_true")
     p_api = sub.add_parser("apiserver", help="local k8s API-server emulator")
     p_api.add_argument("--port", type=int, default=8001)
     p_api.add_argument("--once", action="store_true")

@@ -9,9 +9,13 @@ blowup), not micro-noise.
 """
 
 import random
+import sys
 import time
+from pathlib import Path
 
 import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from nos_tpu import constants
 from nos_tpu.api.objects import (
@@ -36,16 +40,10 @@ from nos_tpu.tpu.packing import _PACK_CACHE, pack
 from nos_tpu.tpu.shape import Shape
 from nos_tpu.tpulib import FakeTpuClient
 
+from test_multihost import Clock  # noqa: E402
+
 PROFILES = ["1x1", "1x2", "2x2", "2x4", "4x4", "4x8", "8x8"]
 WEIGHTS = [2.0 ** -i for i in range(len(PROFILES))]
-
-
-class Clock:
-    def __init__(self):
-        self.t = 0.0
-
-    def __call__(self):
-        return self.t
 
 
 def build_single_node_env(n_nodes, topo, n_pods, seed=0):
@@ -137,14 +135,11 @@ def test_control_round_one_256_chip_mesh():
 def test_control_round_v5e_256_slice_group_64_hosts():
     """The north-star shape: one 16x16 slice group of 64 x 2x2 hosts, 100
     pending gangs — one GroupPartitioner round plus both scheduler passes."""
-    import sys
-
-    sys.path.insert(0, "tests")
-    from test_multihost import Clock as MhClock, make_group, submit_gang
+    from test_multihost import make_group, submit_gang
 
     from nos_tpu.system import ControlPlane
 
-    clock = MhClock()
+    clock = Clock()
     plane = ControlPlane(now=clock).start()
     make_group(plane, "s0", global_topo="16x16", host_topo="2x2", grid=(8, 8))
     rng = random.Random(0)
