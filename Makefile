@@ -57,11 +57,15 @@ kind-cluster:
 	kubectl apply -f deploy/crds.yaml
 
 # Component images (reference Makefile docker-build analog; requires docker).
-COMPONENTS := operator scheduler partitioner tpuagent gpuagent telemetry
+# Pure-Python binaries share one parameterized recipe; the tpu-agent image
+# additionally compiles the native tpuslice shim.
+COMPONENTS := operator scheduler partitioner gpu-agent telemetry
 docker-build:
 	for c in $(COMPONENTS); do \
-		docker build -t nos-tpu-$$c:latest -f build/$$c/Dockerfile . || exit 1 ; \
+		docker build -t nos-tpu-$$c:latest \
+			--build-arg COMPONENT=$$c -f build/Dockerfile . || exit 1 ; \
 	done
+	docker build -t nos-tpu-tpuagent:latest -f build/tpuagent/Dockerfile . || exit 1
 
 clean:
 	$(MAKE) -C nos_tpu/tpulib/native clean

@@ -154,7 +154,15 @@ def main(argv: List[str]) -> int:
     args = iter(argv)
     for a in args:
         if a == "--set":
-            k, _, v = next(args).partition("=")
+            try:
+                pair = next(args)
+            except StopIteration:
+                print("error: --set requires a key=value argument", file=sys.stderr)
+                return 2
+            k, sep, v = pair.partition("=")
+            if not sep or not k:
+                print(f"error: --set expects key=value, got {pair!r}", file=sys.stderr)
+                return 2
             overrides[k] = v
         else:
             chart_dir = a

@@ -34,11 +34,24 @@ from nos_tpu.config import (
 from nos_tpu.observability import HealthManager, ObservabilityServer, metrics, setup_logging
 
 
-def _obs(manager_cfg) -> ObservabilityServer:
+def _obs(manager_cfg, in_cluster: bool = False) -> ObservabilityServer:
+    """Serve /metrics /healthz /readyz. In-cluster (kube backend) binds
+    0.0.0.0 on the configured probe port so the chart's kubelet httpGet
+    probes reach the pod IP; local runs keep loopback + ephemeral (with the
+    probe port as a best-effort first choice)."""
     health = HealthManager()
-    server = ObservabilityServer(metrics, health, port=0).start()
+    port = getattr(manager_cfg, "health_probe_port", 0) or 0
+    host = "0.0.0.0" if in_cluster else "127.0.0.1"
+    try:
+        server = ObservabilityServer(metrics, health, port=port, host=host).start()
+    except OSError:
+        server = ObservabilityServer(metrics, health, port=0).start()
     print(f"observability: http://127.0.0.1:{server.port}/metrics /healthz /readyz")
     return server
+
+
+def _in_cluster(args) -> bool:
+    return bool(getattr(args, "kubeconfig", None) or getattr(args, "kube", False))
 
 
 def _make_cluster(args):
@@ -70,14 +83,40 @@ def cmd_operator(args) -> int:
     webhook_registry = getattr(cluster, "webhooks", None)
     if webhook_registry:
         # Kube backend: hooks are enforced via the AdmissionReview server (the
-        # manager's webhook endpoint), not in-process.
+        # manager's webhook endpoint), not in-process. In-cluster this serves
+        # HTTPS on 9443 with the cert-manager secret the chart mounts; with
+        # no cert dir it falls back to loopback HTTP (emulator/dev path).
+        import os as _os
+
         from nos_tpu.cluster.webhook_server import AdmissionWebhookServer
 
-        hooks = AdmissionWebhookServer(webhook_registry).start()
+        cert_dir = args.webhook_cert_dir
+        certfile = _os.path.join(cert_dir, "tls.crt") if cert_dir else None
+        keyfile = _os.path.join(cert_dir, "tls.key") if cert_dir else None
+        if (
+            certfile
+            and keyfile
+            and _os.path.exists(certfile)
+            and _os.path.exists(keyfile)
+        ):
+            hooks = AdmissionWebhookServer(
+                webhook_registry,
+                port=args.webhook_port,
+                host="0.0.0.0",
+                certfile=certfile,
+                keyfile=keyfile,
+            ).start()
+        else:
+            if cert_dir:
+                print(
+                    f"webhook cert dir {cert_dir} lacks tls.crt/tls.key; "
+                    "serving plain HTTP on loopback"
+                )
+            hooks = AdmissionWebhookServer(webhook_registry).start()
         print(f"admission webhooks: {hooks.url}")
     calc = ResourceCalculator(cfg.tpu_chip_memory_gb, cfg.nvidia_gpu_memory_gb)
     QuotaReconciler(cluster, calc).start_watching()
-    _obs(cfg.manager)
+    _obs(cfg.manager, in_cluster=_in_cluster(args))
     print("operator running (quota webhooks + reconcilers); ctrl-c to exit")
     return _wait(args)
 
@@ -88,7 +127,7 @@ def cmd_scheduler(args) -> int:
     from nos_tpu.system import build_scheduler
 
     scheduler = build_scheduler(_make_cluster(args), cfg)
-    _obs(cfg.manager)
+    _obs(cfg.manager, in_cluster=_in_cluster(args))
     print(f"scheduler '{cfg.scheduler_name}' running; ctrl-c to exit")
     while True:
         scheduler.schedule_pending()
@@ -110,7 +149,7 @@ def cmd_partitioner(args) -> int:
     controllers = build_partitioner_controllers(cluster, state, scheduler, cfg)
     for controller in controllers.values():
         controller.start_watching()
-    _obs(cfg.manager)
+    _obs(cfg.manager, in_cluster=_in_cluster(args))
     print(f"partitioner running for modes {cfg.modes}; ctrl-c to exit")
     while True:
         for controller in controllers.values():
@@ -136,7 +175,7 @@ def cmd_tpu_agent(args) -> int:
         host_agent = HostAgent(cluster, node_name)
         host_agent.startup()
         host_agent.start_watching()
-        _obs(cfg.manager)
+        _obs(cfg.manager, in_cluster=_in_cluster(args))
         print(f"tpu host-agent for node {node_name} running; ctrl-c to exit")
         while True:
             host_agent.reconcile()
@@ -151,7 +190,7 @@ def cmd_tpu_agent(args) -> int:
     )
     agent.startup()
     agent.start_watching()
-    _obs(cfg.manager)
+    _obs(cfg.manager, in_cluster=_in_cluster(args))
     print(f"tpu-agent for node {node_name} running; ctrl-c to exit")
     while True:
         agent.report()
@@ -180,7 +219,7 @@ def cmd_gpu_agent(args) -> int:
     )
     agent.startup()
     agent.start_watching()
-    _obs(cfg.manager)
+    _obs(cfg.manager, in_cluster=_in_cluster(args))
     print(f"{args.mode}-agent for node {node_name} running; ctrl-c to exit")
     while True:
         agent.report()
@@ -404,7 +443,14 @@ def main(argv=None) -> int:
             help="use the Kubernetes backend with $KUBECONFIG / in-cluster config",
         )
 
-    common(sub.add_parser("operator"))
+    p_op = sub.add_parser("operator")
+    common(p_op)
+    p_op.add_argument(
+        "--webhook-cert-dir",
+        default=None,
+        help="directory with tls.crt/tls.key for the HTTPS admission webhook",
+    )
+    p_op.add_argument("--webhook-port", type=int, default=9443)
     common(sub.add_parser("scheduler"))
     common(sub.add_parser("partitioner"))
     p_tpu = sub.add_parser("tpu-agent")

@@ -29,7 +29,19 @@ HookRegistry = Dict[str, List[Callable[[str, Any, Optional[Any]], None]]]
 
 
 class AdmissionWebhookServer:
-    def __init__(self, registry: HookRegistry, port: int = 0):
+    def __init__(
+        self,
+        registry: HookRegistry,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+    ):
+        """In-cluster: host='0.0.0.0', port=9443, and certfile/keyfile from
+        the cert-manager-issued secret the chart mounts (a real API server
+        requires HTTPS webhooks; the caBundle comes from
+        cert-manager.io/inject-ca-from). Loopback HTTP is the emulator/test
+        path."""
         self.registry = registry
         server = self
 
@@ -42,14 +54,22 @@ class AdmissionWebhookServer:
             def do_POST(self):  # noqa: N802
                 server._handle(self)
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
+        self._tls = bool(certfile and keyfile)
+        if self._tls:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=certfile, keyfile=keyfile)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket, server_side=True)
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}/validate"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}/validate"
 
     def start(self) -> "AdmissionWebhookServer":
         self._thread = threading.Thread(

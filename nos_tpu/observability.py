@@ -138,7 +138,16 @@ class HealthManager:
 class ObservabilityServer:
     """Serves /metrics, /healthz, /readyz (kube-rbac-proxy-less analog)."""
 
-    def __init__(self, metrics_registry: Metrics, health: HealthManager, port: int = 0):
+    def __init__(
+        self,
+        metrics_registry: Metrics,
+        health: HealthManager,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        """In-cluster deployments bind host='0.0.0.0' on the configured
+        health_probe_port so kubelet httpGet probes can reach the pod IP;
+        tests/demos keep loopback + ephemeral."""
         self.metrics = metrics_registry
         self.health = health
         obs = self
@@ -166,7 +175,7 @@ class ObservabilityServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_port
         self._thread: Optional[threading.Thread] = None
 

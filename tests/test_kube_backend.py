@@ -386,6 +386,68 @@ class TestQuotaReconcilerOverHttp:
         )
 
 
+# -- HTTPS webhook serving (in-cluster TLS path) ------------------------------
+class TestWebhookTls:
+    def test_admission_review_over_https(self, tmp_path):
+        """The in-cluster path: AdmissionWebhookServer serves HTTPS with a
+        cert-manager-style tls.crt/tls.key pair; a review round-trips."""
+        import json
+        import ssl
+        import subprocess
+        import urllib.request
+
+        crt, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", key, "-out", crt, "-days", "1",
+                "-subj", "/CN=localhost",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        from nos_tpu.cluster.serialize import to_wire
+
+        registry = {}
+        kube_like = type("R", (), {"webhooks": registry})()
+        install_quota_webhooks_into(registry)
+        server = AdmissionWebhookServer(registry, certfile=crt, keyfile=key).start()
+        try:
+            assert server.url.startswith("https://")
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": "u1",
+                    "operation": "CREATE",
+                    "object": to_wire(build_eq("ns", "bad", min={"cpu": 8}, max={"cpu": 4})),
+                },
+            }
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            req = urllib.request.Request(
+                server.url,
+                data=json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+                body = json.loads(resp.read())
+            assert body["response"]["allowed"] is False
+            assert "exceeds max" in body["response"]["status"]["message"]
+        finally:
+            server.stop()
+
+
+def install_quota_webhooks_into(registry):
+    """Adapt install_quota_webhooks to a bare registry: validation that needs
+    cluster reads gets an empty in-memory cluster (min/max checks don't)."""
+    backing = Cluster()
+    install_quota_webhooks(backing)
+    registry.update(backing._webhooks)
+
+
 # -- the CLI apiserver command (make cluster backbone) ------------------------
 class TestApiserverCli:
     def test_apiserver_subprocess_with_kubeconfig(self, tmp_path):

@@ -111,6 +111,55 @@ class TestChartRendering:
         cfg = yaml.safe_load(cm["data"]["config.yaml"])
         assert cfg["modes"] == ["tpu", "tpu-multihost", "mig", "mps"]
 
+    def test_rendered_configs_actually_load(self):
+        """Every rendered component ConfigMap must round-trip through the
+        binaries' own strict config loader — parsing the YAML is not enough
+        (a mis-nested key crash-loops the Deployment, not the chart)."""
+        import tempfile
+
+        from nos_tpu.config import (
+            OperatorConfig,
+            PartitionerConfig,
+            SchedulerConfig,
+            load_config,
+        )
+
+        cms = by_kind(
+            rendered_docs(overrides={"partitioner.knownMigGeometries.A30": '[{"1g.6gb": 4}]'}),
+            "ConfigMap",
+        )
+        for name, cls in [
+            ("nos-tpu-operator-config", OperatorConfig),
+            ("nos-tpu-scheduler-config", SchedulerConfig),
+            ("nos-tpu-partitioner-config", PartitionerConfig),
+        ]:
+            with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+                f.write(cms[name]["data"]["config.yaml"])
+                path = f.name
+            cfg = load_config(cls, path)
+            cfg.validate()
+        # the knownMigGeometries knob actually reaches the partitioner config
+        part = cms["nos-tpu-partitioner-config"]["data"]["config.yaml"]
+        assert "A30" in part
+
+    def test_agents_use_the_agent_image(self):
+        """Agent DaemonSets must run the agent image (ships grpcio + the
+        native tpuslice shim); control-plane pods run the slim image."""
+        docs = rendered_docs(overrides={"gpuAgent.enabled": "true"})
+        for name in ("nos-tpu-tpu-agent", "nos-tpu-tpu-host-agent", "nos-tpu-gpu-agent"):
+            ds = by_kind(docs, "DaemonSet")[name]
+            image = ds["spec"]["template"]["spec"]["containers"][0]["image"]
+            assert "nos-tpu-tpuagent" in image, f"{name} runs {image}"
+        dep = by_kind(docs, "Deployment")["nos-tpu-operator"]
+        assert "nos-tpu-tpuagent" not in dep["spec"]["template"]["spec"]["containers"][0]["image"]
+
+    def test_no_webhook_enforcement_gap_without_cert_manager(self):
+        """certManager.enabled=false must drop the ValidatingWebhookConfig
+        entirely — rendering it with failurePolicy Fail and no reachable
+        backend would brick every quota write cluster-wide."""
+        docs = rendered_docs(overrides={"certManager.enabled": "false"})
+        assert not by_kind(docs, "ValidatingWebhookConfiguration")
+
     def test_agent_mounts_pod_resources_socket(self):
         ds = by_kind(rendered_docs(), "DaemonSet")["nos-tpu-tpu-agent"]
         spec = ds["spec"]["template"]["spec"]
@@ -118,7 +167,35 @@ class TestChartRendering:
             v.get("hostPath", {}).get("path") == "/var/lib/kubelet/pod-resources"
             for v in spec["volumes"]
         )
-        assert "--pod-resources-socket" in spec["containers"][0]["command"]
+        container = spec["containers"][0]
+        assert "--pod-resources-socket" in container["command"]
+        # kubelet's pod-resources dir is root-owned 0750
+        assert container["securityContext"]["runAsUser"] == 0
+
+    def test_webhook_has_cert_manager_wiring(self):
+        """A real API server requires HTTPS webhooks: the chart ships a
+        self-signed Issuer + Certificate, injects the caBundle, mounts the
+        secret into the operator, and points it at the cert dir."""
+        docs = rendered_docs()
+        vwc = by_kind(docs, "ValidatingWebhookConfiguration")["nos-tpu-quota-validation"]
+        inject = vwc["metadata"]["annotations"]["cert-manager.io/inject-ca-from"]
+        assert inject == "nos-system/nos-tpu-webhook-cert"
+        assert "nos-tpu-webhook-cert" in by_kind(docs, "Certificate")
+        assert "nos-tpu-selfsigned" in by_kind(docs, "Issuer")
+        dep = by_kind(docs, "Deployment")["nos-tpu-operator"]
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        assert "--webhook-cert-dir" in container["command"]
+        assert any(
+            v.get("secret", {}).get("secretName") == "nos-tpu-webhook-cert"
+            for v in dep["spec"]["template"]["spec"]["volumes"]
+        )
+
+    def test_cert_manager_disable_drops_tls_wiring(self):
+        docs = rendered_docs(overrides={"certManager.enabled": "false"})
+        assert not by_kind(docs, "Certificate")
+        assert not by_kind(docs, "Issuer")
+        dep = by_kind(docs, "Deployment")["nos-tpu-operator"]
+        assert "--webhook-cert-dir" not in dep["spec"]["template"]["spec"]["containers"][0]["command"]
 
 
 class TestRendererSubset:
@@ -142,19 +219,36 @@ class TestRendererSubset:
 
 
 class TestBuildArtifacts:
-    COMPONENTS = ("operator", "scheduler", "partitioner", "tpuagent", "gpuagent", "telemetry")
+    def test_shared_dockerfile_parameterized_per_component(self):
+        """Pure-Python binaries share one ARG-parameterized recipe (they
+        differ only in entrypoint, unlike the reference's per-cmd Go
+        builds); the Makefile builds one image per component from it."""
+        text = (REPO / "build" / "Dockerfile").read_text()
+        assert "ARG COMPONENT" in text
+        assert "ENTRYPOINT" in text
+        assert "USER 65532:65532" in text  # control plane is non-root
+        makefile = (REPO / "Makefile").read_text()
+        for c in ("operator", "scheduler", "partitioner", "gpu-agent", "telemetry"):
+            assert c in makefile
+        assert "--build-arg COMPONENT=" in makefile
+        assert "|| exit 1" in makefile  # per-component failures fail the make
 
-    def test_dockerfile_per_component(self):
-        for c in self.COMPONENTS:
-            path = REPO / "build" / c / "Dockerfile"
-            assert path.exists(), f"missing {path}"
-            text = path.read_text()
-            assert "ENTRYPOINT" in text
-            assert "USER 65532:65532" in text  # non-root, reference parity
+    def test_images_install_declared_dependencies(self):
+        """The images rely on `pip install .` pulling what the binaries
+        import at startup (yaml for configs/kubeconfigs, numpy)."""
+        import tomllib
 
-    def test_tpuagent_builds_native_shim(self):
+        with open(REPO / "pyproject.toml", "rb") as f:
+            project = tomllib.load(f)["project"]
+        deps = " ".join(project["dependencies"])
+        assert "pyyaml" in deps and "numpy" in deps
+        assert "grpcio" in " ".join(project["optional-dependencies"]["kubelet"])
+
+    def test_tpuagent_builds_native_shim_and_runs_root(self):
         text = (REPO / "build" / "tpuagent" / "Dockerfile").read_text()
         assert "tpulib/native" in text and "libtpuslice.so" in text
+        # must traverse kubelet's 0750 pod-resources dir: no USER drop
+        assert "USER 65532" not in text
 
     def test_kind_cluster_config(self):
         with open(REPO / "hack" / "kind" / "cluster.yaml") as f:
