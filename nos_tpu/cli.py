@@ -45,8 +45,13 @@ def _obs(manager_cfg, in_cluster: bool = False) -> ObservabilityServer:
     try:
         server = ObservabilityServer(metrics, health, port=port, host=host).start()
     except OSError:
+        if in_cluster:
+            # Probes target the configured port on the pod IP; silently
+            # moving to loopback-ephemeral would crash-loop the pod with no
+            # clue. Fail loudly instead.
+            raise
         server = ObservabilityServer(metrics, health, port=0).start()
-    print(f"observability: http://127.0.0.1:{server.port}/metrics /healthz /readyz")
+    print(f"observability: http://{host}:{server.port}/metrics /healthz /readyz")
     return server
 
 
@@ -106,12 +111,17 @@ def cmd_operator(args) -> int:
                 certfile=certfile,
                 keyfile=keyfile,
             ).start()
+        elif cert_dir:
+            # The flag was set explicitly: a missing cert is a deployment
+            # error. Falling back to loopback HTTP would leave the webhook
+            # Service with no backend while the pod reports healthy, and
+            # failurePolicy Fail would brick every quota write cluster-wide.
+            print(
+                f"webhook cert dir {cert_dir} lacks tls.crt/tls.key",
+                file=sys.stderr,
+            )
+            return 2
         else:
-            if cert_dir:
-                print(
-                    f"webhook cert dir {cert_dir} lacks tls.crt/tls.key; "
-                    "serving plain HTTP on loopback"
-                )
             hooks = AdmissionWebhookServer(webhook_registry).start()
         print(f"admission webhooks: {hooks.url}")
     calc = ResourceCalculator(cfg.tpu_chip_memory_gb, cfg.nvidia_gpu_memory_gb)

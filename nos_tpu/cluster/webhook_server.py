@@ -47,6 +47,9 @@ class AdmissionWebhookServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Bound per-connection reads: a stalled peer must never wedge a
+            # handler thread forever.
+            timeout = 30
 
             def log_message(self, fmt, *args):  # noqa: N802
                 logger.debug("webhook: " + fmt, *args)
@@ -62,7 +65,13 @@ class AdmissionWebhookServer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile=certfile, keyfile=keyfile)
-            self._httpd.socket = ctx.wrap_socket(self._httpd.socket, server_side=True)
+            # Defer the handshake to the per-connection handler thread: with
+            # do_handshake_on_connect=True it would run inside accept() on
+            # the single serve_forever loop, letting one half-open client
+            # (slow-loris, stalled LB probe) block every admission review.
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True, do_handshake_on_connect=False
+            )
         self._thread: Optional[threading.Thread] = None
 
     @property

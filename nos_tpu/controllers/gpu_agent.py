@@ -19,7 +19,7 @@ from nos_tpu.api import annotations as ann
 from nos_tpu.api.objects import Node
 from nos_tpu.api.resources import compute_pod_request
 from nos_tpu.cluster.client import Cluster, Event, EventType, NotFoundError
-from nos_tpu.controllers.tpu_agent import SharedState, dict_spec
+from nos_tpu.controllers.tpu_agent import SharedState
 from nos_tpu.gpu.mig import MigProfile, geometry_feasible
 from nos_tpu.gpu.mps import MpsGpu, MpsProfile
 from nos_tpu.tpulib.interface import TpuLibError
@@ -159,14 +159,16 @@ class GpuAgent:
         self.report()
 
     def start_watching(self) -> None:
-        def on_node(ev: Event) -> None:
-            if ev.type == EventType.DELETED or ev.obj.metadata.name != self.node_name:
-                return
-            old_spec = dict_spec(ev.old_obj) if ev.old_obj is not None else None
-            if old_spec != dict_spec(ev.obj):
-                self.reconcile()
+        from nos_tpu.util import predicates as pred
 
-        self._unsub = self.cluster.watch("Node", on_node, replay=False)
+        trigger = pred.all_of(
+            pred.exclude_delete,
+            pred.matching_name(self.node_name),
+            pred.spec_annotations_changed,
+        )
+        self._unsub = self.cluster.watch(
+            "Node", pred.filtered(trigger, lambda ev: self.reconcile()), replay=False
+        )
 
     def stop(self) -> None:
         if self._unsub:

@@ -54,11 +54,12 @@ class QuotaReconciler:
                 self.reconcile_all()
 
         def on_pod(ev: Event) -> None:
-            # Only phase transitions to/from Running matter
-            # (elasticquota_controller.go watch predicate :144-163).
-            if ev.type == EventType.MODIFIED and ev.old_obj is not None:
-                if ev.old_obj.status.phase == ev.obj.status.phase:
-                    return
+            # Only phase transitions matter (elasticquota_controller.go watch
+            # predicate :144-163, promoted to util.predicates.phase_changed).
+            from nos_tpu.util import predicates as pred
+
+            if not pred.phase_changed(ev):
+                return
             self.reconcile_namespace(ev.obj.metadata.namespace)
 
         self._unsubs = [

@@ -91,15 +91,16 @@ class TpuAgent:
         self.reconcile()
 
     def start_watching(self) -> None:
-        def on_node(ev: Event) -> None:
-            if ev.type == EventType.DELETED or ev.obj.metadata.name != self.node_name:
-                return
-            old_spec = dict_spec(ev.old_obj) if ev.old_obj is not None else None
-            new_spec = dict_spec(ev.obj)
-            if old_spec != new_spec:
-                self.reconcile()
+        from nos_tpu.util import predicates as pred
 
-        self._unsub = self.cluster.watch("Node", on_node, replay=False)
+        trigger = pred.all_of(
+            pred.exclude_delete,
+            pred.matching_name(self.node_name),
+            pred.spec_annotations_changed,
+        )
+        self._unsub = self.cluster.watch(
+            "Node", pred.filtered(trigger, lambda ev: self.reconcile()), replay=False
+        )
 
     def stop(self) -> None:
         if self._unsub:
@@ -325,12 +326,6 @@ class TpuAgent:
         self.shared.on_report()
 
 
-def dict_spec(node: Optional[Node]) -> Optional[dict]:
-    if node is None:
-        return None
-    return {
-        k: v
-        for k, v in node.metadata.annotations.items()
-        if constants.ANNOTATION_SPEC_REGEX.match(k)
-        or k == constants.ANNOTATION_SPEC_PLAN
-    }
+# The spec-annotation view used by the reconcile trigger lives in
+# nos_tpu.util.predicates (spec_annotations_changed) so every agent shares
+# one definition.

@@ -436,6 +436,20 @@ class TestWebhookTls:
                 body = json.loads(resp.read())
             assert body["response"]["allowed"] is False
             assert "exceeds max" in body["response"]["status"]["message"]
+
+            # A half-open client (TCP connect, no TLS handshake) must not
+            # block the accept loop: reviews keep flowing (the handshake is
+            # deferred to the per-connection handler thread).
+            import socket
+
+            host, port = server._httpd.server_address[:2]
+            loris = socket.create_connection((host, port), timeout=10)
+            try:
+                with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+                    body = json.loads(resp.read())
+                assert body["response"]["allowed"] is False
+            finally:
+                loris.close()
         finally:
             server.stop()
 

@@ -1,0 +1,88 @@
+"""Event-predicate library (reference pkg/util/predicate/predicates.go)."""
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Node, NodeStatus, ObjectMeta, Pod, PodStatus
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster.client import Event, EventType
+from nos_tpu.util import predicates as pred
+
+
+def node(name="n", annotations=None, allocatable=None, capacity=None):
+    return Node(
+        metadata=ObjectMeta(name=name, annotations=dict(annotations or {})),
+        status=NodeStatus(
+            allocatable=ResourceList.of(allocatable or {}),
+            capacity=ResourceList.of(capacity or {}),
+        ),
+    )
+
+
+def modified(new, old):
+    return Event(EventType.MODIFIED, new, old)
+
+
+def test_matching_name():
+    p = pred.matching_name("target")
+    assert p(Event(EventType.ADDED, node("target")))
+    assert not p(Event(EventType.ADDED, node("other")))
+
+
+def test_exclude_delete():
+    assert not pred.exclude_delete(Event(EventType.DELETED, node()))
+    assert pred.exclude_delete(Event(EventType.ADDED, node()))
+    assert pred.exclude_delete(modified(node(), node()))
+
+
+def test_annotations_changed():
+    same = modified(node(annotations={"a": "1"}), node(annotations={"a": "1"}))
+    diff = modified(node(annotations={"a": "2"}), node(annotations={"a": "1"}))
+    assert not pred.annotations_changed(same)
+    assert pred.annotations_changed(diff)
+    # ADDED always passes (initial sync)
+    assert pred.annotations_changed(Event(EventType.ADDED, node()))
+
+
+def test_node_resources_changed():
+    same = modified(node(allocatable={"cpu": 4}), node(allocatable={"cpu": 4}))
+    diff_alloc = modified(node(allocatable={"cpu": 8}), node(allocatable={"cpu": 4}))
+    diff_cap = modified(node(capacity={"cpu": 8}), node(capacity={"cpu": 4}))
+    assert not pred.node_resources_changed(same)
+    assert pred.node_resources_changed(diff_alloc)
+    assert pred.node_resources_changed(diff_cap)
+
+
+def test_spec_annotations_changed_ignores_status_noise():
+    spec_key = f"{constants.DOMAIN}/spec-dev-0-2x2"
+    status_key = f"{constants.DOMAIN}/status-dev-0-2x2-free"
+    old = node(annotations={spec_key: "1", status_key: "0"})
+    status_only = node(annotations={spec_key: "1", status_key: "1"})
+    spec_change = node(annotations={spec_key: "2", status_key: "0"})
+    assert not pred.spec_annotations_changed(modified(status_only, old))
+    assert pred.spec_annotations_changed(modified(spec_change, old))
+    # plan-id flip counts as a spec change
+    with_plan = node(annotations={spec_key: "1", constants.ANNOTATION_SPEC_PLAN: "p1"})
+    assert pred.spec_annotations_changed(modified(with_plan, old))
+
+
+def test_phase_changed():
+    p_old = Pod(metadata=ObjectMeta(name="p"), status=PodStatus(phase="Pending"))
+    p_run = Pod(metadata=ObjectMeta(name="p"), status=PodStatus(phase="Running"))
+    assert pred.phase_changed(modified(p_run, p_old))
+    assert not pred.phase_changed(modified(p_run, p_run))
+    assert pred.phase_changed(Event(EventType.ADDED, p_run))
+    assert pred.phase_changed(Event(EventType.DELETED, p_run))
+
+
+def test_combinators_and_filtered():
+    p = pred.all_of(pred.exclude_delete, pred.matching_name("n"))
+    seen = []
+    handler = pred.filtered(p, seen.append)
+    handler(Event(EventType.ADDED, node("n")))
+    handler(Event(EventType.DELETED, node("n")))
+    handler(Event(EventType.ADDED, node("x")))
+    assert len(seen) == 1
+
+    q = pred.any_of(pred.matching_name("a"), pred.matching_name("b"))
+    assert q(Event(EventType.ADDED, node("a")))
+    assert q(Event(EventType.ADDED, node("b")))
+    assert not q(Event(EventType.ADDED, node("c")))
