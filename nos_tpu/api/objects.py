@@ -184,6 +184,39 @@ class ConfigMap:
 
 
 @dataclass
+class LeaseSpec:
+    """coordination.k8s.io/v1 Lease spec — the leader-election lock object
+    (controller-runtime managers hold one per component; SURVEY §5 config
+    system: leader election)."""
+
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+    KIND = "Lease"
+
+    def deepcopy(self) -> "Lease":
+        return Lease(
+            metadata=self.metadata.deepcopy(),
+            spec=LeaseSpec(
+                holder_identity=self.spec.holder_identity,
+                lease_duration_seconds=self.spec.lease_duration_seconds,
+                acquire_time=self.spec.acquire_time,
+                renew_time=self.spec.renew_time,
+                lease_transitions=self.spec.lease_transitions,
+            ),
+        )
+
+
+@dataclass
 class PodDisruptionBudgetSpec:
     """Exactly one of min_available / max_unavailable is meaningful (k8s
     policy/v1 semantics); selector matches pod labels within the namespace."""

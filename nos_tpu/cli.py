@@ -59,6 +59,37 @@ def _in_cluster(args) -> bool:
     return bool(getattr(args, "kubeconfig", None) or getattr(args, "kube", False))
 
 
+def _maybe_elect(cluster, manager_cfg, component: str):
+    """Leader election gate (controller-runtime manager semantics): with
+    manager.leader_election, block until this replica holds the Lease;
+    losing it later exits the process so the pod restarts and re-campaigns.
+    MUST be called only after the probe/webhook servers are up — standbys
+    still serve /healthz and /readyz while waiting, or rollouts deadlock.
+    A SIGTERM/normal exit releases the lease so the successor does not wait
+    out the full duration. Returns the elector (or None when disabled)."""
+    if not getattr(manager_cfg, "leader_election", False):
+        return None
+    import atexit
+    import os as _os
+    import signal
+
+    from nos_tpu.util.leader import LeaderElector
+
+    namespace = _os.environ.get("POD_NAMESPACE", "nos-system")
+    elector = LeaderElector(
+        cluster,
+        lease_name=f"nos-tpu-{component}",
+        namespace=namespace,
+        on_stopped_leading=lambda: _os._exit(1),
+    ).start()
+    atexit.register(lambda: elector.stop(release=True))
+    signal.signal(signal.SIGTERM, lambda sig, frame: sys.exit(0))
+    print(f"leader election: campaigning for {namespace}/nos-tpu-{component}")
+    elector.wait_for_leadership()
+    print(f"leader election: leading as {elector.identity}")
+    return elector
+
+
 def _make_cluster(args):
     """Pick the control-plane backend: --kubeconfig (or $KUBECONFIG when
     --kube is passed) selects the real-Kubernetes client; default is the
@@ -124,9 +155,12 @@ def cmd_operator(args) -> int:
         else:
             hooks = AdmissionWebhookServer(webhook_registry).start()
         print(f"admission webhooks: {hooks.url}")
+    # Probes + webhooks serve on EVERY replica; only the reconcilers are
+    # gated behind the lease (controller-runtime manager semantics).
+    _obs(cfg.manager, in_cluster=_in_cluster(args))
+    _maybe_elect(cluster, cfg.manager, "operator")
     calc = ResourceCalculator(cfg.tpu_chip_memory_gb, cfg.nvidia_gpu_memory_gb)
     QuotaReconciler(cluster, calc).start_watching()
-    _obs(cfg.manager, in_cluster=_in_cluster(args))
     print("operator running (quota webhooks + reconcilers); ctrl-c to exit")
     return _wait(args)
 
@@ -136,8 +170,10 @@ def cmd_scheduler(args) -> int:
     setup_logging(cfg.manager.log_level)
     from nos_tpu.system import build_scheduler
 
-    scheduler = build_scheduler(_make_cluster(args), cfg)
+    cluster = _make_cluster(args)
+    scheduler = build_scheduler(cluster, cfg)
     _obs(cfg.manager, in_cluster=_in_cluster(args))
+    _maybe_elect(cluster, cfg.manager, "scheduler")
     print(f"scheduler '{cfg.scheduler_name}' running; ctrl-c to exit")
     while True:
         scheduler.schedule_pending()
@@ -153,13 +189,16 @@ def cmd_partitioner(args) -> int:
     from nos_tpu.system import build_partitioner_controllers, build_scheduler
 
     cluster = _make_cluster(args)
+    # Cache mirrors + probe server run on every replica; planning (the
+    # write path) starts only once the lease is held.
     state = ClusterState()
     state.start_watching(cluster)
     scheduler = build_scheduler(cluster)
     controllers = build_partitioner_controllers(cluster, state, scheduler, cfg)
+    _obs(cfg.manager, in_cluster=_in_cluster(args))
+    _maybe_elect(cluster, cfg.manager, "partitioner")
     for controller in controllers.values():
         controller.start_watching()
-    _obs(cfg.manager, in_cluster=_in_cluster(args))
     print(f"partitioner running for modes {cfg.modes}; ctrl-c to exit")
     while True:
         for controller in controllers.values():

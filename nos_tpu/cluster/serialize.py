@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, Optional
 from nos_tpu.api.objects import (
     ConfigMap,
     Container,
+    Lease,
+    LeaseSpec,
     Node,
     NodeStatus,
     ObjectMeta,
@@ -320,6 +322,41 @@ def pdb_from_wire(data: Dict[str, Any]) -> PodDisruptionBudget:
     )
 
 
+def lease_to_wire(lease: Lease) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {}
+    if lease.spec.holder_identity:
+        spec["holderIdentity"] = lease.spec.holder_identity
+    spec["leaseDurationSeconds"] = lease.spec.lease_duration_seconds
+    at = ts_to_wire(lease.spec.acquire_time)
+    if at:
+        spec["acquireTime"] = at
+    rt = ts_to_wire(lease.spec.renew_time)
+    if rt:
+        spec["renewTime"] = rt
+    if lease.spec.lease_transitions:
+        spec["leaseTransitions"] = lease.spec.lease_transitions
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": meta_to_wire(lease.metadata),
+        "spec": spec,
+    }
+
+
+def lease_from_wire(data: Dict[str, Any]) -> Lease:
+    spec_raw = data.get("spec") or {}
+    return Lease(
+        metadata=meta_from_wire(data.get("metadata") or {}),
+        spec=LeaseSpec(
+            holder_identity=spec_raw.get("holderIdentity") or "",
+            lease_duration_seconds=spec_raw.get("leaseDurationSeconds") or 15,
+            acquire_time=ts_from_wire(spec_raw.get("acquireTime")),
+            renew_time=ts_from_wire(spec_raw.get("renewTime")),
+            lease_transitions=spec_raw.get("leaseTransitions") or 0,
+        ),
+    )
+
+
 def eq_to_wire(eq: ElasticQuota) -> Dict[str, Any]:
     spec: Dict[str, Any] = {"min": resources_to_wire(eq.spec.min) or {}}
     if eq.spec.max is not None:
@@ -413,6 +450,10 @@ KINDS: Dict[str, KindInfo] = {
     "PodDisruptionBudget": KindInfo(
         "PodDisruptionBudget", "policy", "v1", "poddisruptionbudgets", True,
         pdb_to_wire, pdb_from_wire, True,
+    ),
+    "Lease": KindInfo(
+        "Lease", "coordination.k8s.io", "v1", "leases", True,
+        lease_to_wire, lease_from_wire,
     ),
     "ElasticQuota": KindInfo(
         "ElasticQuota", "tpu.nos", "v1alpha1", "elasticquotas", True,

@@ -1,0 +1,196 @@
+"""Lease-based leader election (controller-runtime leaderelection analog,
+SURVEY §5 config system). Covers acquisition, mutual exclusion, stale-lease
+takeover, voluntary release, OCC races, loss detection, and the same flow
+over the HTTP kube backend (Lease round-trips the wire codec)."""
+
+import threading
+
+import pytest
+
+from nos_tpu.api.objects import Lease
+from nos_tpu.cluster.client import Cluster
+from nos_tpu.util.leader import LeaderElector
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def elector(cluster, identity, clock, **kw):
+    return LeaderElector(
+        cluster,
+        lease_name="nos-tpu-operator",
+        namespace="nos-system",
+        identity=identity,
+        lease_duration_s=15,
+        now=clock,
+        **kw,
+    )
+
+
+def test_first_elector_acquires_and_renews():
+    cluster, clock = Cluster(), FakeClock()
+    a = elector(cluster, "a", clock)
+    assert a.try_acquire()
+    lease = cluster.get("Lease", "nos-system", "nos-tpu-operator")
+    assert lease.spec.holder_identity == "a"
+    clock.t += 10
+    assert a.try_acquire()  # renew path
+    assert cluster.get("Lease", "nos-system", "nos-tpu-operator").spec.renew_time == clock.t
+
+
+def test_second_elector_blocked_while_lease_fresh():
+    cluster, clock = Cluster(), FakeClock()
+    a, b = elector(cluster, "a", clock), elector(cluster, "b", clock)
+    assert a.try_acquire()
+    clock.t += 10  # inside the 15s lease duration
+    assert not b.try_acquire()
+    assert cluster.get("Lease", "nos-system", "nos-tpu-operator").spec.holder_identity == "a"
+
+
+def test_stale_lease_taken_over_with_transition_count():
+    """Expiry is judged by LOCAL observation: the candidate must itself
+    watch the lease make no renew progress for a full duration before
+    taking over (client-go leaderelection semantics — trusting the remote
+    renewTime would let clock skew steal live leases)."""
+    cluster, clock = Cluster(), FakeClock()
+    a, b = elector(cluster, "a", clock), elector(cluster, "b", clock)
+    assert a.try_acquire()
+    assert not b.try_acquire()  # first sight: starts the local observation
+    clock.t += 20  # a stopped renewing; b has now watched a full duration
+    assert b.try_acquire()
+    lease = cluster.get("Lease", "nos-system", "nos-tpu-operator")
+    assert lease.spec.holder_identity == "b"
+    assert lease.spec.lease_transitions == 1
+    # a's next renew must report the definitive loss
+    assert a._renew() == "lost"
+
+
+def test_remote_clock_skew_cannot_steal_a_live_lease():
+    """The holder's renewTime is far in the candidate's past (holder clock
+    behind), but the holder IS renewing — every renewal resets the
+    candidate's observation, so takeover never fires."""
+    cluster = Cluster()
+    holder_clock, candidate_clock = FakeClock(), FakeClock()
+    candidate_clock.t = holder_clock.t + 120  # two minutes of skew
+    a = elector(cluster, "a", holder_clock)
+    b = elector(cluster, "b", candidate_clock)
+    assert a.try_acquire()
+    for _ in range(6):
+        assert not b.try_acquire(), "skewed candidate stole a live lease"
+        holder_clock.t += 5
+        candidate_clock.t += 5
+        assert a.try_acquire()  # holder keeps renewing
+
+
+def test_transient_renew_errors_tolerated_until_deadline():
+    """One failed renew must NOT drop leadership while the lease is still
+    valid; only errors outlasting the renew deadline do (controller-runtime
+    retries until RenewDeadline)."""
+    cluster, clock = Cluster(), FakeClock()
+    a = elector(cluster, "a", clock)
+    assert a.try_acquire()
+    a._leading.set()
+    a._last_renew_ok = clock()
+
+    real_patch = cluster.patch
+    calls = {"n": 0}
+
+    def flaky_patch(*args, **kw):
+        calls["n"] += 1
+        raise RuntimeError("apiserver blip")
+
+    cluster.patch = flaky_patch
+    clock.t += 5
+    assert a._renew() == "error"
+    # still inside the deadline: leadership holds
+    assert clock() - a._last_renew_ok <= a.lease_duration_s
+    cluster.patch = real_patch
+    assert a._renew() == "ok"  # recovery
+
+
+def test_voluntary_release_enables_immediate_takeover():
+    cluster, clock = Cluster(), FakeClock()
+    a, b = elector(cluster, "a", clock), elector(cluster, "b", clock)
+    assert a.try_acquire()
+    a.release()
+    clock.t += 1  # no wait-out needed
+    assert b.try_acquire()
+
+
+def test_concurrent_takeover_races_pick_one_winner():
+    cluster, clock = Cluster(), FakeClock()
+    holder = elector(cluster, "old", clock)
+    assert holder.try_acquire()
+    racers = [elector(cluster, f"r{i}", clock) for i in range(6)]
+    for e in racers:
+        assert not e.try_acquire()  # everyone observes the live lease once
+    clock.t += 30  # stale for every local observer
+    results = {}
+    barrier = threading.Barrier(len(racers))
+
+    def race(e):
+        barrier.wait()
+        results[e.identity] = e.try_acquire()
+
+    threads = [threading.Thread(target=race, args=(e,)) for e in racers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results.values()) == 1, results
+    winner = next(k for k, v in results.items() if v)
+    assert (
+        cluster.get("Lease", "nos-system", "nos-tpu-operator").spec.holder_identity
+        == winner
+    )
+
+
+def test_campaign_loop_and_loss_callback():
+    cluster, clock = Cluster(), FakeClock()
+    lost = threading.Event()
+    a = elector(
+        cluster, "a", clock, renew_period_s=0.01, retry_period_s=0.01,
+        on_stopped_leading=lost.set,
+    )
+    a.start()
+    try:
+        assert a.wait_for_leadership(timeout=10)
+        # steal the lease out from under it
+        def steal(lease: Lease) -> None:
+            lease.spec.holder_identity = "thief"
+            lease.spec.renew_time = clock() + 1000
+
+        cluster.patch("Lease", "nos-system", "nos-tpu-operator", steal)
+        assert lost.wait(timeout=10), "loss callback never fired"
+        assert not a.is_leader
+    finally:
+        a.stop(release=False)
+
+
+def test_leader_election_over_http_backend():
+    """The same flow through the kube client + apiserver emulator: Lease
+    round-trips the wire codec and the takeover patch uses real merge
+    patches."""
+    from nos_tpu.cluster.apiserver import ClusterAPIServer
+    from nos_tpu.cluster.kube import KubeCluster, KubeConfig
+
+    server = ClusterAPIServer().start()
+    kube = KubeCluster(KubeConfig(server=server.url))
+    try:
+        clock = FakeClock()
+        a, b = elector(kube, "a", clock), elector(kube, "b", clock)
+        assert a.try_acquire()
+        assert not b.try_acquire()  # observation starts
+        clock.t += 20
+        assert b.try_acquire()
+        lease = kube.get("Lease", "nos-system", "nos-tpu-operator")
+        assert lease.spec.holder_identity == "b"
+        assert lease.spec.lease_transitions == 1
+    finally:
+        kube.close()
+        server.stop()

@@ -58,7 +58,13 @@ def _populate(cls):
     return cls(**kwargs)
 
 
-COPYABLE = [objects.Pod, objects.Node, objects.ConfigMap, objects.PodDisruptionBudget]
+COPYABLE = [
+    objects.Pod,
+    objects.Node,
+    objects.ConfigMap,
+    objects.PodDisruptionBudget,
+    objects.Lease,
+]
 
 
 @pytest.mark.parametrize("cls", COPYABLE, ids=lambda c: c.__name__)
