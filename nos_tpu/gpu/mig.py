@@ -266,6 +266,28 @@ class MigGpu:
             if n - self.used.get(p, 0) > 0
         }
 
+    def free_capacity_gb(self) -> float:
+        """Memory not held by running work: uncarved budget + free carved
+        slices (best-fit node-ordering key). The budget comes from the model
+        spec when known, else from the richest allowed-geometry row — alias
+        spellings and set_known_geometries-only models must not report an
+        empty GPU as zero free capacity (that inverts best-fit into carving
+        up empty devices first)."""
+        spec = model_spec(self.model)
+        carved = sum(p.memory_gb * n for p, n in self.geometry.items())
+        if spec is not None:
+            total = float(spec.memory_gb)
+        else:
+            table = allowed_geometries(self.model)
+            if table:
+                total = float(
+                    max(sum(p.memory_gb * n for p, n in row.items()) for row in table)
+                )
+            else:
+                total = float(carved)
+        uncarved = max(0.0, total - carved)
+        return uncarved + sum(p.memory_gb * n for p, n in self.free.items())
+
     def has_free_capacity(self) -> bool:
         if bool(self.free):
             return True

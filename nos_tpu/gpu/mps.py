@@ -96,6 +96,13 @@ class MpsGpu:
     def has_free_capacity(self) -> bool:
         return self.free_gb >= MIN_SLICE_GB or bool(self.free)
 
+    def free_capacity_gb(self) -> float:
+        """Memory not held by running work: unallocated budget + free carved
+        slices (best-fit node-ordering key)."""
+        return float(self.free_gb) + sum(
+            p.memory_gb * n for p, n in self.free.items()
+        )
+
     def clone(self) -> "MpsGpu":
         return MpsGpu(self.memory_gb, self.index, dict(self.geometry), dict(self.used))
 

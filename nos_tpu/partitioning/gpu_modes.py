@@ -167,6 +167,13 @@ class GpuNode:
     def has_free_capacity(self) -> bool:
         return any(gpu.has_free_capacity() for gpu in self.gpus)
 
+    def free_capacity_units(self) -> float:
+        """Memory GB not pinned by running work — uncarved budget plus free
+        carved slices (the best-fit ordering key; a fully-unpartitioned GPU
+        counts its whole budget, so empty devices sort LAST and keep their
+        large regions intact)."""
+        return float(sum(gpu.free_capacity_gb() for gpu in self.gpus))
+
     def clone(self) -> "GpuNode":
         return GpuNode(
             name=self._name,

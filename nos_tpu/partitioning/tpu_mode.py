@@ -190,6 +190,14 @@ class TpuNode:
     def has_free_capacity(self) -> bool:
         return self.mesh.has_free_capacity()
 
+    def free_capacity_units(self) -> float:
+        """Chips not pinned by running work: uncarved chips plus free carved
+        slices (the best-fit ordering key in Snapshot.get_candidate_nodes)."""
+        return float(
+            self.mesh.free_chips
+            + sum(p.chips * n for p, n in self.mesh.free.items())
+        )
+
     def clone(self) -> "TpuNode":
         return TpuNode(
             name=self._name,

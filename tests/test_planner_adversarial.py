@@ -183,6 +183,36 @@ def test_64_node_oversubscribed_sweep_places_exactly_capacity():
     assert all(name.startswith("default/hi-") for name in result.placed)
 
 
+def test_best_fit_orders_by_true_free_capacity_in_every_mode():
+    """An untouched device must sort LAST (its whole budget is free) — a
+    naive resource-name heuristic counted unpartitioned GPUs as zero free
+    units and carved up empty devices before reusing existing free slices."""
+    from nos_tpu.gpu.mig import MigGpu, MigProfile
+    from nos_tpu.partitioning.gpu_modes import GpuNode, MigSliceSpec
+    from nos_tpu.api.resources import ResourceList as RL
+
+    g1 = MigProfile.parse("1g.5gb")
+    # Both the spec-listed spelling AND an alias-only spelling (absent from
+    # KNOWN_MIG_MODELS, resolved through the geometry tables) must order
+    # correctly — the budget lookup may not silently return zero.
+    for model in ("NVIDIA-A100-PCIE-40GB", "NVIDIA-A100-SXM4-40GB"):
+        empty_gpu = MigGpu(model, 0)  # whole 40GB budget free
+        sliced_gpu = MigGpu(model, 0, {g1: 7}, used={g1: 6})  # one free 5GB slice
+        assert empty_gpu.free_capacity_gb() >= 35.0, model
+        node_empty = GpuNode("empty", [empty_gpu], MigProfile.from_resource)
+        node_sliced = GpuNode("sliced", [sliced_gpu], MigProfile.from_resource)
+        snap = Snapshot({"empty": node_empty, "sliced": node_sliced}, MigSliceSpec())
+        order = [n.name for n in snap.get_candidate_nodes()]
+        assert order == ["sliced", "empty"], (model, order)
+
+    # TPU: uncarved chips count too.
+    t_empty = tpu_node("t-empty")  # 16 free chips
+    t_partial = tpu_node("t-partial", geometry={P("2x2"): 3}, used={P("2x2"): 2})
+    snap2 = Snapshot({"t-empty": t_empty, "t-partial": t_partial}, TpuSliceSpec())
+    order2 = [n.name for n in snap2.get_candidate_nodes()]
+    assert order2 == ["t-partial", "t-empty"], order2
+
+
 def test_plan_is_deterministic_across_input_order():
     """The same pod set in a different submission order yields the same
     placements and the same final geometries (canonical sorting)."""
