@@ -290,6 +290,64 @@ class TestKubeWatch:
         assert not any(e.obj.metadata.name == "pre" and e.type == EventType.ADDED for e in events)
 
 
+class TestInformerResilience:
+    def test_informer_reconnects_and_resyncs_after_apiserver_restart(self):
+        """Kill the API server mid-watch, mutate state while it's down, and
+        bring it back on the same port with the same store (etcd survives an
+        apiserver restart): the informer must reconnect, re-list, and
+        synthesize the delta it missed (client-go re-sync semantics)."""
+        backing = Cluster()
+        server = ClusterAPIServer(backing).start()
+        port = server._httpd.server_address[1]
+        kube = KubeCluster(KubeConfig(server=server.url))
+        try:
+            events = []
+            kube.watch("Pod", events.append)
+            backing.create(make_pod("before", node="host-0"))
+            wait_for(
+                lambda: any(e.obj.metadata.name == "before" for e in events),
+                msg="pre-restart event",
+            )
+
+            server.stop()  # watch streams die; informer begins backoff
+            # state moves while the apiserver is down
+            backing.create(make_pod("during", node="host-0"))
+            backing.patch(
+                "Pod", "default", "before",
+                lambda p: setattr(p.status, "phase", PodPhase.SUCCEEDED),
+            )
+
+            server = ClusterAPIServer(backing, port=port).start()
+            wait_for(
+                lambda: any(
+                    e.type == EventType.ADDED and e.obj.metadata.name == "during"
+                    for e in events
+                ),
+                timeout=30,
+                msg="missed-create synthesized after reconnect",
+            )
+            wait_for(
+                lambda: any(
+                    e.type == EventType.MODIFIED
+                    and e.obj.metadata.name == "before"
+                    and e.obj.status.phase == PodPhase.SUCCEEDED
+                    for e in events
+                ),
+                timeout=30,
+                msg="missed-modify synthesized after reconnect",
+            )
+            # and live watching resumes
+            backing.create(make_pod("after", node="host-0"))
+            wait_for(
+                lambda: any(e.obj.metadata.name == "after" for e in events),
+                timeout=30,
+                msg="live events after reconnect",
+            )
+        finally:
+            kube.close()
+            server.stop()
+
+
 # -- admission over AdmissionReview ------------------------------------------
 class TestWebhooksOverHttp:
     @pytest.fixture()
