@@ -171,9 +171,30 @@ class Cluster:
             old = self._store.pop(key, None)
             if old is None:
                 raise NotFoundError(key)
+            # A deletion is a committed write: version-gated pollers must see
+            # it (freed capacity, dropped quotas) or their fast paths starve.
+            self._rv += 1
             self._dispatch_locked(Event(EventType.DELETED, _copy(old)))
 
     # -- read path ---------------------------------------------------------
+    @property
+    def version(self) -> int:
+        """Monotonic store version: bumps on every committed write. Cheap
+        change detection for pollers (scheduler no-op passes, sim ticks) —
+        the in-process analog of a LIST resourceVersion."""
+        with self._lock:
+            return self._rv
+
+    def peek(self, kind: str, namespace: str, name: str, fn: Callable[[Any], Any]) -> Any:
+        """Apply a READ-ONLY extractor to the stored object under the lock,
+        without the value-semantics copy; returns fn(obj), or None when the
+        object does not exist. For hot paths that need a scalar (a phase, a
+        node name) where a full deepcopy per probe dominates. `fn` MUST NOT
+        mutate or retain the object."""
+        with self._lock:
+            obj = self._store.get((kind, namespace, name))
+            return None if obj is None else fn(obj)
+
     def get(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
             obj = self._store.get((kind, namespace, name))

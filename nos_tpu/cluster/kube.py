@@ -248,6 +248,7 @@ class _Informer:
             logger.exception("watch handler failed for %s %s", ev.type, type(ev.obj).__name__)
 
     def _dispatch(self, ev: Event) -> None:
+        self.kube._bump_version()
         with self.lock:
             handlers = [h for h, _ in self.handlers]
         for h in handlers:
@@ -353,6 +354,33 @@ class KubeCluster:
         self._informer_lock = threading.Lock()
         self._local = threading.local()  # persistent per-thread connection
         self.webhooks: Dict[str, List[Callable[[str, Any, Optional[Any]], None]]] = {}
+        self._version_lock = threading.Lock()
+        self._version = 0
+
+    # -- change signal -------------------------------------------------------
+    @property
+    def version(self) -> int:
+        """Protocol parity with the in-memory Cluster's `version`, minus the
+        guarantee: a remote API server mutates underneath us in ways only a
+        full informer set would observe, so there is no sound "nothing
+        changed" signal here. Each read returns a fresh value, so pollers'
+        version fast paths never engage against the real backend (they keep
+        their full recompute semantics); the counter still advances on local
+        writes and informer events for observability."""
+        with self._version_lock:
+            self._version += 1
+            return self._version
+
+    def _bump_version(self) -> None:
+        with self._version_lock:
+            self._version += 1
+
+    def peek(self, kind: str, namespace: str, name: str, fn: Callable[[Any], Any]) -> Any:
+        """Protocol parity with the in-memory Cluster: apply a read-only
+        extractor to the object, or None when absent. Remote reads already
+        materialize a fresh object, so this is try_get + apply."""
+        obj = self.try_get(kind, namespace, name)
+        return None if obj is None else fn(obj)
 
     # -- transport -----------------------------------------------------------
     def _connect(self):
@@ -477,6 +505,7 @@ class KubeCluster:
                     status_wire,
                 )
                 stored = info.from_wire(out)
+        self._bump_version()
         return stored
 
     def update(self, obj: Any) -> Any:
@@ -493,6 +522,7 @@ class KubeCluster:
                 status_wire["status"] = desired_status
                 out = self._request("PUT", path + "/status", status_wire)
                 stored = info.from_wire(out)
+        self._bump_version()
         return stored
 
     def patch(self, kind: str, namespace: str, name: str, fn: Callable[[Any], None]) -> Any:
@@ -534,6 +564,7 @@ class KubeCluster:
                         content_type="application/merge-patch+json",
                     )
                     stored = info.from_wire(out)
+                self._bump_version()
                 return stored
             except ConflictError as e:
                 last_err = e
@@ -543,6 +574,7 @@ class KubeCluster:
     def delete(self, kind: str, namespace: str, name: str) -> None:
         info = self._info(kind)
         self._request("DELETE", info.path_for(namespace, name))
+        self._bump_version()
 
     # -- Cluster protocol: reads --------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> Any:

@@ -62,6 +62,7 @@ class PartitionerController:
         self.resync_s = resync_s
         self.enable_consolidation = enable_consolidation
         self._last_cycle_at = self._now()
+        self._version_at_last_cycle: Optional[int] = None
         self._unsub = None
         self._stop = threading.Event()
 
@@ -109,8 +110,17 @@ class PartitionerController:
                 lagging,
             )
             return False
-        if not self.batcher.drain_if_ready() and not self._resync_due():
-            return False
+        if not self.batcher.drain_if_ready():
+            if not self._resync_due():
+                return False
+            # Resync exists to retry transient refusals (handshake races,
+            # partial applies) — all of which end with some write. With the
+            # store version unchanged since the last cycle, the replan would
+            # recompute the identical no-op plan; skip it.
+            if self.cluster.version == self._version_at_last_cycle:
+                self._last_cycle_at = self._now()
+                return False
+        self._version_at_last_cycle = self.cluster.version
         pods = self.fetch_pending_pods()
         if not pods:
             # Still a completed cycle for resync purposes: without the stamp,

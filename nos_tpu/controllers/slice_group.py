@@ -52,6 +52,7 @@ class GroupPartitioner:
         self.batcher: Batcher[Pod] = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
         self.resync_s = resync_s
         self._last_cycle_at = self._now()
+        self._version_at_last_cycle: Optional[int] = None
         self._unsub = None
         self._stop = threading.Event()
 
@@ -161,8 +162,16 @@ class GroupPartitioner:
     # -- the planning cycle --------------------------------------------------
     def process_batch_if_ready(self) -> bool:
         ready = bool(self.batcher.drain_if_ready())
-        if not ready and not self._resync_due():
-            return False
+        if not ready:
+            if not self._resync_due():
+                return False
+            # Resync retries transient refusals (host-report lag, in-use
+            # pins) — each resolves via some write. Unchanged store version
+            # since the last cycle means the replan is a guaranteed no-op.
+            if self.cluster.version == self._version_at_last_cycle:
+                self._last_cycle_at = self._now()
+                return False
+        self._version_at_last_cycle = self.cluster.version
         pods = self._pods_snapshot()
         items = self.pending_gang_demand(pods)
         groups = self.member_nodes()

@@ -61,6 +61,12 @@ class Scheduler:
         # later pods in the same pass (incl. the preemptor on its nominated
         # node) don't filter against stale occupancy.
         self._pass_nodes: Optional[List[NodeInfo]] = None
+        # No-op fast path: a pass that bound nothing and changed nothing is
+        # pure recomputation — until the cluster mutates, rerunning it yields
+        # the same nothing. Saturated-backlog simulations spend most ticks
+        # exactly there.
+        self._noop_at_version: Optional[int] = None
+        self._capacity_version: Optional[int] = None
 
     # -- cluster views -------------------------------------------------------
     def node_infos(self) -> List[NodeInfo]:
@@ -109,7 +115,10 @@ class Scheduler:
         Node infos are snapshotted ONCE per pass (the kube-scheduler snapshot
         model) and updated incrementally as pods bind — re-listing the cluster
         per pod is O(pods^2 x objects) and dominated saturated-backlog runs."""
-        self.capacity.refresh_from_cluster(self.cluster)
+        version_at_start = self.cluster.version
+        if version_at_start == self._noop_at_version:
+            return {"bound": [], "unschedulable": [], "nominated": [], "skipped": True}
+        self.refresh_capacity()
         bound, unschedulable, nominated = [], [], []
         pending = self.pending_pods()
         self.capacity.nominated_pods = [p for p in pending if p.status.nominated_node_name]
@@ -150,7 +159,18 @@ class Scheduler:
                     unschedulable.append(pod.metadata.namespaced_name)
             else:
                 bound.append((pod.metadata.namespaced_name, result))
+        if not bound and self.cluster.version == version_at_start:
+            self._noop_at_version = version_at_start
         return {"bound": bound, "unschedulable": unschedulable, "nominated": nominated}
+
+    def refresh_capacity(self) -> None:
+        """Rebuild quota infos from the cluster, at most once per store
+        version (reserve/unreserve bookkeeping between refreshes nets out:
+        every committed reservation also bumps the store via its bind)."""
+        version = self.cluster.version
+        if version != self._capacity_version:
+            self.capacity.refresh_from_cluster(self.cluster)
+            self._capacity_version = version
 
     def schedule_one(self, pod: Pod, nodes: Optional[List[NodeInfo]] = None) -> Optional[str]:
         state = CycleState()

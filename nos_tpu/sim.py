@@ -160,6 +160,20 @@ class _TraceRunner:
         used_chip_seconds_busy = 0.0
         used_chip_seconds_window = 0.0
         backlog_seconds = 0.0
+        # Incremental bookkeeping: chips per job (profile parsing is not
+        # free at 10^5 ticks), the standing-backlog set, the completed count,
+        # and the running chip total — all maintained at transition points so
+        # a quiet tick costs O(running), not O(jobs).
+        chips_of = {j.name: self._job_chips(j) for j in jobs}
+        unbound: set = set()
+        completed_count = 0
+        tick_used = 0
+        # Store-version gates: restarts and bind collection react to WRITES
+        # (an eviction deletes pods, a bind patches them). While the store
+        # version is unchanged since the last probe, both are no-ops — the
+        # dominant case in a saturated backlog.
+        preempt_seen = -1
+        bound_seen = -1
 
         while self.clock.t < max_s:
             now = self.clock.t
@@ -168,61 +182,59 @@ class _TraceRunner:
                 job = pending_arrivals.pop(0)
                 self._submit(job)
                 records[job.name].submitted_s = now
+                unbound.add(job.name)
                 last_progress_s = now
             # 2. Restart preempted jobs: an evicted workload's controller
             #    recreates it from scratch (scheduler._evict deletes pods;
             #    for a gang, losing any member kills the whole mesh).
-            for name, rec in list(running.items()):
-                if self._preempted(rec.job):
-                    self._evict_cleanup(rec.job)
-                    rec.preemptions += 1
-                    rec.bound_s = None
-                    rec.node = None
-                    del running[name]
-                    self._submit(rec.job)
-                    rec.submitted_s = now
+            if running and self.plane.cluster.version != preempt_seen:
+                for name, rec in list(running.items()):
+                    if self._preempted(rec.job):
+                        self._evict_cleanup(rec.job)
+                        rec.preemptions += 1
+                        rec.bound_s = None
+                        rec.node = None
+                        del running[name]
+                        tick_used -= chips_of[name]
+                        self._submit(rec.job)
+                        rec.submitted_s = now
+                        unbound.add(name)
+            preempt_seen = self.plane.cluster.version
             # 3. Complete finished jobs.
             for name, rec in list(running.items()):
                 if rec.bound_s is not None and now >= rec.bound_s + rec.job.duration_s:
                     self._complete(rec.job)
                     rec.completed_s = now
                     del running[name]
+                    tick_used -= chips_of[name]
+                    completed_count += 1
                     last_progress_s = now
             # 4. One control round (schedule -> partition -> schedule).
             self.plane.tick()
             # 5. Record new binds.
-            waiting = {
-                name: rec
-                for name, rec in records.items()
-                if rec.submitted_s is not None
-                and rec.bound_s is None
-                and rec.completed_s is None
-            }
-            if waiting:
+            if unbound and self.plane.cluster.version != bound_seen:
+                waiting = {name: records[name] for name in unbound}
                 for name, node in self._collect_bound(waiting).items():
                     rec = records[name]
                     rec.bound_s = now
                     rec.node = node
                     running[name] = rec
+                    tick_used += chips_of[name]
+                    unbound.discard(name)
                     last_progress_s = now
+            bound_seen = self.plane.cluster.version
             # 6. Integrate utilization over this tick. "Busy" ticks are those
             #    with a standing backlog (some submitted job still unbound):
             #    while demand outstrips supply, delivered chip-seconds over
             #    available chip-seconds is the saturation utilization.
-            tick_used = sum(self._job_chips(rec.job) for rec in running.values())
             used_chip_seconds += tick_used * tick_s
-            if any(
-                rec.submitted_s is not None and rec.bound_s is None
-                for rec in records.values()
-            ):
+            if unbound:
                 used_chip_seconds_busy += tick_used * tick_s
                 backlog_seconds += tick_s
             if measure_window and measure_window[0] <= now < measure_window[1]:
                 used_chip_seconds_window += tick_used * tick_s
             # Done once every job has completed.
-            if not pending_arrivals and not running and all(
-                r.completed_s is not None for r in records.values()
-            ):
+            if not pending_arrivals and not running and completed_count == len(records):
                 break
             # Stalled: the cluster is drained, no arrivals remain, and the
             # leftover pending jobs have not bound through several re-plan
@@ -325,7 +337,10 @@ class WorkloadSim(_TraceRunner):
         return _chips_of(job.request)
 
     def _preempted(self, job: SimJob) -> bool:
-        return self.plane.cluster.try_get("Pod", job.namespace, job.name) is None
+        return (
+            self.plane.cluster.peek("Pod", job.namespace, job.name, lambda p: True)
+            is None
+        )
 
     def _evict_cleanup(self, job: SimJob) -> None:
         pass  # the evicted pod is already gone
@@ -473,17 +488,24 @@ class MultiHostSim(_TraceRunner):
     def _job_chips(self, job: GangJob) -> int:
         return Profile.parse(job.topology).chips
 
-    def _members(self, job: GangJob):
+    def _member_states(self, job: GangJob):
+        """(phase, node_name) per member via copy-free peeks — the per-tick
+        probe path must not deep-copy whole gangs."""
         return [
-            self.plane.cluster.try_get("Pod", job.namespace, f"{job.name}-{i}")
+            self.plane.cluster.peek(
+                "Pod",
+                job.namespace,
+                f"{job.name}-{i}",
+                lambda p: (p.status.phase, p.spec.node_name),
+            )
             for i in range(job.hosts)
         ]
 
     def _preempted(self, job: GangJob) -> bool:
-        return any(m is None for m in self._members(job))
+        return any(m is None for m in self._member_states(job))
 
     def _evict_cleanup(self, job: GangJob) -> None:
-        for i, m in enumerate(self._members(job)):
+        for i, m in enumerate(self._member_states(job)):
             if m is not None:
                 try:
                     self.plane.cluster.delete("Pod", job.namespace, f"{job.name}-{i}")
@@ -493,12 +515,11 @@ class MultiHostSim(_TraceRunner):
     def _collect_bound(self, waiting: Dict[str, JobRecord]) -> Dict[str, str]:
         bound: Dict[str, str] = {}
         for name, rec in waiting.items():
-            members = self._members(rec.job)
+            members = self._member_states(rec.job)
             if all(
-                m is not None and m.status.phase == PodPhase.RUNNING
-                for m in members
+                m is not None and m[0] == PodPhase.RUNNING for m in members
             ):
-                bound[name] = members[0].spec.node_name
+                bound[name] = members[0][1]
         return bound
 
     def _submit(self, job: GangJob) -> None:

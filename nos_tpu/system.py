@@ -60,7 +60,7 @@ class SchedulerSim:
         from nos_tpu.scheduler.framework import CycleState
 
         self._state = CycleState()
-        self._scheduler.capacity.refresh_from_cluster(self._scheduler.cluster)
+        self._scheduler.refresh_capacity()
         return self._scheduler.framework.run_pre_filter(self._state, pod).is_success
 
     def filter(self, pod, node_info) -> bool:
@@ -159,6 +159,10 @@ def _pod_resources_lister(socket_path: Optional[str]):
 class ControlPlane:
     """Everything in one process over one cluster bus."""
 
+    # Periodic agent resync bound: reports are re-driven at least every this
+    # many ticks even with no store writes (device state is not store state).
+    AGENT_RESYNC_TICKS = 10
+
     def __init__(
         self,
         cluster: Optional[Cluster] = None,
@@ -201,6 +205,8 @@ class ControlPlane:
         self.monitors: List[DeviceHealthMonitor] = []
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._agents_reconciled_version: Optional[int] = None
+        self._ticks_since_agent_pass = 0
         self.health.add_healthz("cluster", lambda: None)
         self.health.add_readyz("state", lambda: None)
 
@@ -238,14 +244,34 @@ class ControlPlane:
         result = self.scheduler.schedule_pending()
         # Periodic reporter pass (reportConfigIntervalSeconds analog): keeps
         # status annotations in step with pod completions so the planner can
-        # reshape freed slices. No-op patch-free when nothing changed.
-        for agent in self.agents.values():
-            agent.report()
-        # Host agents re-reconcile too: an ack refused while a workload was
-        # still running must retry after it completes (patch-free when
-        # nothing changed).
-        for host_agent in self.host_agents.values():
-            host_agent.reconcile()
+        # reshape freed slices. Gated on store changes: a report/reconcile
+        # retry only ever has new work after some write (a pod completing, a
+        # spec annotation landing), so an unchanged store version means every
+        # agent pass would be a no-op — skip the O(agents) walk.
+        version = self.cluster.version
+        # Device-layer state (agent.client) can change without a store write
+        # — a real tpulib backend losing a slice, say — so the gate alone
+        # would let annotations go stale forever. Force a full pass every
+        # AGENT_RESYNC_TICKS rounds (the reportConfigIntervalSeconds analog),
+        # bounding staleness while keeping quiet ticks cheap.
+        self._ticks_since_agent_pass += 1
+        if (
+            version != self._agents_reconciled_version
+            or self._ticks_since_agent_pass >= self.AGENT_RESYNC_TICKS
+        ):
+            self._ticks_since_agent_pass = 0
+            for agent in self.agents.values():
+                agent.report()
+            # Host agents re-reconcile too: an ack refused while a workload
+            # was still running must retry after it completes (patch-free
+            # when nothing changed).
+            for host_agent in self.host_agents.values():
+                host_agent.reconcile()
+            # Stamp the PRE-pass version: a concurrent write landing during
+            # the walk (e.g. a health monitor thread) must not be absorbed
+            # into the stamp, or the agents would never process it. The
+            # agents' own writes cost exactly one extra (patch-free) pass.
+            self._agents_reconciled_version = version
         for controller in self.partitioners.values():
             if controller.process_batch_if_ready():
                 metrics.inc("nos_tpu_partitioning_cycles", kind=controller.kind)
