@@ -419,9 +419,8 @@ def _simulate_multihost(args) -> int:
     """Multi-host variant: one slice group carved by the GroupPartitioner,
     consumed by gang workloads (the north star at its true shape)."""
     import json
-    import math
 
-    from nos_tpu.sim import MultiHostSim, mixed_gang_workload
+    from nos_tpu.sim import MultiHostSim, mixed_gang_workload, multihost_shape_ladder
     from nos_tpu.tpu.shape import Shape
 
     global_shape = Shape.parse(args.topology)
@@ -440,21 +439,10 @@ def _simulate_multihost(args) -> int:
         groups={"slice-0": (args.topology, args.host_topology, grid)},
         generation_label=args.generation,
     )
-    # Gang mix: host-aligned sub-slice shapes up to the full mesh.
-    shapes = []
-    d = list(host_shape.dims)
-    w = 1.0
-    while all(x <= g for x, g in zip(d, global_shape.dims)):
-        hosts = math.prod(x // h for x, h in zip(d, host_shape.dims))
-        shapes.append(("x".join(map(str, d)), hosts, w))
-        # Grow the smaller axis first (2x2 -> 2x4 -> 4x4 -> 4x8 ...).
-        i = min(range(len(d)), key=lambda j: d[j])
-        d = [x * 2 if j == i else x for j, x in enumerate(d)]
-        w /= 2
     jobs = mixed_gang_workload(
         args.jobs,
         seed=args.seed,
-        shapes=tuple(shapes),
+        shapes=multihost_shape_ladder(args.topology, args.host_topology),
         mean_interarrival_s=args.interarrival,
         duration_range_s=(args.min_duration, args.max_duration),
     )

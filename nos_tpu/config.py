@@ -96,10 +96,26 @@ class SchedulerConfig:
     scheduler_name: str = constants.SCHEDULER_NAME
     tpu_chip_memory_gb: float = constants.DEFAULT_TPU_CHIP_MEMORY_GB
     nvidia_gpu_memory_gb: float = constants.DEFAULT_GPU_MEMORY_GB
+    # Drain-set backfill reservations (see scheduler.Scheduler): arm only
+    # for units at least this fraction of the cluster's chips; None disables
+    # arming entirely.
+    backfill_min_fraction: Optional[float] = 0.9
+    backfill_after_s: float = 30.0
+    backfill_bypass_factor: float = 2.0
 
     def validate(self) -> None:
         if not self.scheduler_name:
             raise ConfigError("scheduler_name must be non-empty")
+        if self.backfill_min_fraction is not None and not (
+            0.0 < self.backfill_min_fraction
+        ):
+            raise ConfigError("backfill_min_fraction must be positive")
+        if self.backfill_after_s < 0:
+            raise ConfigError("backfill_after_s must be >= 0")
+        if self.backfill_bypass_factor <= 0:
+            # A non-positive factor would arm on age alone — the time-based
+            # arming the bypass gate exists to prevent.
+            raise ConfigError("backfill_bypass_factor must be positive")
 
 
 def _from_dict(cls, data: dict):

@@ -114,6 +114,15 @@ ANNOTATION_STATUS_SUBSLICE_TOPOLOGY = f"{DOMAIN}/status-subslice-topology"
 # where NVML owns MIG placement and counts suffice (SURVEY.md §7 hard parts).
 ANNOTATION_STATUS_LAYOUT = f"{DOMAIN}/status-slice-layout"
 
+# Duration-aware backfill protocol (no reference analog — the reference
+# schedules opaque pods with no temporal model; on a TPU mesh the all-large
+# drain tails it tolerates idle whole pods, see docs/dynamic-partitioning.md):
+# workloads MAY declare an expected runtime (Slurm-timelimit style); the
+# scheduler stamps bind time and uses both to reserve capacity for the head
+# blocked workload while letting provably-harmless smaller work backfill.
+ANNOTATION_EXPECTED_DURATION = f"{DOMAIN}/expected-duration-seconds"
+ANNOTATION_BOUND_AT = f"{DOMAIN}/bound-at"
+
 ANNOTATION_SPEC_REGEX = re.compile(
     rf"^{re.escape(ANNOTATION_SPEC_PREFIX)}(\d+)-(.+)$"
 )

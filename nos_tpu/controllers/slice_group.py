@@ -113,7 +113,20 @@ class GroupPartitioner:
                 continue
             gangs.setdefault(gang, []).append(pod)
         items: List[dict] = []
-        for gang, pods in sorted(gangs.items()):
+        # Carve in the SCHEDULER'S bind order (priority desc, oldest first) —
+        # not name order: if the carve choice disagrees with bind order, the
+        # planner can cover the grid with a lower-priority gang's sub-slice
+        # that the scheduler will never bind first, deadlocking the queue
+        # behind a backfill reservation.
+        def _order(entry):
+            gang, pods = entry
+            return (
+                -max(p.spec.priority for p in pods),
+                min(p.metadata.creation_timestamp for p in pods),
+                gang,
+            )
+
+        for gang, pods in sorted(gangs.items(), key=_order):
             size = gang_size_of(pods[0])
             if len(pods) < size:
                 continue  # incomplete gang: wait for all members

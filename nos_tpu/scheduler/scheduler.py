@@ -10,7 +10,9 @@ victims and nominate a node.
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+import time as _time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
 
 from nos_tpu import constants
 from nos_tpu.api.objects import Node, Pod, PodCondition, PodPhase
@@ -20,6 +22,7 @@ from nos_tpu.partitioning.core.interface import NodeInfo
 from nos_tpu.scheduler.framework import CycleState, Framework, Status
 from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
 from nos_tpu.scheduler.plugins.noderesources import (
+    EndAlignedScore,
     LeastAllocatedScore,
     NodeResourcesFit,
     NodeSelectorFilter,
@@ -31,6 +34,32 @@ from nos_tpu.util import pod as podutil
 logger = logging.getLogger(__name__)
 
 
+from nos_tpu.tpu.profile import chips_of_resources as _tpu_chips
+
+
+@dataclass
+class _Reservation:
+    """Drain-set backfill reservation for the head capacity-blocked unit.
+
+    The reference has no temporal model at all — an unschedulable pod just
+    waits (SURVEY.md §2.3), which on a TPU mesh lets small late arrivals
+    starve pod-scale gangs into an all-large drain tail that idles whole
+    sub-meshes. One reservation per pass bounds that. `protected` is the
+    cheapest node set whose drain covers the holder's chips (earliest
+    drain-complete first, from the running pods' bound-at +
+    expected-duration stamps) and `start_at` is when that drain completes.
+    A later unit schedules normally EXCEPT it may not take capacity on a
+    protected node unless it provably completes before `start_at` — so
+    work keeps flowing everywhere else (consolidation victims rebind, small
+    gangs fill the remainder) while the drain the holder needs actually
+    converges."""
+
+    holder: str
+    chips: float
+    start_at: float
+    protected: frozenset
+
+
 class Scheduler:
     def __init__(
         self,
@@ -38,8 +67,42 @@ class Scheduler:
         calculator: Optional[ResourceCalculator] = None,
         scheduler_name: str = constants.SCHEDULER_NAME,
         bind_starts_pods: bool = True,
+        now=None,
+        backfill_min_fraction: Optional[float] = 0.9,
+        backfill_after_s: float = 30.0,
+        backfill_bypass_factor: float = 2.0,
     ):
         self.cluster = cluster
+        self._now = now if now is not None else _time.time
+        # Drain-set reservations (None = never arm) default to arming only
+        # for near-whole-cluster units (>= 0.9): smaller units churn through
+        # free capacity, and reserving for them during saturation idles more
+        # chips than their tail wait costs (docs/dynamic-partitioning.md has
+        # the measurement matrix); a full-mesh gang, by contrast, can starve
+        # INDEFINITELY behind a stream of smaller gangs — nothing short of a
+        # reservation ever drains the whole mesh for it.
+        # When enabled: only units at least `backfill_min_fraction` of the
+        # cluster's chips, pending at least `backfill_after_s`, AND provably
+        # starving arm one. Starvation is MEASURED, not timed: a unit arms
+        # only after `backfill_bypass_factor` x its own chips have bound past
+        # it while it sat blocked. Time-based arming can't discriminate the
+        # two tail regimes (measured on the north-star trace): a stuck
+        # full-mesh gang watching an endless 8x8 stream (arm: +21 points
+        # busy-window) vs one whose supply dries up so the mesh drains
+        # naturally anyway (arming there forces a pointless mid-run drain,
+        # -7 points).
+        self.backfill_min_fraction = backfill_min_fraction
+        self.backfill_after_s = backfill_after_s
+        self.backfill_bypass_factor = backfill_bypass_factor
+        self._bypassed: dict = {}  # blocked unit name -> chips bound past it
+        # Sticky drain set: re-picking the cheapest block every pass lets the
+        # target drift as backfill lands, so no block ever finishes draining.
+        # The holder keeps its block until it binds or vanishes. The sort key
+        # scopes enforcement: only units RANKED BELOW the holder are gated.
+        self._sticky_holder: Optional[str] = None
+        self._sticky_protected: Optional[frozenset] = None
+        self._sticky_chips: float = 0.0
+        self._sticky_key: Optional[tuple] = None
         self.calculator = calculator or ResourceCalculator()
         self.scheduler_name = scheduler_name
         self.bind_starts_pods = bind_starts_pods
@@ -51,7 +114,11 @@ class Scheduler:
                 NodeResourcesFit(self.calculator.compute_pod_request),
                 TpuTopologyFilter(),
             ],
-            scores=[LeastAllocatedScore(), TpuTopologyScore()],
+            scores=[
+                LeastAllocatedScore(),
+                TpuTopologyScore(),
+                EndAlignedScore(self._now),
+            ],
             reserves=[self.capacity],
             post_filters=[self.capacity],
             request_fn=self.calculator.compute_pod_request,
@@ -66,6 +133,11 @@ class Scheduler:
         # the same nothing. Saturated-backlog simulations spend most ticks
         # exactly there.
         self._noop_at_version: Optional[int] = None
+        # Aging makes scheduling time-driven, not just store-driven: a
+        # capacity-blocked pod-scale unit arms a reservation once it is old
+        # enough, with no store write involved. A recorded no-op pass
+        # therefore expires when the youngest such candidate comes of age.
+        self._noop_until: float = float("inf")
         self._capacity_version: Optional[int] = None
 
     # -- cluster views -------------------------------------------------------
@@ -116,7 +188,7 @@ class Scheduler:
         model) and updated incrementally as pods bind — re-listing the cluster
         per pod is O(pods^2 x objects) and dominated saturated-backlog runs."""
         version_at_start = self.cluster.version
-        if version_at_start == self._noop_at_version:
+        if version_at_start == self._noop_at_version and self._now() < self._noop_until:
             return {"bound": [], "unschedulable": [], "nominated": [], "skipped": True}
         self.refresh_capacity()
         bound, unschedulable, nominated = [], [], []
@@ -143,24 +215,118 @@ class Scheduler:
                 for p in pods
             )
             units.append(best + ("gang", (gang_name, pods)))
-        for *_, kind, item in sorted(units, key=lambda u: u[:3]):
+        # A live sticky reservation protects its drain set for the WHOLE
+        # pass — seeded up front so units sorting ahead of the holder cannot
+        # refill the protected nodes every pass and re-starve it. Rank still
+        # wins: only units sorting BELOW the holder are gated.
+        reservation: Optional[_Reservation] = self._refresh_sticky(nodes)
+        next_arm_at: Optional[float] = None
+        sticky_seen = False
+        failed_large: List[Tuple[str, float]] = []  # blocked this pass
+        pass_bound_chips = 0.0
+        total_chips = sum(_tpu_chips(n.allocatable) for n in nodes)
+        for unit in sorted(units, key=lambda u: u[:3]):
+            unit_key, kind, item = unit[:3], unit[3], unit[4]
+            unit_pods = [item] if kind == "pod" else item[1]
+            unit_name = (
+                item.metadata.namespaced_name if kind == "pod" else item[0]
+            )
+            unit_chips = sum(
+                _tpu_chips(self.calculator.compute_pod_request(p))
+                for p in unit_pods
+            )
+            unit_nodes = nodes
+            if (
+                reservation is not None
+                and unit_chips > 0
+                and (self._sticky_key is None or unit_key > self._sticky_key)
+            ):
+                if not self._finishes_before(unit_pods, reservation.start_at):
+                    # May not take capacity the holder's drain is producing:
+                    # schedule against the unprotected remainder only.
+                    unit_nodes = [
+                        n for n in nodes if n.name not in reservation.protected
+                    ]
             if kind == "gang":
                 gang_name, pods = item
-                g_bound, g_unsched = self._schedule_gangs({gang_name: pods}, nodes)
+                g_bound, g_unsched, capacity_blocked = self._schedule_gangs(
+                    {gang_name: pods}, unit_nodes
+                )
                 bound.extend(g_bound)
                 unschedulable.extend(g_unsched)
-                continue
-            pod = item
-            result = self.schedule_one(pod, nodes)
-            if result is None:
-                if pod.status.nominated_node_name:
-                    nominated.append(pod.metadata.namespaced_name)
-                else:
-                    unschedulable.append(pod.metadata.namespaced_name)
+                unit_ok = bool(g_bound)
             else:
-                bound.append((pod.metadata.namespaced_name, result))
+                pod = item
+                result = self.schedule_one(pod, unit_nodes)
+                if result is None:
+                    if pod.status.nominated_node_name:
+                        nominated.append(pod.metadata.namespaced_name)
+                        capacity_blocked = False  # preemption will free room
+                    else:
+                        unschedulable.append(pod.metadata.namespaced_name)
+                        capacity_blocked = True
+                    unit_ok = False
+                else:
+                    bound.append((pod.metadata.namespaced_name, result))
+                    unit_ok = True
+            if unit_name == self._sticky_holder:
+                sticky_seen = True
+                if unit_ok:
+                    self._clear_sticky()
+                    reservation = None
+                    sticky_seen = False
+            if unit_ok:
+                if unit_chips > 0:
+                    pass_bound_chips += unit_chips
+            elif (
+                capacity_blocked
+                and self.backfill_min_fraction is not None
+                and total_chips > 0
+                and unit_chips >= self.backfill_min_fraction * total_chips
+            ):
+                bypassed = self._bypassed.setdefault(unit_name, 0.0)
+                failed_large.append((unit_name, unit_chips))
+                if (
+                    reservation is None
+                    and bypassed >= self.backfill_bypass_factor * unit_chips
+                ):
+                    arm_at = (
+                        min(p.metadata.creation_timestamp for p in unit_pods)
+                        + self.backfill_after_s
+                    )
+                    if self._now() >= arm_at:
+                        reservation = self._try_reserve(
+                            nodes, unit_pods, unit_name, unit_chips
+                        )
+                        if reservation is not None:
+                            self._sticky_holder = unit_name
+                            self._sticky_protected = reservation.protected
+                            self._sticky_chips = unit_chips
+                            self._sticky_key = unit_key
+                            # Just armed: the pass-end stale-holder sweep
+                            # must not clear it (the holder was processed
+                            # before the sticky name existed).
+                            sticky_seen = True
+                    elif next_arm_at is None or arm_at < next_arm_at:
+                        next_arm_at = arm_at  # too young: expires the no-op
+        # Measured starvation: every chip bound in a pass where a pod-scale
+        # unit stayed blocked counts against it — including binds of units
+        # ahead of it in pass order (an old small-gang stream draining down
+        # the queue starves a younger full-mesh gang just as surely).
+        still_blocked = {name for name, _ in failed_large}
+        if pass_bound_chips > 0:
+            for name in still_blocked:
+                self._bypassed[name] += pass_bound_chips
+        self._bypassed = {
+            n: v for n, v in self._bypassed.items() if n in still_blocked
+        }
+        if not sticky_seen and self._sticky_holder is not None:
+            # The holder left the pending queue without binding through this
+            # scheduler (deleted, or bound elsewhere): release its drain set.
+            self._clear_sticky()
         if not bound and self.cluster.version == version_at_start:
             self._noop_at_version = version_at_start
+            self._noop_until = next_arm_at if next_arm_at is not None else float("inf")
         return {"bound": bound, "unschedulable": unschedulable, "nominated": nominated}
 
     def refresh_capacity(self) -> None:
@@ -217,6 +383,201 @@ class Scheduler:
         best.pods.append(pod)
         return best.name
 
+    # -- duration-aware backfill (drain-set reservation) ---------------------
+    def _clear_sticky(self) -> None:
+        self._sticky_holder = None
+        self._sticky_protected = None
+        self._sticky_chips = 0.0
+        self._sticky_key = None
+
+    def _drain_time(self, node: NodeInfo, now: float) -> Optional[float]:
+        """When this node's TPU occupancy fully drains per the bound-at +
+        expected-duration stamps; None when any occupant is unknown."""
+        drain_at = now
+        for p in node.pods:
+            if _tpu_chips(self.calculator.compute_pod_request(p)) <= 0:
+                continue
+            end = podutil.expected_end_s(p)
+            if end is None:
+                return None
+            drain_at = max(drain_at, end)
+        return drain_at
+
+    def _refresh_sticky(self, nodes: List[NodeInfo]) -> Optional[_Reservation]:
+        """Rebuild the live reservation from the sticky drain set with a
+        fresh drain-complete estimate; clears the sticky state (and returns
+        None) if the set became unusable — a protected node gone, or an
+        unknown-duration occupant landed on it."""
+        if not self._sticky_holder or not self._sticky_protected:
+            return None
+        now = self._now()
+        by_name = {n.name: n for n in nodes}
+        start_at = now
+        for name in self._sticky_protected:
+            node = by_name.get(name)
+            drain_at = self._drain_time(node, now) if node is not None else None
+            if drain_at is None:
+                self._clear_sticky()
+                return None
+            start_at = max(start_at, drain_at)
+        return _Reservation(
+            holder=self._sticky_holder,
+            chips=self._sticky_chips,
+            start_at=start_at,
+            protected=self._sticky_protected,
+        )
+
+    def _finishes_before(self, pods: List[Pod], deadline: float) -> bool:
+        """True iff every member carries an expected duration and the unit
+        would provably complete before `deadline` if bound now. Unknown
+        durations could run forever — never admit them onto a drain."""
+        durations = [podutil.expected_duration_s(p) for p in pods]
+        if any(d is None for d in durations):
+            return False
+        return self._now() + max(durations) <= deadline + 1e-9
+
+    def _try_reserve(
+        self,
+        nodes: List[NodeInfo],
+        pods: List[Pod],
+        unit_name: str,
+        unit_chips: float,
+    ) -> Optional[_Reservation]:
+        """Pick the holder's drain set: nodes in earliest-drain-complete
+        order (a node's drain time = the latest expected end among its TPU
+        pods; free capacity counts immediately) until their combined chip
+        capacity covers the holder. Returns None when the unit is not
+        genuinely capacity-blocked (quota rejects it, it can never fit) or
+        unknown-duration occupancy makes every estimate undefined — backfill
+        then stays unrestricted (the pre-reservation behavior). The estimate
+        is count-level per node and deliberately optimistic about carve
+        geometry: an early `start_at` only makes backfill MORE conservative,
+        so fragmentation can delay the holder but never re-starve it."""
+        state = CycleState()
+        if not self.framework.run_pre_filter(state, pods[0]).is_success:
+            return None
+        now = self._now()
+        drain_of: dict = {}  # node name -> drain-complete time (absent: unknown)
+        cap_of: dict = {}
+        for node in nodes:
+            cap = _tpu_chips(node.allocatable)
+            if cap <= 0:
+                continue
+            cap_of[node.name] = cap
+            drain_at = self._drain_time(node, now)
+            if drain_at is not None:
+                drain_of[node.name] = drain_at
+        profile = podutil.wanted_subslice_topology(pods[0])
+        if profile is not None:
+            if podutil.multislice_count(pods[0]) > 1:
+                return None  # N-group spread: no single drain set to protect
+            choice = self._cheapest_gang_block(nodes, profile, drain_of, now)
+        else:
+            # Single-node workload (a profile or whole-chip request carves
+            # within one node's mesh): the earliest-draining node that alone
+            # covers it. A scattered multi-node set would protect capacity
+            # the holder can never combine.
+            candidates = [
+                (drain_of[n.name], n.name)
+                for n in nodes
+                if n.name in drain_of and cap_of.get(n.name, 0.0) >= unit_chips
+            ]
+            if not candidates:
+                return None
+            drain_at, name = min(candidates)
+            choice = (frozenset([name]), max(drain_at, now))
+        if choice is None:
+            return None
+        protected, start_at = choice
+        logger.info(
+            "backfill reservation: %s needs %g chips; draining %d node(s) "
+            "until t=%.0f",
+            unit_name,
+            unit_chips,
+            len(protected),
+            start_at,
+        )
+        return _Reservation(
+            holder=unit_name,
+            chips=unit_chips,
+            start_at=start_at,
+            protected=frozenset(protected),
+        )
+
+    @staticmethod
+    def _cheapest_gang_block(
+        nodes: List[NodeInfo], profile, drain_of: dict, now: float
+    ):
+        """The gang analog of "earliest-draining node": among every legal
+        placement of the gang's host-block footprint on each slice group's
+        host grid (the same host-aligned orientation rule the
+        GroupPartitioner packs with), pick the window whose occupants drain
+        soonest. Protecting anything non-contiguous would idle hosts the
+        holder can never combine into one ICI mesh. Returns (host names,
+        drain-complete time) or None."""
+        import itertools
+
+        from nos_tpu import constants as C
+        from nos_tpu.tpu.shape import Shape
+        from nos_tpu.tpu.slice_group import parse_host_coord
+
+        by_group: dict = {}
+        for n in nodes:
+            sid = n.labels.get(C.LABEL_TPU_SLICE)
+            raw_coord = n.labels.get(C.LABEL_TPU_HOST_COORD)
+            host_topo = n.labels.get(C.LABEL_TPU_HOST_TOPOLOGY)
+            if not sid or raw_coord is None or not host_topo:
+                continue
+            try:
+                coord = parse_host_coord(raw_coord)
+            except ValueError:
+                continue
+            group = by_group.setdefault(sid, {"hosts": {}, "host_topo": host_topo})
+            group["hosts"][coord] = n.name
+        best = None
+        for group in by_group.values():
+            try:
+                host_shape = Shape.parse(group["host_topo"])
+            except ValueError:
+                continue
+            coords = group["hosts"]
+            rank = host_shape.rank
+            if any(len(c) != rank for c in coords):
+                continue
+            # Host-aligned orientations of the chip profile (the planner's
+            # congruence rule, slice_group.py plan_subslices).
+            allowed = set()
+            for o in profile.shape.orientations():
+                if len(o.dims) == rank and all(
+                    c % h == 0 for c, h in zip(o.dims, host_shape.dims)
+                ):
+                    allowed.add(
+                        tuple(c // h for c, h in zip(o.dims, host_shape.dims))
+                    )
+            if not allowed or not coords:
+                continue
+            grid = tuple(max(c[i] for c in coords) + 1 for i in range(rank))
+            for dims in allowed:
+                if any(d > g for d, g in zip(dims, grid)):
+                    continue
+                # Buddy-aligned origins only, matching the planner's
+                # pack_into(align=True): protecting a window the carve can
+                # never land on would pin hosts the holder cannot use.
+                for origin in itertools.product(
+                    *(range(0, g - d + 1, d) for g, d in zip(grid, dims))
+                ):
+                    window = [
+                        tuple(o + i for o, i in zip(origin, offset))
+                        for offset in itertools.product(*(range(d) for d in dims))
+                    ]
+                    names = [coords.get(c) for c in window]
+                    if any(n is None or n not in drain_of for n in names):
+                        continue  # hole in the grid / unknown occupancy
+                    drain_at = max(max(drain_of[n] for n in names), now)
+                    if best is None or drain_at < best[1]:
+                        best = (frozenset(names), drain_at)
+        return best
+
     # -- gang scheduling (multi-host workloads) ------------------------------
     def _schedule_gangs(self, gangs: dict, nodes: List[NodeInfo]):
         """All-or-nothing binding of complete gangs onto ONE carved sub-slice:
@@ -224,8 +585,12 @@ class Scheduler:
         subslice-id label. A multi-host JAX job is a single ICI mesh; pods
         scattered across different sub-slices (which plain per-pod scheduling
         would happily do, since every host of the right topology matches the
-        node selector) would not be connected."""
+        node selector) would not be connected. The third return value reports
+        whether any gang failed for CAPACITY (placement) reasons — the signal
+        that arms a backfill reservation; membership/label misconfigurations
+        must not (more chips would not help them)."""
         bound, unschedulable = [], []
+        capacity_blocked = False
         for gang_name in sorted(gangs):
             pods = sorted(gangs[gang_name], key=lambda p: p.metadata.name)
             size = podutil.gang_size_of(pods[0])
@@ -257,6 +622,7 @@ class Scheduler:
                 continue
             placed = self._try_place_gang(gang_name, pods, nodes)
             if placed is None:
+                capacity_blocked = True
                 for pod in pods:
                     self._mark_unschedulable(
                         pod,
@@ -267,7 +633,7 @@ class Scheduler:
                     unschedulable.append(pod.metadata.namespaced_name)
             else:
                 bound.extend(placed)
-        return bound, unschedulable
+        return bound, unschedulable, capacity_blocked
 
     def _try_place_gang(
         self, gang_name: str, pods: List[Pod], nodes: List[NodeInfo]
@@ -468,8 +834,13 @@ class Scheduler:
 
     # -- cluster mutations ---------------------------------------------------
     def _bind(self, pod: Pod, node_name: str) -> None:
+        bound_at = self._now()
+
         def mutate(p: Pod) -> None:
             p.spec.node_name = node_name
+            # Temporal stamp for duration-aware backfill: with the pod's
+            # expected-duration annotation this yields its estimated end.
+            p.metadata.annotations[constants.ANNOTATION_BOUND_AT] = f"{bound_at:.3f}"
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"
             ]

@@ -110,14 +110,9 @@ class SimReport:
 
 
 def _chips_of(request: Dict[str, float]) -> int:
-    chips = 0
-    for res, qty in request.items():
-        profile = Profile.from_resource(res)
-        if profile is not None:
-            chips += profile.chips * int(qty)
-        elif res == constants.RESOURCE_TPU:
-            chips += int(qty)
-    return chips
+    from nos_tpu.tpu.profile import chips_of_resources
+
+    return int(chips_of_resources(request))
 
 
 def _percentile(values: Sequence[float], q: float) -> float:
@@ -362,7 +357,13 @@ class WorkloadSim(_TraceRunner):
     def _submit(self, job: SimJob) -> None:
         self.plane.cluster.create(
             Pod(
-                metadata=ObjectMeta(name=job.name, namespace=job.namespace),
+                metadata=ObjectMeta(
+                    name=job.name,
+                    namespace=job.namespace,
+                    annotations={
+                        constants.ANNOTATION_EXPECTED_DURATION: f"{job.duration_s:.0f}"
+                    },
+                ),
                 spec=PodSpec(
                     containers=[Container(resources=ResourceList.of(job.request))],
                     scheduler_name=constants.SCHEDULER_NAME,
@@ -533,6 +534,11 @@ class MultiHostSim(_TraceRunner):
                             constants.LABEL_GANG: job.name,
                             constants.LABEL_GANG_SIZE: str(job.hosts),
                         },
+                        annotations={
+                            constants.ANNOTATION_EXPECTED_DURATION: (
+                                f"{job.duration_s:.0f}"
+                            )
+                        },
                     ),
                     spec=PodSpec(
                         containers=[
@@ -599,17 +605,51 @@ def mixed_gang_workload(
     return jobs
 
 
+def multihost_shape_ladder(
+    global_topology: str, host_topology: str
+) -> Tuple[Tuple[str, int, float], ...]:
+    """The gang-shape mix for a slice group: every host-aligned sub-slice
+    shape from one host up to the FULL global mesh, halving weights as
+    shapes grow (the smaller axis doubles first: 2x2 -> 2x4 -> 4x4 ...).
+    Shared by the `simulate --multihost` CLI and the north-star acceptance
+    test so they always judge the same scenario — the full-mesh gang at the
+    top of the ladder is what exercises drain scheduling."""
+    import math
+
+    from nos_tpu.tpu.shape import Shape
+
+    global_shape = Shape.parse(global_topology)
+    host_shape = Shape.parse(host_topology)
+    shapes: List[Tuple[str, int, float]] = []
+    d = list(host_shape.dims)
+    w = 1.0
+    while all(x <= g for x, g in zip(d, global_shape.dims)):
+        hosts = math.prod(x // h for x, h in zip(d, host_shape.dims))
+        shapes.append(("x".join(map(str, d)), hosts, w))
+        i = min(range(len(d)), key=lambda j: d[j])
+        d = [x * 2 if j == i else x for j, x in enumerate(d)]
+        w /= 2
+    return tuple(shapes)
+
+
 def simulate_north_star_multihost(
-    n_jobs: int = 120,
+    n_jobs: int = 200,
     seed: int = 0,
     tick_s: float = 1.0,
     measure_window: Optional[Tuple[float, float]] = (180.0, 900.0),
 ) -> SimReport:
-    """The north star at its TRUE shape: ONE v5e-256 pod = 64 host nodes of
-    2x2 chips (16x16 global mesh), dynamically carved into ICI-contiguous
-    sub-slices consumed by gang workloads."""
+    """The north star at its TRUE shape — identical to the judged
+    `simulate --multihost --topology 16x16` defaults: ONE v5e-256 pod = 64
+    host nodes of 2x2 chips (16x16 global mesh), dynamically carved into
+    ICI-contiguous sub-slices consumed by 200 gang workloads whose shapes
+    range up to the full mesh."""
     sim = MultiHostSim(groups={"v5e-256": ("16x16", "2x2", (8, 8))})
-    jobs = mixed_gang_workload(n_jobs, seed=seed)
+    jobs = mixed_gang_workload(
+        n_jobs,
+        seed=seed,
+        shapes=multihost_shape_ladder("16x16", "2x2"),
+        mean_interarrival_s=2.0,
+    )
     return sim.run(jobs, tick_s=tick_s, measure_window=measure_window)
 
 

@@ -67,13 +67,23 @@ class SchedulerSim:
         return self._scheduler.framework.run_filters(self._state, pod, node_info).is_success
 
 
-def build_scheduler(cluster: Cluster, config: Optional[SchedulerConfig] = None) -> Scheduler:
+def build_scheduler(
+    cluster: Cluster, config: Optional[SchedulerConfig] = None, now=None
+) -> Scheduler:
     config = config or SchedulerConfig()
     calculator = ResourceCalculator(
         tpu_chip_memory_gb=config.tpu_chip_memory_gb,
         nvidia_gpu_memory_gb=config.nvidia_gpu_memory_gb,
     )
-    return Scheduler(cluster, calculator=calculator, scheduler_name=config.scheduler_name)
+    return Scheduler(
+        cluster,
+        calculator=calculator,
+        scheduler_name=config.scheduler_name,
+        now=now,
+        backfill_min_fraction=config.backfill_min_fraction,
+        backfill_after_s=config.backfill_after_s,
+        backfill_bypass_factor=config.backfill_bypass_factor,
+    )
 
 
 def build_partitioner_controllers(
@@ -171,7 +181,15 @@ class ControlPlane:
         scheduler_config: Optional[SchedulerConfig] = None,
         now=None,
     ):
-        self.cluster = cluster or Cluster()
+        # The bus shares the control plane's clock: creation timestamps feed
+        # scheduling order AND pending-age math (backfill aging), which must
+        # run on the same timeline as the virtual clock in simulations.
+        if cluster is not None:
+            self.cluster = cluster
+        elif now is not None:
+            self.cluster = Cluster(now=now)
+        else:
+            self.cluster = Cluster()
         self.health = HealthManager()
         install_quota_webhooks(self.cluster)
         op_cfg = operator_config or OperatorConfig()
@@ -181,7 +199,7 @@ class ControlPlane:
         )
         self.quota_reconciler = QuotaReconciler(self.cluster, calculator)
         self.state = ClusterState()
-        self.scheduler = build_scheduler(self.cluster, scheduler_config)
+        self.scheduler = build_scheduler(self.cluster, scheduler_config, now=now)
         self.partitioners = build_partitioner_controllers(
             self.cluster, self.state, self.scheduler, partitioner_config, now=now
         )

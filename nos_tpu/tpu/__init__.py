@@ -10,7 +10,7 @@ node agent agree on chip assignment without ever transmitting coordinates.
 """
 
 from nos_tpu.tpu.shape import Shape  # noqa: F401
-from nos_tpu.tpu.profile import Profile  # noqa: F401
+from nos_tpu.tpu.profile import Profile, chips_of_resources  # noqa: F401
 from nos_tpu.tpu.topology import Topology, accelerator_generation  # noqa: F401
 from nos_tpu.tpu.packing import Placement, pack  # noqa: F401
 from nos_tpu.tpu.mesh import TpuMesh  # noqa: F401

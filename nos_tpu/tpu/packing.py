@@ -77,30 +77,57 @@ def _place_one(
     free: List[Block],
     profile: Profile,
     allowed_dims: Optional[Tuple[Coord, ...]] = None,
+    align: bool = False,
 ) -> Optional[Placement]:
     """Best-fit: smallest free block (ties: lexicographic origin) and the first
     orientation (canonical order) that fits. `allowed_dims` restricts the
     orientations tried (host-grid packing on anisotropic hosts: only
-    rotations that keep the carved chip region congruent are legal)."""
-    best: Optional[Tuple[int, Coord, int, Coord]] = None  # (chips, origin, idx, want)
+    rotations that keep the carved chip region congruent are legal).
+
+    With `align`, a block may only sit at origins that are multiples of its
+    own dims (buddy-allocator discipline; dims are powers of two per axis).
+    Unaligned best-fit can strand a grid permanently: one in-use 4x4 block
+    carved at the center of an 8x8 grid leaves no aligned-free 4x4 window
+    anywhere, so every later pod-scale carve fails until that workload ends
+    — measured as a 2,200s one-gang-at-a-time plateau on the north-star
+    trace. Alignment guarantees any in-use block leaves its sibling buddy
+    blocks carvable, and matches real TPU sub-slicing, where wraparound
+    links constrain sub-slice origins."""
+    best = None  # (block_chips, origin, idx, want)
     for idx, block in enumerate(free):
         for orient in profile.shape.orientations():
             want = orient.dims
             if allowed_dims is not None and want not in allowed_dims:
                 continue
-            if _fits(block, want):
-                key = (block.chips, block.origin, idx, want)
-                if best is None or key < best:
-                    best = key
-                break  # orientations are tried in a fixed order; first fit per block
+            if align:
+                origin = tuple(
+                    ((o + w - 1) // w) * w for o, w in zip(block.origin, want)
+                )
+                if not all(
+                    a + w <= o + d
+                    for a, w, o, d in zip(origin, want, block.origin, block.dims)
+                ):
+                    continue
+            else:
+                if not _fits(block, want):
+                    continue
+                origin = block.origin
+            key = (block.chips, origin, idx, want)
+            if best is None or key < best:
+                best = key
+            break  # orientations are tried in a fixed order; first fit per block
     if best is None:
         return None
-    _, _, idx, want = best
+    _, origin, idx, want = best
     block = free.pop(idx)
-    placed, remainders = _split(block, want)
-    free.extend(remainders)
+    if align and origin != block.origin:
+        placed = Block(origin, want)
+        free.extend(_subtract_block([block], placed))
+    else:
+        placed, remainders = _split(block, want)
+        free.extend(remainders)
     free.sort(key=lambda b: (b.chips, b.origin))
-    return Placement(profile, placed.origin, placed.dims)
+    return Placement(profile, placed.origin, want)
 
 
 # Memoization: the packer is a pure function of (mesh, geometry multiset), and
@@ -202,6 +229,7 @@ def pack_into(
     occupied: List[Tuple[Coord, Coord]],
     geometry: Mapping[Profile, int],
     allowed_dims: Optional[Mapping[Profile, Tuple[Coord, ...]]] = None,
+    align: bool = False,
 ) -> Optional[List[Placement]]:
     """Place `geometry` into the mesh *around* already-placed blocks
     ((origin, dims) pairs). Used by node agents to add slices without moving
@@ -214,9 +242,11 @@ def pack_into(
         tuple((tuple(o), tuple(d)) for o, d in occupied),
         _geometry_key(geometry),
         tuple(sorted((p.name, dims) for p, dims in (allowed_dims or {}).items())),
+        align,
     )
     return _cached(
-        key, lambda: _pack_into_uncached(mesh, occupied, geometry, allowed_dims)
+        key,
+        lambda: _pack_into_uncached(mesh, occupied, geometry, allowed_dims, align),
     )
 
 
@@ -225,6 +255,7 @@ def _pack_into_uncached(
     occupied: List[Tuple[Coord, Coord]],
     geometry: Mapping[Profile, int],
     allowed_dims: Optional[Mapping[Profile, Tuple[Coord, ...]]] = None,
+    align: bool = False,
 ) -> Optional[List[Placement]]:
     # Chip-count prune before any geometry work (pack() has the same guard;
     # occupied blocks never overlap, so volumes sum).
@@ -242,7 +273,7 @@ def _pack_into_uncached(
             return None
         restrict = allowed_dims.get(profile) if allowed_dims else None
         for _ in range(geometry[profile]):
-            placed = _place_one(free, profile, restrict)
+            placed = _place_one(free, profile, restrict, align)
             if placed is None:
                 return None
             placements.append(placed)

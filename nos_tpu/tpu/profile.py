@@ -57,3 +57,20 @@ class Profile:
 
     def __str__(self) -> str:
         return self.name
+
+
+def chips_of_resources(resources) -> float:
+    """TPU chips represented by a resource mapping: whole chips plus every
+    sub-slice profile's chip footprint. The single accounting rule shared by
+    the scheduler's reservation math and the simulation's utilization
+    integration — a profile request and the whole-chip capacity it carves
+    into are the same chips."""
+    chips = 0.0
+    for res, qty in resources.items():
+        if res == constants.RESOURCE_TPU:
+            chips += qty
+        else:
+            profile = Profile.from_resource(res)
+            if profile is not None:
+                chips += profile.chips * qty
+    return chips

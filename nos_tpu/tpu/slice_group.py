@@ -240,16 +240,20 @@ class SliceGroup:
         # sub-slices could satisfy everything.
         occ_keep = occupied + [(s.host_origin, s.host_dims) for s in free]
         keep_free: List[SubSlice] = list(free)
-        placements = pack_into(self.host_grid, occ_keep, counts, allowed)
+        placements = pack_into(self.host_grid, occ_keep, counts, allowed, align=True)
         if placements is None:
             keep_free = []
-            placements = pack_into(self.host_grid, list(occupied), counts, allowed)
+            placements = pack_into(self.host_grid, list(occupied), counts, allowed, align=True)
         if placements is None:
             placements = []
             occ2 = list(occupied)
-            for bp in sorted(counts, key=lambda p: (-p.chips, p.name)):
+            # Partial pack honors DEMAND order (the caller sorts demand in
+            # the scheduler's bind order), not size order: carving a large
+            # low-priority block first can cover the grid and deadlock the
+            # higher-priority gang the scheduler insists on binding first.
+            for bp in counts:
                 for _ in range(counts[bp]):
-                    got = pack_into(self.host_grid, occ2, {bp: 1}, allowed)
+                    got = pack_into(self.host_grid, occ2, {bp: 1}, allowed, align=True)
                     if got:
                         placements.extend(got)
                         occ2.extend((pl.origin, pl.dims) for pl in got)

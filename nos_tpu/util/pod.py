@@ -60,6 +60,40 @@ def is_active(pod: Pod) -> bool:
     )
 
 
+# -- temporal model (duration-aware backfill) --------------------------------
+def expected_duration_s(pod: Pod):
+    """User-declared expected runtime in seconds, or None (unknown)."""
+    raw = pod.metadata.annotations.get(constants.ANNOTATION_EXPECTED_DURATION)
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if value > 0 else None
+
+
+def bound_at_s(pod: Pod):
+    """Scheduler-stamped bind time (seconds on the scheduler's clock), or
+    None for pods bound by a scheduler that predates the stamp."""
+    raw = pod.metadata.annotations.get(constants.ANNOTATION_BOUND_AT)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def expected_end_s(pod: Pod):
+    """bound-at + expected-duration, or None when either is unknown."""
+    start = bound_at_s(pod)
+    duration = expected_duration_s(pod)
+    if start is None or duration is None:
+        return None
+    return start + duration
+
+
 # -- gang membership (multi-host workloads: one pod per host) ----------------
 def gang_of(pod: Pod):
     """'<ns>/<gang-name>' or None."""

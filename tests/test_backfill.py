@@ -1,0 +1,297 @@
+"""Duration-aware backfill: temporal pod stamps, drain-set reservations,
+starvation-based arming, and the buddy-aligned host packer.
+
+The reference has no temporal model (an unschedulable pod just waits —
+SURVEY.md §2.3 partitioner_controller.go:81-149); these mechanisms exist
+because a TPU mesh can starve a pod-scale gang indefinitely behind a stream
+of small gangs. The measurement matrix motivating each default lives in
+docs/dynamic-partitioning.md.
+"""
+
+from nos_tpu import constants
+from nos_tpu.api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.sim import GangJob, MultiHostSim, SimJob, WorkloadSim
+from nos_tpu.tpu.packing import pack_into
+from nos_tpu.tpu.profile import Profile
+from nos_tpu.tpu.shape import Shape
+from nos_tpu.util import pod as podutil
+
+
+def _pod(name, ns="ml", duration=None, bound_at=None):
+    ann = {}
+    if duration is not None:
+        ann[constants.ANNOTATION_EXPECTED_DURATION] = str(duration)
+    if bound_at is not None:
+        ann[constants.ANNOTATION_BOUND_AT] = str(bound_at)
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns, annotations=ann))
+
+
+class TestTemporalStamps:
+    def test_expected_duration_parses(self):
+        assert podutil.expected_duration_s(_pod("a", duration=120)) == 120.0
+        assert podutil.expected_duration_s(_pod("a")) is None
+        assert podutil.expected_duration_s(_pod("a", duration="bogus")) is None
+        assert podutil.expected_duration_s(_pod("a", duration=-5)) is None
+
+    def test_expected_end_needs_both_stamps(self):
+        assert podutil.expected_end_s(_pod("a", duration=100, bound_at=50)) == 150.0
+        assert podutil.expected_end_s(_pod("a", duration=100)) is None
+        assert podutil.expected_end_s(_pod("a", bound_at=50)) is None
+
+    def test_scheduler_stamps_bound_at(self):
+        """The bind patch writes the bound-at annotation on the scheduler's
+        clock (virtual time in simulations)."""
+        sim = WorkloadSim(topos={"n": "2x2"})
+        report = sim.run(
+            [SimJob("j", "ml", {constants.RESOURCE_TPU: 4}, 0.0, 30.0)],
+            max_s=120.0,
+        )
+        assert report.completed == 1
+        # The pod is gone (completed); its bind was recorded by the trace.
+        assert report.jobs[0].bound_s is not None
+
+
+class TestAlignedPacking:
+    def test_center_block_cannot_strand_the_grid(self):
+        """The seed-1 pathology: an unaligned 4x4 block at (2,2) of an 8x8
+        grid leaves no 4x4 window anywhere. Aligned packing must never
+        produce such a placement, and must still pack around an ALIGNED
+        in-use block."""
+        grid = Shape.parse("8x8")
+        p44 = Profile.parse("4x4")
+        allowed = {p44: ((4, 4),)}
+        # Aligned pack of one 4x4 into an empty grid lands on a lattice point.
+        placed = pack_into(grid, [], {p44: 1}, allowed, align=True)
+        assert placed is not None
+        origin = placed[0].origin
+        assert origin[0] % 4 == 0 and origin[1] % 4 == 0
+        # Around it, three more 4x4s still fit (the buddy guarantee)...
+        occ = [(placed[0].origin, placed[0].dims)]
+        more = pack_into(grid, occ, {p44: 3}, allowed, align=True)
+        assert more is not None
+        # ...whereas around a CENTER block, none would (the old behavior):
+        assert pack_into(grid, [((2, 2), (4, 4))], {p44: 1}, allowed, align=True) is None
+
+    def test_unaligned_mode_unchanged(self):
+        grid = Shape.parse("8x8")
+        p44 = Profile.parse("4x4")
+        placed = pack_into(grid, [((2, 2), (4, 4))], {p44: 1}, {p44: ((4, 4),)})
+        assert placed is None  # still geometrically impossible
+        placed = pack_into(grid, [((0, 0), (4, 4))], {p44: 1}, {p44: ((4, 4),)})
+        assert placed is not None
+
+
+def _mk_scheduler(cluster, now, **kw):
+    from nos_tpu.scheduler.scheduler import Scheduler
+
+    return Scheduler(cluster, now=now, **kw)
+
+
+class TestDrainSetReservation:
+    def _cluster_with_nodes(self, clock, n_nodes=2):
+        from nos_tpu.cluster.client import Cluster
+
+        cluster = Cluster(now=clock)
+        for i in range(n_nodes):
+            cluster.create(
+                Node(
+                    metadata=ObjectMeta(
+                        name=f"n{i}",
+                        labels={
+                            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                            constants.LABEL_TPU_TOPOLOGY: "4x4",
+                        },
+                    ),
+                    status=NodeStatus(
+                        allocatable=ResourceList.of(
+                            {"cpu": 8, constants.RESOURCE_TPU: 16}
+                        )
+                    ),
+                )
+            )
+        return cluster
+
+    def _submit(self, cluster, name, chips, duration, priority=0, created=None):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=name,
+                namespace="ml",
+                annotations={
+                    constants.ANNOTATION_EXPECTED_DURATION: str(duration)
+                },
+            ),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        resources=ResourceList.of({constants.RESOURCE_TPU: chips})
+                    )
+                ],
+                scheduler_name=constants.SCHEDULER_NAME,
+                priority=priority,
+            ),
+        )
+        created_pod = cluster.create(pod)
+        return created_pod
+
+    def test_starving_whole_node_pod_arms_after_bypass(self):
+        """One node, a whole-node pod stuck behind a stream of small pods:
+        once 2x its chips have bound past it, the reservation arms and the
+        sticky drain set holds."""
+        from nos_tpu.sim import VirtualClock
+
+        clock = VirtualClock()
+        cluster = self._cluster_with_nodes(clock, n_nodes=1)
+        sched = _mk_scheduler(
+            cluster, clock, backfill_min_fraction=0.9, backfill_after_s=30.0,
+            backfill_bypass_factor=2.0,
+        )
+        # Keep the node busy with a rolling population of small pods.
+        live = []
+        for i in range(4):
+            self._submit(cluster, f"seed{i}", 4, 120.0)
+            live.append(f"seed{i}")
+        sched.schedule_pending()
+        # The whole-node pod arrives and blocks.
+        self._submit(cluster, "whole", 16, 100.0)
+        clock.advance(40.0)  # past the age gate
+        sched.schedule_pending()
+
+        def done(p):
+            p.status.phase = "Succeeded"
+
+        # Churn: retire one small, admit one small — each replacement binds
+        # past the blocked whole-node pod, accumulating measured starvation
+        # (2 x 16 chips = 8 replacements of 4 chips).
+        for i in range(10):
+            cluster.patch("Pod", "ml", live.pop(0), done)
+            name = f"fill{i}"
+            self._submit(cluster, name, 4, 120.0)
+            live.append(name)
+            clock.advance(5.0)
+            sched.schedule_pending()
+        assert sched._sticky_holder is not None
+        assert "whole" in sched._sticky_holder
+
+    def test_no_arming_without_bypass_traffic(self):
+        """A blocked whole-cluster pod with NOTHING binding past it never
+        arms (the mesh is draining naturally; a reservation would only force
+        a pointless mid-run drain)."""
+        from nos_tpu.sim import VirtualClock
+
+        clock = VirtualClock()
+        cluster = self._cluster_with_nodes(clock)
+        sched = _mk_scheduler(
+            cluster, clock, backfill_min_fraction=0.9, backfill_after_s=30.0,
+        )
+        self._submit(cluster, "long-a", 16, 500.0)
+        self._submit(cluster, "long-b", 16, 500.0)
+        sched.schedule_pending()
+        self._submit(cluster, "whole", 32, 100.0)
+        for _ in range(20):
+            clock.advance(10.0)
+            sched.schedule_pending()
+        assert sched._sticky_holder is None
+
+    def test_small_units_never_arm(self):
+        from nos_tpu.sim import VirtualClock
+
+        clock = VirtualClock()
+        cluster = self._cluster_with_nodes(clock)
+        sched = _mk_scheduler(cluster, clock, backfill_min_fraction=0.9)
+        self._submit(cluster, "long-a", 16, 500.0)
+        self._submit(cluster, "long-b", 16, 500.0)
+        sched.schedule_pending()
+        self._submit(cluster, "small", 8, 100.0)  # 8/32 < 0.9 of cluster
+        for _ in range(20):
+            clock.advance(10.0)
+            sched.schedule_pending()
+        assert sched._sticky_holder is None
+
+
+class TestStarvationEndToEnd:
+    def test_full_mesh_gang_cannot_starve_behind_small_stream(self):
+        """A 4x4-mesh slice group (4 hosts of 2x2) with an endless stream of
+        single-host gangs: without the reservation the full-mesh gang waits
+        for a coincidental global drain; with the shipped defaults it must
+        bind while small gangs are still arriving/running around it."""
+        sim = MultiHostSim(groups={"g": ("4x4", "2x2", (2, 2))})
+        jobs = [
+            GangJob(
+                name=f"small-{i:03d}",
+                namespace="ml",
+                topology="2x2",
+                hosts=1,
+                arrival_s=float(5 * i),
+                duration_s=60.0,
+            )
+            for i in range(40)
+        ]
+        jobs.append(
+            GangJob(
+                name="whole-mesh",
+                namespace="ml",
+                topology="4x4",
+                hosts=4,
+                arrival_s=10.0,
+                duration_s=50.0,
+            )
+        )
+        report = sim.run(jobs, max_s=3600.0)
+        whole = next(r for r in report.jobs if r.job.name == "whole-mesh")
+        assert whole.completed_s is not None
+        # Without a reservation it binds only after the last small ends
+        # (stream runs to t=200, +60s duration => ~260s+). The armed drain
+        # must beat that decisively.
+        assert whole.bound_s < 220.0
+
+
+class TestCarvePriorityOrder:
+    def test_demand_orders_by_scheduler_bind_order(self):
+        """Carve demand must follow (priority desc, creation) — a
+        lower-priority gang must not have its sub-slice carved ahead of a
+        higher-priority one competing for the same hosts."""
+        from nos_tpu.controllers.slice_group import GroupPartitioner
+        from nos_tpu.cluster.client import Cluster
+
+        cluster = Cluster()
+        gp = GroupPartitioner(cluster)
+        pods = []
+        for name, prio, size, topo in [
+            ("low", 0, 4, "4x4"),
+            ("high", 10, 1, "2x2"),
+        ]:
+            for i in range(size):
+                pod = Pod(
+                    metadata=ObjectMeta(
+                        name=f"{name}-{i}",
+                        namespace="ml",
+                        labels={
+                            constants.LABEL_GANG: name,
+                            constants.LABEL_GANG_SIZE: str(size),
+                        },
+                    ),
+                    spec=PodSpec(
+                        priority=prio,
+                        node_selector={
+                            constants.LABEL_TPU_SUBSLICE_TOPOLOGY: topo
+                        },
+                    ),
+                )
+                pod.status.conditions.append(
+                    __import__(
+                        "nos_tpu.api.objects", fromlist=["PodCondition"]
+                    ).PodCondition(
+                        type="PodScheduled", status="False", reason="Unschedulable"
+                    )
+                )
+                pods.append(cluster.create(pod))
+        items = gp.pending_gang_demand(pods)
+        assert [i["gang"] for i in items] == ["ml/high", "ml/low"]
