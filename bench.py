@@ -173,16 +173,34 @@ def main() -> None:
         sys.exit(1)
 
     value = statistics.median(trial_means)
-    print(
-        json.dumps(
-            {
-                "metric": "avg_inference_time_7_workloads_sharing_one_chip",
-                "value": round(value, 6),
-                "unit": "s",
-                "vs_baseline": round(MPS_BASELINE_7PODS_S / value, 3),
+    result = {
+        "metric": "avg_inference_time_7_workloads_sharing_one_chip",
+        "value": round(value, 6),
+        "unit": "s",
+        "vs_baseline": round(MPS_BASELINE_7PODS_S / value, 3),
+    }
+    # Absolute single-chip statement (VERDICT r2 #4): on-device MFU of the
+    # ViT batch step, tunnel RTT excluded (it dominates the per-request
+    # latency above and is reported as dispatch_overhead_s). Optional
+    # telemetry: a flaky measurement must not sink the headline metric.
+    try:
+        from nos_tpu.runtime.mfu import vit_batch_mfu
+
+        mfu = _retry("mfu", lambda: vit_batch_mfu(batch=N_WORKLOADS))
+        if mfu is not None:
+            result["mfu"] = {
+                "vit_batch_step": round(mfu["mfu"], 4),
+                "achieved_tflops": round(mfu["achieved_tflops"], 1),
+                "peak_tflops": mfu["peak_tflops"],
+                "step_time_ms": round(mfu["step_time_s"] * 1e3, 3),
+                "dispatch_overhead_ms": round(
+                    mfu["dispatch_overhead_s"] * 1e3, 1
+                ),
+                "device_kind": mfu["device_kind"],
             }
-        )
-    )
+    except Exception as e:  # noqa: BLE001 — telemetry only
+        _log(f"mfu measurement skipped: {type(e).__name__}: {e}")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

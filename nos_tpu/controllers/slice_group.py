@@ -119,11 +119,18 @@ class GroupPartitioner:
         # that the scheduler will never bind first, deadlocking the queue
         # behind a backfill reservation.
         def _order(entry):
-            gang, pods = entry
-            return (
-                -max(p.spec.priority for p in pods),
-                min(p.metadata.creation_timestamp for p in pods),
-                gang,
+            # EXACTLY the scheduler's gang unit key (scheduler.py
+            # schedule_pending): min over per-pod (-priority, creation, name)
+            # tuples — i.e. the best member's tuple, NOT max-priority paired
+            # with the earliest timestamp of a possibly different member.
+            _, pods = entry
+            return min(
+                (
+                    -p.spec.priority,
+                    p.metadata.creation_timestamp,
+                    p.metadata.namespaced_name,
+                )
+                for p in pods
             )
 
         for gang, pods in sorted(gangs.items(), key=_order):

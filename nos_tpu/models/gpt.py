@@ -136,22 +136,61 @@ def _block(x, p, cfg: GPTConfig, positions, mesh):
     return x + y
 
 
-def gpt_forward(params, tokens, cfg: GPTConfig, mesh=None):
-    """tokens [B, T] int32 -> logits [B, T, vocab] f32."""
+def gpt_hidden(params, tokens, cfg: GPTConfig, mesh=None):
+    """tokens [B, T] int32 -> final hidden states [B, T, H] (pre-head)."""
     b, t = tokens.shape
     x = params["tok_emb"][tokens]
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     for i in range(cfg.layers):
         x = _block(x, params["layers"][str(i)], cfg, positions, mesh)
-    x = _rmsnorm(x, params["ln_f"])
+    return _rmsnorm(x, params["ln_f"])
+
+
+def gpt_forward(params, tokens, cfg: GPTConfig, mesh=None):
+    """tokens [B, T] int32 -> logits [B, T, vocab] f32."""
+    x = gpt_hidden(params, tokens, cfg, mesh)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
-def gpt_loss(params, tokens, cfg: GPTConfig, mesh=None):
-    """Next-token cross-entropy (mean over B x (T-1))."""
-    logits = gpt_forward(params, tokens, cfg, mesh)
+def gpt_loss(
+    params, tokens, cfg: GPTConfig, mesh=None, loss_chunk: int = 256
+):
+    """Next-token cross-entropy (mean over B x (T-1)), with the vocabulary
+    projection CHUNKED over the sequence.
+
+    Materializing the full [B, T, vocab] f32 logits tensor (plus its
+    log-softmax and gradient) dominates the train step's HBM traffic at
+    small hidden sizes: 8x2048x32000 f32 is 2.1 GB per copy, ~8 GB of the
+    default step's measured 11.3 GB accessed. Scanning the head over
+    [B, chunk, H] slices with rematerialization keeps peak head memory at
+    one chunk and lets the backward recompute chunk logits instead of
+    reading them back. Same math, bit-comparable loss (f32 logsumexp), ~2x
+    faster train step at the default config (see docs/benchmark.md MFU
+    table)."""
+    b, t = tokens.shape
+    x = gpt_hidden(params, tokens, cfg, mesh)
+    xs = x[:, :-1, :]
     targets = tokens[:, 1:]
-    logits = logits[:, :-1, :]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    n = t - 1
+    chunk = max(1, min(loss_chunk, n))
+    pad = (-n) % chunk
+    if pad:
+        xs = jnp.pad(xs, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    mask = (jnp.arange(n + pad) < n).astype(jnp.float32)  # [n+pad]
+    n_chunks = (n + pad) // chunk
+    xs = xs.reshape(b, n_chunks, chunk, cfg.hidden).swapaxes(0, 1)
+    targets = targets.reshape(b, n_chunks, chunk).swapaxes(0, 1)
+    mask = mask.reshape(n_chunks, chunk)
+    lm_head = params["lm_head"]
+
+    @jax.checkpoint
+    def chunk_nll(carry, inp):
+        x_c, tgt_c, mask_c = inp  # [B, C, H], [B, C], [C]
+        logits = (x_c @ lm_head).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tgt_c[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum((lse - tgt) * mask_c[None, :]), None
+
+    total, _ = jax.lax.scan(chunk_nll, jnp.float32(0.0), (xs, targets, mask))
+    return total / (b * n)

@@ -1,0 +1,216 @@
+"""Absolute single-chip performance instrumentation (MFU).
+
+Every other perf number in this project is relative (vs the reference's MPS
+baseline, vs earlier rounds). This module answers "is it actually fast?":
+achieved model FLOP/s as a fraction of the chip's peak, measured ON DEVICE —
+the dispatch tunnel's RTT (~60-200 ms on this rig, dwarfing millisecond
+steps) is factored out by timing a jitted `lax.scan` of N steps against a
+scan of N/4 (min of 5 runs each; jitter is additive, so minima are the
+noise-free estimates) and differencing, and reported separately.
+
+FLOP counts come from XLA's own compiled cost model
+(`lowered.compile().cost_analysis()["flops"]`), so the numerator matches
+what the compiler actually scheduled, not a hand-derived estimate.
+
+Reference anchor: the sharing benchmark this extends,
+demos/gpu-sharing-comparison/README.md:60-72 — the reference publishes only
+relative sharing numbers; MFU is the TPU-native absolute complement.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+# Peak dense bf16 FLOP/s per chip, from Google's published spec sheets.
+# device_kind substrings as reported by jax.devices()[i].device_kind.
+PEAK_BF16_FLOPS: Dict[str, float] = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v5": 459e12,  # bare "TPU v5" reports as v5p
+    "v6 lite": 918e12,  # Trillium
+    "v6e": 918e12,
+}
+
+
+def device_peak_flops(device) -> Optional[float]:
+    kind = getattr(device, "device_kind", "").lower()
+    # Longest-substring match wins ("v5 lite" before "v5").
+    best = None
+    for sub, peak in PEAK_BF16_FLOPS.items():
+        if sub in kind and (best is None or len(sub) > len(best[0])):
+            best = (sub, peak)
+    return best[1] if best else None
+
+
+def _scan_wall(jax, step_fn, length: int, repeats: int = 5) -> float:
+    """MIN wall time of a jitted scan of `length` chained steps. Min, not
+    median: tunnel jitter is strictly additive (100ms-scale hiccups on a
+    remote-dispatch rig), so the minimum is the noise-free estimate — with
+    a median, one bad window can invert the scan-length ordering and yield
+    a negative step time."""
+
+    def scanned(carry):
+        return jax.lax.scan(step_fn, carry, None, length=length)[0]
+
+    f = jax.jit(scanned)
+    import jax.numpy as jnp
+
+    carry0 = jnp.float32(0.0)
+    f(carry0).block_until_ready()  # compile
+    walls = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        f(carry0).block_until_ready()
+        walls.append(time.perf_counter() - t0)
+    return min(walls)
+
+
+def measure_mfu(
+    fn: Callable,
+    args: tuple,
+    scan_length: int = 32,
+    repeats: int = 5,
+    flops: Optional[float] = None,
+) -> Optional[dict]:
+    """Measure `fn(*args)`'s on-device step time and MFU.
+
+    `fn` must be a pure jittable function of `args` (arrays/pytrees). The
+    scan perturbs the first argument by a vanishing multiple of the carry so
+    XLA cannot hoist or CSE the loop body; the carry folds every output in,
+    so no step is dead code. Returns None when the device peak is unknown
+    (non-TPU) — callers treat MFU as optional telemetry."""
+    import jax
+    import jax.numpy as jnp
+
+    device = jax.devices()[0]
+    peak = device_peak_flops(device)
+    if peak is None:
+        return None
+
+    flops_source = "analytic"
+    if flops is None:
+        # XLA's own post-optimization count. Caveat: ops inside a lax.scan
+        # body are counted ONCE, not x length — callers whose fn contains an
+        # internal scan must pass an analytic count instead.
+        flops = float(
+            jax.jit(fn).lower(*args).compile().cost_analysis()["flops"]
+        )
+        flops_source = "xla_cost_analysis"
+
+    first, rest = args[0], args[1:]
+
+    def step(carry, _):
+        # Perturb WITHOUT promoting dtype: bf16 * f32-scalar would silently
+        # run the whole step in f32 (a different computation measured
+        # against the bf16 peak).
+        perturbed = jax.tree_util.tree_map(
+            lambda a: (a * (1.0 + carry * 1e-12)).astype(a.dtype)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+            else a,
+            first,
+        )
+        out = fn(perturbed, *rest)
+        acc = jax.tree_util.tree_reduce(
+            lambda a, b: a + jnp.sum(b).astype(jnp.float32), out, 0.0
+        )
+        return acc * 1e-30, None
+
+    short = max(2, scan_length // 4)
+    scan_length = max(scan_length, short + 1)
+    wall_short = _scan_wall(jax, step, short, repeats)
+    wall_n = _scan_wall(jax, step, scan_length, repeats)
+    step_s = max((wall_n - wall_short) / (scan_length - short), 1e-9)
+    achieved = flops / step_s
+    if achieved > peak:
+        # Physically impossible: the scan-length difference drowned in
+        # dispatch jitter (step too small for this scan_length). A wrong
+        # number is worse than none.
+        return None
+    return {
+        "device_kind": device.device_kind,
+        "flops_source": flops_source,
+        "flops_per_step": flops,
+        "step_time_s": step_s,
+        "achieved_tflops": achieved / 1e12,
+        "peak_tflops": peak / 1e12,
+        "mfu": achieved / peak,
+        "dispatch_overhead_s": max(wall_short - short * step_s, 0.0),
+    }
+
+
+def vit_batch_mfu(batch: int = 7, scan_length: int = 128, **kw) -> Optional[dict]:
+    """MFU of the benchmark's ViT detector batch step (batch 7 = the
+    7-workloads-sharing-one-chip shape). The long default scan keeps the
+    sub-millisecond step's signal well above tunnel jitter."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.vit import ViTConfig, init_vit, vit_detect
+
+    cfg = ViTConfig()
+    params = init_vit(jax.random.PRNGKey(0), cfg)
+    images = jax.random.uniform(
+        jax.random.PRNGKey(1),
+        (batch, cfg.image_size, cfg.image_size, 3),
+        jnp.float32,
+    )
+    return measure_mfu(
+        lambda ims: vit_detect(params, ims, cfg),
+        (images,),
+        scan_length=scan_length,
+        **kw,
+    )
+
+
+def gpt_train_mfu(batch: int = 8, seq: Optional[int] = None, **kw) -> Optional[dict]:
+    """MFU of the GPT training step (fwd + bwd + optimizer) at the default
+    single-chip config."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.train import TrainConfig, init_train_state, make_train_step
+
+    cfg = TrainConfig()
+    seq = seq or cfg.model.max_seq
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg)
+    step_fn = make_train_step(cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, cfg.model.vocab
+    )
+
+    # Params are the perturbed (first) argument: tokens are integers, so a
+    # token-perturbation would be a no-op and XLA could hoist the whole
+    # loop-invariant step out of the timing scan. The FULL step output
+    # (updated params + optimizer state, not just the loss) is returned so
+    # the measurement carry depends on the backward pass and the optimizer
+    # update — returning the loss alone would let XLA dead-code-eliminate
+    # everything but the forward.
+    def loss_of(params_in, opt_in, tokens_in):
+        return step_fn(params_in, opt_in, tokens_in)
+
+    return measure_mfu(
+        loss_of,
+        (params, opt_state, tokens),
+        flops=gpt_train_flops(cfg.model, batch, seq),
+        **kw,
+    )
+
+
+def gpt_train_flops(model, batch: int, seq: int) -> float:
+    """Analytic model FLOPs of one train step (fwd + bwd, the standard MFU
+    numerator: 6 x matmul-params x tokens, plus the quadratic attention
+    term; REMAT recompute is deliberately excluded, so rematerialization
+    shows up as lower MFU, as it should). The chunked loss's internal
+    lax.scan makes XLA's cost_analysis undercount (scan bodies count once),
+    hence analytic."""
+    h = model.hidden
+    kv_dim = model.n_kv * model.head_dim
+    per_layer = 2 * h * h + 2 * h * kv_dim + 3 * h * (h * model.mlp_ratio)
+    matmul_params = model.layers * per_layer + h * model.vocab  # + lm_head
+    tokens = batch * seq
+    dense = 6.0 * matmul_params * tokens
+    attention = 3.0 * model.layers * (4.0 * batch * seq * seq * h)
+    return dense + attention

@@ -3,12 +3,14 @@
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 from nos_tpu.api.objects import Pod
 from nos_tpu.api.resources import ResourceList
 from nos_tpu.partitioning.core.interface import NodeInfo
 from nos_tpu.scheduler.framework import CycleState, FilterPlugin, ScorePlugin, Status
+from nos_tpu.util import pod as podutil
 
 
 class NodeSelectorFilter(FilterPlugin):
@@ -62,21 +64,28 @@ class EndAlignedScore(ScorePlugin):
         self._now = now
         self.scale_s = scale_s
 
+    def _node_end(self, node: NodeInfo, now: float):
+        """Latest stamped end among the node's occupants (None: unknown).
+        Memoized on the NodeInfo itself (keyed by occupant count) — node
+        snapshots are per-pass objects, and this runs for every
+        (pending pod x feasible node) pair on the scheduling hot path. An
+        evict-then-bind netting the same count can serve one pass of stale
+        alignment signal; that is fine for a score heuristic."""
+        cached = getattr(node, "_end_aligned_cache", None)
+        if cached is not None and cached[0] == len(node.pods):
+            return cached[1]
+        node_end = podutil.latest_expected_end(node.pods, now)
+        node._end_aligned_cache = (len(node.pods), node_end)
+        return node_end
+
     def score(self, state: CycleState, pod: Pod, node: NodeInfo) -> float:
-        import math
-
-        from nos_tpu.util import pod as podutil
-
         duration = podutil.expected_duration_s(pod)
         if duration is None:
             return 0.0
         now = self._now()
-        node_end = now
-        for p in node.pods:
-            end = podutil.expected_end_s(p)
-            if end is None:
-                return 0.0  # unknown occupant: no alignment signal
-            node_end = max(node_end, end)
+        node_end = self._node_end(node, now)
+        if node_end is None:
+            return 0.0  # unknown occupant: no alignment signal
         return 30.0 * math.exp(-abs(node_end - (now + duration)) / self.scale_s)
 
 

@@ -393,15 +393,11 @@ class Scheduler:
     def _drain_time(self, node: NodeInfo, now: float) -> Optional[float]:
         """When this node's TPU occupancy fully drains per the bound-at +
         expected-duration stamps; None when any occupant is unknown."""
-        drain_at = now
-        for p in node.pods:
-            if _tpu_chips(self.calculator.compute_pod_request(p)) <= 0:
-                continue
-            end = podutil.expected_end_s(p)
-            if end is None:
-                return None
-            drain_at = max(drain_at, end)
-        return drain_at
+        return podutil.latest_expected_end(
+            node.pods,
+            now,
+            count_pod=lambda p: _tpu_chips(self.calculator.compute_pod_request(p)) > 0,
+        )
 
     def _refresh_sticky(self, nodes: List[NodeInfo]) -> Optional[_Reservation]:
         """Rebuild the live reservation from the sticky drain set with a

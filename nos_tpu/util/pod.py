@@ -94,6 +94,23 @@ def expected_end_s(pod: Pod):
     return start + duration
 
 
+def latest_expected_end(pods, now: float, count_pod=None):
+    """The latest stamped end among `pods` (>= now), or None when ANY
+    counted occupant's end is unknown — the shared "when does this node
+    drain" rule used by both the scheduler's drain-set reservations and the
+    end-aligned score. `count_pod` optionally filters which pods matter
+    (e.g. only TPU-consuming ones)."""
+    latest = now
+    for p in pods:
+        if count_pod is not None and not count_pod(p):
+            continue
+        end = expected_end_s(p)
+        if end is None:
+            return None
+        latest = max(latest, end)
+    return latest
+
+
 # -- gang membership (multi-host workloads: one pod per host) ----------------
 def gang_of(pod: Pod):
     """'<ns>/<gang-name>' or None."""

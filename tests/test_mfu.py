@@ -1,0 +1,41 @@
+"""MFU instrumentation (nos_tpu/runtime/mfu.py): peak tables, analytic
+FLOP counts, and the CPU-neutral behavior (no peak known -> None, so MFU
+stays optional telemetry everywhere it is attached)."""
+
+import jax
+
+from nos_tpu.models.gpt import GPTConfig
+from nos_tpu.runtime import mfu
+
+
+class _FakeDevice:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+def test_device_peak_longest_match_wins():
+    assert mfu.device_peak_flops(_FakeDevice("TPU v5 lite")) == 197e12
+    assert mfu.device_peak_flops(_FakeDevice("TPU v5")) == 459e12
+    assert mfu.device_peak_flops(_FakeDevice("TPU v4")) == 275e12
+    assert mfu.device_peak_flops(_FakeDevice("TPU v6 lite")) == 918e12
+    assert mfu.device_peak_flops(_FakeDevice("cpu")) is None
+
+
+def test_gpt_train_flops_analytic():
+    cfg = GPTConfig(hidden=512, layers=4, heads=8, vocab=32000, max_seq=2048)
+    batch, seq = 8, 2048
+    flops = mfu.gpt_train_flops(cfg, batch, seq)
+    # Matmul params: 4 layers x (2*512^2 + 2*512*512 + 3*512*2048) + lm_head.
+    per_layer = 2 * 512 * 512 + 2 * 512 * 512 + 3 * 512 * 2048
+    expected_dense = 6.0 * (4 * per_layer + 512 * 32000) * batch * seq
+    expected_attn = 3.0 * 4 * (4.0 * batch * seq * seq * 512)
+    assert flops == expected_dense + expected_attn
+    assert 3.5e12 < flops < 4.5e12  # ~4.08 TFLOP at this config
+
+
+def test_measure_mfu_none_without_known_peak():
+    # The test env forces CPU (conftest): device peak is unknown, so the
+    # measurement must decline rather than invent a denominator.
+    assert mfu.device_peak_flops(jax.devices()[0]) is None
+    result = mfu.measure_mfu(lambda x: x * 2.0, (jax.numpy.ones((4,)),))
+    assert result is None
