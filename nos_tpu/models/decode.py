@@ -51,46 +51,67 @@ def _attend_cache(q, cache_k, cache_v, n_rep: int, limit):
     return out.reshape(b, nh, t, hd).astype(cache_v.dtype)
 
 
+def _block_core(x, p, cfg: GPTConfig, positions, attend):
+    """The ONE copy of the cached transformer block math (norms, QKV
+    projection, residuals, gated MLP). Every cache layout — dense
+    contiguous, block-paged — supplies only its `attend(q, k_new, v_new)
+    -> o [B, nh, T, hd]` strategy (cache write + cached attention), so the
+    engines cannot drift numerically in anything but the cache plumbing."""
+    b, t, h = x.shape
+    y = _rmsnorm(x, p["ln1"])
+    q, k_new, v_new = project_qkv(y, p, cfg, positions, repeat_kv=False)
+    o = attend(q, k_new, v_new)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, h)
+    x = x + o @ p["wo"]
+    z = _rmsnorm(x, p["ln2"])
+    z = (jax.nn.silu(z @ p["w_gate"]) * (z @ p["w_up"])) @ p["w_down"]
+    return x + z
+
+
 def _block_with_cache(x, p, cfg: GPTConfig, layer_cache, positions, start):
     """One transformer block writing its new K/V into the cache at `start`
     and attending over everything cached so far. x: [B, T, h]. `start` is a
     scalar (whole batch at one offset: prefill / lockstep decode) or a [B]
     vector (ragged decode: each row at its own position)."""
-    b, t, h = x.shape
+    b, t, _ = x.shape
     nh, nkv = cfg.heads, cfg.n_kv
-    y = _rmsnorm(x, p["ln1"])
-    q, k_new, v_new = project_qkv(y, p, cfg, positions, repeat_kv=False)
-    if jnp.ndim(start) == 0:
-        cache_k = jax.lax.dynamic_update_slice(layer_cache["k"], k_new, (0, 0, start, 0))
-        cache_v = jax.lax.dynamic_update_slice(layer_cache["v"], v_new, (0, 0, start, 0))
-        # Causal within the new chunk: token j attends to cache[: start+j+1].
-        limit = start + jnp.arange(t) + 1  # [T]
-        limit_b = jnp.broadcast_to(start + 1, (b,))  # per-row view for t==1
-    else:
-        write = jax.vmap(
-            lambda arr, new, pos: jax.lax.dynamic_update_slice(arr, new, (0, pos, 0))
-        )
-        cache_k = write(layer_cache["k"], k_new, start)
-        cache_v = write(layer_cache["v"], v_new, start)
-        limit = start[:, None] + jnp.arange(t) + 1  # [B, T]
-        limit_b = start + 1
-    if t == 1:
-        # The serving hot path — lockstep (generate) and ragged (DecodeServer)
-        # single-token steps BOTH go through the cached-attention kernel
-        # (Pallas on TPU, XLA reference elsewhere), so the two decode paths
-        # stay numerically identical to each other on every backend.
-        from nos_tpu.ops.decode_attention import decode_attention
+    new_cache = {}
 
-        o = decode_attention(
-            q[:, :, 0, :], cache_k, cache_v, limit_b.astype(jnp.int32)
-        )[:, :, None, :]
-    else:
-        o = _attend_cache(q, cache_k, cache_v, nh // nkv, limit)
-    o = o.transpose(0, 2, 1, 3).reshape(b, t, h)
-    x = x + o @ p["wo"]
-    z = _rmsnorm(x, p["ln2"])
-    z = (jax.nn.silu(z @ p["w_gate"]) * (z @ p["w_up"])) @ p["w_down"]
-    return x + z, {"k": cache_k, "v": cache_v}
+    def attend(q, k_new, v_new):
+        if jnp.ndim(start) == 0:
+            cache_k = jax.lax.dynamic_update_slice(
+                layer_cache["k"], k_new, (0, 0, start, 0)
+            )
+            cache_v = jax.lax.dynamic_update_slice(
+                layer_cache["v"], v_new, (0, 0, start, 0)
+            )
+            # Causal within the new chunk: token j attends to cache[: start+j+1].
+            limit = start + jnp.arange(t) + 1  # [T]
+            limit_b = jnp.broadcast_to(start + 1, (b,))  # per-row view for t==1
+        else:
+            write = jax.vmap(
+                lambda arr, new, pos: jax.lax.dynamic_update_slice(arr, new, (0, pos, 0))
+            )
+            cache_k = write(layer_cache["k"], k_new, start)
+            cache_v = write(layer_cache["v"], v_new, start)
+            limit = start[:, None] + jnp.arange(t) + 1  # [B, T]
+            limit_b = start + 1
+        new_cache["k"], new_cache["v"] = cache_k, cache_v
+        if t == 1:
+            # The serving hot path — lockstep (generate) and ragged
+            # (DecodeServer) single-token steps BOTH go through the
+            # cached-attention kernel (Pallas on TPU, XLA reference
+            # elsewhere), so the decode paths stay numerically identical to
+            # each other on every backend.
+            from nos_tpu.ops.decode_attention import decode_attention
+
+            return decode_attention(
+                q[:, :, 0, :], cache_k, cache_v, limit_b.astype(jnp.int32)
+            )[:, :, None, :]
+        return _attend_cache(q, cache_k, cache_v, nh // nkv, limit)
+
+    x = _block_core(x, p, cfg, positions, attend)
+    return x, new_cache
 
 
 def _forward_with_cache(params, tokens, cfg: GPTConfig, cache, start):
@@ -128,6 +149,113 @@ def decode_step(params, token, cfg: GPTConfig, cache, pos):
     """One token [B] at position `pos` -> (logits [B, vocab], new cache)."""
     logits, cache = _forward_with_cache(params, token[:, None], cfg, cache, pos)
     return logits[:, 0, :], cache
+
+
+# -- block-paged KV cache (vLLM/Orca-style, TPU-shaped) -----------------------
+def init_paged_cache(cfg: GPTConfig, total_blocks: int, block_size: int) -> Dict:
+    """A shared pool of fixed-size KV blocks [total_blocks, n_kv, block,
+    head_dim] per layer. Sequences own disjoint block lists via a page
+    table; block 0 is the SCRATCH page — writes by inactive batch lanes are
+    redirected there, and table rows point at it beyond a sequence's
+    allocation (reads past the attention limit are masked anyway). Compared
+    to the dense [n_slots, max_len] cache, capacity is pooled: admission
+    charges a request for the blocks IT needs, so one long sequence and
+    several short ones share memory that the dense layout would reserve at
+    n_slots x max_len worst case."""
+    shape = (total_blocks, cfg.n_kv, block_size, cfg.head_dim)
+    return {
+        str(i): {
+            "k": jnp.zeros(shape, cfg.jdtype),
+            "v": jnp.zeros(shape, cfg.jdtype),
+        }
+        for i in range(cfg.layers)
+    }
+
+
+def _gather_pages(arr, table):
+    """[total_blocks, nkv, bs, hd] gathered by table [B, P] ->
+    [B, nkv, P*bs, hd]: a VIRTUALLY contiguous per-sequence cache, laid out
+    exactly like the dense cache so the same attention kernels (and
+    therefore the same numerics) apply."""
+    g = arr[table]  # [B, P, nkv, bs, hd]
+    b, p, nkv, bs, hd = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, nkv, p * bs, hd)
+
+
+def paged_decode_step(
+    params, token, cfg: GPTConfig, pcache, table, pos, mask, block_size: int
+):
+    """One token [B] with per-row positions [B] against the paged pool.
+    Lanes with mask[b]=False write to the scratch page (their cache is
+    untouched) and their logits are garbage the caller ignores. Row b
+    attends to its gathered pages up to pos[b]+1 through the SAME
+    cached-attention op as the dense path — the two engines cannot drift."""
+    from nos_tpu.ops.decode_attention import decode_attention
+
+    x = params["tok_emb"][token[:, None]]
+    positions = pos[:, None].astype(jnp.int32)
+    page_idx = pos // block_size
+    off = pos % block_size
+    new_cache = {}
+    for i in range(cfg.layers):
+        p = params["layers"][str(i)]
+        lc = pcache[str(i)]
+
+        def attend(q, k_new, v_new, lc=lc, i=i):
+            page = jnp.take_along_axis(table, page_idx[:, None], axis=1)[:, 0]
+            page = jnp.where(mask, page, 0)  # inactive lanes hit scratch
+            ck = lc["k"].at[page, :, off, :].set(k_new[:, :, 0, :])
+            cv = lc["v"].at[page, :, off, :].set(v_new[:, :, 0, :])
+            new_cache[str(i)] = {"k": ck, "v": cv}
+            return decode_attention(
+                q[:, :, 0, :],
+                _gather_pages(ck, table),
+                _gather_pages(cv, table),
+                (pos + 1).astype(jnp.int32),
+            )[:, :, None, :]
+
+        x = _block_core(x, p, cfg, positions, attend)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits[:, 0, :], new_cache
+
+
+def paged_prefill_chunk(
+    params, tokens, cfg: GPTConfig, pcache, table_row, start, length, block_size: int
+):
+    """One prompt CHUNK [1, C] for a single sequence, written into its pages
+    at positions start..start+C-1 (positions >= start+length — chunk
+    padding — go to the scratch page). Returns (logits [C, vocab] for the
+    chunk, new pool). Chunking bounds admission cost: a 100k-token prompt
+    is as many bounded dispatches, never one giant compile/step, and each
+    chunk attends over the already-written prefix (exact causal masking
+    within the chunk via _attend_cache)."""
+    _, c = tokens.shape
+    positions = start + jnp.arange(c, dtype=jnp.int32)
+    valid = jnp.arange(c) < length
+    x = params["tok_emb"][tokens]
+    table = table_row[None, :]  # [1, P]
+    pages = jnp.where(valid, table_row[positions // block_size], 0)
+    offs = positions % block_size
+    limit = positions + 1  # [C]; padding rows masked by `valid` at sample time
+    new_cache = {}
+    for i in range(cfg.layers):
+        p = params["layers"][str(i)]
+        lc = pcache[str(i)]
+
+        def attend(q, k_new, v_new, lc=lc, i=i):
+            ck = lc["k"].at[pages, :, offs, :].set(k_new[0].transpose(1, 0, 2))
+            cv = lc["v"].at[pages, :, offs, :].set(v_new[0].transpose(1, 0, 2))
+            new_cache[str(i)] = {"k": ck, "v": cv}
+            return _attend_cache(
+                q, _gather_pages(ck, table), _gather_pages(cv, table),
+                cfg.heads // cfg.n_kv, limit,
+            )
+
+        x = _block_core(x, p, cfg, positions[None, :], attend)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits[0], new_cache
 
 
 # -- ragged (per-row position) decoding --------------------------------------

@@ -10,8 +10,14 @@ their slot independently, so short requests are never held hostage by long
 ones and the MXU always sees the full active batch.
 
 TPU-shaped by construction:
-  - the cache is a static [n_slots, ...] allocation and prompts are padded to
-    bucket lengths, so XLA reuses compiled programs;
+  - the KV cache is a BLOCK-PAGED pool ([total_blocks, n_kv, block, hd] per
+    layer) with per-slot page tables: admission charges a request for the
+    blocks it actually needs (prompt + max_new), so `max_len` is a
+    per-sequence ceiling, not a per-slot reservation — one 2k-token request
+    and several short ones share memory a dense layout would reserve at
+    n_slots x max_len. Prompts are PREFILLED IN CHUNKS (bucket-sized padded
+    dispatches), so admission cost is bounded regardless of prompt length
+    and 1k+-token prompts serve through the same compiled programs;
   - the token loop is DEVICE-RESIDENT: each step's sampled tokens feed the
     next step directly on device, and prefill scatters its first token into
     the device-side token vector, so neither admission nor steady-state
@@ -39,7 +45,11 @@ import numpy as np
 
 import logging
 
-from nos_tpu.models.decode import _forward_with_cache, decode_step_ragged, init_cache
+from nos_tpu.models.decode import (
+    init_paged_cache,
+    paged_decode_step,
+    paged_prefill_chunk,
+)
 from nos_tpu.models.gpt import GPTConfig
 
 logger = logging.getLogger(__name__)
@@ -94,6 +104,8 @@ class DecodeServer:
         seed: int = 0,
         pipeline_depth: int = 16,
         steps_per_dispatch: int = 1,
+        block_size: int = 32,
+        total_blocks: Optional[int] = None,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
         softmax sampling with a deterministic per-slot, per-step PRNG stream
@@ -112,13 +124,21 @@ class DecodeServer:
         trip per K tokens instead of per token — the decisive knob when the
         link RTT, not the step execution, bounds throughput. Admission and
         EOS reaction granularity become K steps; greedy outputs are
-        bit-identical for any K (same math, same order)."""
+        bit-identical for any K (same math, same order).
+
+        `block_size`/`total_blocks` size the paged KV pool. The default pool
+        (n_slots x ceil(max_len/block_size) + scratch) matches the dense
+        layout's worst case, so nothing regresses; operators raise `max_len`
+        for long-context serving WITHOUT paying n_slots x max_len — the pool
+        charges each request only for the blocks its prompt + max_new
+        need, and admission waits (backpressure, FIFO) while the pool is
+        exhausted instead of over-committing."""
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
-        # A bucket must fit in the cache; prompts longer than the largest
-        # bucket are rejected per request (never silently truncated).
+        # Buckets are both admission padding sizes AND prefill chunk sizes;
+        # prompts longer than the largest bucket prefill in chunks of it.
         self.prompt_buckets = sorted(b for b in prompt_buckets if b < max_len)
         if not self.prompt_buckets:
             raise ValueError(
@@ -126,7 +146,23 @@ class DecodeServer:
             )
         self.eos_id = eos_id
         self.pipeline_depth = max(1, pipeline_depth if eos_id is None else min(pipeline_depth, 2))
-        self.cache = init_cache(cfg, n_slots, max_len)
+        self.block_size = int(block_size)
+        self.max_pages = -(-max_len // self.block_size)
+        # +1: block 0 is the scratch page (inactive-lane writes, padding).
+        self.total_blocks = (
+            total_blocks
+            if total_blocks is not None
+            else 1 + n_slots * self.max_pages
+        )
+        if self.total_blocks < 2:
+            raise ValueError("total_blocks must be >= 2 (scratch + 1)")
+        self.cache = init_paged_cache(cfg, self.total_blocks, self.block_size)
+        self._table = jnp.zeros((n_slots, self.max_pages), dtype=jnp.int32)
+        self._free_blocks = list(range(1, self.total_blocks))
+        self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
+        # FIFO head-of-line admission: a request the pool cannot host yet
+        # waits here (never reordered past).
+        self._waiting: Deque[Tuple[list, int, Future]] = deque()
         self._queue: "queue.Queue" = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
         self._last_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
@@ -158,29 +194,25 @@ class DecodeServer:
 
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
         K = self.steps_per_dispatch
+        bs = self.block_size
 
-        def _macro(params, token, cache, pos0, active, serial, step0, steps_left):
+        def _macro(params, token, cache, table, pos0, active, serial, step0, steps_left):
             """K ragged decode iterations in one program. Per iteration k a
             lane participates iff it is active, still owes tokens
             (k < steps_left), and stays inside the cache window; lanes that
-            finish mid-window coast (cache untouched, token held)."""
+            finish mid-window coast (their writes go to the scratch page,
+            token held)."""
 
             def body(carry, k):
                 token, cache = carry
                 pos_k = pos0 + k
                 mask = active & (k < steps_left) & (pos_k < max_len)
-                logits, new_cache = decode_step_ragged(params, token, cfg, cache, pos_k)
-                nxt = _sample(logits, serial, step0 + k)
-                keep = mask[:, None, None, None]
-                new_cache = jax.tree.map(
-                    lambda new, old: jnp.where(keep, new, old)
-                    if new.ndim == 4
-                    else new,
-                    new_cache,
-                    cache,
+                logits, cache = paged_decode_step(
+                    params, token, cfg, cache, table, pos_k, mask, bs
                 )
+                nxt = _sample(logits, serial, step0 + k)
                 out_token = jnp.where(mask, nxt, token)
-                return (out_token, new_cache), jnp.where(mask, nxt, 0)
+                return (out_token, cache), jnp.where(mask, nxt, 0)
 
             (final_token, cache), toks = jax.lax.scan(
                 body, (token, cache), jnp.arange(K)
@@ -188,27 +220,32 @@ class DecodeServer:
             return final_token, toks, cache  # toks: [K, n_slots]
 
         # Donate the cache: with pipeline_depth dispatches in flight,
-        # donation keeps one cache allocation alive instead of depth of them.
+        # donation keeps one pool allocation alive instead of depth of them.
         self._step_fn = jax.jit(_macro, donate_argnums=(2,))
 
-        # Prefill path: run the padded prompt, take logits at the true last
-        # prompt position (sampled as the request's step 0), scatter the
-        # single-lane cache into the slot and the first token into the
-        # device-resident token vector (no host materialization on admit).
-        def _prefill_into(params, tokens, length, cache, last, slot, serial):
-            lane = init_cache(cfg, 1, max_len)
-            logits, lane = _forward_with_cache(params, tokens, cfg, lane, 0)
+        # Chunked prefill: one bounded dispatch per prompt chunk, writing
+        # into the slot's pages. `finish` statically selects the last-chunk
+        # variant that samples the request's first token at its true last
+        # prompt position and scatters it into the device token vector.
+        def _prefill_chunk(params, tokens, cache, table_row, start, length):
+            logits, cache = paged_prefill_chunk(
+                params, tokens, cfg, cache, table_row, start, length, bs
+            )
+            return logits, cache
+
+        def _prefill_last(params, tokens, cache, table_row, start, length, last, slot, serial):
+            logits, cache = paged_prefill_chunk(
+                params, tokens, cfg, cache, table_row, start, length, bs
+            )
             first = _sample(
-                logits[0, length - 1, :][None, :],
+                logits[length - 1, :][None, :],
                 jnp.asarray([serial]),
                 jnp.asarray([0]),
             )[0]
-            cache = jax.tree.map(
-                lambda big, small: big.at[slot].set(small[0]), cache, lane
-            )
             return first, cache, last.at[slot].set(first)
 
-        self._prefill_into = jax.jit(_prefill_into, donate_argnums=(3, 4))
+        self._prefill_chunk = jax.jit(_prefill_chunk, donate_argnums=(2,))
+        self._prefill_last = jax.jit(_prefill_last, donate_argnums=(2, 6))
 
     # -- client side ---------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new: int = 16) -> Future:
@@ -240,8 +277,12 @@ class DecodeServer:
         for idx, slot in enumerate(self._slots):
             if slot.future is not None and not slot.future.done():
                 slot.future.set_exception(exc)
-            self._slots[idx] = _Slot()
+            self._release_slot(idx)
         self._inflight.clear()
+        while self._waiting:
+            _, _, fut = self._waiting.popleft()
+            if not fut.done():
+                fut.set_exception(exc)
         while True:
             try:
                 _, _, fut = self._queue.get_nowait()
@@ -250,10 +291,19 @@ class DecodeServer:
             if not fut.done():
                 fut.set_exception(exc)
 
+    def _release_slot(self, idx: int) -> None:
+        """Return the slot's pages to the pool and clear its lane."""
+        self._free_blocks.extend(self._slot_blocks[idx])
+        self._slot_blocks[idx] = []
+        self._slots[idx] = _Slot()
+
     def _reset_device_state(self) -> None:
         """After an engine error the donated cache chain is untrustworthy;
         start from a fresh allocation."""
-        self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
+        self.cache = init_paged_cache(self.cfg, self.total_blocks, self.block_size)
+        self._table = jnp.zeros((self.n_slots, self.max_pages), dtype=jnp.int32)
+        self._free_blocks = list(range(1, self.total_blocks))
+        self._slot_blocks = [[] for _ in range(self.n_slots)]
         self._last_dev = jnp.zeros((self.n_slots,), dtype=jnp.int32)
 
     def _bucket(self, n: int) -> int:
@@ -262,31 +312,32 @@ class DecodeServer:
                 return b
         return self.prompt_buckets[-1]
 
+    def _next_request(self):
+        """FIFO across the waiting line and the client queue."""
+        if self._waiting:
+            return self._waiting.popleft()
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
     def _admit(self) -> None:
         for idx, slot in enumerate(self._slots):
             if slot.active:
                 continue
-            try:
-                prompt, max_new, fut = self._queue.get_nowait()
-            except queue.Empty:
+            item = self._next_request()
+            if item is None:
                 return
+            prompt, max_new, fut = item
             if len(prompt) >= self.max_len:
                 fut.set_exception(
                     ValueError(f"prompt length {len(prompt)} >= max_len {self.max_len}")
                 )
                 continue
-            if len(prompt) > self.prompt_buckets[-1]:
-                fut.set_exception(
-                    ValueError(
-                        f"prompt length {len(prompt)} exceeds the largest "
-                        f"prompt bucket {self.prompt_buckets[-1]}"
-                    )
-                )
-                continue
             if len(prompt) + max_new - 1 > self.max_len:
-                # The request cannot complete inside the cache window —
-                # reject it rather than silently resolve with fewer tokens
-                # than asked for (the generation finishing at pos == max_len
+                # The request cannot complete inside the per-sequence window
+                # — reject rather than silently resolve with fewer tokens
+                # than asked for (a generation finishing at pos == max_len
                 # with remaining == 0 is the exact boundary, hence the -1).
                 fut.set_exception(
                     ValueError(
@@ -296,23 +347,68 @@ class DecodeServer:
                     )
                 )
                 continue
-            bucket = self._bucket(len(prompt))
-            padded = np.zeros((1, bucket), dtype=np.int32)
-            padded[0, : len(prompt)] = prompt
+            # Block accounting: cache holds positions 0..len+max_new-2 (the
+            # final sampled token is never re-attended).
+            n_blocks = max(1, -(-(len(prompt) + max_new - 1) // self.block_size))
+            if n_blocks > self.total_blocks - 1:
+                # Bigger than the ENTIRE pool: waiting would hang this
+                # request forever and head-of-line-block everything behind
+                # it. Reject like any other un-servable request.
+                fut.set_exception(
+                    ValueError(
+                        f"request needs {n_blocks} KV blocks; the pool has "
+                        f"{self.total_blocks - 1}"
+                    )
+                )
+                continue
+            if n_blocks > len(self._free_blocks):
+                # Pool exhausted: wait for running sequences to finish.
+                # FIFO head-of-line — later requests must not starve this
+                # one by sneaking into blocks as they free.
+                self._waiting.appendleft((prompt, max_new, fut))
+                return
+            blocks = [self._free_blocks.pop() for _ in range(n_blocks)]
+            self._slot_blocks[idx] = blocks
+            row = np.zeros((self.max_pages,), dtype=np.int32)
+            row[: len(blocks)] = blocks
+            self._table = self._table.at[idx].set(jnp.asarray(row))
             serial = self._next_serial
             self._next_serial += 1
             self._slot_serial[idx] = serial
-            # Dispatch only: the slot is decodable immediately because the
-            # first token lives in the device token vector; nothing blocks.
-            first, self.cache, self._last_dev = self._prefill_into(
-                self.params,
-                jnp.asarray(padded),
-                len(prompt),
-                self.cache,
-                self._last_dev,
-                idx,
-                serial,
-            )
+            # Chunked prefill: bounded bucket-padded dispatches; the final
+            # chunk's variant samples the request's first token directly
+            # into the device token vector (no host materialization).
+            chunk = self.prompt_buckets[-1]
+            start = 0
+            first = None
+            while True:
+                piece = prompt[start : start + chunk]
+                last_chunk = start + len(piece) >= len(prompt)
+                bucket = self._bucket(len(piece))
+                padded = np.zeros((1, bucket), dtype=np.int32)
+                padded[0, : len(piece)] = piece
+                if last_chunk:
+                    first, self.cache, self._last_dev = self._prefill_last(
+                        self.params,
+                        jnp.asarray(padded),
+                        self.cache,
+                        self._table[idx],
+                        start,
+                        len(piece),
+                        self._last_dev,
+                        idx,
+                        serial,
+                    )
+                    break
+                _, self.cache = self._prefill_chunk(
+                    self.params,
+                    jnp.asarray(padded),
+                    self.cache,
+                    self._table[idx],
+                    start,
+                    len(piece),
+                )
+                start += len(piece)
             slot.active = True
             slot.pos = len(prompt)
             slot.remaining = max_new - 1
@@ -349,7 +445,7 @@ class DecodeServer:
             return
         if slot.remaining <= 0 or slot.pos >= self.max_len:
             slot.future.set_result(self._finalize(slot))
-            self._slots[idx] = _Slot()
+            self._release_slot(idx)
 
     def _scan_eos(self) -> None:
         """With an eos_id, sequence termination depends on token values; scan
@@ -372,7 +468,7 @@ class DecodeServer:
                 if token == self.eos_id:
                     slot.refs = slot.refs[: slot.eos_scanned]
                     slot.future.set_result(self._finalize(slot))
-                    self._slots[idx] = _Slot()
+                    self._release_slot(idx)
                     break
 
     def _run(self) -> None:
@@ -406,6 +502,7 @@ class DecodeServer:
             self.params,
             self._last_dev,
             self.cache,
+            self._table,
             jnp.asarray(pos),
             jnp.asarray(active),
             jnp.asarray(self._slot_serial),
