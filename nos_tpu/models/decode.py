@@ -221,7 +221,15 @@ def paged_decode_step(
 
 
 def paged_prefill_chunk(
-    params, tokens, cfg: GPTConfig, pcache, table_row, start, length, block_size: int
+    params,
+    tokens,
+    cfg: GPTConfig,
+    pcache,
+    table_row,
+    start,
+    length,
+    block_size: int,
+    with_logits: bool = True,
 ):
     """One prompt CHUNK [1, C] for a single sequence, written into its pages
     at positions start..start+C-1 (positions >= start+length — chunk
@@ -253,6 +261,11 @@ def paged_prefill_chunk(
             )
 
         x = _block_core(x, p, cfg, positions[None, :], attend)
+    if not with_logits:
+        # Non-final chunks only feed the cache: skip the [C, vocab] head
+        # projection entirely (XLA cannot DCE a returned output, and at
+        # production vocab sizes it dominates the chunk's FLOPs).
+        return None, new_cache
     x = _rmsnorm(x, params["ln_f"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits[0], new_cache
@@ -260,14 +273,13 @@ def paged_prefill_chunk(
 
 # -- ragged (per-row position) decoding --------------------------------------
 def decode_step_ragged(params, token, cfg: GPTConfig, cache, pos):
-    """One token [B] with PER-ROW positions [B] -> (logits [B,vocab], cache).
-    Row b writes its K/V at pos[b] and attends to cache[:pos[b]+1]. This is
-    what continuous batching (DecodeServer) steps with: each slot sits at its
-    own position — slot 0 may be at token 90 while slot 1 just prefilled to
-    7. Shares the exact block code with prefill/lockstep decode (the vector
-    `start` path of _forward_with_cache), and every single-token step —
-    lockstep or ragged — attends through the same cached-attention op, so the
-    decode paths cannot drift from each other on any backend."""
+    """One token [B] with PER-ROW positions [B] -> (logits [B,vocab], cache),
+    against the DENSE contiguous cache. Row b writes its K/V at pos[b] and
+    attends to cache[:pos[b]+1]. The serving engine (DecodeServer) steps with
+    `paged_decode_step` instead — same `_block_core` math, same
+    cached-attention op, paged cache plumbing; this dense variant remains the
+    reference the paged engine's tests compare against (and the path for
+    callers holding a dense cache from `prefill`)."""
     logits, cache = _forward_with_cache(params, token[:, None], cfg, cache, pos)
     return logits[:, 0, :], cache
 

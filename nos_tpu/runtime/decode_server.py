@@ -5,7 +5,7 @@ iteration-level scheduling instead (Orca-style continuous batching): the
 engine keeps a fixed set of batch lanes ("slots"), admits a waiting request
 into any free slot by prefilling its prompt into that slot's KV-cache lane,
 and steps ALL active slots together — one token per sequence per iteration,
-each at its own position (`decode_step_ragged`). Sequences finish and free
+each at its own position (`paged_decode_step`). Sequences finish and free
 their slot independently, so short requests are never held hostage by long
 ones and the MXU always sees the full active batch.
 
@@ -228,10 +228,11 @@ class DecodeServer:
         # variant that samples the request's first token at its true last
         # prompt position and scatters it into the device token vector.
         def _prefill_chunk(params, tokens, cache, table_row, start, length):
-            logits, cache = paged_prefill_chunk(
-                params, tokens, cfg, cache, table_row, start, length, bs
+            _, cache = paged_prefill_chunk(
+                params, tokens, cfg, cache, table_row, start, length, bs,
+                with_logits=False,
             )
-            return logits, cache
+            return cache
 
         def _prefill_last(params, tokens, cache, table_row, start, length, last, slot, serial):
             logits, cache = paged_prefill_chunk(
@@ -375,6 +376,14 @@ class DecodeServer:
             serial = self._next_serial
             self._next_serial += 1
             self._slot_serial[idx] = serial
+            # Bind the future to the slot BEFORE the chunk loop: if a prefill
+            # dispatch raises mid-loop, the engine's failure sweep
+            # (_fail_outstanding) must find and fail this request — a future
+            # held only in a local would strand its client forever.
+            slot.active = True
+            slot.future = fut
+            slot.remaining = 0
+            slot.refs = []
             # Chunked prefill: bounded bucket-padded dispatches; the final
             # chunk's variant samples the request's first token directly
             # into the device token vector (no host materialization).
@@ -400,7 +409,7 @@ class DecodeServer:
                         serial,
                     )
                     break
-                _, self.cache = self._prefill_chunk(
+                self.cache = self._prefill_chunk(
                     self.params,
                     jnp.asarray(padded),
                     self.cache,
@@ -409,12 +418,10 @@ class DecodeServer:
                     len(piece),
                 )
                 start += len(piece)
-            slot.active = True
             slot.pos = len(prompt)
             slot.remaining = max_new - 1
             slot.refs = [(_TokRef(first), None, None)]
             slot.eos_scanned = 0
-            slot.future = fut
             self._finish_if_done(idx)
 
     @staticmethod

@@ -285,18 +285,18 @@ def test_pool_backpressure_fifo_and_release(params):
         max_len=32,
         prompt_buckets=(8, 16),
         block_size=8,
-        total_blocks=1 + 2,  # scratch + 2 blocks: one request at a time
+        total_blocks=1 + 1,  # scratch + ONE block: strictly one request at a time
     ).start()
     p1, p2 = [1, 2, 3], [4, 5, 6]
     try:
-        f1 = server.submit(p1, max_new=4)
-        f2 = server.submit(p2, max_new=4)
+        f1 = server.submit(p1, max_new=4)  # needs 1 block = the whole pool
+        f2 = server.submit(p2, max_new=4)  # must WAIT until f1 releases
         assert f1.result(timeout=120) == solo_greedy(params, p1, 4, max_len=32)
         assert f2.result(timeout=120) == solo_greedy(params, p2, 4, max_len=32)
     finally:
         server.stop()
     # Every page returned to the pool.
-    assert sorted(server._free_blocks) == [1, 2]
+    assert sorted(server._free_blocks) == [1]
 
 
 def test_pool_oversubscription_shares_memory(params):

@@ -1,0 +1,177 @@
+"""Concurrency/fault soak for the HTTP stack (KubeCluster over
+ClusterAPIServer, every byte across real sockets).
+
+The in-memory bus has its own soak (tests/test_cluster_soak.py); this is
+the same discipline for the HTTP path the round-2 verdict called out as
+the newest, riskiest layer: concurrent writers driving the patch OCC loop
+from multiple threads/clients, informer-backed watchers asserting
+per-object ordering, and an API-server restart mid-soak (watch streams
+die; informers must re-list and synthesize the missed deltas) with NO
+lost updates and NO stuck clients.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from nos_tpu.api.objects import ConfigMap, ObjectMeta, Pod
+from nos_tpu.cluster.apiserver import ClusterAPIServer
+from nos_tpu.cluster.client import Cluster, EventType
+from nos_tpu.cluster.kube import KubeCluster, KubeConfig
+
+
+def wait_for(cond, timeout=30.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_concurrent_patch_storm_loses_no_updates():
+    """N threads x M increments against ONE ConfigMap counter through the
+    OCC merge-patch loop, from two independent clients: the final count
+    must be exactly N*M (every conflict retried through, nothing lost)."""
+    backing = Cluster()
+    server = ClusterAPIServer(backing).start()
+    clients = [KubeCluster(KubeConfig(server=server.url)) for _ in range(2)]
+    try:
+        clients[0].create(
+            ConfigMap(
+                metadata=ObjectMeta(name="counter", namespace="default"),
+                data={"n": "0"},
+            )
+        )
+        n_threads, n_incr = 4, 25
+        errors = []
+
+        def worker(i):
+            kube = clients[i % len(clients)]
+            try:
+                for _ in range(n_incr):
+                    kube.patch(
+                        "ConfigMap",
+                        "default",
+                        "counter",
+                        lambda cm: cm.data.update(n=str(int(cm.data["n"]) + 1)),
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        final = clients[0].get("ConfigMap", "default", "counter")
+        assert int(final.data["n"]) == n_threads * n_incr
+    finally:
+        for c in clients:
+            c.close()
+        server.stop()
+
+
+def test_soak_with_apiserver_restart_no_lost_state():
+    """Writers churn pods while a watcher follows via informer; the API
+    server is killed and restarted mid-soak (same store — etcd outlives an
+    apiserver). Afterward: every surviving object's final state is visible
+    to the watcher, per-object resourceVersions never went backward, and
+    the writers completed without losing a single update."""
+    backing = Cluster()
+    server = ClusterAPIServer(backing).start()
+    port = server._httpd.server_address[1]
+    writer_client = KubeCluster(KubeConfig(server=server.url))
+    watch_client = KubeCluster(KubeConfig(server=server.url))
+    seen_rvs: dict = {}
+    order_violations = []
+    lock = threading.Lock()
+
+    def on_event(ev):
+        key = ev.obj.metadata.name
+        rv = int(ev.obj.metadata.resource_version)
+        with lock:
+            prev = seen_rvs.get(key)
+            if ev.type == EventType.DELETED:
+                seen_rvs.pop(key, None)
+                return
+            if prev is not None and rv < prev:
+                order_violations.append((key, prev, rv))
+            seen_rvs[key] = rv
+
+    try:
+        watch_client.watch("Pod", on_event)
+        n_objs, n_rounds = 6, 12
+        for i in range(n_objs):
+            writer_client.create(
+                Pod(metadata=ObjectMeta(name=f"p{i}", namespace="default"))
+            )
+        errors = []
+
+        def writer(idx):
+            # Retries tolerate the restart window (connection refused while
+            # the server is down); updates themselves must never be lost.
+            for r in range(n_rounds):
+                for attempt in range(200):
+                    try:
+                        writer_client.patch(
+                            "Pod",
+                            "default",
+                            f"p{idx}",
+                            lambda p, r=r: p.metadata.annotations.update(
+                                round=str(r)
+                            ),
+                        )
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        if attempt == 199:
+                            errors.append(e)
+                        time.sleep(0.05)
+                time.sleep(0.01)
+
+        threads = [
+            threading.Thread(target=writer, args=(i,)) for i in range(n_objs)
+        ]
+        for t in threads:
+            t.start()
+
+        time.sleep(0.3)  # let the soak get going
+        server.stop()  # watch streams die mid-soak
+        backing.create(
+            Pod(metadata=ObjectMeta(name="during-outage", namespace="default"))
+        )
+        time.sleep(0.3)
+        server = ClusterAPIServer(backing, port=port).start()
+
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "writer stuck"
+        assert not errors, errors
+
+        # Every writer round landed (no lost updates through the outage).
+        for i in range(n_objs):
+            pod = writer_client.get("Pod", "default", f"p{i}")
+            assert pod.metadata.annotations.get("round") == str(n_rounds - 1)
+
+        # The watcher converges on final state, including the object created
+        # while its stream was down (re-list synthesis).
+        def converged():
+            with lock:
+                if "during-outage" not in seen_rvs:
+                    return False
+                for i in range(n_objs):
+                    pod = backing.get("Pod", "default", f"p{i}")
+                    if seen_rvs.get(f"p{i}") != pod.metadata.resource_version:
+                        return False
+                return True
+
+        wait_for(converged, timeout=30, msg="watcher convergence after restart")
+        assert not order_violations, order_violations
+    finally:
+        writer_client.close()
+        watch_client.close()
+        server.stop()
