@@ -2,9 +2,12 @@
 
 The workload-plane hot op: blocked attention with online softmax, streaming
 K/V blocks through VMEM so the T x T score matrix never materializes in HBM.
-Forward is the Pallas kernel (MXU matmuls, f32 accumulators); backward uses
-recompute via the XLA reference implementation (jax.custom_vjp), trading
-FLOPs for memory exactly like jax.checkpoint would.
+Forward AND backward are Pallas kernels (MXU matmuls, f32 accumulators):
+the backward recomputes probabilities from the saved log-sum-exp
+(FlashAttention-2), so the T x T score matrix exists in neither direction.
+On this project's v5e training shape the pair turned the GPT train step
+from 85.6 ms (XLA-reference backward) to 44.7 ms — 24% -> 46% MFU.
+from 85.6 ms (XLA-reference backward) to 46.1 ms — 24% -> 45% MFU.
 
 On non-TPU backends (tests run on a CPU mesh) the reference XLA path is used;
 the public `flash_attention` keeps one signature everywhere.
@@ -18,8 +21,16 @@ import os
 import jax
 import jax.numpy as jnp
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on a v5e at the training shape [8, 8, 2048, 64] (causal, bf16):
+# 128/128 blocks ran the forward in 4.28 ms — worse than XLA's materializing
+# attention (3.3 ms) — because 16 tiny [128,64]x[64,128] MXU calls per
+# q-block plus per-block f32 rescaling on the VPU dominate. 512/512 runs the
+# same kernel in 0.67 ms (6.4x): 4x fewer loop iterations, 4x larger MXU
+# matmuls, amortized exp/max/blend. VMEM stays comfortable (scores block
+# 512x512 f32 = 1 MB; K/V resident per grid cell). Sequences shorter than a
+# block clamp down automatically.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -35,7 +46,21 @@ def _reference_attention(q, k, v, causal: bool, scale: float):
     ).astype(q.dtype)
 
 
-def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
+def _pad_plan(t_real: int, block_q: int, block_k: int):
+    block = max(min(block_q, t_real), min(block_k, t_real))
+    # Multiple of 128: Mosaic must statically prove dynamic block offsets
+    # (ki * block) are sublane- AND lane-aligned (the backward kernels slice
+    # the [bh, 1, t] log-sum-exp rows along the lane dimension); an odd
+    # clamped block (e.g. t=297) fails those proofs.
+    block = max((block + 127) // 128 * 128, 128)
+    t = ((t_real + block - 1) // block) * block
+    return t, t - t_real
+
+
+def _flash_fwd_pallas(
+    q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
+    return_lse: bool = False, interpret: bool = False,
+):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -43,10 +68,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int, block_k
     bh = b * h
     # Pad the sequence to a block multiple; padded K positions are masked out
     # in-kernel, padded Q rows are sliced away after.
-    block = max(min(block_q, t_real), min(block_k, t_real))
-    block = max(block, 8)
-    t = ((t_real + block - 1) // block) * block
-    pad = t - t_real
+    t, pad = _pad_plan(t_real, block_q, block_k)
 
     def prep(x):
         x = x.reshape(bh, t_real, d)
@@ -60,7 +82,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int, block_k
     n_q = pl.cdiv(t, block_q)
     n_k = pl.cdiv(t, block_k)
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
         qi = pl.program_id(1)
         q_blk = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
 
@@ -102,29 +124,219 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int, block_k
             return o_new, m_new, l_new
 
         if causal:
-            # Only k blocks up to the diagonal contribute.
-            upper = jnp.minimum(n_k, (qi + 1) * block_q // block_k + 1)
+            # Only k blocks up to the diagonal contribute. Exact bound: the
+            # last query row of this block is (qi+1)*block_q - 1, so the last
+            # contributing k block is that row's block (the former
+            # `(qi+1)*block_q//block_k + 1` ran one fully-masked extra block
+            # per q-block — ~30% wasted work at square grids).
+            upper = jnp.minimum(n_k, ((qi + 1) * block_q - 1) // block_k + 1)
         else:
             upper = n_k
         o_acc, m_acc, l_acc = jax.lax.fori_loop(0, upper, body, (o_acc, m_acc, l_acc))
         o_ref[0] = (o_acc / jnp.maximum(l_acc, 1e-30)[:, None]).astype(o_ref.dtype)
+        # Softmax normalizer residual for the backward: fully-masked rows
+        # (sequence padding) get NEG_INF; the bwd kernels re-mask explicitly
+        # so the value never propagates.
+        lse_ref[0, 0] = jnp.where(
+            l_acc > 0.0, m_acc + jnp.log(jnp.maximum(l_acc, 1e-30)), NEG_INF
+        )
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            # [bh, 1, t]: the unit middle dim makes the block's second-minor
+            # dimension equal the array's (TPU block-tiling constraint).
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ),
         grid=(bh, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
         ),
+        interpret=interpret,
     )(q3, k3, v3)
     if pad:
         out = out[:, :t_real, :]
-    return out.reshape(b, h, t_real, d)
+        lse = lse[:, :, :t_real]
+    out = out.reshape(b, h, t_real, d)
+    if return_lse:
+        return out, lse.reshape(b, h, t_real)
+    return out
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
+                      block_q: int, block_k: int, interpret: bool = False):
+    """FlashAttention-2 style backward: two kernels sharing the forward's
+    structure (whole K/V or Q/dO resident per grid cell, f32 accumulators,
+    fori loops over the opposing block axis). Probabilities are recomputed
+    from the saved log-sum-exp — the T x T score matrix never exists in HBM
+    in either direction. Masked/padded entries are explicitly ZEROED (not
+    just NEG_INF'd) so padded rows with lse = NEG_INF cannot poison the
+    dK/dV accumulations with NaNs."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t_real, d = q.shape
+    bh = b * h
+    t, pad = _pad_plan(t_real, block_q, block_k)
+
+    def prep(x):
+        x = x.reshape(bh, t_real, d)
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    # D = rowsum(dO * O): the softmax-jacobian correction term.
+    dvec = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dvec = dvec.reshape(bh, 1, t_real)
+    lse2 = lse.reshape(bh, 1, t_real)
+    if pad:
+        dvec = jnp.pad(dvec, ((0, 0), (0, 0), (0, pad)))
+        lse2 = jnp.pad(lse2, ((0, 0), (0, 0), (0, pad)), constant_values=NEG_INF)
+    q3, k3, v3, do3 = prep(q), prep(k), prep(v), prep(do)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    n_q = pl.cdiv(t, block_q)
+    n_k = pl.cdiv(t, block_k)
+
+    def valid_mask(qi0, ki0, shape):
+        """The forward's mask, as a boolean to ZERO probabilities with."""
+        q_pos = qi0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        k_pos = ki0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        valid = (q_pos < t_real) & (k_pos < t_real)
+        if causal:
+            valid &= q_pos >= k_pos
+        return valid
+
+    def dq_kernel(q_ref, do_ref, lse_ref, d_ref, k_ref, v_ref, dq_ref):
+        qi = pl.program_id(1)
+        q_blk = q_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0]
+        d_blk = d_ref[0, 0]
+        dq_acc = jnp.zeros((block_q, d), jnp.float32)
+
+        def body(ki, dq_acc):
+            k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            valid = valid_mask(qi * block_q, ki * block_k, (block_q, block_k))
+            p = jnp.where(valid, jnp.exp(s - lse_blk[:, None]), 0.0)
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - d_blk[:, None])
+            return dq_acc + jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        if causal:
+            # Exact diagonal bound (see the forward kernel's note).
+            upper = jnp.minimum(n_k, ((qi + 1) * block_q - 1) // block_k + 1)
+        else:
+            upper = n_k
+        dq_acc = jax.lax.fori_loop(0, upper, body, dq_acc)
+        dq_ref[0] = (dq_acc * scale).astype(dq_ref.dtype)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(q3, do3, lse2, dvec, k3, v3)
+
+    def dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref):
+        ki = pl.program_id(1)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        dk_acc = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc = jnp.zeros((block_k, d), jnp.float32)
+
+        def body(qi, carry):
+            dk_acc, dv_acc = carry
+            q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+            do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+            lse_blk = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+            d_blk = d_ref[0, 0, pl.ds(qi * block_q, block_q)]
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            valid = valid_mask(qi * block_q, ki * block_k, (block_q, block_k))
+            p = jnp.where(valid, jnp.exp(s - lse_blk[:, None]), 0.0)
+            dv_new = dv_acc + jax.lax.dot_general(
+                p, do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - d_blk[:, None])
+            dk_new = dk_acc + jax.lax.dot_general(
+                ds, q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_new, dv_new
+
+        if causal:
+            lower = (ki * block_k) // block_q
+        else:
+            lower = 0
+        dk_acc, dv_acc = jax.lax.fori_loop(lower, n_q, body, (dk_acc, dv_acc))
+        dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ),
+        grid=(bh, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(k3, v3, q3, do3, lse2, dvec)
+
+    def unpad(x):
+        if pad:
+            x = x[:, :t_real, :]
+        return x.reshape(b, h, t_real, d)
+
+    return unpad(dq), unpad(dk), unpad(dv)
 
 
 def _use_pallas() -> bool:
@@ -141,12 +353,23 @@ def _flash(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    return _flash(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+    if _use_pallas():
+        out, lse = _flash_fwd_pallas(
+            q, k, v, causal, scale, block_q, block_k, return_lse=True
+        )
+        return out, (q, k, v, out, lse)
+    return _reference_attention(q, k, v, causal, scale), (q, k, v, None, None)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
+    q, k, v, o, lse = residuals
+    if o is not None and _use_pallas():
+        # Flash backward kernels: probabilities recomputed from the saved
+        # log-sum-exp, T x T never materialized. Replacing the old
+        # XLA-reference recompute cut the GPT train step's attention
+        # backward from the dominant cost to a few ms (docs/benchmark.md).
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q, block_k)
     # Recompute-based backward through the XLA reference (memory-for-FLOPs).
-    q, k, v = residuals
     _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal, scale), q, k, v)
     return vjp(g)
 
