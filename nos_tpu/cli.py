@@ -408,6 +408,7 @@ def cmd_simulate(args) -> int:
         profiles=profiles,
         mean_interarrival_s=args.interarrival,
         duration_range_s=(args.min_duration, args.max_duration),
+        checkpointable_fraction=args.checkpointable_fraction,
     )
     window = (args.window_start, args.window_end) if args.window_end > 0 else None
     report = sim.run(jobs, measure_window=window, max_s=args.max_seconds)
@@ -542,6 +543,13 @@ def main(argv=None) -> int:
     p_sim.add_argument("--interarrival", type=float, default=2.0)
     p_sim.add_argument("--min-duration", type=float, default=60.0)
     p_sim.add_argument("--max-duration", type=float, default=600.0)
+    p_sim.add_argument(
+        "--checkpointable-fraction",
+        type=float,
+        default=0.0,
+        help="fraction of jobs annotated checkpoint-resumable (enables "
+        "checkpoint-aware consolidation preemption for them)",
+    )
     p_sim.add_argument("--window-start", type=float, default=180.0)
     p_sim.add_argument("--window-end", type=float, default=900.0)
     p_sim.add_argument("--max-seconds", type=float, default=86400.0)

@@ -59,10 +59,22 @@ class PartitionerConfig:
     # Per-model MIG geometry overrides (knownMigGeometries analog):
     # {"NVIDIA-A100-PCIE-40GB": [{"1g.5gb": 7}, ...]}
     known_mig_geometries: Dict[str, List[Dict[str, int]]] = field(default_factory=dict)
+    # After a stranded pod waits this long, consolidation may drain a node of
+    # ALL-checkpointable victims without the provable-rebind guarantee (they
+    # resume from checkpoint). None disables; only fires for pods annotated
+    # tpu.nos/checkpointable.
+    checkpoint_preempt_after_s: Optional[float] = 120.0
 
     def validate(self) -> None:
         if self.batch_window_timeout_s <= 0:
             raise ConfigError("batch_window_timeout_s must be positive")
+        if (
+            self.checkpoint_preempt_after_s is not None
+            and self.checkpoint_preempt_after_s < 0
+        ):
+            # 0 means "immediately eligible"; negative is a typo that would
+            # also pin the resync age gate permanently open.
+            raise ConfigError("checkpoint_preempt_after_s must be >= 0 or null")
         if not 0 < self.batch_window_idle_s <= self.batch_window_timeout_s:
             raise ConfigError(
                 "batch_window_idle_s must be in (0, batch_window_timeout_s]"

@@ -122,6 +122,11 @@ ANNOTATION_STATUS_LAYOUT = f"{DOMAIN}/status-slice-layout"
 # blocked workload while letting provably-harmless smaller work backfill.
 ANNOTATION_EXPECTED_DURATION = f"{DOMAIN}/expected-duration-seconds"
 ANNOTATION_BOUND_AT = f"{DOMAIN}/bound-at"
+# Declares that the workload checkpoints (e.g. orbax) and resumes after
+# eviction: consolidation may preempt it WITHOUT the provable-rebind
+# guarantee when a stranded pod has aged past the configured threshold —
+# eviction costs a requeue, not lost work.
+ANNOTATION_CHECKPOINTABLE = f"{DOMAIN}/checkpointable"
 
 ANNOTATION_SPEC_REGEX = re.compile(
     rf"^{re.escape(ANNOTATION_SPEC_PREFIX)}(\d+)-(.+)$"

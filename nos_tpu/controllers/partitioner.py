@@ -46,6 +46,7 @@ class PartitionerController:
         batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
         resync_s: float = constants.DEFAULT_PARTITIONER_RESYNC_S,
         enable_consolidation: bool = True,
+        checkpoint_preempt_after_s: float = 120.0,
         now=None,
     ):
         self.cluster = cluster
@@ -56,13 +57,24 @@ class PartitionerController:
         self.actuator = Actuator(partitioner, self._current_partitioning)
         import time as _time
 
-        self._now = now if now is not None else _time.monotonic
+        # Wall clock, NOT monotonic: pending-age math compares against pod
+        # creation timestamps, which are wall-clock epoch both on the
+        # in-memory bus (Cluster's now default) and over the kube wire codec
+        # (ISO timestamps -> epoch). A monotonic default would make every
+        # age hugely negative in a real deployment and silently disable the
+        # checkpoint fallback.
+        self._now = now if now is not None else _time.time
         kwargs = {"now": now} if now is not None else {}
         self.batcher: Batcher[Pod] = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
         self.resync_s = resync_s
         self.enable_consolidation = enable_consolidation
+        # None disables the checkpoint-aware fallback entirely; it only ever
+        # fires for pods ANNOTATED checkpointable, so unannotated clusters
+        # behave identically regardless.
+        self.checkpoint_preempt_after_s = checkpoint_preempt_after_s
         self._last_cycle_at = self._now()
         self._version_at_last_cycle: Optional[int] = None
+        self._age_gate_at: Optional[float] = None
         self._unsub = None
         self._stop = threading.Event()
 
@@ -116,12 +128,30 @@ class PartitionerController:
             # Resync exists to retry transient refusals (handshake races,
             # partial applies) — all of which end with some write. With the
             # store version unchanged since the last cycle, the replan would
-            # recompute the identical no-op plan; skip it.
-            if self.cluster.version == self._version_at_last_cycle:
+            # recompute the identical no-op plan; skip it — UNLESS a pending
+            # pod has crossed the checkpoint-preemption age threshold since
+            # (aging is time-driven, no write announces it; same shape as
+            # the scheduler's no-op expiry).
+            if self.cluster.version == self._version_at_last_cycle and (
+                self._age_gate_at is None or self._now() < self._age_gate_at
+            ):
                 self._last_cycle_at = self._now()
                 return False
         self._version_at_last_cycle = self.cluster.version
         pods = self.fetch_pending_pods()
+        if self.checkpoint_preempt_after_s is not None:
+            now = self._now()
+            # The gate must fire exactly when the next pod CROSSES the age
+            # threshold; already-aged pods need no retry — with an unchanged
+            # store version their fallback outcome is deterministic, so the
+            # version gate handles reopening on writes.
+            crossings = [
+                p.metadata.creation_timestamp + self.checkpoint_preempt_after_s
+                for p in pods
+                if now - p.metadata.creation_timestamp
+                < self.checkpoint_preempt_after_s
+            ]
+            self._age_gate_at = min(crossings) if crossings else None
         if not pods:
             # Still a completed cycle for resync purposes: without the stamp,
             # an idle cluster would re-list all pods every control round once
@@ -188,6 +218,11 @@ class PartitionerController:
             name: self._free_chips(spec, node) for name, node in snapshot.nodes.items()
         }
         total_free = sum(free_by_node.values())
+        aged = (
+            self.checkpoint_preempt_after_s is not None
+            and self._now() - pod.metadata.creation_timestamp
+            >= self.checkpoint_preempt_after_s
+        )
         candidates = []  # (displaced_chips, node_name, drained_node, victims)
         for name in sorted(snapshot.nodes):
             node = snapshot.nodes[name]
@@ -197,11 +232,20 @@ class PartitionerController:
             if not victims:
                 continue
             # Cheap bound before any packing: the victims' chips must fit in
-            # the OTHER nodes' free capacity, or the what-if cannot succeed.
+            # the OTHER nodes' free capacity, or the what-if cannot succeed —
+            # UNLESS the checkpoint fallback could take this drain anyway
+            # (aged preemptor, every victim resumes from checkpoint, so no
+            # rebind capacity is required).
             displaced_lb = sum(
                 self._tpu_chips(spec, compute_pod_request(p)) for p in victims
             )
-            if displaced_lb > total_free - free_by_node[name] + 1e-9:
+            ckpt_eligible = aged and all(
+                podutil.is_checkpointable(v) for v in victims
+            )
+            if (
+                displaced_lb > total_free - free_by_node[name] + 1e-9
+                and not ckpt_eligible
+            ):
                 continue
             result = self._drain_plan(spec, node, pod, victims, lacking)
             if result is None:
@@ -241,6 +285,38 @@ class PartitionerController:
 
             metrics.inc("nos_tpu_consolidations", kind=self.kind)
             return True
+        # Checkpoint-aware fallback: no drain had a provable victim rebind
+        # (full saturation — nowhere for victims to go NOW). If the stranded
+        # pod has aged past the threshold and some drain's victims are ALL
+        # checkpointable, evict them anyway: a checkpointable workload
+        # resumes from its checkpoint after requeueing, so the cost is a
+        # scheduling round trip, not lost work — and without this, a
+        # pod-scale request waits out the longest natural drain
+        # (docs/dynamic-partitioning.md: the irreducible ~500s p95 under
+        # restart-on-preempt semantics).
+        if aged:
+            for _, _, name, drained, victims in candidates:
+                if not victims or not all(
+                    podutil.is_checkpointable(v) for v in victims
+                ):
+                    continue
+                plan = PartitioningPlan(state={name: drained.partitioning()})
+                logger.info(
+                    "consolidation (checkpoint): draining %s (%d checkpointable "
+                    "victims, no rebind proof) to host %s",
+                    name,
+                    len(victims),
+                    pod.metadata.namespaced_name,
+                )
+                for victim in victims:
+                    self._evict(victim)
+                self.actuator.apply(plan)
+                from nos_tpu.observability import metrics
+
+                metrics.inc(
+                    "nos_tpu_consolidations", kind=f"{self.kind}-checkpoint"
+                )
+                return True
         return False
 
     def _movable(self, spec, victim: Pod, preemptor: Pod) -> bool:

@@ -54,7 +54,11 @@ class VirtualClock:
 @dataclass
 class SimJob:
     """One workload in the trace: arrives, requests a sub-slice (or whole
-    chips), runs for ``duration_s`` once bound, then completes."""
+    chips), runs for ``duration_s`` once bound, then completes.
+    `checkpointable` models a workload that checkpoints (orbax) and RESUMES
+    after eviction — preemption costs a requeue, not the work done so far —
+    and annotates the pod so checkpoint-aware consolidation may preempt it
+    without a rebind proof."""
 
     name: str
     namespace: str
@@ -62,6 +66,7 @@ class SimJob:
     arrival_s: float
     duration_s: float
     priority: int = 0
+    checkpointable: bool = False
 
 
 @dataclass
@@ -72,6 +77,7 @@ class JobRecord:
     node: Optional[str] = None
     completed_s: Optional[float] = None
     preemptions: int = 0
+    remaining_s: Optional[float] = None  # work left (resume semantics)
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -187,6 +193,19 @@ class _TraceRunner:
                     if self._preempted(rec.job):
                         self._evict_cleanup(rec.job)
                         rec.preemptions += 1
+                        if getattr(rec.job, "checkpointable", False):
+                            # Resume semantics: progress up to the eviction
+                            # survives in the checkpoint.
+                            start = rec.bound_s if rec.bound_s is not None else now
+                            elapsed = max(0.0, now - start)
+                            left = (
+                                rec.remaining_s
+                                if rec.remaining_s is not None
+                                else rec.job.duration_s
+                            )
+                            rec.remaining_s = max(0.0, left - elapsed)
+                        else:
+                            rec.remaining_s = rec.job.duration_s
                         rec.bound_s = None
                         rec.node = None
                         del running[name]
@@ -197,7 +216,8 @@ class _TraceRunner:
             preempt_seen = self.plane.cluster.version
             # 3. Complete finished jobs.
             for name, rec in list(running.items()):
-                if rec.bound_s is not None and now >= rec.bound_s + rec.job.duration_s:
+                due = rec.remaining_s if rec.remaining_s is not None else rec.job.duration_s
+                if rec.bound_s is not None and now >= rec.bound_s + due:
                     self._complete(rec.job)
                     rec.completed_s = now
                     del running[name]
@@ -355,14 +375,17 @@ class WorkloadSim(_TraceRunner):
         return bound
 
     def _submit(self, job: SimJob) -> None:
+        annotations = {
+            constants.ANNOTATION_EXPECTED_DURATION: f"{job.duration_s:.0f}"
+        }
+        if job.checkpointable:
+            annotations[constants.ANNOTATION_CHECKPOINTABLE] = "true"
         self.plane.cluster.create(
             Pod(
                 metadata=ObjectMeta(
                     name=job.name,
                     namespace=job.namespace,
-                    annotations={
-                        constants.ANNOTATION_EXPECTED_DURATION: f"{job.duration_s:.0f}"
-                    },
+                    annotations=annotations,
                 ),
                 spec=PodSpec(
                     containers=[Container(resources=ResourceList.of(job.request))],
@@ -388,12 +411,18 @@ def mixed_workload(
     namespaces: Sequence[str] = ("team-a", "team-b", "team-c"),
     mean_interarrival_s: float = 2.0,
     duration_range_s: Tuple[float, float] = (60.0, 600.0),
+    checkpointable_fraction: float = 0.0,
 ) -> List[SimJob]:
     """A deterministic mixed JAX workload trace: Poisson arrivals, weighted
     sub-slice sizes, uniform durations — the shape of the north-star scenario
     (BASELINE.json: 'mixed JAX workload onto a dynamically-partitioned
-    v5e-256')."""
+    v5e-256'). `checkpointable_fraction` marks that share of jobs as
+    checkpoint-resumable (drawn from an INDEPENDENT RNG stream, so traces
+    with different fractions share arrivals/shapes/durations exactly —
+    including fraction 0, which must reproduce the judged trace
+    bit-for-bit)."""
     rng = random.Random(seed)
+    flag_rng = random.Random(f"{seed}-checkpointable")
     names = [p for p, _ in profiles]
     weights = [w for _, w in profiles]
     jobs: List[SimJob] = []
@@ -409,6 +438,7 @@ def mixed_workload(
                 arrival_s=t,
                 duration_s=rng.uniform(*duration_range_s),
                 priority=rng.choice([0, 0, 0, 10]),
+                checkpointable=flag_rng.random() < checkpointable_fraction,
             )
         )
     return jobs

@@ -122,6 +122,7 @@ def build_partitioner_controllers(
             sim_scheduler=sim,
             batch_timeout_s=config.batch_window_timeout_s,
             batch_idle_s=config.batch_window_idle_s,
+            checkpoint_preempt_after_s=config.checkpoint_preempt_after_s,
             now=now,
         )
     return controllers

@@ -111,6 +111,15 @@ def latest_expected_end(pods, now: float, count_pod=None):
     return latest
 
 
+def is_checkpointable(pod: Pod) -> bool:
+    """The workload declared it checkpoints and resumes after eviction."""
+    return (
+        pod.metadata.annotations.get(constants.ANNOTATION_CHECKPOINTABLE, "")
+        .lower()
+        == "true"
+    )
+
+
 # -- gang membership (multi-host workloads: one pod per host) ----------------
 def gang_of(pod: Pod):
     """'<ns>/<gang-name>' or None."""

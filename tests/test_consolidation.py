@@ -94,10 +94,12 @@ def bound_pod(name, profile, node, ns="ml", priority=0):
 
 class Env:
     def __init__(self, topos):
-        self.cluster = Cluster()
+        self.clock = FakeClock()
+        # One timeline: creation timestamps must be comparable with the
+        # controller's clock (pending-age math for checkpoint preemption).
+        self.cluster = Cluster(now=self.clock)
         self.state = ClusterState()
         self.state.start_watching(self.cluster)
-        self.clock = FakeClock()
         self.agents = {}
         for name, topo in topos.items():
             self.cluster.create(make_node(name, topo))
@@ -316,3 +318,102 @@ def test_consolidation_actuates_rebind_carves():
     # needs to rebind (its own original 1x1 is still held by its own pod).
     spec = env.node(survivor).metadata.annotations
     assert spec.get(f"{constants.DOMAIN}/spec-dev-0-1x1") == "2"
+
+
+# -- checkpoint-aware preemption (round 3) ------------------------------------
+def _mark_checkpointable(env, name, ns="ml"):
+    env.cluster.patch(
+        "Pod", ns, name,
+        lambda p: p.metadata.annotations.__setitem__(
+            constants.ANNOTATION_CHECKPOINTABLE, "true"
+        ),
+    )
+
+
+def test_checkpoint_fallback_drains_without_rebind_proof():
+    """The no-rebind scenario (both nodes full, victims have nowhere to go):
+    once the stranded pod ages past the threshold AND the drain's victims
+    are all checkpointable, consolidation evicts them anyway — they resume
+    from checkpoint after requeueing."""
+    env = Env({"a": "4x4", "b": "4x4"})
+    env.carve_and_bind("a", "1x1", "small-a")
+    env.carve_and_bind("b", "4x4", "big-b")
+    _mark_checkpointable(env, "small-a")
+    env.cluster.create(pending_pod("big", "4x4"))
+    env.run_cycle()
+    # Too young: nothing moves yet.
+    assert env.pod_exists("small-a")
+    env.clock.t += 200  # past checkpoint_preempt_after_s (120)
+    env.cluster.patch(  # any write reopens the version-gated resync
+        "Pod", "ml", "big",
+        lambda p: p.metadata.annotations.__setitem__("poke", "1"),
+    )
+    env.run_cycle()
+    assert not env.pod_exists("small-a")  # evicted (resumes from checkpoint)
+    assert env.pod_exists("big-b")        # the OTHER drain was never chosen
+
+
+def test_checkpoint_fallback_requires_all_victims_checkpointable():
+    env = Env({"a": "4x4", "b": "4x4"})
+    env.carve_and_bind("a", "1x1", "small-a")   # NOT checkpointable
+    env.carve_and_bind("b", "4x4", "big-b")
+    env.cluster.create(pending_pod("big", "4x4"))
+    env.clock.t += 200
+    env.run_cycle()
+    assert env.pod_exists("small-a")
+    assert env.pod_exists("big-b")
+
+
+def test_checkpoint_fallback_disabled_by_none():
+    env = Env({"a": "4x4", "b": "4x4"})
+    env.controller.checkpoint_preempt_after_s = None
+    env.carve_and_bind("a", "1x1", "small-a")
+    _mark_checkpointable(env, "small-a")
+    env.carve_and_bind("b", "4x4", "big-b")
+    env.cluster.create(pending_pod("big", "4x4"))
+    env.clock.t += 500
+    env.run_cycle()
+    assert env.pod_exists("small-a")
+
+
+def test_checkpointable_jobs_resume_not_restart_in_sim():
+    """Sim resume semantics: a preempted checkpointable job keeps its
+    progress (total chip-seconds delivered stay bounded by one duration),
+    and checkpointable traces finish no later than restart traces."""
+    from nos_tpu.sim import SimJob, WorkloadSim
+
+    def run(checkpointable):
+        sim = WorkloadSim(topos={"n0": "4x4", "n1": "4x4"})
+        for c in sim.plane.partitioners.values():
+            c.checkpoint_preempt_after_s = 30.0
+        jobs = [
+            SimJob(f"fill-{i}", "ml", {"google.com/tpu-1x1": 1}, 0.0, 400.0,
+                   checkpointable=checkpointable)
+            for i in range(32)
+        ] + [
+            SimJob("whole", "ml", {"google.com/tpu-4x4": 1}, 10.0, 60.0,
+                   checkpointable=checkpointable)
+        ]
+        return sim.run(jobs, max_s=3600.0)
+
+    rep_ckpt = run(True)
+    assert rep_ckpt.completed == 33
+    whole = next(r for r in rep_ckpt.jobs if r.job.name == "whole")
+    # The whole-mesh pod must have been unblocked by checkpoint preemption,
+    # far sooner than the 400s natural drain.
+    assert whole.bound_s is not None and whole.bound_s < 200.0
+    preempted = [r for r in rep_ckpt.jobs if r.preemptions > 0 and r.job.name != "whole"]
+    assert preempted, "the drain must have evicted fillers"
+    # RESUME, not restart: an evicted filler completes at rebind + REMAINING
+    # work. Restart-from-scratch would rerun the full 400s after a rebind
+    # that cannot happen before the whole-mesh job frees chips (~70s), so
+    # every preempted filler would finish past 470s.
+    assert all(r.completed_s < 470.0 for r in preempted), [
+        (r.job.name, r.completed_s) for r in preempted
+    ]
+    # The restart-semantics control: nothing is evicted (victims are not
+    # checkpointable), so the whole-mesh job waits out the natural drain.
+    rep_restart = run(False)
+    whole_r = next(r for r in rep_restart.jobs if r.job.name == "whole")
+    assert whole_r.bound_s >= 400.0
+    assert rep_restart.to_dict()["preemptions"] == 0
