@@ -64,6 +64,14 @@ class PartitionerConfig:
     # resume from checkpoint). None disables; only fires for pods annotated
     # tpu.nos/checkpointable.
     checkpoint_preempt_after_s: Optional[float] = 120.0
+    # Churn discipline on the checkpoint fallback: the drain must shorten the
+    # preemptor's stamped natural wait by more than `min_gain`; no workload is
+    # fallback-evicted twice within `cooldown` or more than `budget` times per
+    # sliding `window`.
+    checkpoint_min_gain_s: float = 60.0
+    checkpoint_victim_cooldown_s: float = 300.0
+    checkpoint_victim_budget: int = 3
+    checkpoint_victim_window_s: float = 3600.0
 
     def validate(self) -> None:
         if self.batch_window_timeout_s <= 0:
@@ -75,6 +83,14 @@ class PartitionerConfig:
             # 0 means "immediately eligible"; negative is a typo that would
             # also pin the resync age gate permanently open.
             raise ConfigError("checkpoint_preempt_after_s must be >= 0 or null")
+        if self.checkpoint_min_gain_s < 0:
+            raise ConfigError("checkpoint_min_gain_s must be >= 0")
+        if self.checkpoint_victim_cooldown_s < 0:
+            raise ConfigError("checkpoint_victim_cooldown_s must be >= 0")
+        if self.checkpoint_victim_budget < 1:
+            raise ConfigError("checkpoint_victim_budget must be >= 1")
+        if self.checkpoint_victim_window_s <= 0:
+            raise ConfigError("checkpoint_victim_window_s must be positive")
         if not 0 < self.batch_window_idle_s <= self.batch_window_timeout_s:
             raise ConfigError(
                 "batch_window_idle_s must be in (0, batch_window_timeout_s]"
@@ -114,10 +130,23 @@ class SchedulerConfig:
     backfill_min_fraction: Optional[float] = 0.9
     backfill_after_s: float = 30.0
     backfill_bypass_factor: float = 2.0
+    # Queue ordering within a priority band: "fifo" (arrival order) or
+    # "aged-swf" (shortest-work-first with an aging credit of
+    # `swf_aging_chips` chip-seconds per pending second; unstamped pods
+    # assume `swf_default_duration_s`). See scheduler.Scheduler.
+    queue_policy: str = "fifo"
+    swf_aging_chips: float = 16.0
+    swf_default_duration_s: float = 600.0
 
     def validate(self) -> None:
         if not self.scheduler_name:
             raise ConfigError("scheduler_name must be non-empty")
+        if self.queue_policy not in ("fifo", "aged-swf"):
+            raise ConfigError("queue_policy must be 'fifo' or 'aged-swf'")
+        if self.swf_aging_chips < 0:
+            raise ConfigError("swf_aging_chips must be >= 0")
+        if self.swf_default_duration_s <= 0:
+            raise ConfigError("swf_default_duration_s must be positive")
         if self.backfill_min_fraction is not None and not (
             0.0 < self.backfill_min_fraction
         ):

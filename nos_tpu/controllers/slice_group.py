@@ -44,9 +44,18 @@ class GroupPartitioner:
         batch_timeout_s: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S,
         batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
         resync_s: float = constants.DEFAULT_PARTITIONER_RESYNC_S,
+        unit_key=None,
         now=None,
     ):
         self.cluster = cluster
+        # The scheduler's unit-rank function (Scheduler._unit_key). Carve
+        # demand MUST rank gangs exactly as the scheduler's queue does —
+        # under a non-FIFO queue policy (aged-swf), a hardcoded
+        # (-priority, creation) order here carves for a gang the scheduler
+        # ranks below its reservation holder: the holder can't bind (wrong
+        # carve), the carved-for gang is reservation-gated, no write ever
+        # lands, and both version gates freeze the deadlock in place.
+        self._unit_key = unit_key
         self._now = now if now is not None else _time.monotonic
         kwargs = {"now": now} if now is not None else {}
         self.batcher: Batcher[Pod] = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
@@ -119,11 +128,13 @@ class GroupPartitioner:
         # that the scheduler will never bind first, deadlocking the queue
         # behind a backfill reservation.
         def _order(entry):
-            # EXACTLY the scheduler's gang unit key (scheduler.py
-            # schedule_pending): min over per-pod (-priority, creation, name)
-            # tuples — i.e. the best member's tuple, NOT max-priority paired
-            # with the earliest timestamp of a possibly different member.
+            # EXACTLY the scheduler's unit key (Scheduler._unit_key, injected
+            # at wiring time so a queue-policy change cannot desynchronize
+            # the two rankings). Fallback: the FIFO tuple — min over per-pod
+            # (-priority, creation, name), i.e. the best member's tuple.
             _, pods = entry
+            if self._unit_key is not None:
+                return self._unit_key(pods)
             return min(
                 (
                     -p.spec.priority,

@@ -71,6 +71,9 @@ class Scheduler:
         backfill_min_fraction: Optional[float] = 0.9,
         backfill_after_s: float = 30.0,
         backfill_bypass_factor: float = 2.0,
+        queue_policy: str = "fifo",
+        swf_aging_chips: float = 16.0,
+        swf_default_duration_s: float = 600.0,
     ):
         self.cluster = cluster
         self._now = now if now is not None else _time.time
@@ -94,6 +97,21 @@ class Scheduler:
         self.backfill_min_fraction = backfill_min_fraction
         self.backfill_after_s = backfill_after_s
         self.backfill_bypass_factor = backfill_bypass_factor
+        # Queue ordering within a priority band. "fifo" is arrival order
+        # (kube-scheduler semantics). "aged-swf" is shortest-work-first with
+        # aging: units rank by estimated chip-seconds (chips x stamped
+        # expected-duration; unstamped pods assume `swf_default_duration_s`)
+        # minus an aging credit of `swf_aging_chips` chip-seconds per pending
+        # second — so small work binds first (an oversubscribed backlog's p50
+        # is queue-depth-bound, and most of the queue is small), while every
+        # unit's rank monotonically rises to the front: starvation-free by
+        # construction, on top of the drain-set reservation for pod-scale
+        # units. Priority still dominates: aging never crosses bands.
+        if queue_policy not in ("fifo", "aged-swf"):
+            raise ValueError(f"unknown queue_policy {queue_policy!r}")
+        self.queue_policy = queue_policy
+        self.swf_aging_chips = swf_aging_chips
+        self.swf_default_duration_s = swf_default_duration_s
         self._bypassed: dict = {}  # blocked unit name -> chips bound past it
         # Sticky drain set: re-picking the cheapest block every pass lets the
         # target drift as backfill lands, so no block ever finishes draining.
@@ -204,29 +222,32 @@ class Scheduler:
         for pod in pending:
             gang = podutil.gang_of(pod)
             if gang is None:
-                units.append((-pod.spec.priority, pod.metadata.creation_timestamp,
-                              pod.metadata.namespaced_name, "pod", pod))
+                units.append((self._unit_key([pod]), "pod", pod))
             else:
                 gangs.setdefault(gang, []).append(pod)
         for gang_name, pods in gangs.items():
-            best = min(
-                (-p.spec.priority, p.metadata.creation_timestamp,
-                 p.metadata.namespaced_name)
-                for p in pods
-            )
-            units.append(best + ("gang", (gang_name, pods)))
+            units.append((self._unit_key(pods), "gang", (gang_name, pods)))
         # A live sticky reservation protects its drain set for the WHOLE
         # pass — seeded up front so units sorting ahead of the holder cannot
         # refill the protected nodes every pass and re-starve it. Rank still
-        # wins: only units sorting BELOW the holder are gated.
+        # wins: only units sorting BELOW the holder are gated. Under aged-swf
+        # the keys drift between passes, so the holder's rank is re-read from
+        # THIS pass's key (a stale key would mis-scope the gate as the holder
+        # ages toward the front).
         reservation: Optional[_Reservation] = self._refresh_sticky(nodes)
+        if self._sticky_holder is not None:
+            for key, kind, item in units:
+                name = item.metadata.namespaced_name if kind == "pod" else item[0]
+                if name == self._sticky_holder:
+                    self._sticky_key = key
+                    break
         next_arm_at: Optional[float] = None
         sticky_seen = False
         failed_large: List[Tuple[str, float]] = []  # blocked this pass
         pass_bound_chips = 0.0
         total_chips = sum(_tpu_chips(n.allocatable) for n in nodes)
-        for unit in sorted(units, key=lambda u: u[:3]):
-            unit_key, kind, item = unit[:3], unit[3], unit[4]
+        for unit in sorted(units, key=lambda u: u[0]):
+            unit_key, kind, item = unit
             unit_pods = [item] if kind == "pod" else item[1]
             unit_name = (
                 item.metadata.namespaced_name if kind == "pod" else item[0]
@@ -328,6 +349,27 @@ class Scheduler:
             self._noop_at_version = version_at_start
             self._noop_until = next_arm_at if next_arm_at is not None else float("inf")
         return {"bound": bound, "unschedulable": unschedulable, "nominated": nominated}
+
+    def _unit_key(self, pods: List[Pod]) -> tuple:
+        """Queue rank of a scheduling unit (a pod, or a gang's members).
+        FIFO: (-priority, oldest creation, name). aged-swf: (-priority,
+        estimated chip-seconds minus the aging credit, creation, name) —
+        see `queue_policy` in __init__ for the rationale."""
+        prio, creation, nsname = min(
+            (-p.spec.priority, p.metadata.creation_timestamp,
+             p.metadata.namespaced_name)
+            for p in pods
+        )
+        if self.queue_policy == "fifo":
+            return (prio, creation, nsname)
+        work = 0.0
+        for p in pods:
+            duration = podutil.expected_duration_s(p)
+            if duration is None:
+                duration = self.swf_default_duration_s
+            work += _tpu_chips(self.calculator.compute_pod_request(p)) * duration
+        age = max(0.0, self._now() - creation)
+        return (prio, work - self.swf_aging_chips * age, creation, nsname)
 
     def refresh_capacity(self) -> None:
         """Rebuild quota infos from the cluster, at most once per store

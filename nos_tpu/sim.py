@@ -188,7 +188,7 @@ class _TraceRunner:
             # 2. Restart preempted jobs: an evicted workload's controller
             #    recreates it from scratch (scheduler._evict deletes pods;
             #    for a gang, losing any member kills the whole mesh).
-            if running and self.plane.cluster.version != preempt_seen:
+            if (running or unbound) and self.plane.cluster.version != preempt_seen:
                 for name, rec in list(running.items()):
                     if self._preempted(rec.job):
                         self._evict_cleanup(rec.job)
@@ -213,6 +213,21 @@ class _TraceRunner:
                         self._submit(rec.job)
                         rec.submitted_s = now
                         unbound.add(name)
+                # Submitted-but-unbound jobs whose pods vanished: eviction can
+                # race the bind window (the scheduler binds, consolidation
+                # evicts in the same control round, the trace never observes
+                # RUNNING). The workload controller resubmits those exactly
+                # like running ones — without this, an evicted-while-pending
+                # job is silently destroyed and the trace strands (the
+                # round-3 live-lock: 11/200 jobs never finished).
+                for name in list(unbound):
+                    rec = records[name]
+                    if rec.submitted_s is None or not self._preempted(rec.job):
+                        continue
+                    self._evict_cleanup(rec.job)
+                    rec.preemptions += 1
+                    self._submit(rec.job)
+                    rec.submitted_s = now
             preempt_seen = self.plane.cluster.version
             # 3. Complete finished jobs.
             for name, rec in list(running.items()):

@@ -83,6 +83,9 @@ def build_scheduler(
         backfill_min_fraction=config.backfill_min_fraction,
         backfill_after_s=config.backfill_after_s,
         backfill_bypass_factor=config.backfill_bypass_factor,
+        queue_policy=config.queue_policy,
+        swf_aging_chips=config.swf_aging_chips,
+        swf_default_duration_s=config.swf_default_duration_s,
     )
 
 
@@ -123,6 +126,10 @@ def build_partitioner_controllers(
             batch_timeout_s=config.batch_window_timeout_s,
             batch_idle_s=config.batch_window_idle_s,
             checkpoint_preempt_after_s=config.checkpoint_preempt_after_s,
+            checkpoint_min_gain_s=config.checkpoint_min_gain_s,
+            checkpoint_victim_cooldown_s=config.checkpoint_victim_cooldown_s,
+            checkpoint_victim_budget=config.checkpoint_victim_budget,
+            checkpoint_victim_window_s=config.checkpoint_victim_window_s,
             now=now,
         )
     return controllers
@@ -217,6 +224,7 @@ class ControlPlane:
                 self.cluster,
                 batch_timeout_s=p_cfg.batch_window_timeout_s,
                 batch_idle_s=p_cfg.batch_window_idle_s,
+                unit_key=self.scheduler._unit_key,
                 now=now,
             )
         self.host_agents: Dict[str, HostAgent] = {}
