@@ -2,13 +2,18 @@
 
 Where the reference drives NVML through cgo (pkg/gpu/nvml, build-tagged so CI
 never needs a GPU — SURVEY.md §4 "hardware-boundary mocking"), this package
-drives TPU sub-slice carving. Three backends satisfy one interface:
+drives TPU sub-slice carving. Four backends satisfy one interface:
 
   - FakeTpuClient (pure Python) — tests and the in-memory runtime;
   - NativeTpuClient (ctypes over the C++ shim in native/) — the production
     analog of the cgo layer, modeling slice lifecycle natively;
-  - a real libtpu-backed client would slot in behind the same interface.
+  - CloudTpuClient (tpulib/cloud.py) — the real-infrastructure backend: a
+    from-scratch REST client over the Cloud-TPU-v2-shaped queuedResources
+    provisioning surface (long-running operations, async quota denial,
+    retries), fixture-tested against tpulib/cloud_server.py;
+  - a node-local libtpu-backed client would slot in behind the same seam.
 """
 
 from nos_tpu.tpulib.interface import SliceHandle, TpuClient, TpuLibError  # noqa: F401
 from nos_tpu.tpulib.fake import FakeTpuClient  # noqa: F401
+from nos_tpu.tpulib.cloud import CloudTpuClient, QuotaExhaustedError  # noqa: F401
