@@ -6,8 +6,8 @@ Forward AND backward are Pallas kernels (MXU matmuls, f32 accumulators):
 the backward recomputes probabilities from the saved log-sum-exp
 (FlashAttention-2), so the T x T score matrix exists in neither direction.
 On this project's v5e training shape the pair turned the GPT train step
-from 85.6 ms (XLA-reference backward) to 44.7 ms — 24% -> 46% MFU.
-from 85.6 ms (XLA-reference backward) to 46.1 ms — 24% -> 45% MFU.
+from 85.6 ms (XLA-reference backward) to 44.7 ms — 24% -> 46% MFU
+(docs/benchmark.md "Training step").
 
 On non-TPU backends (tests run on a CPU mesh) the reference XLA path is used;
 the public `flash_attention` keeps one signature everywhere.
@@ -57,6 +57,21 @@ def _pad_plan(t_real: int, block_q: int, block_k: int):
     return t, t - t_real
 
 
+def _fit_block(requested: int, t: int) -> int:
+    """Largest 128-multiple <= `requested` that divides the padded length
+    exactly. The grids and in-kernel pl.ds slices then always tile `t` with
+    no overrun — with unequal non-power-of-two blocks (e.g. block_q=384,
+    block_k=512), `min(requested, t)` alone could leave a ragged last block
+    relying on clamping semantics for correctness."""
+    best = 128
+    b = 128
+    while b <= min(requested, t):
+        if t % b == 0:
+            best = b
+        b += 128
+    return best
+
+
 def _flash_fwd_pallas(
     q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
     return_lse: bool = False, interpret: bool = False,
@@ -77,8 +92,8 @@ def _flash_fwd_pallas(
         return x
 
     q3, k3, v3 = prep(q), prep(k), prep(v)
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
     n_q = pl.cdiv(t, block_q)
     n_k = pl.cdiv(t, block_k)
 
@@ -200,8 +215,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
         dvec = jnp.pad(dvec, ((0, 0), (0, 0), (0, pad)))
         lse2 = jnp.pad(lse2, ((0, 0), (0, 0), (0, pad)), constant_values=NEG_INF)
     q3, k3, v3, do3 = prep(q), prep(k), prep(v), prep(do)
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
     n_q = pl.cdiv(t, block_q)
     n_k = pl.cdiv(t, block_k)
 

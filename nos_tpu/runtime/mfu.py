@@ -212,5 +212,10 @@ def gpt_train_flops(model, batch: int, seq: int) -> float:
     matmul_params = model.layers * per_layer + h * model.vocab  # + lm_head
     tokens = batch * seq
     dense = 6.0 * matmul_params * tokens
-    attention = 3.0 * model.layers * (4.0 * batch * seq * seq * h)
+    # Causal: the model executes only the at-or-below-diagonal half of the
+    # T x T score/PV work (the flash kernels' diagonal loop bounds are exact,
+    # ops/flash_attention.py), so the numerator counts seq^2/2 — counting the
+    # full matrix (the PaLM-appendix convention) would inflate reported MFU
+    # ~15% at seq 2048 with FLOPs the chip never executes.
+    attention = 3.0 * model.layers * (4.0 * batch * (seq * seq / 2.0) * h)
     return dense + attention
