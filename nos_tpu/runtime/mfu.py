@@ -212,10 +212,12 @@ def gpt_train_flops(model, batch: int, seq: int) -> float:
     matmul_params = model.layers * per_layer + h * model.vocab  # + lm_head
     tokens = batch * seq
     dense = 6.0 * matmul_params * tokens
-    # Causal: the model executes only the at-or-below-diagonal half of the
-    # T x T score/PV work (the flash kernels' diagonal loop bounds are exact,
-    # ops/flash_attention.py), so the numerator counts seq^2/2 — counting the
-    # full matrix (the PaLM-appendix convention) would inflate reported MFU
-    # ~15% at seq 2048 with FLOPs the chip never executes.
+    # Causal convention: the numerator counts seq^2/2 — the USEFUL attention
+    # work of a causal model. (The full-matrix PaLM-appendix convention
+    # inflates reported MFU ~11% at the CI config / seq 2048. Note this is
+    # a useful-work convention, not an executed-FLOPs count: the flash
+    # kernels' block-diagonal bounds still compute-then-mask partial blocks,
+    # ~62% of the full matrix at block 512 / seq 2048 — masked waste should
+    # read as lower MFU, which this convention does.)
     attention = 3.0 * model.layers * (4.0 * batch * (seq * seq / 2.0) * h)
     return dense + attention

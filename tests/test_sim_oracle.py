@@ -1,0 +1,74 @@
+"""Fungible-chip oracle (nos_tpu/sim_oracle.py): determinism, policy
+semantics, and the adapter from sim traces."""
+
+import pytest
+
+from nos_tpu.sim import GangJob, SimJob, mixed_workload
+from nos_tpu.sim_oracle import OracleJob, from_sim_jobs, oracle_schedule
+
+
+def test_work_conservation_floor():
+    """Sequential saturation: 4 jobs x 4 chips x 100s on 4 chips must take
+    exactly 400s; waits are 0/100/200/300."""
+    jobs = [OracleJob(f"j{i}", 0.0, 100.0, 4) for i in range(4)]
+    report = oracle_schedule(jobs, total_chips=4)
+    assert report.makespan_s == 400.0
+    assert sorted(report.latencies.values()) == [0.0, 100.0, 200.0, 300.0]
+
+
+def test_backfill_never_blocks_behind_a_big_job():
+    """A 4-chip job queued behind nothing-fits must not block a 1-chip job
+    that fits now (the pass-with-backfill semantics the real scheduler
+    has)."""
+    jobs = [
+        OracleJob("big-running", 0.0, 100.0, 4),
+        OracleJob("big-waiting", 1.0, 100.0, 4),
+        OracleJob("small", 2.0, 10.0, 1),
+    ]
+    report = oracle_schedule(jobs, total_chips=5)
+    assert report.latencies["small"] == 0.0  # bound on arrival via backfill
+
+
+def test_sjf_orders_by_chip_seconds_within_priority():
+    jobs = [
+        OracleJob("fat", 0.0, 100.0, 4),      # 400 chip-s
+        OracleJob("thin", 0.0, 10.0, 1),      # 10 chip-s
+        OracleJob("vip", 0.0, 50.0, 4, priority=10),
+    ]
+    report = oracle_schedule(jobs, total_chips=4, policy="sjf")
+    # Priority band first; then SJF: thin fits alongside nothing (4 used)…
+    assert report.latencies["vip"] == 0.0
+    # after vip completes, thin (smaller work) goes before fat.
+    assert report.latencies["thin"] < report.latencies["fat"]
+
+
+def test_priority_dominates_fifo_order():
+    jobs = [
+        OracleJob("early", 0.0, 100.0, 4),
+        OracleJob("late-vip", 1.0, 100.0, 4, priority=10),
+        OracleJob("mid", 0.5, 100.0, 4),
+    ]
+    report = oracle_schedule(jobs, total_chips=4)
+    assert report.latencies["late-vip"] < report.latencies["mid"]
+
+
+def test_adapter_handles_both_trace_shapes():
+    sim_jobs = [SimJob("s", "ns", {"google.com/tpu-2x4": 1}, 3.0, 60.0)]
+    gang_jobs = [GangJob("g", "ns", "4x4", 4, 5.0, 70.0)]
+    o1 = from_sim_jobs(sim_jobs)[0]
+    o2 = from_sim_jobs(gang_jobs)[0]
+    assert (o1.chips, o1.arrival_s, o1.duration_s) == (8, 3.0, 60.0)
+    assert (o2.chips, o2.arrival_s, o2.duration_s) == (16, 5.0, 70.0)
+
+
+def test_deterministic_and_complete_on_real_trace():
+    jobs = from_sim_jobs(mixed_workload(60, seed=1))
+    r1 = oracle_schedule(jobs, total_chips=64)
+    r2 = oracle_schedule(jobs, total_chips=64)
+    assert r1.latencies == r2.latencies
+    assert len(r1.latencies) == 60
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError):
+        oracle_schedule([], 4, policy="lifo")
