@@ -1,0 +1,148 @@
+"""Paged decode attention as a Pallas TPU kernel (vLLM-style, TPU-shaped).
+
+The paged KV pool's read path used to be `pool[table]` — an XLA gather that
+materializes every active sequence's pages into a contiguous copy per layer
+per step ([B, P, nkv, bs, hd] of HBM traffic that exists only to be read
+once by the attention kernel and thrown away). That copy is why the paged
+engine trailed the round-2 dense engine by 17-34% at 8 short streams
+(docs/benchmark.md): short sequences pay the long-context machinery's rent.
+
+This kernel deletes the copy: the page table rides in as a SCALAR-PREFETCH
+operand, and the K/V BlockSpec index maps look the page id up directly —
+`(table[b, p], g, 0, 0)` — so Mosaic's pipeline streams exactly the blocks
+each sequence owns from HBM into VMEM, in page order, with an online-softmax
+accumulator across pages. No gather, no relayout, no wasted bytes: the
+long-context pool now has the same read cost as the dense cache.
+
+Same contract as every op here: Pallas on TPU; everywhere else the XLA
+reference (gather + decode_attention's reference math) keeps one signature
+and exact semantics (the kernel is tested bit-close against it in interpret
+mode; tests/test_paged_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _reference(q, pool_k, pool_v, table, limit):
+    """The gather formulation: q [B,nh,hd]; pool [T,nkv,bs,hd]; table [B,P]
+    int32; limit [B] -> [B,nh,hd]."""
+    from nos_tpu.ops.decode_attention import _reference as dense_reference
+
+    def gather(pool):
+        g = pool[table]  # [B, P, nkv, bs, hd]
+        b, p, nkv, bs, hd = g.shape
+        return g.transpose(0, 2, 1, 3, 4).reshape(b, nkv, p * bs, hd)
+
+    return dense_reference(q, gather(pool_k), gather(pool_v), limit)
+
+
+def _pallas(q, pool_k, pool_v, table, limit, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, nh, hd = q.shape
+    t, nkv, bs, _ = pool_k.shape
+    n_pages = table.shape[1]
+    rep = nh // nkv
+    rep_p = max(8, rep)  # sublane-pad the row block
+    qg = q.reshape(b, nkv, rep, hd)
+    if rep_p != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_p - rep), (0, 0)))
+    scale = hd ** -0.5
+
+    def kernel(table_ref, limit_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        i = pl.program_id(0)
+        p = pl.program_id(2)
+
+        @pl.when(p == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        lim = limit_ref[i]
+        qf = q_ref[0, 0].astype(jnp.float32)          # [rep_p, hd]
+        kf = k_ref[0, 0].astype(jnp.float32)          # [bs, hd]
+        s = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                      # [rep_p, bs]
+        idx = p * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = idx < lim
+        s = jnp.where(valid, s, NEG_INF)
+        # Online softmax across pages. The running max/normalizer live in
+        # VMEM scratch broadcast across lanes (1-lane slices are hostile to
+        # Mosaic's tiling; a lane-wide reduce of an all-equal array is free).
+        m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)   # [rep_p, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # exp(s - m_new) would be exp(0)=1 for masked lanes while every
+        # real score is still NEG_INF — mask explicitly, not arithmetically.
+        e = jnp.where(valid, jnp.exp(s - m_new), 0.0)           # [rep_p, bs]
+        alpha = jnp.exp(m_prev - m_new)                         # [rep_p, 1]
+        l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            e, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(p == n_pages - 1)
+        def _finalize():
+            l_fin = jnp.max(l_ref[...], axis=-1, keepdims=True)
+            o_ref[0, 0] = (
+                acc_ref[...] / jnp.maximum(l_fin, 1e-30)
+            ).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # (table, limit) ride in SMEM
+        grid=(b, nkv, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep_p, hd), lambda i, g, p, tr, lr: (i, g, 0, 0)),
+            # THE point of the kernel: the page id comes straight from the
+            # prefetched table — Mosaic streams only the owned blocks.
+            pl.BlockSpec((1, 1, bs, hd), lambda i, g, p, tr, lr: (tr[i, p], g, 0, 0)),
+            pl.BlockSpec((1, 1, bs, hd), lambda i, g, p, tr, lr: (tr[i, p], g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rep_p, hd), lambda i, g, p, tr, lr: (i, g, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rep_p, 128), jnp.float32),  # running max
+            pltpu.VMEM((rep_p, 128), jnp.float32),  # running normalizer
+            pltpu.VMEM((rep_p, hd), jnp.float32),   # unnormalized output
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, rep_p, hd), q.dtype),
+        interpret=interpret,
+    )(table.astype(jnp.int32), limit.astype(jnp.int32), qg, pool_k, pool_v)
+    return out[:, :, :rep, :].reshape(b, nh, hd)
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("NOS_TPU_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def paged_decode_attention(q, pool_k, pool_v, table, limit):
+    """Single-token attention over a block-paged KV pool: q [B,nh,hd],
+    pool [total_blocks,nkv,block,hd], table [B,P] (page ids per sequence,
+    rows beyond a sequence's allocation point at the scratch page), limit
+    [B] attention bounds. Pallas scalar-prefetch kernel on TPU (no
+    materialized gather); XLA gather reference elsewhere."""
+    if _use_pallas():
+        return _pallas(q, pool_k, pool_v, table, limit)
+    return _reference(q, pool_k, pool_v, table, limit)

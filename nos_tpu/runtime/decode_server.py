@@ -83,9 +83,9 @@ class _Slot:
     active: bool = False
     pos: int = 0  # next cache write index (dispatched, not materialized)
     remaining: int = 0  # generated tokens still to dispatch
-    # Token sources in generation order: (ref, lane, row) — lane None = the
-    # prefill's scalar first token; row = the step's index within its
-    # macro-dispatch window.
+    # Token sources in generation order: (ref, lane, row) — row None = the
+    # admission wave's first-token vector (indexed by lane); otherwise row =
+    # the step's index within its macro-dispatch window [K, n_slots].
     refs: List[Tuple[_TokRef, Optional[int], Optional[int]]] = field(default_factory=list)
     eos_scanned: int = 0
     future: Optional[Future] = None
@@ -166,6 +166,7 @@ class DecodeServer:
         self._queue: "queue.Queue" = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
         self._last_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
+        self._first_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
         self._inflight: Deque[_TokRef] = deque()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -234,7 +235,10 @@ class DecodeServer:
             )
             return cache
 
-        def _prefill_last(params, tokens, cache, table_row, start, length, last, slot, serial):
+        def _prefill_last(
+            params, tokens, cache, table_row, start, length, last, first_vec,
+            slot, serial,
+        ):
             logits, cache = paged_prefill_chunk(
                 params, tokens, cfg, cache, table_row, start, length, bs
             )
@@ -243,9 +247,20 @@ class DecodeServer:
                 jnp.asarray([serial]),
                 jnp.asarray([0]),
             )[0]
-            return first, cache, last.at[slot].set(first)
+            # The first token stays ON DEVICE twice over: scattered into the
+            # step-feed vector AND into the per-slot first-token vector.
+            # Slots admitted in one wave share ONE host materialization of
+            # the (cumulative) first-token vector — on a network-attached
+            # chip each device->host read costs a full link RTT, and a
+            # per-slot scalar read made admission alone cost
+            # n_slots x RTT (~1.1s of the 8-stream benchmark's 1.4s).
+            return cache, last.at[slot].set(first), first_vec.at[slot].set(first)
 
         self._prefill_chunk = jax.jit(_prefill_chunk, donate_argnums=(2,))
+        # first_vec is deliberately NOT donated: earlier admission waves'
+        # _TokRefs still hold previous versions of the vector — donating it
+        # would delete a buffer a pending request reads at completion. It is
+        # [n_slots] int32; the copy is nothing.
         self._prefill_last = jax.jit(_prefill_last, donate_argnums=(2, 6))
 
     # -- client side ---------------------------------------------------------
@@ -306,6 +321,7 @@ class DecodeServer:
         self._free_blocks = list(range(1, self.total_blocks))
         self._slot_blocks = [[] for _ in range(self.n_slots)]
         self._last_dev = jnp.zeros((self.n_slots,), dtype=jnp.int32)
+        self._first_dev = jnp.zeros((self.n_slots,), dtype=jnp.int32)
 
     def _bucket(self, n: int) -> int:
         for b in self.prompt_buckets:
@@ -323,12 +339,13 @@ class DecodeServer:
             return None
 
     def _admit(self) -> None:
+        admitted: List[int] = []
         for idx, slot in enumerate(self._slots):
             if slot.active:
                 continue
             item = self._next_request()
             if item is None:
-                return
+                break
             prompt, max_new, fut = item
             if len(prompt) >= self.max_len:
                 fut.set_exception(
@@ -367,7 +384,7 @@ class DecodeServer:
                 # FIFO head-of-line — later requests must not starve this
                 # one by sneaking into blocks as they free.
                 self._waiting.appendleft((prompt, max_new, fut))
-                return
+                break
             blocks = [self._free_blocks.pop() for _ in range(n_blocks)]
             self._slot_blocks[idx] = blocks
             row = np.zeros((self.max_pages,), dtype=np.int32)
@@ -389,7 +406,6 @@ class DecodeServer:
             # into the device token vector (no host materialization).
             chunk = self.prompt_buckets[-1]
             start = 0
-            first = None
             while True:
                 piece = prompt[start : start + chunk]
                 last_chunk = start + len(piece) >= len(prompt)
@@ -397,16 +413,19 @@ class DecodeServer:
                 padded = np.zeros((1, bucket), dtype=np.int32)
                 padded[0, : len(piece)] = piece
                 if last_chunk:
-                    first, self.cache, self._last_dev = self._prefill_last(
-                        self.params,
-                        jnp.asarray(padded),
-                        self.cache,
-                        self._table[idx],
-                        start,
-                        len(piece),
-                        self._last_dev,
-                        idx,
-                        serial,
+                    self.cache, self._last_dev, self._first_dev = (
+                        self._prefill_last(
+                            self.params,
+                            jnp.asarray(padded),
+                            self.cache,
+                            self._table[idx],
+                            start,
+                            len(piece),
+                            self._last_dev,
+                            self._first_dev,
+                            idx,
+                            serial,
+                        )
                     )
                     break
                 self.cache = self._prefill_chunk(
@@ -420,16 +439,25 @@ class DecodeServer:
                 start += len(piece)
             slot.pos = len(prompt)
             slot.remaining = max_new - 1
-            slot.refs = [(_TokRef(first), None, None)]
             slot.eos_scanned = 0
-            self._finish_if_done(idx)
+            admitted.append(idx)
+        if admitted:
+            # ONE _TokRef over the cumulative first-token vector for the
+            # whole admission wave: every wave member's value is present in
+            # the latest array (each scatter built on the previous), so the
+            # wave costs a single device->host transfer instead of one RTT
+            # per slot.
+            ref = _TokRef(self._first_dev)
+            for idx in admitted:
+                self._slots[idx].refs.insert(0, (ref, idx, None))
+                self._finish_if_done(idx)
 
     @staticmethod
     def _token_at(ref: _TokRef, lane: Optional[int], row: Optional[int]) -> int:
         arr = ref.np()
-        if lane is None:
-            return int(arr)
-        return int(arr[row, lane])
+        if row is None:
+            return int(arr[lane])  # admission-wave first-token vector
+        return int(arr[row, lane])  # macro-dispatch window [K, n_slots]
 
     def _materialize_tokens(self, slot: _Slot) -> List[int]:
         return [self._token_at(ref, lane, row) for ref, lane, row in slot.refs]
