@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all test test-tpu native bench dryrun demo simulate example clean \
-	render cluster kind-cluster docker-build
+	render cluster kind-cluster docker-build e2e-kind
 
 all: native test
 
@@ -14,6 +14,15 @@ test:
 # Same suite against the real accelerator (slow: per-test compiles).
 test-tpu:
 	NOS_TPU_TEST_ON_TPU=1 $(PY) -m pytest tests/ -q
+
+# Hardware gate only: flash/paged kernel numerics + perf floors on the chip.
+test-tpu-kernels:
+	NOS_TPU_TEST_ON_TPU=1 $(PY) -m pytest tests/test_flash_attention_tpu.py -q
+
+# THE live-cluster gate: provision kind, deploy the chart, drive one full
+# dynamic-partitioning loop, assert (hack/e2e_kind.sh; needs Docker).
+e2e-kind:
+	bash hack/e2e_kind.sh
 
 # Native tpuslice shim (the cgo/NVML-layer analog).
 native:

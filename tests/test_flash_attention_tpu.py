@@ -1,0 +1,110 @@
+"""Hardware gate for the flash kernels (VERDICT r3 #6): numerics AND a perf
+floor on the real chip. CI runs the kernels in interpret mode only (fast,
+but a Mosaic compile/lowering regression would pass it and fail on
+hardware); this file is the on-TPU gate — `make test-tpu` runs it against
+the real accelerator, and the driver's bench artifact records the same
+speedup through runtime/mfu.flash_train_shape_speedup.
+
+Skipped automatically off-TPU (the CPU CI suite stays hermetic)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="real-TPU gate; CPU CI runs interpret mode"
+)
+
+TRAIN_SHAPE = (8, 8, 2048, 64)  # the GPT train step's attention shape
+
+
+def _rand(shape, seed, dtype=jnp.bfloat16):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+def test_forward_matches_reference_on_chip():
+    import importlib
+
+    fa = importlib.import_module("nos_tpu.ops.flash_attention")
+
+    q, k, v = (_rand(TRAIN_SHAPE, i) for i in range(3))
+    out = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))(q, k, v)
+    ref = jax.jit(
+        lambda q, k, v: fa._reference_attention(q, k, v, True, TRAIN_SHAPE[-1] ** -0.5)
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_backward_matches_cpu_reference_on_chip():
+    """Flash backward kernels vs the CPU-backend reference VJP. The oracle
+    is deliberately CROSS-BACKEND: the TPU-compiled XLA reference VJP emits
+    spurious nonzero dq for masked-dominated rows (measured 0.15 at query
+    position 0, whose exact gradient is 0 — single-key softmax), so
+    on-chip-reference-vs-kernel would flag the KERNEL for the oracle's bug.
+    Flash-vs-CPU agrees within bf16 ulps (maxabs 0.0625-0.125 on values of
+    magnitude 7-16)."""
+    import importlib
+
+    fa = importlib.import_module("nos_tpu.ops.flash_attention")
+
+    shape = (2, 4, 512, 64)
+    q, k, v = (_rand(shape, 10 + i) for i in range(3))
+    scale = shape[-1] ** -0.5
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            fa._reference_attention(q, k, v, True, scale).astype(jnp.float32) ** 2
+        )
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        q_c, k_c, v_c = (jax.device_put(np.asarray(x), cpu) for x in (q, k, v))
+        g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q_c, k_c, v_c)
+    for got, ref in zip(g_flash, g_ref):
+        got = np.asarray(got, np.float32)
+        ref = np.asarray(ref, np.float32)
+        np.testing.assert_allclose(got, ref, rtol=3e-2, atol=3e-2)
+
+
+def test_paged_attention_kernel_matches_reference_on_chip():
+    from nos_tpu.ops.paged_attention import _pallas, _reference
+
+    rng = np.random.RandomState(0)
+    b, nkv, hd, bs, n_pages, total = 8, 8, 64, 32, 4, 33
+    q = jnp.asarray(rng.randn(b, nkv, hd), jnp.bfloat16)
+    pk = jnp.asarray(rng.randn(total, nkv, bs, hd), jnp.bfloat16)
+    pv = jnp.asarray(rng.randn(total, nkv, bs, hd), jnp.bfloat16)
+    table = jnp.asarray(
+        1 + np.arange(b * n_pages, dtype=np.int32).reshape(b, n_pages)
+    )
+    limit = jnp.asarray(rng.randint(1, n_pages * bs + 1, size=b), jnp.int32)
+    out = jax.jit(_pallas)(q, pk, pv, table, limit)
+    ref = _reference(q, pk, pv, table, limit)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_flash_pair_perf_floor_on_chip():
+    """The fwd+bwd flash pair must beat the XLA materializing reference at
+    the training shape by a firm margin. Measured on the bench chip:
+    forward alone 6.4x (docs/benchmark.md); the fwd+bwd pair measured
+    2.2x-11.8x across tunnel states (median ~3.5x — XLA's attention
+    BACKWARD is the competitive half and the shared chip's load moves the
+    ratio). The floor is 2x: the kernel must always be CLEARLY faster, and
+    a Mosaic lowering regression (the CI-interpret blind spot this gate
+    exists for) lands it near or below 1x. Same scan-differencing as the
+    bench artifact's flash_attention block, so the two cannot disagree
+    about what was measured."""
+    from nos_tpu.runtime.mfu import flash_train_shape_speedup
+
+    result = flash_train_shape_speedup()
+    assert result is not None
+    assert result["speedup"] >= 2.0, result
