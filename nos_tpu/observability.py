@@ -144,12 +144,20 @@ class ObservabilityServer:
         health: HealthManager,
         port: int = 0,
         host: str = "127.0.0.1",
+        metrics_token: Optional[str] = None,
     ):
         """In-cluster deployments bind host='0.0.0.0' on the configured
         health_probe_port so kubelet httpGet probes can reach the pod IP;
-        tests/demos keep loopback + ephemeral."""
+        tests/demos keep loopback + ephemeral.
+
+        `metrics_token` guards /metrics with bearer-token auth (the
+        kube-rbac-proxy-guarded pattern without the sidecar: Prometheus
+        authenticates via the ServiceMonitor's bearerTokenSecret, everyone
+        else gets 401). /healthz and /readyz stay open — kubelet httpGet
+        probes cannot attach credentials."""
         self.metrics = metrics_registry
         self.health = health
+        self.metrics_token = metrics_token
         obs = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -158,6 +166,20 @@ class ObservabilityServer:
 
             def do_GET(self):
                 if self.path == "/metrics":
+                    if obs.metrics_token is not None:
+                        import hmac
+
+                        presented = self.headers.get("Authorization", "")
+                        if not hmac.compare_digest(
+                            presented, f"Bearer {obs.metrics_token}"
+                        ):
+                            body = b"unauthorized"
+                            self.send_response(401)
+                            self.send_header("WWW-Authenticate", "Bearer")
+                            self.send_header("Content-Length", str(len(body)))
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
                     body = obs.metrics.render().encode()
                     self.send_response(200)
                 elif self.path == "/healthz":

@@ -117,3 +117,41 @@ class TestEndpoints:
         stop.set()
         w.join(timeout=10)
         assert not errors, errors
+
+
+def test_metrics_bearer_token_guard():
+    """With a token configured, /metrics requires the exact bearer token
+    (401 otherwise) while /healthz and /readyz stay open for kubelet
+    probes."""
+    import urllib.error
+    import urllib.request
+
+    from nos_tpu.observability import HealthManager, Metrics, ObservabilityServer
+
+    registry = Metrics()
+    registry.inc("nos_tpu_test_counter")
+    server = ObservabilityServer(
+        registry, HealthManager(), metrics_token="s3cret"
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/metrics")
+        assert err.value.code == 401
+        assert err.value.headers.get("WWW-Authenticate") == "Bearer"
+        req = urllib.request.Request(
+            f"{base}/metrics", headers={"Authorization": "Bearer wrong"}
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 401
+        req = urllib.request.Request(
+            f"{base}/metrics", headers={"Authorization": "Bearer s3cret"}
+        )
+        body = urllib.request.urlopen(req).read().decode()
+        assert "nos_tpu_test_counter" in body
+        # Probes stay open (kubelet httpGet cannot attach credentials).
+        assert urllib.request.urlopen(f"{base}/healthz").status == 200
+        assert urllib.request.urlopen(f"{base}/readyz").status == 200
+    finally:
+        server.stop()
