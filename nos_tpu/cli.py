@@ -489,6 +489,7 @@ def _simulate_multihost(args) -> int:
         shapes=multihost_shape_ladder(args.topology, args.host_topology),
         mean_interarrival_s=args.interarrival,
         duration_range_s=(args.min_duration, args.max_duration),
+        checkpointable_fraction=args.checkpointable_fraction,
     )
     window = (args.window_start, args.window_end) if args.window_end > 0 else None
     report = sim.run(jobs, measure_window=window, max_s=args.max_seconds)

@@ -137,6 +137,13 @@ class SchedulerConfig:
     queue_policy: str = "fifo"
     swf_aging_chips: float = 16.0
     swf_default_duration_s: float = 600.0
+    # Checkpoint-aware reservation drain (scheduler-side sibling of the
+    # partitioner's fallback; same gates, shared churn-ledger semantics).
+    checkpoint_preempt_after_s: Optional[float] = 120.0
+    checkpoint_min_gain_s: float = 60.0
+    checkpoint_victim_cooldown_s: float = 300.0
+    checkpoint_victim_budget: int = 3
+    checkpoint_victim_window_s: float = 3600.0
 
     def validate(self) -> None:
         if not self.scheduler_name:
@@ -147,6 +154,19 @@ class SchedulerConfig:
             raise ConfigError("swf_aging_chips must be >= 0")
         if self.swf_default_duration_s <= 0:
             raise ConfigError("swf_default_duration_s must be positive")
+        if (
+            self.checkpoint_preempt_after_s is not None
+            and self.checkpoint_preempt_after_s < 0
+        ):
+            raise ConfigError("checkpoint_preempt_after_s must be >= 0 or null")
+        if self.checkpoint_min_gain_s < 0:
+            raise ConfigError("checkpoint_min_gain_s must be >= 0")
+        if self.checkpoint_victim_cooldown_s < 0:
+            raise ConfigError("checkpoint_victim_cooldown_s must be >= 0")
+        if self.checkpoint_victim_budget < 1:
+            raise ConfigError("checkpoint_victim_budget must be >= 1")
+        if self.checkpoint_victim_window_s <= 0:
+            raise ConfigError("checkpoint_victim_window_s must be positive")
         if self.backfill_min_fraction is not None and not (
             0.0 < self.backfill_min_fraction
         ):

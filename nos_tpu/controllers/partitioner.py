@@ -93,11 +93,15 @@ class PartitionerController:
         self.checkpoint_victim_cooldown_s = checkpoint_victim_cooldown_s
         self.checkpoint_victim_budget = checkpoint_victim_budget
         self.checkpoint_victim_window_s = checkpoint_victim_window_s
-        # workload namespaced-name -> recent fallback-eviction timestamps
-        # (pruned to the sliding window; keyed by name so the budget follows
-        # the workload across resubmissions, which reuse the name under every
-        # controller that resumes from checkpoint).
-        self._ckpt_evictions: dict = {}
+        from nos_tpu.util.churn import ChurnLedger
+
+        self._churn = ChurnLedger(
+            checkpoint_victim_cooldown_s,
+            checkpoint_victim_budget,
+            checkpoint_victim_window_s,
+        )
+        # Alias kept for tests/operators poking the raw history.
+        self._ckpt_evictions = self._churn.history
         self._last_cycle_at = self._mono()
         self._version_at_last_cycle: Optional[int] = None
         self._age_gate_at: Optional[float] = None
@@ -413,47 +417,12 @@ class PartitionerController:
 
     # -- checkpoint-eviction churn bookkeeping -------------------------------
     def _victim_eligible_at(self, victim: Pod, now: float) -> float:
-        """Earliest time this workload may be fallback-evicted again: after
-        `cooldown` since its last eviction, and only while fewer than
-        `budget` evictions sit inside the sliding `window`."""
-        history = self._ckpt_evictions.get(victim.metadata.namespaced_name)
-        if history:
-            history = [
-                t for t in history if now - t < self.checkpoint_victim_window_s
-            ]
-        if not history:
-            # No evictions, or every eviction aged out of the window (the
-            # map prunes lazily on write, so a quiet period leaves stale
-            # non-empty entries behind).
-            return now
-        eligible = history[-1] + self.checkpoint_victim_cooldown_s
-        if len(history) >= self.checkpoint_victim_budget:
-            # The oldest of the last `budget` evictions must age out of the
-            # window before another is allowed.
-            eligible = max(
-                eligible,
-                history[-self.checkpoint_victim_budget]
-                + self.checkpoint_victim_window_s,
-            )
-        return eligible
+        """Earliest time this workload may be fallback-evicted again
+        (util/churn.ChurnLedger: cooldown + sliding-window budget)."""
+        return self._churn.eligible_at(victim.metadata.namespaced_name, now)
 
     def _note_checkpoint_eviction(self, victim: Pod, now: float) -> None:
-        key = victim.metadata.namespaced_name
-        history = [
-            t
-            for t in self._ckpt_evictions.get(key, [])
-            if now - t < self.checkpoint_victim_window_s
-        ]
-        history.append(now)
-        self._ckpt_evictions[key] = history
-        if len(self._ckpt_evictions) > 4096:
-            # Bound the map on long-lived controllers: drop fully-aged-out
-            # workloads (their eligibility is `now` anyway).
-            self._ckpt_evictions = {
-                k: h
-                for k, h in self._ckpt_evictions.items()
-                if any(now - t < self.checkpoint_victim_window_s for t in h)
-            }
+        self._churn.note(victim.metadata.namespaced_name, now)
 
     def _movable(self, spec, victim: Pod, preemptor: Pod) -> bool:
         """A victim is movable when it holds TPU capacity the carve needs,

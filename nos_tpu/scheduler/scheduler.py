@@ -74,6 +74,11 @@ class Scheduler:
         queue_policy: str = "fifo",
         swf_aging_chips: float = 16.0,
         swf_default_duration_s: float = 600.0,
+        checkpoint_preempt_after_s: Optional[float] = 120.0,
+        checkpoint_min_gain_s: float = 60.0,
+        checkpoint_victim_cooldown_s: float = 300.0,
+        checkpoint_victim_budget: int = 3,
+        checkpoint_victim_window_s: float = 3600.0,
     ):
         self.cluster = cluster
         self._now = now if now is not None else _time.time
@@ -112,6 +117,25 @@ class Scheduler:
         self.queue_policy = queue_policy
         self.swf_aging_chips = swf_aging_chips
         self.swf_default_duration_s = swf_default_duration_s
+        # Checkpoint-aware reservation drain (the scheduler-side sibling of
+        # the partitioner's consolidation fallback, same discipline and
+        # defaults): an aged sticky holder whose protected drain set is
+        # occupied ENTIRELY by declared-checkpointable workloads may evict
+        # them — they resume from checkpoint, so the drain completes now
+        # instead of at the natural end. Round 3 shipped this WITHOUT the
+        # gain gate and churn ledger and had to revert it (mass evictions
+        # at full-mesh scale live-locked the north-star trace); the gates
+        # are what make it deployable. None disables.
+        self.checkpoint_preempt_after_s = checkpoint_preempt_after_s
+        self.checkpoint_min_gain_s = checkpoint_min_gain_s
+        from nos_tpu.util.churn import ChurnLedger
+
+        self._churn = ChurnLedger(
+            checkpoint_victim_cooldown_s,
+            checkpoint_victim_budget,
+            checkpoint_victim_window_s,
+        )
+        self._last_ckpt_drain_at: Optional[float] = None
         self._bypassed: dict = {}  # blocked unit name -> chips bound past it
         # Sticky drain set: re-picking the cheapest block every pass lets the
         # target drift as backfill lands, so no block ever finishes draining.
@@ -241,6 +265,23 @@ class Scheduler:
                 if name == self._sticky_holder:
                     self._sticky_key = key
                     break
+        # Once the holder's checkpoint drain is imminent (aged, or within
+        # one min-gain of aging) AND feasible (every current occupant of
+        # the protected set declares checkpoint-resume — at fraction 0 the
+        # drain can never fire and blocking backfill would only starve the
+        # mesh), stop admitting even provably-short backfill onto the
+        # protected set: a pod bound there now would be drained moments
+        # later — a bind/requeue round trip the scheduler itself created.
+        protect_hard = False
+        if reservation is not None and self.checkpoint_preempt_after_s is not None:
+            pre_holder = self._holder_pods(pending)
+            if pre_holder:
+                now = self._now()
+                ready_at, victims = self._drain_assessment(nodes, pre_holder, now)
+                protect_hard = (
+                    victims is not None
+                    and ready_at - now <= self.checkpoint_min_gain_s
+                )
         next_arm_at: Optional[float] = None
         sticky_seen = False
         failed_large: List[Tuple[str, float]] = []  # blocked this pass
@@ -262,7 +303,9 @@ class Scheduler:
                 and unit_chips > 0
                 and (self._sticky_key is None or unit_key > self._sticky_key)
             ):
-                if not self._finishes_before(unit_pods, reservation.start_at):
+                if protect_hard or not self._finishes_before(
+                    unit_pods, reservation.start_at
+                ):
                     # May not take capacity the holder's drain is producing:
                     # schedule against the unprotected remainder only.
                     unit_nodes = [
@@ -345,6 +388,20 @@ class Scheduler:
             # The holder left the pending queue without binding through this
             # scheduler (deleted, or bound elsewhere): release its drain set.
             self._clear_sticky()
+        if self._sticky_holder is not None:
+            # Resolved from the PASS's pending list, not a pre-loop capture:
+            # on the very pass that ARMS the reservation the holder name
+            # only exists after the loop, and skipping the drain evaluation
+            # there would freeze its age wake-up out of the no-op expiry.
+            holder_pods = self._holder_pods(pending)
+            if holder_pods:
+                drain_retry = self._maybe_checkpoint_drain(nodes, holder_pods)
+                if drain_retry is not None and (
+                    next_arm_at is None or drain_retry < next_arm_at
+                ):
+                    # Time-driven drain condition (holder aging, pacing,
+                    # victim cooldown): expire the no-op record when due.
+                    next_arm_at = drain_retry
         if not bound and self.cluster.version == version_at_start:
             self._noop_at_version = version_at_start
             self._noop_until = next_arm_at if next_arm_at is not None else float("inf")
@@ -424,6 +481,106 @@ class Scheduler:
         best.requested = best.requested.add(self.calculator.compute_pod_request(pod))
         best.pods.append(pod)
         return best.name
+
+    def _protected_victims(self, nodes: List[NodeInfo]) -> Optional[List[Pod]]:
+        """TPU-consuming occupants of the sticky protected set, or None when
+        a protected node vanished from the snapshot."""
+        if not self._sticky_protected:
+            return None
+        by_name = {n.name: n for n in nodes}
+        victims: List[Pod] = []
+        for name in self._sticky_protected:
+            node = by_name.get(name)
+            if node is None:
+                return None
+            for p in node.pods:
+                if _tpu_chips(self.calculator.compute_pod_request(p)) > 0:
+                    victims.append(p)
+        return victims
+
+    def _holder_pods(self, pending: List[Pod]) -> List[Pod]:
+        """The sticky holder's pending pods (a gang's members, or the one
+        pod), by unit name."""
+        if self._sticky_holder is None:
+            return []
+        return [
+            p
+            for p in pending
+            if (podutil.gang_of(p) or p.metadata.namespaced_name)
+            == self._sticky_holder
+        ]
+
+    def _drain_assessment(self, nodes, holder_pods: List[Pod], now: float):
+        """(ready_at, victims) for the checkpoint drain: victims is the
+        eviction set when every NON-temporal gate passes (occupants exist,
+        none outranks the holder, ALL checkpointable, and the natural drain
+        is provably further out than `checkpoint_min_gain_s` — unknown
+        stamps count as unbounded), else None. ready_at is the earliest
+        time every TIME gate clears (holder age, global pacing, the churn
+        ledger). protect_hard and the drain itself share this assessment —
+        two divergent copies once froze mesh-wide admission for a drain the
+        gain gate would never allow (measured busy 0.90 -> 0.81)."""
+        if self.checkpoint_preempt_after_s is None or not self._sticky_protected:
+            return None, None
+        victims = self._protected_victims(nodes)
+        if not victims:  # vanished node (None) or already drained ([])
+            return None, None
+        holder_prio = max(p.spec.priority for p in holder_pods)
+        if any(p.spec.priority > holder_prio for p in victims):
+            return None, None
+        if not all(podutil.is_checkpointable(p) for p in victims):
+            return None, None
+        end = podutil.latest_expected_end(victims, now)
+        if end is not None and end - now <= self.checkpoint_min_gain_s:
+            # The natural drain is imminent; eviction would buy less than
+            # a requeue costs. Only writes change this.
+            return None, None
+        ready_at = (
+            min(p.metadata.creation_timestamp for p in holder_pods)
+            + self.checkpoint_preempt_after_s
+        )
+        if self._last_ckpt_drain_at is not None:
+            ready_at = max(
+                ready_at, self._last_ckpt_drain_at + self.checkpoint_min_gain_s
+            )
+        ready_at = max(
+            ready_at,
+            max(
+                self._churn.eligible_at(p.metadata.namespaced_name, now)
+                for p in victims
+            ),
+        )
+        return ready_at, victims
+
+    def _maybe_checkpoint_drain(
+        self, nodes: List[NodeInfo], holder_pods: List[Pod]
+    ) -> Optional[float]:
+        """Evict the sticky holder's drain-set occupants when the shared
+        assessment passes; returns the next time a time-driven gate
+        unblocks (for the no-op expiry), or None when the drain fired /
+        can only unblock via a store write."""
+        now = self._now()
+        ready_at, victims = self._drain_assessment(nodes, holder_pods, now)
+        if victims is None:
+            return None
+        if ready_at > now:
+            return ready_at
+        end = podutil.latest_expected_end(victims, now)
+        logger.info(
+            "checkpoint drain: evicting %d checkpointable occupant(s) of "
+            "%s's drain set (natural drain %s)",
+            len(victims),
+            self._sticky_holder,
+            "unknown" if end is None else f"in {end - now:.0f}s",
+        )
+        for p in victims:
+            self._churn.note(p.metadata.namespaced_name, now)
+            self._evict(p)
+        self._last_ckpt_drain_at = now
+        from nos_tpu.observability import metrics
+
+        metrics.inc("nos_tpu_checkpoint_drains")
+        return None
 
     # -- duration-aware backfill (drain-set reservation) ---------------------
     def _clear_sticky(self) -> None:

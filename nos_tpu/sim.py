@@ -462,7 +462,10 @@ def mixed_workload(
 @dataclass
 class GangJob:
     """A multi-host workload: `hosts` pods, one per host, gang-bound onto a
-    sub-slice of `topology` chips."""
+    sub-slice of `topology` chips. `checkpointable` marks a gang that
+    checkpoints (orbax) and RESUMES after eviction — the common case for
+    exactly the large long-running training jobs whose drains dominate the
+    multihost tail."""
 
     name: str
     namespace: str
@@ -471,6 +474,7 @@ class GangJob:
     arrival_s: float
     duration_s: float
     priority: int = 0
+    checkpointable: bool = False
 
 
 class MultiHostSim(_TraceRunner):
@@ -582,7 +586,12 @@ class MultiHostSim(_TraceRunner):
                         annotations={
                             constants.ANNOTATION_EXPECTED_DURATION: (
                                 f"{job.duration_s:.0f}"
-                            )
+                            ),
+                            **(
+                                {constants.ANNOTATION_CHECKPOINTABLE: "true"}
+                                if job.checkpointable
+                                else {}
+                            ),
                         },
                     ),
                     spec=PodSpec(
@@ -625,10 +634,15 @@ def mixed_gang_workload(
     namespaces: Sequence[str] = ("team-a", "team-b", "team-c"),
     mean_interarrival_s: float = 4.0,
     duration_range_s: Tuple[float, float] = (60.0, 600.0),
+    checkpointable_fraction: float = 0.0,
 ) -> List[GangJob]:
     """Gang-shaped mixed trace: (chip topology, hosts) weighted toward the
-    small end, Poisson arrivals, uniform durations."""
+    small end, Poisson arrivals, uniform durations. `checkpointable_fraction`
+    draws from an INDEPENDENT RNG stream so traces with different fractions
+    share arrivals/shapes/durations exactly (fraction 0 reproduces the
+    judged trace bit-for-bit)."""
     rng = random.Random(seed)
+    flag_rng = random.Random(f"{seed}-checkpointable")
     names = [(t, h) for t, h, _ in shapes]
     weights = [w for _, _, w in shapes]
     jobs: List[GangJob] = []
@@ -645,6 +659,7 @@ def mixed_gang_workload(
                 arrival_s=t,
                 duration_s=rng.uniform(*duration_range_s),
                 priority=rng.choice([0, 0, 0, 10]),
+                checkpointable=flag_rng.random() < checkpointable_fraction,
             )
         )
     return jobs
@@ -682,6 +697,7 @@ def simulate_north_star_multihost(
     seed: int = 0,
     tick_s: float = 1.0,
     measure_window: Optional[Tuple[float, float]] = (180.0, 900.0),
+    checkpointable_fraction: float = 0.0,
 ) -> SimReport:
     """The north star at its TRUE shape — identical to the judged
     `simulate --multihost --topology 16x16` defaults: ONE v5e-256 pod = 64
@@ -694,6 +710,7 @@ def simulate_north_star_multihost(
         seed=seed,
         shapes=multihost_shape_ladder("16x16", "2x2"),
         mean_interarrival_s=2.0,
+        checkpointable_fraction=checkpointable_fraction,
     )
     return sim.run(jobs, tick_s=tick_s, measure_window=measure_window)
 

@@ -86,6 +86,11 @@ def build_scheduler(
         queue_policy=config.queue_policy,
         swf_aging_chips=config.swf_aging_chips,
         swf_default_duration_s=config.swf_default_duration_s,
+        checkpoint_preempt_after_s=config.checkpoint_preempt_after_s,
+        checkpoint_min_gain_s=config.checkpoint_min_gain_s,
+        checkpoint_victim_cooldown_s=config.checkpoint_victim_cooldown_s,
+        checkpoint_victim_budget=config.checkpoint_victim_budget,
+        checkpoint_victim_window_s=config.checkpoint_victim_window_s,
     )
 
 

@@ -295,3 +295,106 @@ class TestCarvePriorityOrder:
                 pods.append(cluster.create(pod))
         items = gp.pending_gang_demand(pods)
         assert [i["gang"] for i in items] == ["ml/high", "ml/low"]
+
+
+class TestCheckpointReservationDrain:
+    """Scheduler-side checkpoint drain (round 4): an aged sticky holder may
+    evict its drain set when EVERY occupant declares checkpoint-resume and
+    every gate (gain, priority, churn ledger, pacing) passes. Round 3 tried
+    this without the gates and live-locked at full-mesh scale."""
+
+    _cluster_with_nodes = TestDrainSetReservation._cluster_with_nodes
+    _submit = TestDrainSetReservation._submit
+
+    def _mark_checkpointable(self, cluster, name):
+        cluster.patch(
+            "Pod", "ml", name,
+            lambda p: p.metadata.annotations.__setitem__(
+                constants.ANNOTATION_CHECKPOINTABLE, "true"
+            ),
+        )
+
+    def _armed_scheduler(self, clock, cluster, fill_duration=900.0):
+        """Arm a reservation for a whole-node pod via measured starvation
+        (the rolling-small-pod churn of the arming test above); returns the
+        scheduler with live fill pods occupying the drain set."""
+        sched = _mk_scheduler(
+            cluster, clock, backfill_min_fraction=0.9, backfill_after_s=30.0,
+            backfill_bypass_factor=2.0, checkpoint_preempt_after_s=120.0,
+            checkpoint_min_gain_s=60.0,
+        )
+        live = []
+        for i in range(4):
+            self._submit(cluster, f"seed{i}", 4, fill_duration)
+            live.append(f"seed{i}")
+        sched.schedule_pending()
+        self._submit(cluster, "whole", 16, 100.0)
+        clock.advance(40.0)
+        sched.schedule_pending()
+
+        def done(p):
+            p.status.phase = "Succeeded"
+
+        for i in range(10):
+            cluster.patch("Pod", "ml", live.pop(0), done)
+            name = f"fill{i}"
+            self._submit(cluster, name, 4, fill_duration)
+            live.append(name)
+            clock.advance(5.0)
+            sched.schedule_pending()
+        assert sched._sticky_holder is not None
+        occupants = [
+            p.metadata.name
+            for p in cluster.list("Pod")
+            if p.spec.node_name and podutil.is_active(p)
+        ]
+        assert occupants
+        return sched, occupants
+
+    def test_drain_evicts_aged_holders_checkpointable_set(self):
+        from nos_tpu.sim import VirtualClock
+
+        clock = VirtualClock()
+        cluster = self._cluster_with_nodes(clock, n_nodes=1)
+        sched, occupants = self._armed_scheduler(clock, cluster)
+        for name in occupants:
+            self._mark_checkpointable(cluster, name)
+        # Holder crosses the age threshold; next pass fires the drain.
+        clock.advance(130.0)
+        sched.schedule_pending()
+        for name in occupants:
+            assert cluster.try_get("Pod", "ml", name) is None, name
+        # Every eviction is in the churn ledger.
+        assert all(
+            f"ml/{name}" in sched._churn.history for name in occupants
+        )
+
+    def test_drain_requires_every_occupant_checkpointable(self):
+        from nos_tpu.sim import VirtualClock
+
+        clock = VirtualClock()
+        cluster = self._cluster_with_nodes(clock, n_nodes=1)
+        sched, occupants = self._armed_scheduler(clock, cluster)
+        for name in occupants[1:]:
+            self._mark_checkpointable(cluster, name)  # occupants[0] is NOT
+        clock.advance(130.0)
+        sched.schedule_pending()
+        for name in occupants:
+            assert cluster.try_get("Pod", "ml", name) is not None, name
+
+    def test_drain_declines_when_natural_end_is_imminent(self):
+        from nos_tpu.sim import VirtualClock
+
+        clock = VirtualClock()
+        cluster = self._cluster_with_nodes(clock, n_nodes=1)
+        # Fill durations short enough that by the time the holder ages, the
+        # occupants' stamped ends are inside the 60s min-gain window.
+        sched, occupants = self._armed_scheduler(clock, cluster, fill_duration=220.0)
+        for name in occupants:
+            self._mark_checkpointable(cluster, name)
+        # By +265s the occupants' stamped ends (bound ~45-90, duration 220)
+        # are at or inside the 60s min-gain window: waiting beats evicting.
+        clock.advance(265.0)
+        sched.schedule_pending()
+        for name in occupants:
+            assert cluster.try_get("Pod", "ml", name) is not None, name
