@@ -1,0 +1,158 @@
+"""Queue-policy unit properties (scheduler._unit_key) and the rank
+consistency invariant between the scheduler and the GroupPartitioner.
+
+The deadlock class these guard: if carve demand is ranked differently from
+the scheduler's queue, the partitioner carves for a gang the scheduler
+ranks below its reservation holder — the holder can't bind (wrong carve),
+the carved-for gang is reservation-gated, no write lands, and both version
+gates freeze the stalemate (found live under aged-swf in round 4)."""
+
+import random
+
+from nos_tpu import constants
+from nos_tpu.api.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster import Cluster
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.sim import VirtualClock
+
+
+def _pod(name, chips, duration=None, created=0.0, priority=0, gang=None, ns="ml"):
+    ann = {}
+    if duration is not None:
+        ann[constants.ANNOTATION_EXPECTED_DURATION] = str(duration)
+    labels = {}
+    if gang:
+        labels[constants.LABEL_GANG] = gang
+        labels[constants.LABEL_GANG_SIZE] = "2"
+    pod = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, annotations=ann, labels=labels),
+        spec=PodSpec(
+            containers=[
+                Container(resources=ResourceList.of({constants.RESOURCE_TPU: chips}))
+            ],
+            scheduler_name=constants.SCHEDULER_NAME,
+            priority=priority,
+        ),
+    )
+    pod.metadata.creation_timestamp = created
+    return pod
+
+
+def _scheduler(policy="aged-swf", t=0.0, aging=16.0):
+    clock = VirtualClock(t)
+    sched = Scheduler(
+        Cluster(now=clock), now=clock, queue_policy=policy,
+        swf_aging_chips=aging,
+    )
+    return sched, clock
+
+
+class TestAgedSwfKey:
+    def test_priority_dominates_work(self):
+        sched, _ = _scheduler()
+        vip = sched._unit_key([_pod("vip", 64, duration=600, priority=10)])
+        tiny = sched._unit_key([_pod("tiny", 1, duration=10)])
+        assert vip < tiny
+
+    def test_smaller_work_ranks_first_within_band(self):
+        sched, _ = _scheduler()
+        small = sched._unit_key([_pod("small", 4, duration=60)])
+        big = sched._unit_key([_pod("big", 32, duration=600)])
+        assert small < big
+
+    def test_aged_big_overtakes_fresh_small(self):
+        """The starvation bound: waiting earns swf_aging_chips chip-seconds
+        of rank credit per second, so an old big unit eventually outranks
+        any newly arrived small one."""
+        sched, clock = _scheduler(aging=16.0)
+        big = _pod("big", 32, duration=600, created=0.0)  # work 19200
+        clock.t = 19200 / 16.0 + 60.0  # past the crossover vs zero-work
+        fresh_small = _pod("small", 4, duration=60, created=clock.t)
+        assert sched._unit_key([big]) < sched._unit_key([fresh_small])
+
+    def test_fixed_pair_order_is_time_invariant(self):
+        """Both keys decay at the same rate, so the relative order of two
+        FIXED units never changes over time — the property that keeps the
+        no-op version gates sound under aged-swf."""
+        sched, clock = _scheduler()
+        a = _pod("a", 8, duration=300, created=10.0)
+        b = _pod("b", 16, duration=100, created=40.0)
+        orders = []
+        for t in (50.0, 500.0, 5000.0):
+            clock.t = t
+            orders.append(sched._unit_key([a]) < sched._unit_key([b]))
+        assert len(set(orders)) == 1
+
+    def test_unstamped_pods_assume_default_duration(self):
+        sched, _ = _scheduler()
+        stamped = sched._unit_key([_pod("s", 4, duration=600)])
+        unstamped = sched._unit_key([_pod("u", 4)])  # default 600s
+        # Same chips, same effective duration: rank falls back to creation.
+        assert stamped[1] == unstamped[1]
+
+    def test_fifo_key_is_arrival_order(self):
+        sched, _ = _scheduler(policy="fifo")
+        first = sched._unit_key([_pod("first", 32, duration=600, created=1.0)])
+        later = sched._unit_key([_pod("later", 1, duration=10, created=2.0)])
+        assert first < later
+
+
+class TestRankConsistency:
+    def test_group_partitioner_uses_the_schedulers_ranking(self):
+        """For random pending gang sets under BOTH policies, the
+        GroupPartitioner's demand order must equal the scheduler's unit
+        order exactly (system.py injects scheduler._unit_key; this pins
+        the wiring AND the semantics)."""
+        from nos_tpu.controllers.slice_group import GroupPartitioner
+
+        rng = random.Random(0)
+        for policy in ("fifo", "aged-swf"):
+            sched, clock = _scheduler(policy=policy)
+            clock.t = 500.0
+            gp = GroupPartitioner(sched.cluster, unit_key=sched._unit_key)
+            pods = []
+            for i in range(12):
+                members = [
+                    _pod(
+                        f"g{i}-{m}",
+                        chips=rng.choice([4, 8, 16]),
+                        duration=rng.uniform(30, 600),
+                        created=rng.uniform(0, 400),
+                        priority=rng.choice([0, 0, 10]),
+                        gang=f"g{i}",
+                    )
+                    for m in range(2)
+                ]
+                for p in members:
+                    p.status.phase = PodPhase.PENDING
+                    p.status.conditions.append(
+                        PodCondition(
+                            type="PodScheduled", status="False",
+                            reason="Unschedulable",
+                        )
+                    )
+                    p.spec.node_selector[
+                        constants.LABEL_TPU_SUBSLICE_TOPOLOGY
+                    ] = "2x2"
+                pods.extend(members)
+            gangs = {}
+            for p in pods:
+                gangs.setdefault(f"ml/{p.metadata.labels[constants.LABEL_GANG]}", []).append(p)
+            demand = gp.pending_gang_demand(pods)
+            demand_order = [item["gang"] for item in demand]
+            sched_order = [
+                name
+                for _, name in sorted(
+                    (sched._unit_key(members), name)
+                    for name, members in gangs.items()
+                )
+            ]
+            assert demand_order == sched_order, policy
