@@ -112,6 +112,11 @@ class AgentConfig:
     node_name: str = ""  # defaults to $NODE_NAME
     report_interval_s: float = 10.0
     use_native_tpulib: bool = True
+    # Permit real-chip discovery/health (tpulib/local.py). Activation
+    # additionally requires the operator's explicit NOS_TPU_LOCAL_CHIPS
+    # grant — visibility alone never activates it (libtpu is
+    # single-process; see the chip-ownership contract in docs/tpulib.md).
+    use_local_tpulib: bool = True
 
     def validate(self) -> None:
         if self.report_interval_s <= 0:

@@ -171,6 +171,12 @@ SCHEDULER_NAME = "nos-tpu-scheduler"
 # Env var node agents use to learn their node (reference constant.EnvVarNodeName).
 ENV_NODE_NAME = "NODE_NAME"
 
+# Explicit operator grant of the host's chips to the agent process
+# (tpulib/local.py chip-ownership contract): libtpu is single-process, so
+# the agent must never seize the chips merely because they are visible —
+# the chart sets this alongside the google.com/tpu resource request.
+ENV_LOCAL_CHIPS = "NOS_TPU_LOCAL_CHIPS"
+
 # Partitioning kinds.
 KIND_TPU = "tpu"
 # Multi-host podslice mode: nodes are member hosts of a slice group; carving

@@ -147,10 +147,13 @@ def build_tpu_agent(
     client=None,
     pod_resources_socket: Optional[str] = None,
 ) -> TpuAgent:
-    """Node agent with the best available device backend: native tpuslice if
-    it builds, else the pure-Python fake (the build-tag seam). With
-    `pod_resources_socket`, device accounting comes from the kubelet
-    pod-resources gRPC socket instead of the in-process client."""
+    """Node agent with the best available device backend: the real local
+    chips when the operator explicitly granted them to this process
+    (NOS_TPU_LOCAL_CHIPS — discovery + health on silicon, tpulib/local.py),
+    else native tpuslice if it builds, else the pure-Python fake (the
+    build-tag seam). With `pod_resources_socket`, device accounting comes
+    from the kubelet pod-resources gRPC socket instead of the in-process
+    client."""
     config = config or AgentConfig()
     if client is None:
         node = cluster.get("Node", "", node_name)
@@ -158,7 +161,52 @@ def build_tpu_agent(
         if topology is None:
             raise ValueError(f"node {node_name} has no TPU topology labels")
         client = None
-        if config.use_native_tpulib:
+        import os
+
+        grant = os.environ.get(constants.ENV_LOCAL_CHIPS, "").strip().lower()
+        if config.use_local_tpulib and grant in ("1", "true", "yes", "on"):
+            # Gated on the operator's EXPLICIT chip grant, not mere
+            # visibility: probing initializes the single-process libtpu
+            # runtime, which on a shared TPU VM would seize the chips out
+            # from under colocated workloads. The chart sets the env var
+            # together with the google.com/tpu resource request. ("0" /
+            # "false" disable — a truthiness check would read '0' as a
+            # grant.)
+            from nos_tpu.tpulib.interface import TpuLibError
+            from nos_tpu.tpulib.local import LocalChipClient
+
+            try:
+                candidate = LocalChipClient(expected=topology)
+            except TpuLibError as e:
+                # The explicit grant could not be honored (no runtime, no
+                # chips, unmapped device kind, holey enumeration): say so
+                # — the operator asked for silicon and is getting a model
+                # — then fall through the ladder rather than crash.
+                logger.warning(
+                    "local-chip grant set but unusable (%s); falling back "
+                    "to a modeled backend",
+                    e,
+                )
+                candidate = None
+            if candidate is not None and candidate.topology_mismatch is None:
+                client = candidate
+            elif candidate is not None:
+                # Device truth contradicts the node labels. The whole
+                # control plane (planner, annotations, scheduler) plans
+                # against the LABEL geometry, so actuating on a
+                # different one would diverge from every plan written
+                # for this node — surface the conflict and keep the
+                # label-shaped modeled backend instead (fail-safe).
+                # NB the probe already initialized libtpu, and a live
+                # process cannot release it — fix the labels or the
+                # grant and restart the agent.
+                logger.warning(
+                    "%s; declining the local backend (note: this "
+                    "process still holds the TPU runtime — restart "
+                    "after fixing labels/grant)",
+                    candidate.topology_mismatch,
+                )
+        if client is None and config.use_native_tpulib:
             try:
                 from nos_tpu.tpulib.native_client import NativeTpuClient
 

@@ -2,7 +2,7 @@
 
 Where the reference drives NVML through cgo (pkg/gpu/nvml, build-tagged so CI
 never needs a GPU — SURVEY.md §4 "hardware-boundary mocking"), this package
-drives TPU sub-slice carving. Four backends satisfy one interface:
+drives TPU sub-slice carving. Five backends satisfy one interface:
 
   - FakeTpuClient (pure Python) — tests and the in-memory runtime;
   - NativeTpuClient (ctypes over the C++ shim in native/) — the production
@@ -11,9 +11,12 @@ drives TPU sub-slice carving. Four backends satisfy one interface:
     from-scratch REST client over the Cloud-TPU-v2-shaped queuedResources
     provisioning surface (long-running operations, async quota denial,
     retries), fixture-tested against tpulib/cloud_server.py;
-  - a node-local libtpu-backed client would slot in behind the same seam.
+  - LocalChipClient (tpulib/local.py) — discovery and health on the REAL
+    local chips via the XLA runtime's device enumeration; slice
+    bookkeeping stays logical (no carve syscall exists on a single chip).
 """
 
 from nos_tpu.tpulib.interface import SliceHandle, TpuClient, TpuLibError  # noqa: F401
 from nos_tpu.tpulib.fake import FakeTpuClient  # noqa: F401
 from nos_tpu.tpulib.cloud import CloudTpuClient, QuotaExhaustedError  # noqa: F401
+from nos_tpu.tpulib.local import LocalChipClient, discover_local_topology  # noqa: F401

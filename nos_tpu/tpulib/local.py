@@ -1,0 +1,243 @@
+"""LocalChipClient: TpuClient whose discovery and health run on REAL silicon.
+
+The reference's device layer talks to hardware through NVML
+(pkg/gpu/nvml/client.go:148-223 — device enumeration, memory info, health).
+This backend is that layer's TPU analog for the machine the agent runs on:
+
+  - **Discovery is real.** Generation and mesh shape are read from the XLA
+    runtime's device enumeration — `device_kind` strings ("TPU v5 lite",
+    "TPU v4", ...) map to the generation table, and the chip-coordinate
+    bounding box of the local devices yields the node's mesh shape. No
+    labels, no environment variables: the same source of truth libtpu gives
+    every JAX program on the host.
+  - **Health is real.** `health()` dispatches a one-element computation to
+    every local chip and blocks on the result; a chip that cannot complete
+    an add is reported with the runtime's error string (the
+    XID-error-watch analog of the reference's nvml health surface).
+  - **Carve lifecycle is logical, by design.** A single in-service chip has
+    no NVML-like "create compute instance" syscall — sub-chip sharing on
+    TPU is runtime multiplexing (runtime/slice_server.py), and MULTI-chip
+    carving is a provisioning-plane operation (tpulib/cloud.py drives the
+    queued-resources surface). So slice bookkeeping here reuses the
+    canonical state machine (overlap/bounds/in-use guards) seeded with the
+    REAL discovered topology; docs/tpulib.md states the real-vs-modeled
+    boundary.
+
+The agent composes this with the node-label topology as a cross-check:
+labels are operator intent, the device runtime is ground truth, and a
+mismatch is surfaced loudly (`verify_topology`) — on which the agent
+declines the local backend rather than actuate a geometry the control
+plane didn't plan for.
+
+**Chip-ownership contract.** libtpu grants the chips to ONE process at a
+time, so this backend activates only on the operator's EXPLICIT grant:
+the `NOS_TPU_LOCAL_CHIPS=1` environment variable, which the chart's
+`tpuAgent.localChips` value sets together with the `google.com/tpu`
+resource request. Mere visibility never activates it — even probing
+initializes the single-process runtime, which on a shared TPU VM would
+seize the chips out from under colocated workloads. Do not grant chips
+to both the agent and workload pods on the same node — the second
+process to initialize fails with the runtime's "device already in use"
+error. The intended colocations are (a) health/discovery daemonsets on
+nodes whose workloads run elsewhere, and (b) this framework's own
+fractional-sharing runtime, where workloads share the agent process's
+runtime through `runtime/slice_server.py` rather than opening the chips
+themselves.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from nos_tpu.tpu import Topology
+from nos_tpu.tpu.shape import Shape
+from nos_tpu.tpulib.fake import FakeTpuClient
+from nos_tpu.tpulib.interface import TpuLibError
+
+# device_kind prefix -> generation (topology.py _ACCELERATOR_GENERATIONS is
+# keyed by GKE label values; this table is keyed by what the PJRT runtime
+# reports). Longest prefix wins so "TPU v5 lite" resolves before "TPU v5".
+_DEVICE_KIND_GENERATIONS: Tuple[Tuple[str, str], ...] = (
+    ("TPU v5 lite", "v5e"),
+    ("TPU v5e", "v5e"),
+    ("TPU v6 lite", "v6e"),
+    ("TPU v6e", "v6e"),
+    ("TPU v5p", "v5p"),
+    ("TPU v5", "v5p"),
+    ("TPU v4", "v4"),
+)
+
+
+def generation_for_device_kind(kind: str) -> Optional[str]:
+    for prefix, gen in _DEVICE_KIND_GENERATIONS:
+        if kind.startswith(prefix):
+            return gen
+    return None
+
+
+def _local_tpu_devices():
+    try:
+        # jax is an optional extra for control-plane-only installs, so the
+        # import itself is part of the probe.
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as e:  # noqa: BLE001 — backend init failure = no TPU
+        raise TpuLibError(f"device runtime unavailable: {e}") from e
+    tpus = [d for d in devices if d.platform == "tpu"]
+    if not tpus:
+        raise TpuLibError(
+            f"no local TPU devices (platforms: "
+            f"{sorted({d.platform for d in devices})})"
+        )
+    return tpus
+
+
+def discover_local_topology() -> Topology:
+    """Topology of THIS host's chips, from the device runtime.
+
+    Generation comes from `device_kind`; the mesh shape is the bounding box
+    of the local chips' coordinates (2D generations report coords (x, y, 0),
+    3D generations use all three axes). A lone chip is a 1x1 (or 1x1x1)
+    mesh — the fractional-sharing host shape."""
+    return _discover(_local_tpu_devices())
+
+
+def _discover(tpus) -> Topology:
+    kinds = sorted({d.device_kind for d in tpus})
+    if len(kinds) != 1:
+        raise TpuLibError(f"mixed device kinds on one host: {kinds}")
+    gen = generation_for_device_kind(kinds[0])
+    if gen is None:
+        raise TpuLibError(f"unknown TPU device kind {kinds[0]!r}")
+    coords = []
+    for d in tpus:
+        c = getattr(d, "coords", None)
+        if c is None:
+            raise TpuLibError(f"device {d} exposes no chip coordinates")
+        coords.append(tuple(int(v) for v in c))
+    rank = 3 if gen in ("v4", "v5p") else 2
+    lo = [min(c[i] for c in coords) for i in range(rank)]
+    hi = [max(c[i] for c in coords) for i in range(rank)]
+    dims = tuple(h - l + 1 for l, h in zip(lo, hi))
+    topo = Topology(gen, Shape(dims))
+    if topo.chips != len(tpus):
+        # A holey enumeration (dead chip inside the bounding box) must not
+        # be reported as a full mesh — the agent would plan slices over a
+        # chip that does not exist and health() would never probe it.
+        raise TpuLibError(
+            f"incomplete chip enumeration: bounding box {topo.shape.name} "
+            f"implies {topo.chips} chips but the runtime reports {len(tpus)}"
+        )
+    return topo
+
+
+class LocalChipClient(FakeTpuClient):
+    """TpuClient over the host's real chips.
+
+    Inherits the canonical slice state machine (overlap, bounds, in-use,
+    crash-recovery cleanup — the part with no hardware syscall on TPU) and
+    replaces its two hardware-facing surfaces with the real thing:
+    construction discovers the topology from the device runtime, and
+    `health()` probes every chip with a live computation."""
+
+    def __init__(self, expected: Optional[Topology] = None):
+        # ONE enumeration feeds both the topology and the probe list — a
+        # second call could see a chip drop out in between, leaving a
+        # state machine sized for N chips but a health probe covering N-1.
+        devices = _local_tpu_devices()
+        topology = _discover(devices)
+        self.topology_mismatch: Optional[str] = None
+        if expected is not None:
+            self.topology_mismatch = verify_topology(topology, expected)
+            if self.topology_mismatch is None:
+                # Same physical mesh, possibly transposed in the runtime's
+                # coordinate order: seed the slice state machine with the
+                # LABEL orientation — plans, annotations, and packer output
+                # are all written in control-plane (label) coordinates.
+                topology = expected
+        super().__init__(topology)
+        self._devices = devices
+
+    #: Per-chip probe deadline. TPU runtime failures often manifest as
+    #: HANGS, not exceptions — without a watchdog a wedged chip would
+    #: stall the health monitor thread forever with the node still
+    #: labeled healthy (the worst possible failure mode for a health
+    #: probe). The probe thread is daemonic: if it never returns, it is
+    #: abandoned, and the chip is reported unhealthy.
+    probe_timeout_s: float = 30.0
+
+    def health(self) -> Optional[str]:
+        """None when every local chip completes a probe computation within
+        the deadline, else the first failure, formatted as
+        'chip <coords>: <reason>'."""
+        for d in self._devices:
+            reason = _probe_chip(d, self.probe_timeout_s)
+            if reason is not None:
+                coords = getattr(d, "coords", None)
+                ident = tuple(coords) if coords is not None else f"id={d.id}"
+                return f"chip {ident}: {reason}"
+        return None
+
+
+def _probe_chip(device, timeout_s: float) -> Optional[str]:
+    """One chip's live probe under a watchdog: None when a one-element
+    computation completes correctly within `timeout_s`, else the reason."""
+    import threading
+
+    result: list = []
+
+    def run() -> None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            x = jax.device_put(jnp.ones((), jnp.float32), device)
+            val = float(jax.block_until_ready(x + x))
+            result.append(None if val == 2.0 else f"probe returned {val}")
+        except Exception as e:  # noqa: BLE001 — the reason IS the result
+            result.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return f"probe timed out after {timeout_s:.0f}s"
+    return result[0] if result else "probe thread died without a result"
+
+
+def verify_topology(discovered: Topology, expected: Topology) -> Optional[str]:
+    """Cross-check device truth against operator intent (node labels).
+
+    Agreement is up to axis permutation: the runtime may enumerate a 2x4
+    mesh as coords spanning 4x2 — same chips, same links, transposed
+    order — so orientation differences corroborate (the caller then keeps
+    the LABEL orientation, the control plane's coordinate convention).
+
+    Returns None on agreement, else a human-readable mismatch description.
+    Policy is the caller's: the agent builder declines to actuate on a
+    geometry the control plane didn't plan for (it falls back to the
+    label-shaped modeled backend and logs this), because the planner,
+    annotations, and scheduler all derive from the labels."""
+    if discovered.generation == expected.generation and any(
+        o == expected.shape for o in discovered.shape.orientations()
+    ):
+        return None
+    return (
+        f"device runtime reports {discovered} but node labels declare "
+        f"{expected}"
+    )
+
+
+def local_chips_visible() -> bool:
+    """True when this host's JAX runtime can see TPU chips. Never raises.
+
+    NB: answering the question initializes the (single-process) TPU
+    runtime — call only where the process is entitled to the chips. The
+    agent builder therefore gates on the NOS_TPU_LOCAL_CHIPS grant BEFORE
+    any enumeration; this helper is for code already past that gate."""
+    try:
+        _local_tpu_devices()
+        return True
+    except TpuLibError:
+        return False

@@ -1,0 +1,383 @@
+"""LocalChipClient: real-silicon discovery + health behind the TpuClient seam.
+
+CI (CPU) exercises the discovery math, the backend-selection ladder, and the
+health-probe error paths against stubbed device enumerations; the final class
+runs gated on a real chip (`make test-tpu`), where discovery, the probe, and
+the slice lifecycle execute against actual hardware — the NVML-client-analog
+surface the reference tests the same split way (mocks in CI, silicon in e2e;
+pkg/gpu/nvml/client.go:148-223)."""
+
+import jax
+import pytest
+
+from nos_tpu.tpu import Topology
+from nos_tpu.tpulib import TpuLibError
+from nos_tpu.tpulib import local as local_mod
+from nos_tpu.tpulib.local import (
+    LocalChipClient,
+    discover_local_topology,
+    generation_for_device_kind,
+    local_chips_visible,
+    verify_topology,
+)
+
+
+class StubDevice:
+    platform = "tpu"
+
+    def __init__(self, kind, coords):
+        self.device_kind = kind
+        self.coords = coords
+
+
+def stub_devices(monkeypatch, devices):
+    monkeypatch.setattr(local_mod, "_local_tpu_devices", lambda: list(devices))
+
+
+# -- device-kind table ------------------------------------------------------
+
+
+def test_generation_mapping_longest_prefix_wins():
+    assert generation_for_device_kind("TPU v5 lite") == "v5e"
+    assert generation_for_device_kind("TPU v5e") == "v5e"
+    assert generation_for_device_kind("TPU v5p") == "v5p"
+    # Bare "TPU v5" must NOT be swallowed by the v5e prefixes.
+    assert generation_for_device_kind("TPU v5") == "v5p"
+    assert generation_for_device_kind("TPU v4") == "v4"
+    assert generation_for_device_kind("TPU v6 lite") == "v6e"
+    assert generation_for_device_kind("TPU v2") is None
+    assert generation_for_device_kind("H100") is None
+
+
+# -- topology discovery -----------------------------------------------------
+
+
+def test_discover_2d_mesh_from_coords(monkeypatch):
+    stub_devices(
+        monkeypatch,
+        [
+            StubDevice("TPU v5 lite", [x, y, 0])
+            for x in range(2)
+            for y in range(4)
+        ],
+    )
+    topo = discover_local_topology()
+    assert topo == Topology.parse("v5e", "2x4")
+
+
+def test_discover_3d_mesh_for_cuboid_generations(monkeypatch):
+    stub_devices(
+        monkeypatch,
+        [
+            StubDevice("TPU v4", [x, y, z])
+            for x in range(2)
+            for y in range(2)
+            for z in range(2)
+        ],
+    )
+    assert discover_local_topology() == Topology.parse("v4", "2x2x2")
+
+
+def test_discover_single_chip_is_1x1(monkeypatch):
+    stub_devices(monkeypatch, [StubDevice("TPU v5 lite", [0, 0, 0])])
+    assert discover_local_topology() == Topology.parse("v5e", "1x1")
+
+
+def test_discover_rejects_mixed_kinds(monkeypatch):
+    stub_devices(
+        monkeypatch,
+        [StubDevice("TPU v4", [0, 0, 0]), StubDevice("TPU v5 lite", [1, 0, 0])],
+    )
+    with pytest.raises(TpuLibError, match="mixed device kinds"):
+        discover_local_topology()
+
+
+def test_discover_rejects_unknown_kind(monkeypatch):
+    stub_devices(monkeypatch, [StubDevice("TPU v2", [0, 0, 0])])
+    with pytest.raises(TpuLibError, match="unknown TPU device kind"):
+        discover_local_topology()
+
+
+def test_discover_requires_coords(monkeypatch):
+    d = StubDevice("TPU v5 lite", None)
+    d.coords = None
+    stub_devices(monkeypatch, [d])
+    with pytest.raises(TpuLibError, match="no chip coordinates"):
+        discover_local_topology()
+
+
+@pytest.mark.skipif(
+    jax.default_backend() == "tpu", reason="needs the chip-less CPU backend"
+)
+def test_no_tpu_devices_raises_and_visibility_is_false():
+    # CI runs on the CPU backend: enumeration itself is the real call here.
+    with pytest.raises(TpuLibError, match="no local TPU devices"):
+        local_mod._local_tpu_devices()
+    assert local_chips_visible() is False
+
+
+def test_discover_rejects_holey_enumeration(monkeypatch):
+    """A dead chip inside the bounding box must fail discovery loudly, not
+    report a full mesh the agent would then plan nonexistent slices on."""
+    devices = [
+        StubDevice("TPU v5 lite", [x, y, 0]) for x in range(2) for y in range(2)
+    ]
+    del devices[1]  # interior/edge chip missing from the enumeration
+    stub_devices(monkeypatch, devices)
+    with pytest.raises(TpuLibError, match="incomplete chip enumeration"):
+        discover_local_topology()
+
+
+# -- topology cross-check ---------------------------------------------------
+
+
+def test_verify_topology_agreement_and_mismatch():
+    v5e_2x2 = Topology.parse("v5e", "2x2")
+    assert verify_topology(v5e_2x2, Topology.parse("v5e", "2x2")) is None
+    msg = verify_topology(v5e_2x2, Topology.parse("v5e", "4x4"))
+    assert "device runtime reports v5e-2x2" in msg
+    assert "labels declare v5e-4x4" in msg
+
+
+def test_verify_topology_transposed_enumeration_corroborates():
+    """The runtime may enumerate a 2x4 mesh with coords spanning 4x2 —
+    same chips, transposed order. That must corroborate, not decline; a
+    genuinely different mesh must not."""
+    assert (
+        verify_topology(Topology.parse("v5e", "4x2"), Topology.parse("v5e", "2x4"))
+        is None
+    )
+    assert (
+        verify_topology(Topology.parse("v5e", "4x2"), Topology.parse("v5e", "2x8"))
+        is not None
+    )
+    # Generation is part of identity even at equal shape.
+    assert (
+        verify_topology(Topology.parse("v5e", "2x2"), Topology.parse("v6e", "2x2"))
+        is not None
+    )
+
+
+def test_client_adopts_label_orientation_for_transposed_mesh(monkeypatch):
+    """Orientation-equivalent discovery seeds the slice state machine with
+    the LABEL orientation — plans/annotations are written in control-plane
+    coordinates, so a (0,3)-origin 1x1 carve must be in-bounds on a node
+    labeled 2x4 even when the runtime enumerated it 4x2."""
+    stub_devices(
+        monkeypatch,
+        [StubDevice("TPU v5 lite", [x, y, 0]) for x in range(4) for y in range(2)],
+    )
+    expected = Topology.parse("v5e", "2x4")
+    client = LocalChipClient(expected=expected)
+    assert client.topology_mismatch is None
+    assert client.get_topology() == expected
+    profile = expected.allowed_profiles[0]
+    client.create_slice(profile, (0, 3), (1, 1))  # label-space corner
+    with pytest.raises(TpuLibError, match="out of mesh bounds"):
+        client.create_slice(profile, (3, 0), (1, 1))  # runtime-space corner
+
+
+# -- client over stubbed silicon -------------------------------------------
+
+
+def make_client(monkeypatch, shape="2x2", expected=None):
+    dims = [int(p) for p in shape.split("x")]
+    stub_devices(
+        monkeypatch,
+        [
+            StubDevice("TPU v5 lite", [x, y, 0])
+            for x in range(dims[0])
+            for y in range(dims[1])
+        ],
+    )
+    return LocalChipClient(expected=expected)
+
+
+def test_client_slice_lifecycle_on_discovered_topology(monkeypatch):
+    client = make_client(monkeypatch, "2x2")
+    topo = client.get_topology()
+    profile = topo.allowed_profiles[0]  # 1x1
+    handle = client.create_slice(profile, (0, 0), (1, 1))
+    assert [s.slice_id for s in client.list_slices()] == [handle.slice_id]
+    # Out-of-mesh carve is refused against the DISCOVERED bounds.
+    with pytest.raises(TpuLibError, match="out of mesh bounds"):
+        client.create_slice(profile, (3, 3), (1, 1))
+    client.delete_slice(handle.slice_id)
+    assert client.list_slices() == []
+
+
+def test_client_topology_mismatch_is_surfaced_not_fatal(monkeypatch):
+    client = make_client(
+        monkeypatch, "2x2", expected=Topology.parse("v5e", "8x8")
+    )
+    assert client.topology_mismatch is not None
+    assert "8x8" in client.topology_mismatch
+    # Device truth wins.
+    assert client.get_topology() == Topology.parse("v5e", "2x2")
+
+
+def test_health_probe_success_and_failure_paths(monkeypatch):
+    client = make_client(monkeypatch, "1x1")
+    # Success: probe against a real (CPU) device — device_put + add complete.
+    client._devices = [jax.devices()[0]]
+    assert client.health() is None
+
+    class BrokenDevice:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+        coords = (0, 0, 0)
+
+    # Failure: the runtime rejects the transfer; the reason is surfaced.
+    client._devices = [BrokenDevice()]
+    reason = client.health()
+    assert reason is not None and reason.startswith("chip (0, 0, 0):")
+
+
+def test_health_probe_watchdog_catches_hangs(monkeypatch):
+    """TPU runtime failures often HANG rather than raise; a probe without
+    a deadline would stall the health monitor forever with the node still
+    labeled healthy. The watchdog must convert the hang into an unhealthy
+    report."""
+    import time
+
+    client = make_client(monkeypatch, "1x1")
+    client._devices = [jax.devices()[0]]
+    client.probe_timeout_s = 0.2
+
+    def wedged_device_put(x, device=None, **kw):
+        time.sleep(10.0)
+        return x
+
+    monkeypatch.setattr(jax, "device_put", wedged_device_put)
+    reason = client.health()
+    assert reason is not None and "timed out" in reason
+
+
+def test_grant_gate_rejects_conventional_disable_values(monkeypatch):
+    """NOS_TPU_LOCAL_CHIPS=0 / 'false' must NOT count as a grant — a
+    truthiness check would read the conventional disable as an opt-in and
+    seize the chips."""
+    from nos_tpu.config import AgentConfig
+    from nos_tpu.system import build_tpu_agent
+
+    def explode():
+        raise AssertionError("enumerated devices despite a disable value")
+
+    monkeypatch.setattr(local_mod, "_local_tpu_devices", explode)
+    for value in ("0", "false", "no", "off", ""):
+        cluster = make_cluster_with_node()
+        monkeypatch.setenv("NOS_TPU_LOCAL_CHIPS", value)
+        agent = build_tpu_agent(cluster, "node-a", AgentConfig())
+        assert not isinstance(agent.client, LocalChipClient), value
+
+
+# -- backend-selection ladder ----------------------------------------------
+
+
+def make_cluster_with_node(name="node-a", topo="8x8"):
+    from nos_tpu.cluster import Cluster
+    from tests.test_operations import tpu_node
+
+    cluster = Cluster()
+    cluster.create(tpu_node(name, topo))
+    return cluster
+
+
+def test_agent_builder_prefers_local_chips_when_granted(monkeypatch):
+    from nos_tpu.config import AgentConfig
+    from nos_tpu.system import build_tpu_agent
+
+    cluster = make_cluster_with_node()
+    monkeypatch.setenv("NOS_TPU_LOCAL_CHIPS", "1")
+    stub_devices(
+        monkeypatch,
+        [StubDevice("TPU v5 lite", [x, y, 0]) for x in range(8) for y in range(8)],
+    )
+    agent = build_tpu_agent(cluster, "node-a", AgentConfig())
+    assert isinstance(agent.client, LocalChipClient)
+    assert agent.client.get_topology() == Topology.parse("v5e", "8x8")
+    assert agent.client.topology_mismatch is None
+
+
+def test_agent_builder_declines_local_on_topology_mismatch(monkeypatch):
+    """Device truth contradicting the labels must NOT put the agent on the
+    local backend: the planner/annotations/scheduler all derive from the
+    label geometry, so the builder falls back to the label-shaped modeled
+    backend (and logs the conflict)."""
+    from nos_tpu.config import AgentConfig
+    from nos_tpu.system import build_tpu_agent
+
+    cluster = make_cluster_with_node(topo="8x8")
+    monkeypatch.setenv("NOS_TPU_LOCAL_CHIPS", "1")
+    stub_devices(monkeypatch, [StubDevice("TPU v5 lite", [0, 0, 0])])
+    agent = build_tpu_agent(cluster, "node-a", AgentConfig())
+    assert not isinstance(agent.client, LocalChipClient)
+    assert agent.client.get_topology() == Topology.parse("v5e", "8x8")
+
+
+def test_agent_builder_survives_undiscoverable_chips(monkeypatch):
+    """Granted, visible TPUs whose topology cannot be discovered (unmapped
+    future device kind) must fall through the ladder, not crash startup."""
+    from nos_tpu.config import AgentConfig
+    from nos_tpu.system import build_tpu_agent
+
+    cluster = make_cluster_with_node(topo="8x8")
+    monkeypatch.setenv("NOS_TPU_LOCAL_CHIPS", "1")
+    stub_devices(monkeypatch, [StubDevice("TPU v9 hyper", [0, 0, 0])])
+    agent = build_tpu_agent(cluster, "node-a", AgentConfig())
+    assert not isinstance(agent.client, LocalChipClient)
+    assert agent.client.get_topology() == Topology.parse("v5e", "8x8")
+
+
+def test_agent_builder_never_probes_without_explicit_grant(monkeypatch):
+    """Chip OWNERSHIP is explicit (NOS_TPU_LOCAL_CHIPS), never inferred
+    from visibility: libtpu is single-process, so an ungated probe on a
+    shared TPU VM would seize the chips out from under colocated
+    workloads. Without the env grant the builder must not even enumerate
+    devices — asserted by stubbing enumeration to explode. Holds on every
+    backend (CPU CI and `make test-tpu` alike)."""
+    from nos_tpu.config import AgentConfig
+    from nos_tpu.system import build_tpu_agent
+
+    cluster = make_cluster_with_node()
+    monkeypatch.delenv("NOS_TPU_LOCAL_CHIPS", raising=False)
+
+    def explode():
+        raise AssertionError("enumerated devices without the explicit grant")
+
+    monkeypatch.setattr(local_mod, "_local_tpu_devices", explode)
+    agent = build_tpu_agent(cluster, "node-a", AgentConfig())
+    assert not isinstance(agent.client, LocalChipClient)
+    assert agent.client.get_topology() == Topology.parse("v5e", "8x8")
+
+
+# -- real silicon (make test-tpu) ------------------------------------------
+
+on_tpu = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="real-TPU gate; CPU CI uses stubs"
+)
+
+
+@on_tpu
+def test_real_chip_discovery_and_health():
+    topo = discover_local_topology()
+    assert topo.generation in ("v4", "v5e", "v5p", "v6e")
+    assert topo.chips == len([d for d in jax.local_devices() if d.platform == "tpu"])
+    client = LocalChipClient()
+    assert client.health() is None
+
+
+@on_tpu
+def test_real_chip_slice_lifecycle():
+    client = LocalChipClient()
+    topo = client.get_topology()
+    profile = topo.allowed_profiles[0]
+    origin = (0,) * topo.shape.rank
+    dims = profile.shape.dims
+    handle = client.create_slice(profile, origin, dims)
+    client.set_slice_in_use(handle.slice_id, True)
+    with pytest.raises(TpuLibError, match="in use"):
+        client.delete_slice(handle.slice_id)
+    client.set_slice_in_use(handle.slice_id, False)
+    client.delete_slice(handle.slice_id)
+    assert client.list_slices() == []
