@@ -111,12 +111,24 @@ def _discover(tpus) -> Topology:
     if gen is None:
         raise TpuLibError(f"unknown TPU device kind {kinds[0]!r}")
     coords = []
+    rank = 3 if gen in ("v4", "v5p") else 2
     for d in tpus:
         c = getattr(d, "coords", None)
         if c is None:
             raise TpuLibError(f"device {d} exposes no chip coordinates")
-        coords.append(tuple(int(v) for v in c))
-    rank = 3 if gen in ("v4", "v5p") else 2
+        try:
+            parsed = tuple(int(v) for v in c)
+        except (TypeError, ValueError) as e:
+            raise TpuLibError(f"malformed chip coordinates {c!r}: {e}") from e
+        if len(parsed) < rank:
+            # Must be TpuLibError, not a bare IndexError downstream: the
+            # agent builder's fall-through contract catches only the
+            # typed device-layer error.
+            raise TpuLibError(
+                f"device coordinates {parsed} shorter than the "
+                f"{gen} mesh rank {rank}"
+            )
+        coords.append(parsed)
     lo = [min(c[i] for c in coords) for i in range(rank)]
     hi = [max(c[i] for c in coords) for i in range(rank)]
     dims = tuple(h - l + 1 for l, h in zip(lo, hi))
@@ -158,6 +170,11 @@ class LocalChipClient(FakeTpuClient):
                 topology = expected
         super().__init__(topology)
         self._devices = devices
+        # device -> timeout reason, sticky. A wedged libtpu call never
+        # unwedges without a process restart, and re-probing it would leak
+        # one abandoned watchdog thread per poll (10s cadence = thousands
+        # of pinned stacks per day on a long-lived agent).
+        self._wedged: dict = {}
 
     #: Per-chip probe deadline. TPU runtime failures often manifest as
     #: HANGS, not exceptions — without a watchdog a wedged chip would
@@ -170,9 +187,16 @@ class LocalChipClient(FakeTpuClient):
     def health(self) -> Optional[str]:
         """None when every local chip completes a probe computation within
         the deadline, else the first failure, formatted as
-        'chip <coords>: <reason>'."""
+        'chip <coords>: <reason>'. A chip that timed out is remembered as
+        wedged and never re-probed (its watchdog thread is already
+        abandoned; only a process restart can recover the runtime)."""
         for d in self._devices:
-            reason = _probe_chip(d, self.probe_timeout_s)
+            key = id(d)
+            reason = self._wedged.get(key)
+            if reason is None:
+                reason = _probe_chip(d, self.probe_timeout_s)
+                if reason is not None and "timed out" in reason:
+                    self._wedged[key] = reason
             if reason is not None:
                 coords = getattr(d, "coords", None)
                 ident = tuple(coords) if coords is not None else f"id={d.id}"

@@ -1,0 +1,99 @@
+"""ChurnLedger unit tests: the eviction-bound math every checkpoint-aware
+preemption path (partitioner fallback, scheduler reservation drain) leans
+on. The controller-level tests prove evictions LAND in the ledger; these
+prove the ledger's own arithmetic — cooldown vs budget interaction, the
+sliding window, lazy pruning on the read path, and the 4096-entry in-place
+prune that must not detach callers' aliases."""
+
+from nos_tpu.util.churn import ChurnLedger
+
+
+def make(cooldown=10.0, budget=3, window=100.0):
+    return ChurnLedger(cooldown, budget, window)
+
+
+def test_unknown_key_is_immediately_eligible():
+    ledger = make()
+    assert ledger.eligible_at("w", now=50.0) == 50.0
+
+
+def test_cooldown_applies_after_one_eviction():
+    """Contract: a return <= now means eligible now (the value may be a
+    past time); > now is the earliest future eligibility."""
+    ledger = make(cooldown=10.0)
+    ledger.note("w", 100.0)
+    assert ledger.eligible_at("w", 101.0) == 110.0  # blocked until 110
+    assert ledger.eligible_at("w", 115.0) <= 115.0  # cooldown passed
+
+
+def test_budget_blocks_until_oldest_ages_out_of_window():
+    """After `budget` evictions inside one window, the next eligibility is
+    when the oldest of the last `budget` leaves the window — not merely
+    after the cooldown."""
+    ledger = make(cooldown=10.0, budget=3, window=100.0)
+    for t in (100.0, 120.0, 140.0):
+        ledger.note("w", t)
+    # Cooldown alone would say 150; the budget pushes it to 100+window=200.
+    assert ledger.eligible_at("w", 141.0) == 200.0
+    # At 201 the 100.0 eviction has aged out: two remain in-window, so
+    # only the cooldown (already passed) applies — eligible now.
+    assert ledger.eligible_at("w", 201.0) <= 201.0
+
+
+def test_budget_window_slides_per_eviction():
+    ledger = make(cooldown=0.0, budget=2, window=100.0)
+    ledger.note("w", 0.0)
+    ledger.note("w", 90.0)
+    # Budget hit: eligible when the 0.0 entry leaves the window.
+    assert ledger.eligible_at("w", 95.0) == 100.0
+    ledger.note("w", 100.0)
+    # Last two are 90 and 100: eligible at 90+window.
+    assert ledger.eligible_at("w", 101.0) == 190.0
+
+
+def test_read_path_prunes_lazily_without_writing():
+    """eligible_at must ignore fully-aged-out history even though only
+    note() rewrites it — a quiet workload must not stay blocked by stale
+    entries."""
+    ledger = make(cooldown=10.0, budget=1, window=100.0)
+    ledger.note("w", 0.0)
+    # Entry aged out: eligible now, and the stale history is still stored
+    # (reads do not mutate).
+    assert ledger.eligible_at("w", 500.0) == 500.0
+    assert ledger.history["w"] == [0.0]
+
+
+def test_keys_are_independent():
+    ledger = make(cooldown=50.0)
+    ledger.note("a", 100.0)
+    assert ledger.eligible_at("b", 101.0) == 101.0
+
+
+def test_bulk_prune_is_in_place_preserving_aliases():
+    """Past 4096 tracked workloads, fully-aged-out entries are dropped IN
+    PLACE: callers holding an alias to .history (the partitioner's
+    `_ckpt_evictions` escape hatch) must observe the prune, not a detached
+    dict."""
+    ledger = make(cooldown=1.0, budget=3, window=100.0)
+    alias = ledger.history
+    for i in range(4200):
+        ledger.note(f"old-{i}", float(i) * 0.001)  # all inside t~[0, 4.2]
+    assert len(alias) == 4200  # no prune yet: nothing aged out
+    # One write far in the future triggers the prune; every old-* entry has
+    # aged out of the window.
+    ledger.note("fresh", 10_000.0)
+    assert alias is ledger.history
+    assert "fresh" in alias
+    assert len(alias) == 1, "aged-out workloads must be dropped"
+    # And pruned entries are again immediately eligible.
+    assert ledger.eligible_at("old-17", 10_001.0) == 10_001.0
+
+
+def test_prune_keeps_live_entries():
+    ledger = make(cooldown=1.0, budget=3, window=1000.0)
+    for i in range(4200):
+        ledger.note(f"w-{i}", 100.0)
+    ledger.note("trigger", 200.0)  # inside the window: nothing ages out
+    assert len(ledger.history) == 4201
+    # The live entries still enforce their cooldowns.
+    assert ledger.eligible_at("w-7", 100.5) == 101.0

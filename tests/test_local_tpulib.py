@@ -106,6 +106,18 @@ def test_discover_requires_coords(monkeypatch):
         discover_local_topology()
 
 
+def test_discover_malformed_coords_raise_the_typed_error(monkeypatch):
+    """Short or non-numeric coords must surface as TpuLibError — the agent
+    builder's fall-through contract catches ONLY the typed device-layer
+    error, so a bare IndexError/ValueError would crash startup."""
+    stub_devices(monkeypatch, [StubDevice("TPU v4", [0, 0])])  # 3D gen, 2 coords
+    with pytest.raises(TpuLibError, match="shorter than the v4 mesh rank"):
+        discover_local_topology()
+    stub_devices(monkeypatch, [StubDevice("TPU v5 lite", ["x", "y", 0])])
+    with pytest.raises(TpuLibError, match="malformed chip coordinates"):
+        discover_local_topology()
+
+
 @pytest.mark.skipif(
     jax.default_backend() == "tpu", reason="needs the chip-less CPU backend"
 )
@@ -251,6 +263,16 @@ def test_health_probe_watchdog_catches_hangs(monkeypatch):
     monkeypatch.setattr(jax, "device_put", wedged_device_put)
     reason = client.health()
     assert reason is not None and "timed out" in reason
+    # The wedged verdict is sticky: re-polling must NOT spawn another
+    # watchdog thread per cycle (a 10s-cadence monitor would leak
+    # thousands of pinned stacks per day against a hung chip).
+    import threading
+
+    before = threading.active_count()
+    for _ in range(5):
+        again = client.health()
+        assert again is not None and "timed out" in again
+    assert threading.active_count() == before
 
 
 def test_grant_gate_rejects_conventional_disable_values(monkeypatch):
@@ -349,6 +371,45 @@ def test_agent_builder_never_probes_without_explicit_grant(monkeypatch):
     agent = build_tpu_agent(cluster, "node-a", AgentConfig())
     assert not isinstance(agent.client, LocalChipClient)
     assert agent.client.get_topology() == Topology.parse("v5e", "8x8")
+
+
+def test_local_client_drives_tpu_agent_e2e(monkeypatch):
+    """The node agent runs unchanged over the real-silicon client — the
+    same spec-plan scenario the fake and native backends are held to
+    (cgo-vs-mock parity of the reference). Enumeration is stubbed at 4x4
+    here; the same loop ran against the bench chip's real 1x1 in the
+    round's verification."""
+    from nos_tpu import constants
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.controllers.tpu_agent import TpuAgent
+    from tests.test_e2e_partitioning import make_tpu_node
+
+    cluster = Cluster()
+    cluster.create(make_tpu_node())
+    client = make_client(monkeypatch, "4x4")
+    agent = TpuAgent(cluster, "tpu-node-0", client)
+    agent.startup()
+
+    cluster.patch(
+        "Node",
+        "",
+        "tpu-node-0",
+        lambda n: n.metadata.annotations.update(
+            {
+                "tpu.nos/spec-dev-0-2x2": "2",
+                "tpu.nos/spec-dev-0-1x2": "1",
+                constants.ANNOTATION_SPEC_PLAN: "plan-local-1",
+            }
+        ),
+    )
+    agent.reconcile()
+    node = cluster.get("Node", "", "tpu-node-0")
+    anns = node.metadata.annotations
+    assert anns[constants.ANNOTATION_STATUS_PLAN] == "plan-local-1"
+    assert anns["tpu.nos/status-dev-0-2x2-free"] == "2"
+    assert node.status.allocatable["google.com/tpu-2x2"] == 2
+    assert node.status.allocatable["google.com/tpu-1x2"] == 1
+    assert node.status.allocatable[constants.RESOURCE_TPU] == 16 - 8 - 2
 
 
 # -- real silicon (make test-tpu) ------------------------------------------
