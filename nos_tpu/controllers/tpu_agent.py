@@ -76,6 +76,9 @@ class TpuAgent:
         self.shared = SharedState()
         self.pod_resources_lister = pod_resources_lister
         self._unsub = None
+        # (key, chip) gauge series exported last report — cleared when a
+        # chip stops reporting so /metrics never serves frozen values.
+        self._chip_gauges: set = set()
 
     # -- lifecycle ----------------------------------------------------------
     def startup(self) -> None:
@@ -257,6 +260,35 @@ class TpuAgent:
             sum(p.chips * n for p, n in used.items()),
             node=self.node_name,
         )
+        # Real-silicon backends (tpulib/local.py) expose per-chip runtime
+        # stats; export whatever the runtime reports (HBM gauges are the
+        # DCGM-exporter-style per-device telemetry of the reference's GPU
+        # world). Modeled backends have no device_stats — nothing exported.
+        device_stats = getattr(self.client, "device_stats", None)
+        if device_stats is not None:
+            live = set()
+            for entry in device_stats():
+                chip = "x".join(str(c) for c in entry.get("coords", ())) or "0"
+                for key in (
+                    "hbm_bytes_in_use",
+                    "hbm_bytes_limit",
+                    "hbm_peak_bytes_in_use",
+                ):
+                    if key in entry:
+                        metrics.set_gauge(
+                            f"nos_tpu_chip_{key}",
+                            entry[key],
+                            node=self.node_name,
+                            chip=chip,
+                        )
+                        live.add((key, chip))
+            # A chip that stopped reporting must DROP its series: a frozen
+            # last value on /metrics reads as a live measurement.
+            for key, chip in self._chip_gauges - live:
+                metrics.remove_gauge(
+                    f"nos_tpu_chip_{key}", node=self.node_name, chip=chip
+                )
+            self._chip_gauges = live
         desired_status = dict(
             ann.format_status(ann.status_from_geometry(DEVICE_INDEX, geometry, used))
         )

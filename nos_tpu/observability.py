@@ -41,6 +41,13 @@ class Metrics:
         with self._lock:
             self._gauges[self._key(name, labels)] = value
 
+    def remove_gauge(self, name: str, **labels) -> None:
+        """Drop one labeled gauge series. For per-entity gauges whose
+        entity stopped reporting: a frozen last value on /metrics is worse
+        than the series disappearing."""
+        with self._lock:
+            self._gauges.pop(self._key(name, labels), None)
+
     def observe(self, name: str, seconds: float, **labels) -> None:
         with self._lock:
             self._durations[self._key(name, labels)].append(seconds)

@@ -187,15 +187,18 @@ class LocalChipClient(FakeTpuClient):
     def health(self) -> Optional[str]:
         """None when every local chip completes a probe computation within
         the deadline, else the first failure, formatted as
-        'chip <coords>: <reason>'. A chip that timed out is remembered as
-        wedged and never re-probed (its watchdog thread is already
-        abandoned; only a process restart can recover the runtime)."""
+        'chip <coords>: <reason>'. A chip whose probe TIMED OUT (the
+        watchdog fired — distinct from a probe that returned an error) is
+        remembered as wedged and never re-probed: its watchdog thread is
+        already abandoned, and only a process restart can recover the
+        runtime. Erroring probes are retried every cycle (a tunnel blip
+        must not permanently condemn the chip)."""
         for d in self._devices:
             key = id(d)
             reason = self._wedged.get(key)
             if reason is None:
-                reason = _probe_chip(d, self.probe_timeout_s)
-                if reason is not None and "timed out" in reason:
+                reason, timed_out = _probe_chip(d, self.probe_timeout_s)
+                if timed_out:
                     self._wedged[key] = reason
             if reason is not None:
                 coords = getattr(d, "coords", None)
@@ -204,9 +207,50 @@ class LocalChipClient(FakeTpuClient):
         return None
 
 
-def _probe_chip(device, timeout_s: float) -> Optional[str]:
-    """One chip's live probe under a watchdog: None when a one-element
-    computation completes correctly within `timeout_s`, else the reason."""
+    def device_stats(self) -> List[dict]:
+        """Per-chip runtime statistics for the metrics surface: coords,
+        kind, and — where the PJRT runtime exposes allocator stats — HBM
+        bytes in use / limit. Entries omit what the runtime doesn't
+        report (e.g. memory_stats() is None over a remote-dispatch
+        tunnel); the agent exports whatever is present as gauges. The
+        same hang discipline as health(): a chip already marked wedged is
+        skipped, and the stats call itself runs under the watchdog so a
+        wedged runtime cannot block the agent's report loop."""
+        out = []
+        for d in self._devices:
+            entry: dict = {
+                "coords": tuple(getattr(d, "coords", ()) or ()),
+                "device_kind": getattr(d, "device_kind", ""),
+            }
+            if id(d) in self._wedged:
+                out.append(entry)
+                continue
+            try:
+                stats = _call_with_deadline(d.memory_stats, self.probe_timeout_s)
+            except TimeoutError as e:
+                self._wedged[id(d)] = f"memory_stats: {e}"
+                stats = None
+            except Exception:  # noqa: BLE001 — optional surface
+                stats = None
+            if stats:
+                for src, dst in (
+                    ("bytes_in_use", "hbm_bytes_in_use"),
+                    ("bytes_limit", "hbm_bytes_limit"),
+                    ("peak_bytes_in_use", "hbm_peak_bytes_in_use"),
+                ):
+                    if src in stats:
+                        entry[dst] = int(stats[src])
+            out.append(entry)
+        return out
+
+
+def _probe_chip(device, timeout_s: float) -> Tuple[Optional[str], bool]:
+    """One chip's live probe under a watchdog: (None, False) when a
+    one-element computation completes correctly within `timeout_s`, else
+    (reason, timed_out). `timed_out` is True ONLY when the watchdog fired
+    and the probe thread was abandoned — an error whose message merely
+    mentions a timeout (e.g. an RPC deadline from a tunnel blip) is a
+    completed probe and must stay retryable."""
     import threading
 
     result: list = []
@@ -226,8 +270,37 @@ def _probe_chip(device, timeout_s: float) -> Optional[str]:
     t.start()
     t.join(timeout_s)
     if t.is_alive():
-        return f"probe timed out after {timeout_s:.0f}s"
-    return result[0] if result else "probe thread died without a result"
+        return f"probe timed out after {timeout_s:.0f}s", True
+    if not result:
+        return "probe thread died without a result", False
+    return result[0], False
+
+
+def _call_with_deadline(fn, timeout_s: float):
+    """Run fn() on a watchdog thread; returns its result or raises
+    TimeoutError. The same hang discipline as the probe: a wedged libtpu
+    call must never block the caller's loop."""
+    import threading
+
+    out: list = []
+
+    def run() -> None:
+        try:
+            out.append(("ok", fn()))
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            out.append(("err", e))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(f"call exceeded {timeout_s:.0f}s")
+    if not out:
+        raise RuntimeError("watchdog thread died without a result")
+    kind, value = out[0]
+    if kind == "err":
+        raise value
+    return value
 
 
 def verify_topology(discovered: Topology, expected: Topology) -> Optional[str]:

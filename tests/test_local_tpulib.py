@@ -412,6 +412,113 @@ def test_local_client_drives_tpu_agent_e2e(monkeypatch):
     assert node.status.allocatable[constants.RESOURCE_TPU] == 16 - 8 - 2
 
 
+def test_device_stats_exports_hbm_gauges_through_agent(monkeypatch):
+    """Per-chip runtime stats flow into the metrics surface: a backend
+    exposing memory_stats yields nos_tpu_chip_hbm_* gauges labeled by
+    chip; entries without stats (tunnel-attached runtimes) export
+    nothing rather than zeros."""
+    from nos_tpu.cluster import Cluster
+    from nos_tpu.controllers.tpu_agent import TpuAgent
+    from nos_tpu.observability import metrics
+    from tests.test_e2e_partitioning import make_tpu_node
+
+    class StatsDevice(StubDevice):
+        def __init__(self, kind, coords, stats):
+            super().__init__(kind, coords)
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    stub_devices(
+        monkeypatch,
+        [
+            StatsDevice(
+                "TPU v5 lite", [0, 0, 0],
+                {"bytes_in_use": 1 << 30, "bytes_limit": 16 << 30},
+            ),
+            StatsDevice("TPU v5 lite", [1, 0, 0], None),  # tunnel: no stats
+            StatsDevice("TPU v5 lite", [2, 0, 0], None),
+            StatsDevice("TPU v5 lite", [3, 0, 0], None),
+        ],
+    )
+    client = LocalChipClient(expected=Topology.parse("v5e", "4x1"))
+    assert client.topology_mismatch is None
+
+    stats = client.device_stats()
+    assert stats[0]["hbm_bytes_in_use"] == 1 << 30
+    assert stats[0]["hbm_bytes_limit"] == 16 << 30
+    assert "hbm_bytes_in_use" not in stats[1]
+
+    cluster = Cluster()
+    cluster.create(make_tpu_node())
+    agent = TpuAgent(cluster, "tpu-node-0", client)
+    agent.startup()
+    agent.report()
+    rendered = metrics.render()
+    assert 'nos_tpu_chip_hbm_bytes_in_use{chip="0x0x0",node="tpu-node-0"}' in rendered
+    assert 'nos_tpu_chip_hbm_bytes_limit{chip="0x0x0",node="tpu-node-0"}' in rendered
+    assert 'chip="1x0x0"' not in rendered  # no stats -> no gauge
+
+    # A chip that STOPS reporting must drop its series, not freeze: a
+    # stale last value on /metrics reads as a live measurement.
+    client._devices[0]._stats = None
+    agent.report()
+    rendered = metrics.render()
+    assert "nos_tpu_chip_hbm_bytes_in_use" not in rendered
+
+
+def test_device_stats_skips_wedged_chips_and_survives_hangs(monkeypatch):
+    """The stats path carries the same hang discipline as health(): a
+    wedged memory_stats call is cut off by the watchdog (and the chip
+    remembered), and an already-wedged chip is never re-queried."""
+    import time
+
+    calls = []
+
+    class HangingDevice(StubDevice):
+        def memory_stats(self):
+            calls.append("hang")
+            time.sleep(10.0)
+            return {}
+
+    stub_devices(monkeypatch, [HangingDevice("TPU v5 lite", [0, 0, 0])])
+    client = LocalChipClient()
+    client.probe_timeout_s = 0.2
+    stats = client.device_stats()
+    assert len(stats) == 1 and "hbm_bytes_in_use" not in stats[0]
+    assert client._wedged  # remembered
+    stats = client.device_stats()  # second pass must not re-query
+    assert calls == ["hang"]
+    assert len(stats) == 1
+
+
+def test_erroring_probe_is_retried_not_condemned(monkeypatch):
+    """Only a watchdog-expired probe is sticky. A probe that RETURNS an
+    error — even one whose message says 'timed out' (an RPC deadline from
+    a tunnel blip) — must be retried next cycle and recover."""
+    client = make_client(monkeypatch, "1x1")
+    flaky = {"fail": True}
+
+    def flaky_device_put(x, device=None, **kw):
+        if flaky["fail"]:
+            raise RuntimeError("RPC timed out mid-transfer")
+        return x
+
+    real_put = jax.device_put
+    monkeypatch.setattr(
+        jax, "device_put",
+        lambda x, device=None, **kw: flaky_device_put(x, device, **kw)
+        if flaky["fail"] else real_put(x),
+    )
+    client._devices = [jax.devices()[0]]
+    reason = client.health()
+    assert reason is not None and "RPC timed out" in reason
+    assert not client._wedged  # an ERROR, not a watchdog expiry
+    flaky["fail"] = False
+    assert client.health() is None  # recovered
+
+
 # -- real silicon (make test-tpu) ------------------------------------------
 
 on_tpu = pytest.mark.skipif(
@@ -426,6 +533,19 @@ def test_real_chip_discovery_and_health():
     assert topo.chips == len([d for d in jax.local_devices() if d.platform == "tpu"])
     client = LocalChipClient()
     assert client.health() is None
+
+
+@on_tpu
+def test_real_chip_device_stats_shape():
+    """On silicon, device_stats reports one entry per chip with kind and
+    coords; HBM numbers appear only where the runtime exposes allocator
+    stats (a remote-dispatch tunnel reports none — that must not error)."""
+    client = LocalChipClient()
+    stats = client.device_stats()
+    assert len(stats) == client.get_topology().chips
+    for entry in stats:
+        assert entry["device_kind"]
+        assert isinstance(entry["coords"], tuple)
 
 
 @on_tpu

@@ -105,6 +105,105 @@ def test_pipelined_fetch_preserves_results_under_load():
         server.stop()
 
 
+def test_warmup_compiles_every_bucket_and_serves_without_recompiling():
+    compiles = []
+    base = jax.jit(lambda x: x + 1.0)
+
+    def counting_fn(x):
+        compiles.append(x.shape)  # traced once per (bucket) compilation
+        return base(x)
+
+    server = SliceServer(counting_fn, max_batch=4, buckets=(1, 2, 4))
+    server.warmup(jnp.zeros((3,)))
+    # One trace per bucket: stacked shapes (1,3) (2,3) (4,3).
+    assert sorted(s[0] for s in compiles) == [1, 2, 4]
+    server.start()
+    try:
+        compiles.clear()
+        out = server.infer(jnp.ones((3,)), timeout=10)
+        np.testing.assert_allclose(np.asarray(out), np.full(3, 2.0))
+        assert compiles == []  # served from the warmed cache
+    finally:
+        server.stop()
+
+
+def test_adaptive_wait_stays_at_floor_for_single_client():
+    """Uncontended latency must not pay the adaptive batching window: with
+    concurrency ~1 the effective wait stays at max_wait_s even after many
+    sequential requests have taught the server its cycle time."""
+    server = make_server(max_batch=8, max_wait_s=0.002, adaptive_wait=True).start()
+    try:
+        for i in range(12):
+            server.infer(jnp.full((2,), float(i)), timeout=10)
+        assert server._effective_wait_s() == pytest.approx(0.002)
+    finally:
+        server.stop()
+
+
+def test_adaptive_wait_grows_with_observed_concurrency():
+    """Once batches coalesce multiple clients, the window grows toward a
+    quarter of the measured cycle (bounded at 100ms) and never drops below
+    the configured floor. The EMAs are set directly — driving real threads
+    through a 2ms window is scheduler-timing-flaky on loaded CI runners;
+    the formula, floor, and ceiling are what this test pins."""
+    server = make_server(max_batch=8, max_wait_s=0.002, adaptive_wait=True)
+    server._concurrency_ema = 4.0
+    server._cycle_ema = 0.08
+    assert server._effective_wait_s() == pytest.approx(0.02)  # cycle/4
+    server._cycle_ema = 1.0
+    assert server._effective_wait_s() == pytest.approx(0.1)  # ceiling
+    server._cycle_ema = 0.001
+    assert server._effective_wait_s() == pytest.approx(0.002)  # floor
+    # Below the coalescing threshold the floor applies regardless of cycle.
+    server._concurrency_ema = 1.2
+    server._cycle_ema = 1.0
+    assert server._effective_wait_s() == pytest.approx(0.002)
+
+
+def test_eager_stacking_mode_matches_in_program_stacking():
+    """stack_in_program=False (the eager jnp.stack fallback) must produce
+    identical results — it is the same computation, minus the per-bucket
+    jitted stacking program."""
+    results = {}
+    for mode in (True, False):
+        server = SliceServer(
+            jax.jit(lambda x: x * 3.0), max_batch=4, stack_in_program=mode
+        ).start()
+        try:
+            futs = [server.submit(jnp.full((2,), float(i))) for i in range(4)]
+            results[mode] = [np.asarray(f.result(timeout=10)) for f in futs]
+        finally:
+            server.stop()
+    for a, b in zip(results[True], results[False]):
+        np.testing.assert_allclose(a, b)
+
+
+def test_oversized_burst_is_served_across_batches():
+    """More concurrent requests than max_batch: everything still completes,
+    split over >= ceil(n/max_batch) executions, each row correct."""
+    server = make_server(max_batch=4, max_wait_s=0.02).start()
+    try:
+        futs = [server.submit(jnp.full((2,), float(i))) for i in range(11)]
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(
+                np.asarray(f.result(timeout=10)), np.full(2, 2.0 * i + 1.0)
+            )
+        assert server.batches_run >= 3  # 11 requests over 4-wide buckets
+    finally:
+        server.stop()
+
+
+def test_stop_then_submit_leaves_future_unresolved_not_crashed():
+    """After stop(), the executor thread is gone: a late submit must not
+    raise at enqueue time (the caller's timeout surfaces it) and must not
+    wedge stop() itself."""
+    server = make_server(max_batch=2).start()
+    server.stop()
+    fut = server.submit(jnp.ones((1,)))
+    with pytest.raises(Exception):
+        fut.result(timeout=0.2)
+
+
 def test_vit_detect_compact_output():
     from nos_tpu.models.vit import ViTConfig, init_vit, vit_detect
 
