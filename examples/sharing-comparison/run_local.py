@@ -1,0 +1,148 @@
+"""TPU accelerator-sharing comparison — the reference demo, TPU-native.
+
+The reference's demo (demos/gpu-sharing-comparison: 1/3/5/7 pods sharing
+one A100 under time-slicing / MPS / MIG, average inference time of
+YOLOS-small per pod count) is reproduced here against ONE TPU chip shared
+through this framework's runtime:
+
+  - mode `shared` (the framework's answer): N closed-loop clients submit
+    to one SliceServer, which micro-batches concurrent requests into
+    single MXU executions — batching, not interleaving, is what a
+    systolic-array machine rewards.
+  - mode `sequential` (the time-slicing analog): the same N clients
+    serialize through a lock, one inference at a time — what GPU
+    time-slicing effectively does to co-located pods, minus its context
+    switches (so it flatters the baseline).
+
+Usage:
+    python examples/sharing-comparison/run_local.py                # 1,3,5,7 shared
+    python examples/sharing-comparison/run_local.py --workloads 7
+    python examples/sharing-comparison/run_local.py --mode sequential
+
+Prints one table row per workload count: mean per-request latency over
+all clients, plus the reference's published numbers for the same
+concurrency (BASELINE.md) for side-by-side reading. On-cluster manifests
+for the same experiment live next door in manifests/ (the client loop is
+this file with --workloads 1 --forever).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+# Reference's published table (demos/gpu-sharing-comparison/README.md:60-72,
+# BASELINE.md): average inference time (s) per pod count.
+REFERENCE = {
+    "time-slicing": {1: 0.0882, 3: 0.2931, 5: 0.4890, 7: 0.6849},
+    "mps": {1: 0.0880, 3: 0.1640, 5: 0.2409, 7: 0.3198},
+    "mig": {1: 0.3424, 3: 0.3413, 5: 0.3453, 7: 0.3442},
+}
+
+WARMUP_REQUESTS = 3
+MEASURE_REQUESTS = 20
+
+
+def build_server(jax, jnp, cfg, params, max_batch: int):
+    from nos_tpu.runtime.slice_server import SliceServer
+    from nos_tpu.models.vit import vit_detect
+
+    buckets = sorted({b for b in (1, 2, 4, max_batch) if b <= max_batch})
+    server = SliceServer(
+        lambda im: vit_detect(params, im, cfg),
+        max_batch=max_batch,
+        max_wait_s=0.003,
+        buckets=buckets,
+    )
+    example = jax.random.uniform(
+        jax.random.PRNGKey(0), (cfg.image_size, cfg.image_size, 3), jnp.float32
+    )
+    server.warmup(example)
+    return server.start()
+
+
+def run_point(jax, jnp, cfg, params, n: int, mode: str) -> float:
+    """Mean per-request latency (s) with n closed-loop clients."""
+    server = build_server(jax, jnp, cfg, params, max_batch=n if mode == "shared" else 1)
+    serial = threading.Lock() if mode == "sequential" else None
+    latencies: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def client(i: int) -> None:
+        try:
+            image = jax.random.uniform(
+                jax.random.PRNGKey(i), (cfg.image_size, cfg.image_size, 3), jnp.float32
+            )
+            mine = []
+            for _ in range(WARMUP_REQUESTS):
+                if serial:
+                    with serial:
+                        server.infer(image, timeout=120)
+                else:
+                    server.infer(image, timeout=120)
+            for _ in range(MEASURE_REQUESTS):
+                t0 = time.perf_counter()
+                if serial:
+                    with serial:
+                        server.infer(image, timeout=120)
+                else:
+                    server.infer(image, timeout=120)
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                latencies.extend(mine)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    if errors:
+        raise errors[0]
+    return statistics.mean(latencies)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workloads", type=int, nargs="*", default=[1, 3, 5, 7])
+    ap.add_argument("--mode", choices=("shared", "sequential"), default="shared")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from nos_tpu.models.vit import ViTConfig, init_vit
+
+    cfg = ViTConfig()  # YOLOS-small class
+    params = init_vit(jax.random.PRNGKey(0), cfg)
+    device = jax.devices()[0]
+    print(f"device: {device.device_kind or device.platform} | mode: {args.mode}")
+    print(f"{'N':>3}  {'this framework':>15}  {'ref MPS':>9}  {'ref MIG':>9}  {'ref t-slice':>11}")
+    for n in args.workloads:
+        mean_s = run_point(jax, jnp, cfg, params, n, args.mode)
+        ref = {k: v.get(n) for k, v in REFERENCE.items()}
+        fmt = lambda v: f"{v:.4f}s" if v else "-"
+        print(
+            f"{n:>3}  {mean_s:>14.4f}s  {fmt(ref['mps']):>9}  "
+            f"{fmt(ref['mig']):>9}  {fmt(ref['time-slicing']):>11}",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

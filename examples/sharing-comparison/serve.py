@@ -1,0 +1,131 @@
+"""Demo inference server: the SliceServer behind a minimal HTTP front.
+
+POST /infer {"seed": int} -> {"labels": [...], "scores": [...],
+"boxes": [...], "latency_s": float}. The client sends a seed, not pixels:
+the server generates the deterministic image on device, so the wire stays
+off the measured path (the reference demo's clients likewise generate
+their inputs in-process and measure inference only).
+
+GET /metrics serves the runtime's Prometheus surface (request counts,
+batch occupancy) for the PodMonitor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def main() -> int:
+    import jax
+
+    # Env vars alone can lose to a site-installed accelerator plugin (the
+    # same guard as __graft_entry__.py): flip the config before use.
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from nos_tpu.models.vit import ViTConfig, init_vit, vit_detect
+    from nos_tpu.observability import metrics
+    from nos_tpu.runtime.slice_server import SliceServer
+
+    cfg = ViTConfig()
+    params = init_vit(jax.random.PRNGKey(0), cfg)
+    server = SliceServer(
+        lambda im: vit_detect(params, im, cfg),
+        max_batch=int(os.environ.get("MAX_BATCH", "8")),
+        max_wait_s=0.003,
+    )
+    example = jax.random.uniform(
+        jax.random.PRNGKey(0), (cfg.image_size, cfg.image_size, 3), jnp.float32
+    )
+    server.warmup(example)
+    server.start()
+    images: dict = {}
+    images_lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok\n")
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            seed = int(json.loads(self.rfile.read(length) or b"{}").get("seed", 0))
+            with images_lock:
+                image = images.get(seed)
+                if image is None:
+                    image = jax.random.uniform(
+                        jax.random.PRNGKey(seed),
+                        (cfg.image_size, cfg.image_size, 3),
+                        jnp.float32,
+                    )
+                    images[seed] = image
+            t0 = time.perf_counter()
+            labels, scores, boxes = server.infer(image, timeout=120)
+            latency = time.perf_counter() - t0
+            metrics.inc("sharing_demo_requests")  # renders *_total
+            metrics.set_gauge("sharing_demo_last_latency_seconds", latency)
+            body = json.dumps(
+                {
+                    "labels": labels.tolist(),
+                    "scores": scores.tolist(),
+                    "boxes": boxes.tolist(),
+                    "latency_s": latency,
+                }
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+    port = int(os.environ.get("PORT", "8090"))
+    metrics_port = int(os.environ.get("METRICS_PORT", "8081"))
+
+    class MetricsHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body)
+
+    # Dedicated metrics listener on the port the PodMonitor scrapes (the
+    # same split as the control-plane binaries: serving and observability
+    # never share a port).
+    metrics_httpd = ThreadingHTTPServer(("0.0.0.0", metrics_port), MetricsHandler)
+    threading.Thread(target=metrics_httpd.serve_forever, daemon=True).start()
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    print(
+        f"sharing-server on :{port}, metrics on :{metrics_port} "
+        f"(max_batch {server.max_batch})",
+        flush=True,
+    )
+    httpd.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -267,8 +267,11 @@ class TpuAgent:
         device_stats = getattr(self.client, "device_stats", None)
         if device_stats is not None:
             live = set()
-            for entry in device_stats():
-                chip = "x".join(str(c) for c in entry.get("coords", ())) or "0"
+            for i, entry in enumerate(device_stats()):
+                # Index fallback keeps coord-less chips' series DISTINCT —
+                # collapsing them onto one label would silently overwrite
+                # every chip's gauges with the last one's.
+                chip = "x".join(str(c) for c in entry.get("coords", ())) or str(i)
                 for key in (
                     "hbm_bytes_in_use",
                     "hbm_bytes_limit",

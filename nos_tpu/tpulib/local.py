@@ -245,35 +245,27 @@ class LocalChipClient(FakeTpuClient):
 
 
 def _probe_chip(device, timeout_s: float) -> Tuple[Optional[str], bool]:
-    """One chip's live probe under a watchdog: (None, False) when a
+    """One chip's live probe under the watchdog: (None, False) when a
     one-element computation completes correctly within `timeout_s`, else
     (reason, timed_out). `timed_out` is True ONLY when the watchdog fired
     and the probe thread was abandoned — an error whose message merely
     mentions a timeout (e.g. an RPC deadline from a tunnel blip) is a
     completed probe and must stay retryable."""
-    import threading
 
-    result: list = []
+    def probe() -> Optional[str]:
+        import jax
+        import jax.numpy as jnp
 
-    def run() -> None:
-        try:
-            import jax
-            import jax.numpy as jnp
+        x = jax.device_put(jnp.ones((), jnp.float32), device)
+        val = float(jax.block_until_ready(x + x))
+        return None if val == 2.0 else f"probe returned {val}"
 
-            x = jax.device_put(jnp.ones((), jnp.float32), device)
-            val = float(jax.block_until_ready(x + x))
-            result.append(None if val == 2.0 else f"probe returned {val}")
-        except Exception as e:  # noqa: BLE001 — the reason IS the result
-            result.append(f"{type(e).__name__}: {e}")
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
+    try:
+        return _call_with_deadline(probe, timeout_s), False
+    except TimeoutError:
         return f"probe timed out after {timeout_s:.0f}s", True
-    if not result:
-        return "probe thread died without a result", False
-    return result[0], False
+    except Exception as e:  # noqa: BLE001 — the reason IS the result
+        return f"{type(e).__name__}: {e}", False
 
 
 def _call_with_deadline(fn, timeout_s: float):
