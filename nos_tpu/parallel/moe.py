@@ -1,11 +1,19 @@
 """Mixture-of-Experts with expert parallelism over the `ep` mesh axis.
 
-Token-choice top-1 routing with capacity, experts sharded one-per-rank-group
+Token-choice top-k routing with capacity, experts sharded one-per-rank-group
 over `ep`, and the canonical two-hop all_to_all: tokens are dispatched to the
 rank holding their expert, processed by the local expert FFN (a dense MXU
 matmul over the capacity buffer), and combined back — the Switch-Transformer
-construction expressed as a shard_map program so XLA lowers the exchanges to
-ICI all-to-alls.
+construction (top_k=1, raw-probability gate) and the GShard/Mixtral
+construction (top_k=2, gates renormalized over the chosen experts) expressed
+as one shard_map program so XLA lowers the exchanges to ICI all-to-alls.
+
+Capacity is assigned choice-major (every token's first choice before any
+second choice), so under pressure second choices overflow first — the
+GShard discipline. The optional auxiliary output carries the
+load-balancing loss (n_experts * sum(fraction_dispatched * mean_prob),
+Switch eq. 4), already pmean-averaged over the mesh — add it to the
+training loss as-is with a small coefficient.
 """
 
 from __future__ import annotations
@@ -35,31 +43,54 @@ def init_moe(key, hidden: int, mlp_dim: int, n_experts: int, dtype=jnp.bfloat16)
     }
 
 
-def _moe_local(params, x, axis_name: str, n_experts: int, capacity: int):
+def _moe_local(
+    params, x, axis_name: str, n_experts: int, capacity: int, top_k: int = 1
+):
     """Per-rank program. x: [tokens_local, hidden]; experts sharded on ep —
-    this rank holds n_experts/ep experts (leading axis already sliced)."""
+    this rank holds n_experts/ep experts (leading axis already sliced).
+    Returns (y, aux_loss)."""
     ep = lax.axis_size(axis_name)
     local_experts = params["w_in"].shape[0]
     t, h = x.shape
 
-    # Top-1 routing (f32 logits for a stable softmax).
+    # Routing (f32 logits for a stable softmax).
     logits = x.astype(jnp.float32) @ params["router"]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [t]
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    topk_gate, topk_idx = lax.top_k(probs, top_k)  # [t, k], [t, k]
+    if top_k > 1:
+        # GShard/Mixtral convention: renormalize over the chosen experts.
+        topk_gate = topk_gate / jnp.sum(topk_gate, axis=-1, keepdims=True)
+    # (top_k == 1 keeps the raw probability — the Switch gate.)
 
-    # Position of each token within its expert's capacity buffer.
-    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [t, E]
-    position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per token
-    slot = jnp.sum(position, axis=-1) - 1  # [t]
-    kept = slot < capacity  # overflow tokens are dropped (residual passes)
+    # Capacity assignment, choice-major: every token's c-th choice queues
+    # behind ALL (c-1)-th choices, so under pressure second choices
+    # overflow first. `counts` carries each expert's fill between rounds.
+    counts = jnp.zeros((n_experts,), jnp.int32)
+    slots, kepts = [], []
+    for c in range(top_k):
+        onehot = jax.nn.one_hot(topk_idx[:, c], n_experts, dtype=jnp.int32)
+        position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based within round
+        slot = jnp.sum(position, axis=-1) - 1 + counts[topk_idx[:, c]]
+        slots.append(slot)
+        kepts.append(slot < capacity)
+        counts = counts + jnp.sum(onehot, axis=0)
+    slot = jnp.stack(slots, axis=1)  # [t, k]
+    kept = jnp.stack(kepts, axis=1)  # [t, k]
 
-    # Dispatch buffer: [E, capacity, h].
+    # Load-balancing loss over this rank's tokens (Switch eq. 4): uses the
+    # FIRST choice's dispatch fraction against the mean router probability.
+    frac_dispatched = jnp.mean(
+        jax.nn.one_hot(topk_idx[:, 0], n_experts, dtype=jnp.float32), axis=0
+    )
+    aux_loss = n_experts * jnp.sum(frac_dispatched * jnp.mean(probs, axis=0))
+
+    # Dispatch buffer: [E, capacity, h]; a token may enter up to k buffers.
     dispatch = jnp.zeros((n_experts, capacity, h), x.dtype)
     safe_slot = jnp.clip(slot, 0, capacity - 1)
-    dispatch = dispatch.at[expert_idx, safe_slot].add(
-        jnp.where(kept[:, None], x, 0).astype(x.dtype)
-    )
+    for c in range(top_k):
+        dispatch = dispatch.at[topk_idx[:, c], safe_slot[:, c]].add(
+            jnp.where(kept[:, c][:, None], x, 0).astype(x.dtype)
+        )
 
     # all_to_all hop 1: group by destination rank.
     # [E, cap, h] -> [ep(dst), local_experts, cap, h]; exchange over ep puts a
@@ -82,10 +113,13 @@ def _moe_local(params, x, axis_name: str, n_experts: int, capacity: int):
     # [ep(owner-of-expert), local_experts, cap, h] -> [E, cap, h] locally.
     out = out.reshape(n_experts, capacity, h)
 
-    # Combine: gather each token's slot, apply gate, drop overflow.
-    y = out[expert_idx, safe_slot]  # [t, h]
-    y = jnp.where(kept[:, None], y * gate[:, None].astype(y.dtype), 0)
-    return y
+    # Combine: gather each token's k slots, apply gates, drop overflow.
+    y = jnp.zeros((t, h), x.dtype)
+    for c in range(top_k):
+        contrib = out[topk_idx[:, c], safe_slot[:, c]]  # [t, h]
+        contrib = contrib * topk_gate[:, c][:, None].astype(contrib.dtype)
+        y = y + jnp.where(kept[:, c][:, None], contrib, 0)
+    return y, aux_loss
 
 
 def moe_apply(
@@ -94,22 +128,35 @@ def moe_apply(
     mesh: Mesh,
     axis_name: str = "ep",
     capacity_factor: float = 2.0,
+    top_k: int = 1,
+    return_aux: bool = False,
 ):
     """Apply the MoE layer. x: [B, T, H] (batch may be dp-sharded); expert
-    weights sharded over `axis_name`. Returns [B, T, H]."""
+    weights sharded over `axis_name`. Returns [B, T, H], or
+    (y, aux_loss) with `return_aux` — aux_loss is the load-balancing term
+    (scalar, already psum-averaged over the mesh), to be added to the
+    training loss with a small coefficient (Switch uses 1e-2).
+
+    `top_k=1` is the Switch construction (raw-probability gate); `top_k=2`
+    is GShard/Mixtral (gates renormalized over the chosen pair). Capacity
+    scales with top_k automatically — `capacity_factor` always means
+    "headroom multiple over a perfectly balanced load", whatever k is."""
     ep = mesh.shape[axis_name]
     n_experts = params["w_in"].shape[0]
     if n_experts % ep != 0:
         raise ValueError(f"{n_experts} experts not divisible by ep={ep}")
+    if not 1 <= top_k <= n_experts:
+        raise ValueError(f"top_k={top_k} out of range for {n_experts} experts")
     b, t, h = x.shape
     if t % ep != 0:
         raise ValueError(f"sequence {t} not divisible by ep={ep}")
     dp = "dp" if "dp" in mesh.shape else None
     b_local = b // mesh.shape[dp] if dp else b
     # Tokens are distributed: batch over dp, sequence over ep — every rank
-    # routes its own tokens; capacity is per-rank.
+    # routes its own tokens; capacity is per-rank. top_k dispatches charge
+    # capacity k times, hence the k in the numerator.
     local_tokens = b_local * (t // ep)
-    capacity = max(1, int(capacity_factor * local_tokens / n_experts))
+    capacity = max(1, int(capacity_factor * top_k * local_tokens / n_experts))
 
     data_spec = P(dp, axis_name, None)
     param_specs = {
@@ -121,13 +168,19 @@ def moe_apply(
     def local(p, xx):
         bb, tt = xx.shape[0], xx.shape[1]
         flat = xx.reshape(bb * tt, h)
-        y = _moe_local(p, flat, axis_name, n_experts, capacity)
-        return y.reshape(bb, tt, h)
+        y, aux = _moe_local(p, flat, axis_name, n_experts, capacity, top_k)
+        # Mean over every rank's local aux (dp ranks route different
+        # tokens; ep ranks route different sequence shards).
+        aux = lax.pmean(aux, axis_name)
+        if dp:
+            aux = lax.pmean(aux, dp)
+        return y.reshape(bb, tt, h), aux
 
     fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, data_spec),
-        out_specs=data_spec,
+        out_specs=(data_spec, P()),
     )
-    return fn(params, x)
+    y, aux = fn(params, x)
+    return (y, aux) if return_aux else y
