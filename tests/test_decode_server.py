@@ -49,9 +49,16 @@ def test_single_request_matches_solo_decode(params):
 
 def test_concurrent_requests_are_isolated(params):
     """Different prompts and lengths in flight together: every stream must
-    match its solo decode exactly (per-slot cache isolation + per-row
-    positions)."""
-    server = DecodeServer(params, CFG, n_slots=3, max_len=64).start()
+    match the SAME request run alone through an identical engine, exactly —
+    co-tenants must never change a request's tokens (per-slot cache
+    isolation + per-row positions). The oracle is engine-solo, not the
+    scalar reference: on TPU the batch-1 scalar step tiles bf16 matmuls
+    differently from the batched macro step, and this tiny random model
+    has near-tie logits, so scalar-vs-engine argmax can legitimately flip —
+    that cross-IMPLEMENTATION equality is asserted separately on the
+    deterministic CPU backend (test_single_request_matches_solo_decode).
+    Engine-solo shares the concurrent run's compiled shapes, so any
+    difference here is true cross-request leakage."""
     prompts = [
         [1, 2, 3],
         [40, 41, 42, 43, 44, 45, 46],
@@ -60,6 +67,16 @@ def test_concurrent_requests_are_isolated(params):
         [9, 8, 7, 6, 5],
     ]
     news = [5, 7, 4, 6, 3]
+
+    solo = []
+    for prompt, n in zip(prompts, news):
+        ref_server = DecodeServer(params, CFG, n_slots=3, max_len=64).start()
+        try:
+            solo.append(ref_server.generate(prompt, max_new=n, timeout=120))
+        finally:
+            ref_server.stop()
+
+    server = DecodeServer(params, CFG, n_slots=3, max_len=64).start()
     results = [None] * len(prompts)
     try:
         def client(i):
@@ -72,8 +89,13 @@ def test_concurrent_requests_are_isolated(params):
             t.join()
     finally:
         server.stop()
-    for i, prompt in enumerate(prompts):
-        assert results[i] == solo_greedy(params, prompt, news[i]), f"stream {i}"
+    for i in range(len(prompts)):
+        assert results[i] == solo[i], f"stream {i}"
+    if jax.default_backend() != "tpu":
+        # On the deterministic CPU backend the engine also matches the
+        # scalar reference bit-for-bit (the cross-implementation bar).
+        for i, prompt in enumerate(prompts):
+            assert results[i] == solo_greedy(params, prompt, news[i]), f"stream {i}"
 
 
 def test_eos_frees_slot_early(params):
