@@ -15,3 +15,7 @@ from nos_tpu.models.decode import (  # noqa: F401
     init_cache,
     prefill,
 )
+from nos_tpu.models.speculative import (  # noqa: F401
+    find_prompt_lookup_draft,
+    speculative_generate,
+)

@@ -1,0 +1,216 @@
+"""Prompt-lookup speculative decoding: greedy-exact multi-token steps.
+
+Speculative decoding amortizes the per-step cost of autoregressive
+generation (on this rig the dispatch RTT; on a local chip the HBM weight
+read) by VERIFYING k drafted tokens in one forward pass and accepting the
+longest correct prefix. The draft here is prompt-lookup (PLD): the
+continuation after the most recent earlier occurrence of the current
+n-gram suffix — free (no draft model), and strong exactly where long
+contexts pay off (retrieval, code editing, summarization: text that
+repeats its context).
+
+Greedy exactness is structural, not statistical: a draft token is kept
+only when it EQUALS the model's argmax given every previously accepted
+token, so output matches one-token-at-a-time greedy decoding — each
+round emits between 1 (all drafts rejected: the plain decode step) and
+k+1 tokens (all accepted plus the bonus token). The one caveat every
+speculative implementation shares: "the model's argmax" is computed by a
+differently-shaped program than the single-step path, so when two logits
+are EXACTLY tied (observed on tiny random bf16 models, where quantized
+logits collide; real models' gaps dwarf cross-program ulp noise) the tie
+may break differently — equality is exact wherever argmax is decisive.
+
+The verify pass IS the chunked-prefill program (models/decode.py
+paged_prefill_chunk): a fixed-width window of tokens appended to the
+paged cache at positions pos..pos+W-1, attending over the confirmed
+prefix plus itself, causally. Rejected rows leave stale K/V beyond the
+accepted position; the next round starts there and overwrites them before
+anything attends that far, so no masking fixup is needed. Two compiled
+programs total (prompt bucket + verify window), reused every round.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.decode import init_paged_cache, paged_prefill_chunk
+from nos_tpu.models.gpt import GPTConfig
+
+
+def find_prompt_lookup_draft(
+    history: Sequence[int], ngram: int = 3, k: int = 8
+) -> List[int]:
+    """The k tokens that followed the most recent EARLIER occurrence of
+    history's final n-gram (host-side; history is a python list). Empty
+    when the suffix never occurred before or history is too short.
+
+    Reference implementation (O(n) scan). The generate loop uses the
+    incrementally-maintained `_LookupIndex`, which matches this function's
+    semantics exactly (property-tested) at O(ngram) per lookup."""
+    n = len(history)
+    if n <= ngram:
+        return []
+    suffix = tuple(history[-ngram:])
+    # Scan right-to-left over earlier positions (most recent match wins —
+    # locality: recent repetitions predict best).
+    for start in range(n - ngram - 1, -1, -1):
+        if tuple(history[start : start + ngram]) == suffix:
+            cont = history[start + ngram : start + ngram + k]
+            return list(cont)
+    return []
+
+
+class _LookupIndex:
+    """ngram-tuple -> latest start position, maintained incrementally.
+
+    The ngram ending at history's FINAL token is deliberately deferred
+    (inserted on the next extend), so a lookup never matches the suffix
+    occurrence itself — bit-for-bit the semantics of the reference scan,
+    without the per-round O(len(history)) walk that would otherwise
+    compete with the dispatch round trip on long contexts."""
+
+    def __init__(self, history: List[int], ngram: int):
+        self.history = history  # shared alias; extend() appends to it
+        self.ngram = ngram
+        self.index: Dict[tuple, int] = {}
+        self._indexed_through = 0  # ngrams ending strictly before this idx
+        self._catch_up(len(history) - 1)
+
+    def _catch_up(self, end_exclusive: int) -> None:
+        """Insert every ngram ending at positions [..end_exclusive)."""
+        h, g = self.history, self.ngram
+        for j in range(max(self._indexed_through, g - 1), end_exclusive):
+            self.index[tuple(h[j - g + 1 : j + 1])] = j - g + 1
+        self._indexed_through = max(self._indexed_through, end_exclusive)
+
+    def extend(self, tokens: Sequence[int]) -> None:
+        self.history.extend(tokens)
+        self._catch_up(len(self.history) - 1)
+
+    def draft(self, k: int) -> List[int]:
+        h, g = self.history, self.ngram
+        if len(h) <= g:
+            return []
+        start = self.index.get(tuple(h[-g:]))
+        if start is None:
+            return []
+        return list(h[start + g : start + g + k])
+
+
+def speculative_generate(
+    params,
+    cfg: GPTConfig,
+    prompt: Sequence[int],
+    max_new: int,
+    ngram: int = 3,
+    draft_k: int = 8,
+    eos_id: Optional[int] = None,
+    block_size: int = 64,
+    prompt_chunk: int = 256,
+    return_stats: bool = False,
+) -> List[int] | Tuple[List[int], Dict[str, float]]:
+    """Generate `max_new` greedy tokens after `prompt`, matching plain
+    greedy decoding (see the module caveat on exact ties), in
+    ceil(max_new / accepted-per-round) forward passes instead of max_new.
+    `draft_k` bounds the window (W = draft_k+1 query rows per verify
+    pass); `ngram` is the lookup key length. `draft_k=0` disables
+    speculation cleanly — every round is the plain single-token step
+    through the same machinery (the A/B baseline)."""
+    if max_new <= 0:
+        return ([], {"rounds": 0, "accepted_per_round": 0.0}) if return_stats else []
+    prompt = list(prompt)
+    if not prompt:
+        raise ValueError("speculative_generate needs a non-empty prompt")
+    W = draft_k + 1
+    # Capacity: prompt + generated + one full window of scratch rows, in
+    # whole blocks, plus the shared scratch page at block 0.
+    max_len = len(prompt) + max_new + W
+    max_pages = -(-max_len // block_size)
+    cache = init_paged_cache(cfg, 1 + max_pages, block_size)
+    table_row = jnp.arange(1, 1 + max_pages, dtype=jnp.int32)
+
+    chunk_fn = jax.jit(
+        lambda p, t, c, s, l: paged_prefill_chunk(
+            p, t, cfg, c, table_row, s, l, block_size
+        ),
+        donate_argnums=(2,),
+    )
+    # Non-final prompt chunks skip the [C, vocab] lm_head projection — at
+    # production vocab sizes it dominates the chunk's FLOPs and only the
+    # final chunk's logits are ever read (the DecodeServer prefill makes
+    # the same split).
+    fill_fn = jax.jit(
+        lambda p, t, c, s, l: paged_prefill_chunk(
+            p, t, cfg, c, table_row, s, l, block_size, with_logits=False
+        )[1],
+        donate_argnums=(2,),
+    )
+
+    # -- prompt prefill, chunked at one static width ------------------------
+    pos = 0
+    logits = None
+    starts = list(range(0, len(prompt), prompt_chunk))
+    for start in starts:
+        piece = prompt[start : start + prompt_chunk]
+        padded = piece + [0] * (prompt_chunk - len(piece))
+        tokens = jnp.asarray([padded], dtype=jnp.int32)
+        if start == starts[-1]:
+            logits, cache = chunk_fn(
+                params, tokens, cache, jnp.int32(start), jnp.int32(len(piece))
+            )
+        else:
+            cache = fill_fn(
+                params, tokens, cache, jnp.int32(start), jnp.int32(len(piece))
+            )
+        pos = start + len(piece)
+        last_piece_len = len(piece)
+    first = int(jnp.argmax(logits[last_piece_len - 1, :]))
+
+    out: List[int] = [first]
+    history: List[int] = prompt + [first]
+    lookup = _LookupIndex(history, ngram)
+    rounds = 0
+
+    # -- verify loop --------------------------------------------------------
+    while len(out) < max_new and (eos_id is None or out[-1] != eos_id):
+        draft = lookup.draft(draft_k)
+        draft = draft[: max_new - len(out)]  # never overshoot the budget
+        window = [history[-1]] + draft
+        L = len(window)
+        padded = window + [0] * (W - L)
+        tokens = jnp.asarray([padded], dtype=jnp.int32)
+        logits, cache = chunk_fn(
+            params, tokens, cache, jnp.int32(pos), jnp.int32(L)
+        )
+        # argmax on device, then ONE host materialization of L ints per
+        # round — per-element int() would cost one device->host round trip
+        # EACH (measured: it erased the entire speculative win over a
+        # remote-dispatch link).
+        preds = np.asarray(jnp.argmax(logits[:L, :], axis=-1)).tolist()
+        rounds += 1
+        # Accept preds[0..m]: preds[j] is the true greedy token iff every
+        # earlier window token was correct; window[j+1] (the j-th draft)
+        # is correct iff it equals preds[j].
+        m = 0
+        while m < L - 1 and window[m + 1] == preds[m]:
+            m += 1
+        accepted = preds[: m + 1]
+        if eos_id is not None and eos_id in accepted:
+            accepted = accepted[: accepted.index(eos_id) + 1]
+        out.extend(accepted)
+        lookup.extend(accepted)  # appends to `history` (shared alias)
+        # Confirmed cache extent: rows pos..pos+m came from correct tokens.
+        pos += len(accepted)
+        if eos_id is not None and out and out[-1] == eos_id:
+            break
+    out = out[:max_new]
+    if return_stats:
+        return out, {
+            "rounds": rounds,
+            "accepted_per_round": (len(out) - 1) / rounds if rounds else 0.0,
+        }
+    return out

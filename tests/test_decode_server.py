@@ -23,7 +23,13 @@ def params():
 
 
 def solo_greedy(params, prompt, max_new, max_len=64):
-    """Reference: batch-1 prefill + scalar decode loop, pure greedy."""
+    """Reference: batch-1 prefill + scalar decode loop, pure greedy.
+
+    Comparisons against this reference are exact on the deterministic CPU
+    backend. On TPU the engine's batched programs tile bf16 differently,
+    so an EXACT logit tie (possible on this tiny random model) may break
+    differently — input-dependent; see
+    test_concurrent_requests_are_isolated for the tie-free oracle."""
     tokens = jnp.asarray([prompt], dtype=jnp.int32)
     logits, cache = prefill(params, tokens, CFG, max_len)
     out = [int(jnp.argmax(logits[0]))]
@@ -55,10 +61,11 @@ def test_concurrent_requests_are_isolated(params):
     scalar reference: on TPU the batch-1 scalar step tiles bf16 matmuls
     differently from the batched macro step, and this tiny random model
     has near-tie logits, so scalar-vs-engine argmax can legitimately flip —
-    that cross-IMPLEMENTATION equality is asserted separately on the
-    deterministic CPU backend (test_single_request_matches_solo_decode).
-    Engine-solo shares the concurrent run's compiled shapes, so any
-    difference here is true cross-request leakage."""
+    that cross-IMPLEMENTATION equality is covered by the suite's other
+    solo_greedy comparisons, which are exact on the deterministic CPU
+    backend (and on TPU share this tie caveat, input-dependent). Engine-
+    solo shares the concurrent run's compiled shapes, so any difference
+    here is true cross-request leakage."""
     prompts = [
         [1, 2, 3],
         [40, 41, 42, 43, 44, 45, 46],

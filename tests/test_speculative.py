@@ -1,0 +1,147 @@
+"""Prompt-lookup speculative decoding: the bar is EXACTNESS — output
+bit-identical to one-token-at-a-time greedy decoding on every input, with
+multi-token rounds merely changing how fast it gets there."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.decode import decode_step, prefill
+from nos_tpu.models.gpt import GPTConfig, init_gpt
+from nos_tpu.models.speculative import (
+    find_prompt_lookup_draft,
+    speculative_generate,
+)
+
+# float32: the tiny random bf16 model has EXACT logit ties (measured gap
+# 0.0 between competing tokens), where argmax across differently-shaped
+# programs is undefined — any cross-program comparison would test tie-
+# breaking luck, not the algorithm. f32 random logits are almost surely
+# distinct with gaps far above ulp noise, so greedy equality is decisive.
+CFG = GPTConfig(
+    vocab=97, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=512,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_gpt(jax.random.PRNGKey(0), CFG)
+
+
+def solo_greedy(params, prompt, max_new, max_len=512):
+    tokens = jnp.asarray([prompt], dtype=jnp.int32)
+    logits, cache = prefill(params, tokens, CFG, max_len)
+    out = [int(jnp.argmax(logits[0]))]
+    pos = len(prompt)
+    for _ in range(max_new - 1):
+        logits, cache = decode_step(
+            params, jnp.asarray([out[-1]], dtype=jnp.int32), CFG, cache, pos
+        )
+        out.append(int(jnp.argmax(logits[0])))
+        pos += 1
+    return out
+
+
+# -- the draft function -------------------------------------------------------
+
+
+def test_lookup_finds_most_recent_continuation():
+    #           0  1  2  3  4  5  6  7  8
+    history = [5, 6, 7, 9, 5, 6, 7, 1, 5, 6, 7]
+    # suffix (5,6,7) occurred at 0 (followed by 9) and 4 (followed by 1):
+    # the MOST RECENT earlier occurrence wins.
+    assert find_prompt_lookup_draft(history, ngram=3, k=2) == [1, 5]
+
+
+def test_lookup_empty_cases():
+    assert find_prompt_lookup_draft([1, 2, 3], ngram=3, k=4) == []  # only itself
+    assert find_prompt_lookup_draft([1, 2], ngram=3, k=4) == []
+    assert find_prompt_lookup_draft([1, 2, 3, 4, 5, 6], ngram=3, k=4) == []
+
+
+def test_lookup_draft_capped_at_k():
+    history = [1, 2, 3, 4, 5, 6, 7, 1, 2, 3]
+    assert find_prompt_lookup_draft(history, ngram=3, k=2) == [4, 5]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_incremental_index_matches_reference_scan(seed):
+    """The O(ngram) incremental index must reproduce the reference scan's
+    drafts exactly at every step of a growing history — including the
+    deferred-final-ngram rule that keeps a suffix from matching itself."""
+    from nos_tpu.models.speculative import _LookupIndex
+
+    rng = np.random.default_rng(seed)
+    tokens = [int(x) for x in rng.integers(0, 6, size=300)]  # tie-heavy
+    for ngram in (2, 3):
+        history: list = list(tokens[:10])
+        idx = _LookupIndex(history, ngram)
+        i = 10
+        while i < len(tokens):
+            step = int(rng.integers(1, 5))
+            assert idx.draft(6) == find_prompt_lookup_draft(history, ngram, 6), (
+                seed, ngram, len(history)
+            )
+            idx.extend(tokens[i : i + step])
+            i += step
+
+
+# -- exactness ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_prompt_bit_identical(params, seed):
+    """Random prompts rarely accept drafts — the path degrades to plain
+    decoding and must still be exact."""
+    prompt = [int(x) for x in
+              np.random.default_rng(seed).integers(1, 96, size=37)]
+    got = speculative_generate(params, CFG, prompt, max_new=24, prompt_chunk=16)
+    assert got == solo_greedy(params, prompt, 24)
+
+
+def test_repetitive_prompt_bit_identical_and_faster(params):
+    """Repetitive context is PLD's home turf: acceptance must climb above
+    one token per round while the output stays bit-identical."""
+    phrase = [11, 22, 33, 44, 55, 66, 77, 88]
+    prompt = (phrase * 8)[:60]
+    got, stats = speculative_generate(
+        params, CFG, prompt, max_new=32, prompt_chunk=16, return_stats=True
+    )
+    assert got == solo_greedy(params, prompt, 32)
+    assert stats["rounds"] < 32, "speculation never accepted anything"
+    assert stats["accepted_per_round"] > 1.0
+
+
+def test_exactness_across_window_and_ngram_settings(params):
+    prompt = ([3, 1, 4, 1, 5, 9, 2, 6] * 6)[:44]
+    want = solo_greedy(params, prompt, 20)
+    for draft_k in (2, 4, 8):
+        for ngram in (2, 3):
+            got = speculative_generate(
+                params, CFG, prompt, max_new=20,
+                draft_k=draft_k, ngram=ngram, prompt_chunk=16,
+            )
+            assert got == want, (draft_k, ngram)
+
+
+def test_eos_truncates_inside_an_accepted_run(params):
+    """When eos lands mid-window the output stops AT it — drafted tokens
+    beyond eos must never leak out."""
+    prompt = ([7, 7, 2, 9] * 10)[:36]
+    ref = solo_greedy(params, prompt, 24)
+    eos = ref[len(ref) // 2]  # a token known to appear mid-stream
+    want = ref[: ref.index(eos) + 1]
+    got = speculative_generate(
+        params, CFG, prompt, max_new=24, eos_id=eos, prompt_chunk=16
+    )
+    assert got == want
+
+
+def test_max_new_budget_exact(params):
+    prompt = [5, 6, 7, 8] * 5
+    for budget in (1, 2, 7):
+        got = speculative_generate(params, CFG, prompt, max_new=budget, prompt_chunk=16)
+        assert len(got) == budget
+        assert got == solo_greedy(params, prompt, budget)
