@@ -117,6 +117,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--workloads", type=int, nargs="*", default=[1, 3, 5, 7])
     ap.add_argument("--mode", choices=("shared", "sequential"), default="shared")
+    ap.add_argument(
+        "--tiny", action="store_true",
+        help="toy model for CI smoke runs (seconds on CPU; numbers are "
+        "meaningless — the real sweep uses the YOLOS-small-class default)",
+    )
     args = ap.parse_args(argv)
 
     import jax
@@ -127,7 +132,11 @@ def main(argv=None) -> int:
 
     from nos_tpu.models.vit import ViTConfig, init_vit
 
-    cfg = ViTConfig()  # YOLOS-small class
+    if args.tiny:
+        cfg = ViTConfig(image_size=32, patch_size=16, hidden=64, layers=1,
+                        heads=2, det_tokens=5)
+    else:
+        cfg = ViTConfig()  # YOLOS-small class
     params = init_vit(jax.random.PRNGKey(0), cfg)
     device = jax.devices()[0]
     print(f"device: {device.device_kind or device.platform} | mode: {args.mode}")

@@ -3,6 +3,7 @@ reference chart ships (helm-charts/nos, SURVEY §1 L6), the rendered CRDs
 equal deploy/crds.yaml, Dockerfiles exist per component, and the kind config
 mirrors hack/kind/cluster.yaml (3 nodes, admission webhooks enabled)."""
 
+import os
 import sys
 from pathlib import Path
 
@@ -528,3 +529,21 @@ class TestSharingDemo:
         assert mod.REFERENCE["mps"][7] == 0.3198
         assert mod.REFERENCE["time-slicing"][1] == 0.0882
         assert set(mod.REFERENCE["mig"]) == {1, 3, 5, 7}
+
+    def test_local_harness_runs_end_to_end_tiny(self):
+        """The demo harness executes for real in CI (tiny model, one
+        point per mode): client threads, the SliceServer path, and the
+        sequential baseline all work — not just parse."""
+        import subprocess
+        import sys
+
+        for mode in ("shared", "sequential"):
+            proc = subprocess.run(
+                [sys.executable, str(self.DEMO / "run_local.py"),
+                 "--tiny", "--workloads", "3", "--mode", mode],
+                capture_output=True, text=True, timeout=300,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            assert "mode: " + mode in proc.stdout
+            assert "  3  " in proc.stdout  # the N=3 row printed
