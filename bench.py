@@ -226,11 +226,22 @@ def main() -> None:
         result["mfu"] = mfu_result
     try:
         flash = _retry("flash_speedup", flash_train_shape_speedup)
-        if flash is not None:
+        if flash is not None and "invalid" in flash:
+            # Corrupted measurement window: publish the alert, not a number
+            # (VERDICT r4 #2 — the r4 artifact presented noise as a 41x win).
+            result["flash_attention"] = flash
+            _log(f"flash speedup invalid: {flash}")
+        elif flash is not None:
+            # Walls carried raw (unrounded): rounding to 3 decimals is what
+            # made the r4 artifact's degenerate 0.000 ms unauditable.
             result["flash_attention"] = {
                 "speedup_vs_reference": round(flash["speedup"], 2),
-                "flash_ms": round(flash["flash_ms"], 3),
-                "reference_ms": round(flash["reference_ms"], 3),
+                "flash_ms": flash["flash_ms"],
+                "reference_ms": flash["reference_ms"],
+                "flash_walls_ms": flash["flash_walls_ms"],
+                "reference_walls_ms": flash["reference_walls_ms"],
+                "floor_ms": flash["floor_ms"],
+                "rejected_attempts": flash["rejected_attempts"],
                 "shape": flash["shape"],
             }
     except Exception as e:  # noqa: BLE001 — telemetry only

@@ -107,4 +107,9 @@ def test_flash_pair_perf_floor_on_chip():
 
     result = flash_train_shape_speedup()
     assert result is not None
+    assert "invalid" not in result, result
+    # Both walls must clear the analytic 100%-MXU floor (the r4 artifact's
+    # degenerate 0.000/0.001 ms pair would fail here).
+    assert result["flash_ms"] >= result["floor_ms"], result
+    assert result["reference_ms"] >= result["floor_ms"], result
     assert result["speedup"] >= 2.0, result
