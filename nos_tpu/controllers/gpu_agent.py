@@ -125,6 +125,58 @@ def mps_validator(memory_gb: int) -> Callable[[int, Dict[str, int]], bool]:
     return validate
 
 
+def _split_hybrid_geometry(geometry: Dict[str, int]):
+    """Partition a mixed profile multiset by mode ('1g.5gb' is MIG,
+    '10gb' is MPS); raises ValueError on a profile neither mode parses."""
+    mig_part: Dict[MigProfile, int] = {}
+    mps_part: Dict[MpsProfile, int] = {}
+    for p, n in geometry.items():
+        try:
+            mig_part[MigProfile.parse(p)] = n
+        except ValueError:
+            mps_part[MpsProfile.parse(p)] = n
+    return mig_part, mps_part
+
+
+def hybrid_validator(
+    model: str, memory_gb: int
+) -> Callable[[int, Dict[str, int]], bool]:
+    """Device rules for a hybrid node (constants.KIND_HYBRID): each GPU is
+    EITHER MIG-partitioned OR MPS-sliced, never both — MIG is a per-GPU
+    hardware mode on NVIDIA silicon, so hybrid means mixing modes across a
+    node's GPUs, not within one. A single-mode geometry then follows that
+    mode's own rules (menu feasibility / memory budget)."""
+
+    def validate(gpu_index: int, geometry: Dict[str, int]) -> bool:
+        try:
+            mig_part, mps_part = _split_hybrid_geometry(geometry)
+        except ValueError:
+            return False
+        if mig_part and mps_part:
+            return False
+        if mig_part:
+            return geometry_feasible(model, mig_part)
+        total = sum(p.memory_gb * n for p, n in mps_part.items())
+        return total <= memory_gb
+
+    return validate
+
+
+def hybrid_parse_profile(resource_name: str):
+    """Pod-request resource -> profile, either mode (hybrid agent)."""
+    return MigProfile.from_resource(resource_name) or MpsProfile.from_resource(
+        resource_name
+    )
+
+
+def hybrid_resource_of(profile: str) -> str:
+    """Profile name -> extended-resource name, either mode (hybrid agent)."""
+    try:
+        return MigProfile.parse(profile).resource
+    except ValueError:
+        return MpsProfile.parse(profile).resource
+
+
 class GpuAgent:
     """Node daemon applying/reporting per-GPU slice geometry."""
 

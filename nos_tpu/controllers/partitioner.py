@@ -135,7 +135,11 @@ class PartitionerController:
         (partitioner_controller.go:212-232)."""
         lagging = []
         for node in self.state.nodes(
-            label_selector={constants.LABEL_PARTITIONING: self.kind}
+            label_selector={
+                constants.LABEL_PARTITIONING: constants.partitioning_label_values(
+                    self.kind
+                )
+            }
         ):
             if not ann.node_reported_last_plan(node.metadata.annotations):
                 lagging.append(node.metadata.name)

@@ -199,13 +199,20 @@ def _gfd(node: Node):
 
 
 def _node_status_geometry(node: Node, parse) -> Dict[int, Dict]:
-    """device index -> (geometry, used) from status annotations."""
+    """device index -> (geometry, used) from status annotations. Profiles
+    the parser rejects are skipped, not fatal: on a hybrid node the same
+    annotation set carries BOTH modes' statuses, and each taker must read
+    past the other mode's entries ("10gb" raises in MigProfile.parse and
+    "1g.5gb" raises in MpsProfile.parse)."""
     out: Dict[int, Dict] = {}
     statuses = ann.parse_status(node.metadata.annotations)
     for idx, profs in ann.geometry_counts_from_status(statuses).items():
         geometry, used = {}, {}
         for prof_name, (free, in_use) in profs.items():
-            profile = parse(prof_name)
+            try:
+                profile = parse(prof_name)
+            except ValueError:
+                profile = None
             if profile is None:
                 continue
             total = free + in_use
@@ -215,6 +222,41 @@ def _node_status_geometry(node: Node, parse) -> Dict[int, Dict]:
                 used[profile] = in_use
         out[idx] = {"geometry": geometry, "used": used}
     return out
+
+
+def _parses_as(parse) -> Callable[[str], bool]:
+    def accepts(profile_name: str) -> bool:
+        try:
+            parse(profile_name)
+            return True
+        except ValueError:
+            return False
+
+    return accepts
+
+
+def _claimed_by_other_mode(node: Node, other_parse) -> set:
+    """Device indexes on a hybrid node whose status (or pending spec) shows
+    the OTHER mode's slices. Each GPU of a hybrid node is single-mode (MIG
+    is a per-GPU hardware mode), so a taker must not offer those GPUs to its
+    planner; an uncarved GPU stays eligible for both modes and the first
+    plan to land claims it (the agent's hybrid validator arbitrates races,
+    and the plan handshake re-syncs the loser's view)."""
+    if node.metadata.labels.get(constants.LABEL_PARTITIONING) != constants.KIND_HYBRID:
+        return set()
+    claimed = set()
+    entries = [
+        (s.device_index, s.profile, s.quantity)
+        for s in ann.parse_status(node.metadata.annotations)
+    ] + [
+        (s.device_index, s.profile, s.quantity)
+        for s in ann.parse_spec(node.metadata.annotations)
+    ]
+    accepts = _parses_as(other_parse)
+    for idx, prof_name, qty in entries:
+        if qty > 0 and accepts(prof_name):
+            claimed.add(idx)
+    return claimed
 
 
 class MigSnapshotTaker:
@@ -228,7 +270,11 @@ class MigSnapshotTaker:
 
         nodes = {}
         for node in cluster_state.nodes(
-            label_selector={constants.LABEL_PARTITIONING: constants.KIND_MIG}
+            label_selector={
+                constants.LABEL_PARTITIONING: constants.partitioning_label_values(
+                    constants.KIND_MIG
+                )
+            }
         ):
             if not is_node_device_healthy(node):
                 continue
@@ -236,6 +282,7 @@ class MigSnapshotTaker:
             if not mig_model_known(model) or count < 1:
                 continue
             per_gpu = _node_status_geometry(node, lambda n: MigProfile.parse(n))
+            mps_claimed = _claimed_by_other_mode(node, MpsProfile.parse)
             try:
                 gpus = [
                     MigGpu(
@@ -245,6 +292,7 @@ class MigSnapshotTaker:
                         per_gpu.get(idx, {}).get("used"),
                     )
                     for idx in range(count)
+                    if idx not in mps_claimed
                 ]
             except ValueError:
                 # A node reporting a geometry the current menus consider
@@ -280,7 +328,11 @@ class MpsSnapshotTaker:
 
         nodes = {}
         for node in cluster_state.nodes(
-            label_selector={constants.LABEL_PARTITIONING: constants.KIND_MPS}
+            label_selector={
+                constants.LABEL_PARTITIONING: constants.partitioning_label_values(
+                    constants.KIND_MPS
+                )
+            }
         ):
             if not is_node_device_healthy(node):
                 continue
@@ -289,6 +341,7 @@ class MpsSnapshotTaker:
                 continue
             memory_gb = memory_gb or constants.DEFAULT_GPU_MEMORY_GB
             per_gpu = _node_status_geometry(node, lambda n: MpsProfile.parse(n))
+            mig_claimed = _claimed_by_other_mode(node, MigProfile.parse)
             gpus = [
                 MpsGpu(
                     memory_gb,
@@ -297,6 +350,7 @@ class MpsSnapshotTaker:
                     per_gpu.get(idx, {}).get("used"),
                 )
                 for idx in range(count)
+                if idx not in mig_claimed
             ]
             name = node.metadata.name
             nodes[name] = GpuNode(
@@ -315,16 +369,27 @@ class MpsSnapshotTaker:
 # Partitioners (actuation channels)
 # ---------------------------------------------------------------------------
 class AnnotationPartitioner:
-    """Spec-annotation writer shared by TPU and MIG modes."""
+    """Spec-annotation writer shared by TPU and MIG modes. `profile_filter`
+    scopes the rewrite to one mode's profiles so that on a hybrid node the
+    MIG and MPS plans coexist instead of wiping each other."""
 
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, profile_filter=None):
         self._cluster = cluster
+        self._profile_filter = profile_filter
 
     def apply_partitioning(
         self, node_name: str, plan_id: str, partitioning: NodePartitioning
     ) -> None:
         def mutate(node: Node) -> None:
-            ann.strip_spec_annotations(node.metadata.annotations)
+            # Scoped stripping ONLY on hybrid nodes: a non-hybrid node has a
+            # single owner mode, so a full rewrite is the path that clears
+            # stale other-mode specs left by a relabel (mps->mig) — left in
+            # place they would poison the agent's reconcile forever.
+            profile_filter = self._profile_filter
+            node_kind = node.metadata.labels.get(constants.LABEL_PARTITIONING)
+            if node_kind != constants.KIND_HYBRID:
+                profile_filter = None
+            ann.strip_spec_annotations(node.metadata.annotations, profile_filter)
             specs = []
             for device_index, profiles in partitioning.items():
                 specs.extend(
@@ -338,7 +403,9 @@ class AnnotationPartitioner:
         self._cluster.patch("Node", "", node_name, mutate)
 
 
-MigPartitioner = AnnotationPartitioner
+class MigPartitioner(AnnotationPartitioner):
+    def __init__(self, cluster: Cluster):
+        super().__init__(cluster, profile_filter=_parses_as(MigProfile.parse))
 
 
 class MpsPartitioner:
@@ -354,7 +421,9 @@ class MpsPartitioner:
         cm_namespace: str = constants.DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE,
     ):
         self._cluster = cluster
-        self._annotations = AnnotationPartitioner(cluster)
+        self._annotations = AnnotationPartitioner(
+            cluster, profile_filter=_parses_as(MpsProfile.parse)
+        )
         self.cm_name = cm_name
         self.cm_namespace = cm_namespace
 

@@ -74,11 +74,18 @@ class ClusterState:
             return n.deepcopy() if n is not None else None
 
     def nodes(self, label_selector: Optional[Dict[str, str]] = None) -> List[Node]:
+        """Nodes, optionally filtered. A selector value may be a str (exact
+        match) or a tuple/set/list of accepted values (the k8s set-based
+        `key in (a, b)` selector form — used by the GPU modes, whose nodes
+        may be labeled with their own kind OR `hybrid`)."""
         with self._lock:
             out = []
             for n in self._nodes.values():
                 if label_selector and any(
-                    n.metadata.labels.get(k) != v for k, v in label_selector.items()
+                    n.metadata.labels.get(k) not in v
+                    if isinstance(v, (tuple, set, frozenset, list))
+                    else n.metadata.labels.get(k) != v
+                    for k, v in label_selector.items()
                 ):
                     continue
                 out.append(n.deepcopy())
@@ -101,10 +108,12 @@ class ClusterState:
             return out
 
     def partitioning_enabled(self, kind: str) -> bool:
-        """Any node labeled for this partitioning mode
-        (state.go IsPartitioningEnabled:216-222)."""
+        """Any node labeled for this partitioning mode — a hybrid-labeled
+        node enables both GPU modes (state.go IsPartitioningEnabled:216-222;
+        hybrid completion per constants.KIND_HYBRID)."""
+        values = constants.partitioning_label_values(kind)
         with self._lock:
             return any(
-                n.metadata.labels.get(constants.LABEL_PARTITIONING) == kind
+                n.metadata.labels.get(constants.LABEL_PARTITIONING) in values
                 for n in self._nodes.values()
             )

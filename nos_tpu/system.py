@@ -117,9 +117,19 @@ def build_partitioner_controllers(
             ),
         ),
     }
-    for mode in config.modes:
-        if mode == constants.KIND_TPU_MULTIHOST:
-            continue  # host-group carving runs in the dedicated GroupPartitioner
+    modes = list(config.modes)
+    if constants.KIND_HYBRID in modes:
+        # Not a controller of its own: hybrid-labeled nodes are served by
+        # BOTH the mig and mps controllers (constants.KIND_HYBRID), so
+        # enabling hybrid pulls in whichever of the two is not already on.
+        modes += [
+            m
+            for m in (constants.KIND_MIG, constants.KIND_MPS)
+            if m not in modes
+        ]
+    for mode in modes:
+        if mode in (constants.KIND_TPU_MULTIHOST, constants.KIND_HYBRID):
+            continue  # multihost: dedicated GroupPartitioner; hybrid: see above
         taker, partitioner = mode_wiring[mode]
         controllers[mode] = PartitionerController(
             cluster=cluster,
@@ -415,6 +425,29 @@ def build_gpu_agent(
             cluster,
             node_name,
             client,
+            plugin_client=plugin_client,
+            pod_resources_lister=lister,
+        )
+    if mode == constants.KIND_HYBRID:
+        # model_or_memory: (gpu model, memory GB) — the node serves MIG and
+        # MPS slices simultaneously (constants.KIND_HYBRID), so the agent
+        # validates both modes' rules and maps both resource namespaces.
+        from nos_tpu.controllers.gpu_agent import (
+            hybrid_parse_profile,
+            hybrid_resource_of,
+            hybrid_validator,
+        )
+
+        model, memory_gb = model_or_memory
+        client = FakeGpuDeviceClient(
+            gpu_count, hybrid_validator(model, int(memory_gb))
+        )
+        return GpuAgent(
+            cluster,
+            node_name,
+            client,
+            parse_profile=hybrid_parse_profile,
+            resource_of=hybrid_resource_of,
             plugin_client=plugin_client,
             pod_resources_lister=lister,
         )

@@ -289,6 +289,108 @@ def test_mps_end_to_end_configmap_and_label():
     assert node.status.allocatable.get("nvidia.com/gpu-10gb", 0) >= 2
 
 
+def test_hybrid_node_serves_mig_and_mps_across_two_plans():
+    """A node labeled `hybrid` (constants.KIND_HYBRID; reference
+    pkg/gpu/partitioning.go:75) is eligible for BOTH modes: the MIG
+    controller carves a mig profile on one GPU (plan 1), then the MPS
+    controller slices ANOTHER GPU (plan 2) WITHOUT wiping the MIG plan —
+    the two spec sets coexist on the node, one agent actuates both, and
+    each GPU stays single-mode (MIG is a per-GPU hardware mode)."""
+    from nos_tpu.controllers.gpu_agent import (
+        hybrid_parse_profile,
+        hybrid_resource_of,
+        hybrid_validator,
+    )
+
+    cluster = Cluster()
+    state = ClusterState()
+    state.start_watching(cluster)
+    clock = FakeClock()
+    cluster.create(
+        Node(
+            metadata=ObjectMeta(
+                name="hy-node-0",
+                labels={
+                    constants.LABEL_PARTITIONING: constants.KIND_HYBRID,
+                    constants.LABEL_GPU_PRODUCT: A100_40,
+                    constants.LABEL_GPU_COUNT: "2",
+                    constants.LABEL_GPU_MEMORY: "40536",
+                },
+            ),
+            status=NodeStatus(allocatable=ResourceList.of({"cpu": 64})),
+        )
+    )
+    assert state.partitioning_enabled(constants.KIND_MIG)
+    assert state.partitioning_enabled(constants.KIND_MPS)
+
+    client = FakeGpuDeviceClient(2, hybrid_validator(A100_40, 40))
+    agent = GpuAgent(
+        cluster,
+        "hy-node-0",
+        client,
+        parse_profile=hybrid_parse_profile,
+        resource_of=hybrid_resource_of,
+    )
+    agent.startup()
+    agent.start_watching()
+
+    mig_ctrl = make_controller(
+        cluster, state, constants.KIND_MIG, MigSnapshotTaker(), MigPartitioner(cluster), clock
+    )
+    mps_ctrl = make_controller(
+        cluster, state, constants.KIND_MPS, MpsSnapshotTaker(), MpsPartitioner(cluster), clock
+    )
+
+    # Plan 1: the MIG controller carves for a mig-profile pod.
+    cluster.create(unschedulable_pod("train", {"nvidia.com/mig-3g.20gb": 1}))
+    clock.advance(11)
+    assert mig_ctrl.process_batch_if_ready()
+    node = cluster.get("Node", "", "hy-node-0")
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+    assert node.status.allocatable.get("nvidia.com/mig-3g.20gb", 0) >= 1
+
+    # Plan 2: the MPS controller adds a slice for an mps pod.
+    cluster.create(unschedulable_pod("infer", {"nvidia.com/gpu-10gb": 1}))
+    clock.advance(11)
+    assert mps_ctrl.process_batch_if_ready()
+
+    node = cluster.get("Node", "", "hy-node-0")
+    assert ann.node_reported_last_plan(node.metadata.annotations)
+    # Both modes' spec annotations coexist (the MPS rewrite did not strip
+    # the MIG plan) and both device sets are live on the one node.
+    spec_profiles = {s.profile for s in ann.parse_spec(node.metadata.annotations)}
+    assert "3g.20gb" in spec_profiles and "10gb" in spec_profiles
+    # Each GPU is single-mode: the MIG carve and the MPS slice landed on
+    # DIFFERENT GPUs of the hybrid node.
+    by_gpu = {}
+    for d in client.list_devices():
+        by_gpu.setdefault(d.gpu_index, set()).add(d.profile)
+    mig_gpus = {gi for gi, profs in by_gpu.items() if "3g.20gb" in profs}
+    mps_gpus = {gi for gi, profs in by_gpu.items() if "10gb" in profs}
+    assert mig_gpus and mps_gpus and mig_gpus.isdisjoint(mps_gpus)
+    assert node.status.allocatable.get("nvidia.com/mig-3g.20gb", 0) >= 1
+    assert node.status.allocatable.get("nvidia.com/gpu-10gb", 0) >= 1
+    statuses = ann.parse_status(node.metadata.annotations)
+    assert ann.spec_matches_status(
+        ann.parse_spec(node.metadata.annotations), statuses
+    )
+
+
+def test_hybrid_validator_single_mode_per_gpu():
+    """Each GPU of a hybrid node is either MIG-partitioned or MPS-sliced —
+    never both (MIG is a per-GPU hardware mode); single-mode geometries
+    follow that mode's own rules."""
+    from nos_tpu.controllers.gpu_agent import hybrid_validator
+
+    v = hybrid_validator(A100_40, 40)
+    assert v(0, {"3g.20gb": 2})  # valid MIG menu row
+    assert v(0, {"10gb": 4})  # 40 GB of MPS slices: fits
+    assert not v(0, {"3g.20gb": 1, "10gb": 1})  # mixed modes on one GPU
+    assert not v(0, {"10gb": 5})  # MPS over budget
+    assert not v(0, {"3g.20gb": 3})  # not a feasible MIG geometry
+    assert not v(0, {"bogus": 1})
+
+
 def test_device_plugin_restart_after_geometry_change():
     from nos_tpu.gpu.device_plugin import (
         DevicePluginClient,
