@@ -272,6 +272,66 @@ def paged_prefill_chunk(
     return logits[0], new_cache
 
 
+def paged_verify_window(
+    params,
+    tokens,
+    cfg: GPTConfig,
+    pcache,
+    table,
+    pos,
+    lengths,
+    mask,
+    block_size: int,
+):
+    """Batched speculative-verify window over the shared paged pool: tokens
+    [B, W] are per-slot draft windows (window[0] = the slot's last accepted
+    token), each slot writing K/V at its own positions pos[b]..pos[b]+
+    lengths[b]-1 into its own pages and attending causally over its
+    confirmed prefix plus the window. Rows beyond lengths[b] (window
+    padding) and lanes with mask[b]=False write to the scratch page and
+    yield garbage logits the caller ignores. Returns (logits [B, W, vocab],
+    new pool).
+
+    This is `paged_prefill_chunk` batched across slots — the DecodeServer's
+    speculative rounds verify every slot's prompt-lookup draft in ONE
+    dispatch (the multi-stream composition of models/speculative.py, which
+    verifies a single stream per dispatch). Rejected rows leave stale K/V
+    beyond the accepted position; the next round's window starts there and
+    overwrites before anything attends that far (same argument as the
+    sidecar's)."""
+    b, w = tokens.shape
+    positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
+    valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]
+    x = params["tok_emb"][tokens]
+    pages = jnp.where(
+        valid,
+        jnp.take_along_axis(table, positions // block_size, axis=1),
+        0,
+    )  # [B, W]; invalid rows hit scratch
+    offs = positions % block_size
+    # Invalid rows attend the scratch page's first position only: their
+    # logits are garbage, but an all-masked score row would softmax to NaN.
+    limit = jnp.where(valid, positions + 1, 1)  # [B, W]
+    new_cache = {}
+    for i in range(cfg.layers):
+        p = params["layers"][str(i)]
+        lc = pcache[str(i)]
+
+        def attend(q, k_new, v_new, lc=lc, i=i):
+            ck = lc["k"].at[pages, :, offs, :].set(k_new.transpose(0, 2, 1, 3))
+            cv = lc["v"].at[pages, :, offs, :].set(v_new.transpose(0, 2, 1, 3))
+            new_cache[str(i)] = {"k": ck, "v": cv}
+            return _attend_cache(
+                q, _gather_pages(ck, table), _gather_pages(cv, table),
+                cfg.heads // cfg.n_kv, limit,
+            )
+
+        x = _block_core(x, p, cfg, positions, attend)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
 # -- ragged (per-row position) decoding --------------------------------------
 def decode_step_ragged(params, token, cfg: GPTConfig, cache, pos):
     """One token [B] with PER-ROW positions [B] -> (logits [B,vocab], cache),

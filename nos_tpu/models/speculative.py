@@ -101,6 +101,19 @@ class _LookupIndex:
         return list(h[start + g : start + g + k])
 
 
+def accept_prefix(window: Sequence[int], preds: Sequence[int]) -> List[int]:
+    """Greedy-exact acceptance: preds[j] is the true greedy token iff every
+    earlier window token was correct; draft window[j+1] is correct iff it
+    equals preds[j]. Returns the accepted tokens (1..len(window) of them).
+    The ONE copy of the correctness-critical rule — the single-stream
+    sidecar and the DecodeServer's batched verify rounds must not drift."""
+    m = 0
+    L = len(window)
+    while m < L - 1 and window[m + 1] == preds[m]:
+        m += 1
+    return [int(t) for t in preds[: m + 1]]
+
+
 def speculative_generate(
     params,
     cfg: GPTConfig,
@@ -192,15 +205,14 @@ def speculative_generate(
         # remote-dispatch link).
         preds = np.asarray(jnp.argmax(logits[:L, :], axis=-1)).tolist()
         rounds += 1
-        # Accept preds[0..m]: preds[j] is the true greedy token iff every
-        # earlier window token was correct; window[j+1] (the j-th draft)
-        # is correct iff it equals preds[j].
-        m = 0
-        while m < L - 1 and window[m + 1] == preds[m]:
-            m += 1
-        accepted = preds[: m + 1]
+        accepted = accept_prefix(window, preds)
         if eos_id is not None and eos_id in accepted:
             accepted = accepted[: accepted.index(eos_id) + 1]
+        # Cap to the remaining budget: a fully-accepted final round's bonus
+        # token would otherwise overshoot max_new by one — counted in the
+        # stats and inserted into the shared history before out[:max_new]
+        # discarded it (ADVICE r4).
+        accepted = accepted[: max_new - len(out)]
         out.extend(accepted)
         lookup.extend(accepted)  # appends to `history` (shared alias)
         # Confirmed cache extent: rows pos..pos+m came from correct tokens.

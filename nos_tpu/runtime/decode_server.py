@@ -49,8 +49,10 @@ from nos_tpu.models.decode import (
     init_paged_cache,
     paged_decode_step,
     paged_prefill_chunk,
+    paged_verify_window,
 )
 from nos_tpu.models.gpt import GPTConfig
+from nos_tpu.models.speculative import _LookupIndex, accept_prefix
 
 logger = logging.getLogger(__name__)
 
@@ -85,10 +87,16 @@ class _Slot:
     remaining: int = 0  # generated tokens still to dispatch
     # Token sources in generation order: (ref, lane, row) — row None = the
     # admission wave's first-token vector (indexed by lane); otherwise row =
-    # the step's index within its macro-dispatch window [K, n_slots].
+    # the step's index within its macro-dispatch window [K, n_slots] (or a
+    # speculative round's host-side accepted-token column [m, 1]).
     refs: List[Tuple[_TokRef, Optional[int], Optional[int]]] = field(default_factory=list)
     eos_scanned: int = 0
     future: Optional[Future] = None
+    # Speculative decoding (spec_k > 0): host-side token history (prompt +
+    # generated, synced from refs) feeding the prompt-lookup draft index.
+    prompt: Optional[list] = None
+    history: Optional[list] = None
+    lookup: Optional[_LookupIndex] = None
 
 
 class DecodeServer:
@@ -106,6 +114,9 @@ class DecodeServer:
         steps_per_dispatch: int = 1,
         block_size: int = 32,
         total_blocks: Optional[int] = None,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
+        spec_sync: bool = False,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
         softmax sampling with a deterministic per-slot, per-step PRNG stream
@@ -132,7 +143,29 @@ class DecodeServer:
         for long-context serving WITHOUT paying n_slots x max_len — the pool
         charges each request only for the blocks its prompt + max_new
         need, and admission waits (backpressure, FIFO) while the pool is
-        exhausted instead of over-committing."""
+        exhausted instead of over-committing.
+
+        `spec_k` > 0 enables SPECULATIVE decoding inside the continuous
+        batch (greedy only — acceptance is exact-match, so temperature must
+        be 0): each slot keeps a host-side prompt-lookup index
+        (models/speculative.py), and whenever ANY active slot has a draft,
+        one `paged_verify_window` dispatch verifies every slot's window
+        ([B, spec_k+1] rows at per-slot positions) and accepts each slot's
+        longest correct prefix — up to spec_k+1 tokens per slot per
+        dispatch. Rounds with no draft anywhere fall back to the normal
+        pipelined macro path, so non-repetitive traffic keeps today's
+        device-resident behavior (the no-regression guarantee); repetitive
+        traffic (retrieval, code editing, agent transcripts) trades the
+        pipeline for multi-token rounds, which wins exactly when drafts
+        accept. Outputs remain bit-identical to spec_k=0 greedy decoding
+        (same argmax chain, modulo exact logit ties — see
+        models/speculative.py module docstring). Draft detection needs the
+        host to SEE generated tokens, so spec mode clamps the pipeline
+        depth like eos does; `spec_sync=True` goes further and syncs
+        histories (blocking) before every drafts probe — deterministic
+        speculation scheduling, and the right choice when dispatch latency
+        is negligible (a locally attached chip) or draft reactivity beats
+        pipelining (heavily repetitive traffic)."""
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -171,7 +204,22 @@ class DecodeServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.steps_run = 0
+        self.spec_rounds = 0
+        self.spec_tokens_accepted = 0
         self.temperature = float(temperature)
+        self.spec_k = max(0, int(spec_k))
+        self.spec_ngram = int(spec_ngram)
+        self.spec_sync = bool(spec_sync)
+        if self.spec_k > 0 and self.temperature > 0.0:
+            raise ValueError(
+                "speculative decoding (spec_k > 0) is greedy-exact: "
+                "temperature must be 0"
+            )
+        if self.spec_k > 0:
+            # Drafts come from materialized tokens: a deep dispatch pipeline
+            # would keep refs perpetually in flight and starve the lookup
+            # (the same value-dependence clamp the eos path applies).
+            self.pipeline_depth = min(self.pipeline_depth, 2)
         self._base_key = jax.random.PRNGKey(seed)
         # Per-slot sampling identity: (serial of the request in the slot,
         # step within the request). Serials make streams independent of slot
@@ -255,6 +303,19 @@ class DecodeServer:
             # per-slot scalar read made admission alone cost
             # n_slots x RTT (~1.1s of the 8-stream benchmark's 1.4s).
             return cache, last.at[slot].set(first), first_vec.at[slot].set(first)
+
+        if self.spec_k > 0:
+            W = self.spec_k + 1
+
+            def _verify(params, tokens, cache, table, pos, lengths, active):
+                logits, cache = paged_verify_window(
+                    params, tokens, cfg, cache, table, pos, lengths, active, bs
+                )
+                # Greedy acceptance is argmax-only: ship [B, W] int32 to the
+                # host, never [B, W, vocab] logits.
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            self._verify_fn = jax.jit(_verify, donate_argnums=(2,))
 
         self._prefill_chunk = jax.jit(_prefill_chunk, donate_argnums=(2,))
         # first_vec is deliberately NOT donated: earlier admission waves'
@@ -401,6 +462,9 @@ class DecodeServer:
             slot.future = fut
             slot.remaining = 0
             slot.refs = []
+            slot.prompt = list(prompt) if self.spec_k > 0 else None
+            slot.history = None
+            slot.lookup = None
             # Chunked prefill: bounded bucket-padded dispatches; the final
             # chunk's variant samples the request's first token directly
             # into the device token vector (no host materialization).
@@ -506,6 +570,118 @@ class DecodeServer:
                     self._release_slot(idx)
                     break
 
+    # -- speculative rounds ---------------------------------------------------
+    def _sync_spec_history(self, idx: int, blocking: bool) -> bool:
+        """Bring the slot's host-side history up to date with its refs
+        (materializing only ready buffers unless `blocking`). Returns True
+        when every dispatched token is in the history — the invariant the
+        verify round needs (window[0] must be the TRUE last token, and
+        slot.pos == len(history) - 1)."""
+        slot = self._slots[idx]
+        if slot.history is None:
+            if not slot.refs:
+                return False  # prefill not dispatched yet
+            if not blocking and not slot.refs[0][0].is_ready():
+                return False
+            slot.history = list(slot.prompt)
+            slot.lookup = _LookupIndex(slot.history, self.spec_ngram)
+        known = len(slot.history) - len(slot.prompt)
+        new = []
+        for ref, lane, row in slot.refs[known:]:
+            if not blocking and not ref.is_ready():
+                break
+            new.append(self._token_at(ref, lane, row))
+        if new:
+            slot.lookup.extend(new)  # appends to slot.history (shared alias)
+        return len(slot.history) - len(slot.prompt) == len(slot.refs)
+
+    def _spec_drafts(self) -> dict:
+        """Non-blocking draft probe: {slot idx -> draft tokens} for slots
+        whose history is fully synced and whose lookup finds a repetition.
+        Lag-tolerant by design: refs still in flight just delay a draft by a
+        tick, so non-repetitive traffic never leaves the pipelined path."""
+        drafts = {}
+        for idx, slot in enumerate(self._slots):
+            if not slot.active or slot.remaining <= 1:
+                continue
+            if not self._sync_spec_history(idx, blocking=self.spec_sync):
+                continue
+            # Cap: the round may emit at most `remaining` tokens, and the
+            # window's last row must stay inside the slot's block
+            # allocation (positions 0..prompt+max_new-2), hence -1.
+            cap = min(self.spec_k, slot.remaining - 1)
+            d = slot.lookup.draft(cap)
+            if d:
+                drafts[idx] = d
+        return drafts
+
+    def _spec_round(self, drafts: dict) -> None:
+        """One batched verify dispatch over every active slot: slots with a
+        draft verify it; slots without advance one token through the same
+        program (their window is just their last token). Greedy-exact: a
+        draft token is accepted iff it equals the model's argmax given all
+        previously accepted tokens."""
+        W = self.spec_k + 1
+        # Histories must be exact before building windows.
+        for idx, slot in enumerate(self._slots):
+            if slot.active:
+                self._sync_spec_history(idx, blocking=True)
+        # A late EOS may have materialized during the blocking sync.
+        self._scan_eos()
+        windows: List[Optional[list]] = [None] * self.n_slots
+        tokens = np.zeros((self.n_slots, W), dtype=np.int32)
+        lengths = np.zeros((self.n_slots,), dtype=np.int32)
+        active = np.zeros((self.n_slots,), dtype=bool)
+        for idx, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            window = [slot.history[-1]] + drafts.get(idx, [])[
+                : max(0, slot.remaining - 1)
+            ]
+            windows[idx] = window
+            tokens[idx, : len(window)] = window
+            lengths[idx] = len(window)
+            active[idx] = True
+        if not active.any():
+            return
+        pos = np.array([s.pos for s in self._slots], dtype=np.int32)
+        preds_dev, self.cache = self._verify_fn(
+            self.params,
+            jnp.asarray(tokens),
+            self.cache,
+            self._table,
+            jnp.asarray(pos),
+            jnp.asarray(lengths),
+            jnp.asarray(active),
+        )
+        # ONE host materialization for the whole round ([B, W] ints) — the
+        # acceptance decision is inherently host-side, and this read is the
+        # RTT the accepted multi-token prefix amortizes.
+        preds = np.asarray(preds_dev)
+        self.steps_run += 1
+        self.spec_rounds += 1
+        host_last = np.asarray(self._last_dev).copy()
+        for idx, slot in enumerate(self._slots):
+            window = windows[idx]
+            if window is None or not slot.active:
+                continue
+            accepted = accept_prefix(window, preds[idx, : len(window)])
+            ref = _TokRef(np.asarray(accepted, dtype=np.int32).reshape(-1, 1))
+            for j in range(len(accepted)):
+                slot.refs.append((ref, 0, j))
+            slot.pos += len(accepted)
+            slot.remaining -= len(accepted)
+            slot.lookup.extend(accepted)
+            self.spec_tokens_accepted += len(accepted)
+            host_last[idx] = accepted[-1]
+            if self.eos_id is not None and self.eos_id in accepted:
+                # Deterministic completion now: _finalize truncates at EOS.
+                slot.remaining = 0
+            self._finish_if_done(idx)
+        # Keep the device-side token vector coherent so a later macro
+        # dispatch (draftless rounds) starts from the true last tokens.
+        self._last_dev = jnp.asarray(host_last)
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
@@ -525,6 +701,11 @@ class DecodeServer:
         if not any(active):
             self._stop.wait(0.005)
             return
+        if self.spec_k > 0:
+            drafts = self._spec_drafts()
+            if drafts:
+                self._spec_round(drafts)
+                return
         K = self.steps_per_dispatch
         pos = np.array([s.pos for s in self._slots], dtype=np.int32)
         step = np.array(

@@ -409,3 +409,132 @@ def test_request_larger_than_pool_rejected_not_hung(params):
         )
     finally:
         server.stop()
+
+
+# -- speculative decoding inside the continuous batch -------------------------
+# float32 model: spec-vs-nonspec comparisons cross differently-shaped
+# programs (verify window vs single-step), where the tiny random bf16
+# model's EXACT logit ties would test tie-breaking luck, not the algorithm
+# (same reasoning as tests/test_speculative.py).
+SPEC_CFG = GPTConfig(
+    vocab=97, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=256,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def spec_params():
+    return init_gpt(jax.random.PRNGKey(0), SPEC_CFG)
+
+
+def spec_solo_greedy(params, prompt, max_new, max_len=256):
+    tokens = jnp.asarray([prompt], dtype=jnp.int32)
+    logits, cache = prefill(params, tokens, SPEC_CFG, max_len)
+    out = [int(jnp.argmax(logits[0]))]
+    pos = len(prompt)
+    for _ in range(max_new - 1):
+        logits, cache = decode_step(
+            params, jnp.asarray([out[-1]], dtype=jnp.int32), SPEC_CFG, cache, pos
+        )
+        out.append(int(jnp.argmax(logits[0])))
+        pos += 1
+    return out
+
+
+REPETITIVE = [3, 1, 4, 1, 5, 9, 2, 6] * 6  # strong prompt-lookup signal
+
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="cross-program greedy equality needs the deterministic CPU backend",
+)
+
+
+@cpu_only
+def test_spec_server_multi_stream_matches_nonspec(spec_params):
+    """VERDICT r4 #4 done-criterion: multi-stream A/B, spec on vs off —
+    identical outputs, and the spec engine actually took multi-token
+    rounds (it must COMPOSE with continuous batching, not bypass it).
+
+    Determinism: requests are submitted BEFORE the engine starts (one
+    admission wave) and spec_sync=True makes every drafts probe blocking,
+    so which program computes each token is a pure function of the inputs
+    — without it, thread timing decides when drafts fire, and on this tiny
+    random model a ~4e-3 logit gap at a bistable loop point can then flip
+    between the macro and verify programs run-to-run (the cross-program
+    tie caveat of models/speculative.py; real models' gaps dwarf it)."""
+    prompts = [
+        REPETITIVE,
+        [7, 7, 2, 9] * 10,
+        list(range(20, 44)),  # non-repetitive stream sharing the batch
+        [11, 13, 17, 19, 11, 13, 17, 19] * 4,
+    ]
+    max_new = 24
+
+    def run(spec_k):
+        server = DecodeServer(
+            spec_params, SPEC_CFG, n_slots=4, max_len=256,
+            prompt_buckets=(16, 32, 64), spec_k=spec_k, spec_sync=True,
+        )
+        futs = [server.submit(p, max_new=max_new) for p in prompts]
+        server.start()
+        try:
+            outs = [f.result(timeout=300) for f in futs]
+        finally:
+            server.stop()
+        return outs, server.spec_rounds, server.spec_tokens_accepted
+
+    base, rounds0, _ = run(0)
+    spec, rounds1, accepted1 = run(6)
+    assert rounds0 == 0
+    assert base == spec
+    # The spec engine took verify rounds and they averaged >1 token/round
+    # (the repetitive streams accept their drafts).
+    assert rounds1 > 0
+    assert accepted1 > rounds1
+
+
+@cpu_only
+def test_spec_server_eos_truncates_exactly(spec_params):
+    """EOS inside an accepted draft run terminates the stream exactly where
+    the non-speculative engine would (same-engine A/B: see the program
+    determinism note on the multi-stream test)."""
+    prompt = REPETITIVE
+
+    def run(spec_k, eos):
+        server = DecodeServer(
+            spec_params, SPEC_CFG, n_slots=2, max_len=256,
+            prompt_buckets=(16, 32, 64), spec_k=spec_k, spec_sync=True,
+            eos_id=eos,
+        )
+        fut = server.submit(prompt, max_new=24)
+        server.start()
+        try:
+            return fut.result(timeout=300)
+        finally:
+            server.stop()
+
+    full = run(0, None)
+    eos = full[len(full) // 2]  # guaranteed to occur mid-stream
+    want = full[: full.index(eos) + 1]
+    assert run(6, eos) == want
+    assert run(0, eos) == want
+
+
+def test_spec_server_budget_never_overshoots(spec_params):
+    """A fully-accepted final round must not emit past max_new."""
+    server = DecodeServer(
+        spec_params, SPEC_CFG, n_slots=2, max_len=256,
+        prompt_buckets=(16, 32, 64), spec_k=8,
+    ).start()
+    try:
+        for max_new in (1, 2, 5, 17):
+            out = server.generate(REPETITIVE, max_new=max_new, timeout=300)
+            assert len(out) == max_new
+    finally:
+        server.stop()
+
+
+def test_spec_requires_greedy(spec_params):
+    with pytest.raises(ValueError, match="greedy"):
+        DecodeServer(spec_params, SPEC_CFG, spec_k=4, temperature=0.7)
