@@ -30,6 +30,13 @@ class GPTConfig:
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"
     attention: str = "flash"  # "flash" | "ring" | "ulysses" | "reference"
+    # Run the QKV projections (and the MLP gate/up pair) as ONE matmul over
+    # runtime-concatenated weights: same math and the same param tree, but a
+    # single wider MXU dispatch instead of three narrow ones — measured on
+    # v5e at the bench config (see docs/benchmark.md MFU table). Off by
+    # default on meshes: concatenating tp-sharded weights inside pjit can
+    # force reshards, so the sharded train path opts in explicitly.
+    fuse_projections: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -104,12 +111,27 @@ def project_qkv(x, p, cfg: GPTConfig, positions, repeat_kv: bool = True):
     b, t, _ = x.shape
     nh, nkv, hd = cfg.heads, cfg.n_kv, cfg.head_dim
 
-    def heads(proj, n):
-        return (x @ proj).reshape(b, t, n, hd).transpose(0, 2, 1, 3)
+    if cfg.fuse_projections:
+        wqkv = jnp.concatenate([p["wq"], p["wk"], p["wv"]], axis=1)
+        qkv = x @ wqkv
+        q_flat, k_flat, v_flat = jnp.split(
+            qkv, [nh * hd, nh * hd + nkv * hd], axis=-1
+        )
 
-    q = _rope(heads(p["wq"], nh), positions, cfg.rope_theta)
-    k = _rope(heads(p["wk"], nkv), positions, cfg.rope_theta)
-    v = heads(p["wv"], nkv)
+        def split_heads(y, n):
+            return y.reshape(b, t, n, hd).transpose(0, 2, 1, 3)
+
+        q = _rope(split_heads(q_flat, nh), positions, cfg.rope_theta)
+        k = _rope(split_heads(k_flat, nkv), positions, cfg.rope_theta)
+        v = split_heads(v_flat, nkv)
+    else:
+
+        def heads(proj, n):
+            return (x @ proj).reshape(b, t, n, hd).transpose(0, 2, 1, 3)
+
+        q = _rope(heads(p["wq"], nh), positions, cfg.rope_theta)
+        k = _rope(heads(p["wk"], nkv), positions, cfg.rope_theta)
+        v = heads(p["wv"], nkv)
     if repeat_kv and nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=1)
         v = jnp.repeat(v, nh // nkv, axis=1)
@@ -132,7 +154,12 @@ def _attention(x, p, cfg: GPTConfig, positions, mesh):
 def _block(x, p, cfg: GPTConfig, positions, mesh):
     x = x + _attention(_rmsnorm(x, p["ln1"]), p, cfg, positions, mesh)
     y = _rmsnorm(x, p["ln2"])
-    y = (jax.nn.silu(y @ p["w_gate"]) * (y @ p["w_up"])) @ p["w_down"]
+    if cfg.fuse_projections:
+        gate_up = y @ jnp.concatenate([p["w_gate"], p["w_up"]], axis=1)
+        g, u = jnp.split(gate_up, 2, axis=-1)
+        y = (jax.nn.silu(g) * u) @ p["w_down"]
+    else:
+        y = (jax.nn.silu(y @ p["w_gate"]) * (y @ p["w_up"])) @ p["w_down"]
     return x + y
 
 

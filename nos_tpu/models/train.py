@@ -28,6 +28,10 @@ class TrainConfig:
     learning_rate: float = 3e-4
     weight_decay: float = 0.01
     grad_clip: float = 1.0
+    # Sequence chunk for the vocabulary-projection loss (see gpt_loss):
+    # bounds peak logits memory at batch x loss_chunk x vocab while the
+    # scan's rematerialization keeps the backward from re-reading them.
+    loss_chunk: int = 256
 
 
 def make_optimizer(cfg: TrainConfig):
@@ -53,7 +57,7 @@ def make_train_step(cfg: TrainConfig, mesh: Optional[Mesh] = None):
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: gpt_loss(p, tokens, cfg.model, mesh)
+            lambda p: gpt_loss(p, tokens, cfg.model, mesh, loss_chunk=cfg.loss_chunk)
         )(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -165,6 +165,23 @@ def moe_apply(
         "w_out": P(axis_name),
     }
 
+    if not return_aux:
+        # Inference path: no aux output at all — the pmean collectives the
+        # aux mean needs would otherwise run on every call (ADVICE r4), and
+        # the local aux arithmetic left behind is dead code XLA eliminates.
+        def local_y(p, xx):
+            bb, tt = xx.shape[0], xx.shape[1]
+            flat = xx.reshape(bb * tt, h)
+            y, _ = _moe_local(p, flat, axis_name, n_experts, capacity, top_k)
+            return y.reshape(bb, tt, h)
+
+        return shard_map(
+            local_y,
+            mesh=mesh,
+            in_specs=(param_specs, data_spec),
+            out_specs=data_spec,
+        )(params, x)
+
     def local(p, xx):
         bb, tt = xx.shape[0], xx.shape[1]
         flat = xx.reshape(bb * tt, h)
@@ -182,5 +199,4 @@ def moe_apply(
         in_specs=(param_specs, data_spec),
         out_specs=(data_spec, P()),
     )
-    y, aux = fn(params, x)
-    return (y, aux) if return_aux else y
+    return fn(params, x)

@@ -25,17 +25,20 @@ if not os.environ.get("NOS_TPU_TEST_ON_TPU"):
 
 # -- multi-device gating ------------------------------------------------------
 # Modules whose tests construct multi-device meshes (dp/tp/sp/pp/ep, the
-# virtual 8-device CPU fabric). Under NOS_TPU_TEST_ON_TPU=1 on a single-chip
-# host there is exactly ONE device, so these cannot build their meshes —
+# virtual 8-device CPU fabric) declare `pytestmark = pytest.mark.multidevice`
+# so the gate travels WITH the tests (ADVICE r4: a hand-maintained name list
+# here silently rots). Under NOS_TPU_TEST_ON_TPU=1 on a single-chip host
+# there is exactly ONE device, so marked modules cannot build their meshes —
 # they SKIP (the sharding semantics they pin are identical on the virtual
 # mesh; a multi-chip TPU host runs them for real).
-_MULTI_DEVICE_MODULES = {
-    "test_workload_plane",
-    "test_pipeline_moe",
-    "test_tpu_mesh",
-    "test_checkpoint",
-    "test_data_pipeline",
-}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multidevice: test builds a multi-device mesh; skipped when fewer "
+        "than 8 devices are visible (single-chip accelerator runs)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -49,5 +52,5 @@ def pytest_collection_modifyitems(config, items):
         f"{jax.device_count()} (single-chip NOS_TPU_TEST_ON_TPU run)"
     )
     for item in items:
-        if item.module.__name__ in _MULTI_DEVICE_MODULES:
+        if item.get_closest_marker("multidevice") is not None:
             item.add_marker(skip)

@@ -5,6 +5,8 @@ state."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.multidevice  # needs the 8-device virtual mesh
+
 import jax
 
 from nos_tpu.models.checkpoint import latest_step, restore_checkpoint, save_checkpoint

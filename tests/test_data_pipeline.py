@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.multidevice  # needs the 8-device virtual mesh
 from jax.sharding import Mesh, PartitionSpec as P
 
 from nos_tpu.models.data import (

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.multidevice  # needs the 8-device virtual mesh
+
 import jax
 import jax.numpy as jnp
 

@@ -2,6 +2,8 @@
 
 import pytest
 
+pytestmark = pytest.mark.multidevice  # needs the 8-device virtual mesh
+
 from nos_tpu.tpu import Profile, Topology, TpuMesh
 
 

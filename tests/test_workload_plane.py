@@ -4,6 +4,8 @@ construction, ring attention vs reference, models, sharded train step."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.multidevice  # needs the 8-device virtual mesh
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
