@@ -57,7 +57,21 @@ def _scan_walls(jax, step_fn, length: int, repeats: int = 5, operands=()):
     step_fn(carry, operands) — never a closure constant: closed-over arrays
     are serialized into the compiled program, and a large model's params +
     optimizer state blow past the remote-compile payload limit (observed:
-    HTTP 413 at the 167M-param wide config)."""
+    HTTP 413 at the 167M-param wide config).
+
+    Each timed repeat FETCHES the scalar result (float(...)) rather than
+    calling block_until_ready, and perturbs the carry input per repeat.
+    Measured necessity, not style: on the remote-dispatch tunnel,
+    block_until_ready returns when the dispatch queue flushes — NOT when
+    the remote execution finishes — so short programs that fit in the
+    pipeline time at ~0 ms until backpressure kicks in (this is the
+    mechanism behind the r4 artifact's physically impossible flash_ms
+    0.000). A value fetch is a synchronous round trip that cannot be
+    pipelined away; the fetch RTT is a constant both scan lengths pay, so
+    the long-minus-short differencing cancels it. The per-repeat carry
+    perturbation (numerically invisible: it enters the computation at the
+    1e-12-relative level) guarantees distinct request bytes, so no layer
+    of the stack can serve a memoized result."""
 
     def scanned(carry, operands):
         def body(c, _):
@@ -68,12 +82,12 @@ def _scan_walls(jax, step_fn, length: int, repeats: int = 5, operands=()):
     f = jax.jit(scanned)
     import jax.numpy as jnp
 
-    carry0 = jnp.float32(0.0)
-    f(carry0, operands).block_until_ready()  # compile
+    float(f(jnp.float32(0.0), operands))  # compile + full fetch
     walls = []
-    for _ in range(repeats):
+    for i in range(repeats):
+        carry_i = jnp.float32((i + 1) * 1e-6)
         t0 = time.perf_counter()
-        f(carry0, operands).block_until_ready()
+        float(f(carry_i, operands))
         walls.append(time.perf_counter() - t0)
     walls.sort()
     return walls[0], walls[min(1, len(walls) - 1)]
@@ -204,15 +218,23 @@ def vit_batch_mfu(batch: int = 7, scan_length: int = 128, **kw) -> Optional[dict
 def gpt_train_mfu(
     batch: int = 8, seq: Optional[int] = None, cfg=None, **kw
 ) -> Optional[dict]:
-    """MFU of the GPT training step (fwd + bwd + optimizer). Default: the
-    bench's single-chip config; pass a TrainConfig to measure a variant
-    (hack/mfu_experiments.py uses this to A/B the perf levers)."""
+    """MFU of the GPT training step (fwd + bwd + optimizer) at the flagship
+    single-chip bench config: hidden 1024 x 8 layers (~167M params), batch
+    8 x seq 2048. Width chosen by measurement, not taste (r5 lever sweep,
+    hack/mfu_experiments.py): the old hidden-512/4-layer config topped out
+    at ~42-43% MFU with every software lever flat (loss-chunk sizes, fused
+    projections, batch 16 — all within noise), while 1024x8 measures ~62%
+    on v5e — the small config was arithmetic-intensity-bound, exactly as
+    docs/benchmark.md:256 suspected, not software-bound. The analytic FLOP
+    numerator (gpt_train_flops: causal, remat-excluded) is unchanged.
+    Pass a TrainConfig to measure a variant."""
     import jax
     import jax.numpy as jnp
 
+    from nos_tpu.models.gpt import GPTConfig
     from nos_tpu.models.train import TrainConfig, init_train_state, make_train_step
 
-    cfg = cfg or TrainConfig()
+    cfg = cfg or TrainConfig(model=GPTConfig(hidden=1024, layers=8))
     seq = seq or cfg.model.max_seq
     params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg)
     step_fn = make_train_step(cfg)
