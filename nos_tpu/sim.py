@@ -146,6 +146,7 @@ class _TraceRunner:
         tick_s: float = 1.0,
         max_s: float = 86_400.0,
         measure_window: Optional[Tuple[float, float]] = None,
+        on_tick=None,
     ) -> SimReport:
         """Drive the trace to completion (or `max_s`). `measure_window`
         bounds the steady-state utilization metric: a finite trace always has
@@ -263,6 +264,10 @@ class _TraceRunner:
                 backlog_seconds += tick_s
             if measure_window and measure_window[0] <= now < measure_window[1]:
                 used_chip_seconds_window += tick_used * tick_s
+            if on_tick is not None:
+                # Diagnostic probe (per-tick utilization trajectory): now,
+                # chips in use, the unbound job-name set, the running map.
+                on_tick(now, tick_used, unbound, running)
             # Done once every job has completed.
             if not pending_arrivals and not running and completed_count == len(records):
                 break
@@ -713,6 +718,39 @@ def simulate_north_star_multihost(
         checkpointable_fraction=checkpointable_fraction,
     )
     return sim.run(jobs, tick_s=tick_s, measure_window=measure_window)
+
+
+def cli_single_host_trace(
+    n_jobs: int = 200,
+    seed: int = 0,
+    topology: str = "8x8",
+    generation_label: str = "tpu-v5-lite-podslice",
+    mean_interarrival_s: float = 2.0,
+    duration_range_s: Tuple[float, float] = (60.0, 600.0),
+    checkpointable_fraction: float = 0.0,
+) -> List[SimJob]:
+    """THE trace behind `python -m nos_tpu.cli simulate` (no flags): every
+    sub-slice the node topology supports, weighted toward the small end.
+    One definition shared by the CLI and the oracle/CI tests — a diverging
+    re-construction is exactly how the r4 doc-table/CLI mismatch happened
+    on the multihost side."""
+    from nos_tpu.tpu import Topology
+    from nos_tpu.tpu.topology import _ACCELERATOR_GENERATIONS
+
+    generation = _ACCELERATOR_GENERATIONS[generation_label]
+    allowed = Topology.parse(generation, topology).allowed_profiles
+    weights = [2.0 ** -i for i in range(len(allowed))]
+    profiles = tuple(
+        (p.name, w / sum(weights)) for p, w in zip(allowed, weights)
+    )
+    return mixed_workload(
+        n_jobs,
+        seed=seed,
+        profiles=profiles,
+        mean_interarrival_s=mean_interarrival_s,
+        duration_range_s=duration_range_s,
+        checkpointable_fraction=checkpointable_fraction,
+    )
 
 
 def simulate_north_star(
