@@ -149,10 +149,47 @@ class SchedulerConfig:
     checkpoint_victim_cooldown_s: float = 300.0
     checkpoint_victim_budget: int = 3
     checkpoint_victim_window_s: float = 3600.0
+    # Versioned plugin-args documents (KubeSchedulerConfiguration
+    # pluginConfig analog): each entry carries apiVersion/kind and decodes
+    # through api/scheduler_args.py's scheme (defaulting + conversion into
+    # the internal args type) — the reference's v1beta3
+    # CapacitySchedulingArgs wire contract. Fields a document EXPLICITLY
+    # sets override the flat memory knobs above; omitted fields leave them
+    # alone (the flat knobs are the baseline, so v1beta3 defaulting must
+    # not clobber an operator's explicit tpu_chip_memory_gb just because
+    # the doc only mentioned the GPU one). Applied in __post_init__ so
+    # programmatic construction and load_config behave identically;
+    # validate() stays pure (decode-and-check only).
+    plugin_config: List[dict] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        from nos_tpu.api.scheduler_args import (
+            CapacitySchedulingArgsV1Beta3,
+            PluginArgsError,
+            decode_plugin_args,
+        )
+
+        for doc in self.plugin_config:
+            try:
+                internal = decode_plugin_args(doc)  # validates fully
+                explicit = CapacitySchedulingArgsV1Beta3.from_doc(doc)
+            except PluginArgsError as e:
+                raise ConfigError(f"plugin_config: {e}") from e
+            if explicit.tpu_chip_memory_gb is not None:
+                self.tpu_chip_memory_gb = internal.tpu_chip_memory_gb
+            if explicit.nvidia_gpu_resource_memory_gb is not None:
+                self.nvidia_gpu_memory_gb = internal.nvidia_gpu_resource_memory_gb
 
     def validate(self) -> None:
         if not self.scheduler_name:
             raise ConfigError("scheduler_name must be non-empty")
+        from nos_tpu.api.scheduler_args import PluginArgsError, decode_plugin_args
+
+        for doc in self.plugin_config:
+            try:
+                decode_plugin_args(doc)
+            except PluginArgsError as e:
+                raise ConfigError(f"plugin_config: {e}") from e
         if self.queue_policy not in ("fifo", "aged-swf"):
             raise ConfigError("queue_policy must be 'fifo' or 'aged-swf'")
         if self.swf_aging_chips < 0:

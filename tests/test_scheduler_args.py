@@ -1,0 +1,105 @@
+"""Versioned scheduler plugin-args (api/scheduler_args.py): the v1beta3
+decode -> default -> convert pipeline and its wiring into SchedulerConfig
+(reference pkg/api/scheduler/{types.go,v1beta3/} + generated conversions)."""
+
+import json
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.scheduler_args import (
+    KIND_CAPACITY,
+    V1BETA3,
+    CapacitySchedulingArgs,
+    PluginArgsError,
+    decode_plugin_args,
+    encode_plugin_args,
+)
+from nos_tpu.config import ConfigError, SchedulerConfig, load_config
+
+
+def _doc(**fields):
+    return {"apiVersion": V1BETA3, "kind": KIND_CAPACITY, **fields}
+
+
+def test_decode_with_overrides():
+    args = decode_plugin_args(
+        _doc(nvidiaGpuResourceMemoryGB=40, tpuChipMemoryGB=32)
+    )
+    assert args == CapacitySchedulingArgs(40.0, 32.0)
+
+
+def test_defaulting_fills_unset_pointers():
+    args = decode_plugin_args(_doc(nvidiaGpuResourceMemoryGB=24))
+    assert args.nvidia_gpu_resource_memory_gb == 24.0
+    assert args.tpu_chip_memory_gb == constants.DEFAULT_TPU_CHIP_MEMORY_GB
+    assert decode_plugin_args(_doc()) == CapacitySchedulingArgs()
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(PluginArgsError, match="unknown field"):
+        decode_plugin_args(_doc(nvidiaGpuMemoryGB=40))  # typo'd name
+
+
+def test_unknown_version_or_kind_rejected_with_supported_set():
+    with pytest.raises(PluginArgsError, match="supported"):
+        decode_plugin_args({"apiVersion": "kubescheduler.config.k8s.io/v1beta2",
+                            "kind": KIND_CAPACITY})
+    with pytest.raises(PluginArgsError, match="supported"):
+        decode_plugin_args(_doc() | {"kind": "ElasticQuotaArgs"})
+
+
+def test_invalid_values_rejected():
+    with pytest.raises(PluginArgsError, match="positive"):
+        decode_plugin_args(_doc(tpuChipMemoryGB=0))
+    with pytest.raises(PluginArgsError, match="not a number"):
+        decode_plugin_args(_doc(tpuChipMemoryGB="lots"))
+
+
+def test_round_trip():
+    args = CapacitySchedulingArgs(80.0, 16.0)
+    assert decode_plugin_args(encode_plugin_args(args)) == args
+
+
+def test_scheduler_config_applies_plugin_config(tmp_path):
+    path = tmp_path / "scheduler.json"
+    path.write_text(json.dumps({
+        "plugin_config": [
+            _doc(nvidiaGpuResourceMemoryGB=40, tpuChipMemoryGB=24)
+        ]
+    }))
+    cfg = load_config(SchedulerConfig, str(path))
+    assert cfg.nvidia_gpu_memory_gb == 40.0
+    assert cfg.tpu_chip_memory_gb == 24.0
+
+
+def test_scheduler_config_rejects_bad_plugin_config(tmp_path):
+    path = tmp_path / "scheduler.json"
+    path.write_text(json.dumps({
+        "plugin_config": [{"apiVersion": "nope/v1", "kind": "What"}]
+    }))
+    with pytest.raises(ConfigError, match="plugin_config"):
+        load_config(SchedulerConfig, str(path))
+
+
+def test_plugin_config_does_not_clobber_explicit_flat_knobs(tmp_path):
+    """A doc that only sets the GPU field must not reset an explicitly
+    configured tpu_chip_memory_gb to the built-in default via v1beta3
+    defaulting (explicit-fields-only override)."""
+    path = tmp_path / "scheduler.json"
+    path.write_text(json.dumps({
+        "tpu_chip_memory_gb": 32,
+        "plugin_config": [_doc(nvidiaGpuResourceMemoryGB=40)],
+    }))
+    cfg = load_config(SchedulerConfig, str(path))
+    assert cfg.tpu_chip_memory_gb == 32.0
+    assert cfg.nvidia_gpu_memory_gb == 40.0
+
+
+def test_plugin_config_applies_on_programmatic_construction():
+    """Direct SchedulerConfig(...) construction (no load_config/validate)
+    must honor plugin_config too — it applies in __post_init__."""
+    cfg = SchedulerConfig(plugin_config=[_doc(tpuChipMemoryGB=24)])
+    assert cfg.tpu_chip_memory_gb == 24.0
+    with pytest.raises(ConfigError, match="plugin_config"):
+        SchedulerConfig(plugin_config=[{"apiVersion": "nope/v1", "kind": "X"}])
