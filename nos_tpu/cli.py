@@ -6,7 +6,7 @@ metricsexporter; SURVEY.md §2.1).
     python -m nos_tpu.cli scheduler       --config scheduler.yaml
     python -m nos_tpu.cli partitioner     --config partitioner.yaml
     python -m nos_tpu.cli tpu-agent       --node <name>
-    python -m nos_tpu.cli gpu-agent       --node <name> --mode mig|mps
+    python -m nos_tpu.cli gpu-agent       --node <name> --mode mig|mps|hybrid
     python -m nos_tpu.cli telemetry       [--share]
     python -m nos_tpu.cli demo            # single-process full system demo
     python -m nos_tpu.cli simulate        # north-star capacity simulation
@@ -301,12 +301,17 @@ def cmd_gpu_agent(args) -> int:
     from nos_tpu.system import build_gpu_agent
 
     cluster = _make_cluster(args)
+    # Both identity knobs pass through; build_gpu_agent picks per mode.
+    # (The previous `args.model or args.memory_gb` was a latent bug: --model
+    # has a non-empty default, so the mps agent always received the model
+    # STRING and died in int() at startup.)
     agent = build_gpu_agent(
         cluster,
         node_name,
         args.mode,
         args.gpus,
-        args.model or args.memory_gb,
+        model=args.model,
+        memory_gb=args.memory_gb,
         pod_resources_socket=args.pod_resources_socket,
     )
     agent.startup()
@@ -566,7 +571,7 @@ def main(argv=None) -> int:
         default=None,
         help="kubelet pod-resources gRPC socket for device accounting",
     )
-    p_gpu.add_argument("--mode", choices=["mig", "mps"], default="mig")
+    p_gpu.add_argument("--mode", choices=["mig", "mps", "hybrid"], default="mig")
     p_gpu.add_argument("--gpus", type=int, default=1)
     p_gpu.add_argument("--model", default="NVIDIA-A100-PCIE-40GB")
     p_gpu.add_argument("--memory-gb", type=int, default=40)

@@ -404,15 +404,19 @@ def build_gpu_agent(
     node_name: str,
     mode: str,
     gpu_count: int,
-    model_or_memory,
+    model: str = "NVIDIA-A100-PCIE-40GB",
+    memory_gb: int = constants.DEFAULT_GPU_MEMORY_GB,
     with_fake_device_plugin: bool = True,
     pod_resources_socket: Optional[str] = None,
 ) -> GpuAgent:
-    """MIG/MPS node agent over the fake device layer (real NVML/CUDA-MPS
-    backends would slot in behind the same client interface). By default a
-    fake device-plugin DaemonSet (one per cluster bus) backs the post-apply
-    plugin restart; pass with_fake_device_plugin=False when a real DaemonSet
-    manages the plugin pods."""
+    """MIG/MPS/hybrid node agent over the fake device layer (real
+    NVML/CUDA-MPS backends would slot in behind the same client interface).
+    Device identity is per mode — mig validates against `model`'s geometry
+    menus, mps against the `memory_gb` budget, hybrid against both — and
+    the selection lives HERE, once, so callers never special-case modes.
+    By default a fake device-plugin DaemonSet (one per cluster bus) backs
+    the post-apply plugin restart; pass with_fake_device_plugin=False when
+    a real DaemonSet manages the plugin pods."""
     from nos_tpu.gpu.device_plugin import DevicePluginClient, ensure_fake_daemonset
 
     if with_fake_device_plugin:
@@ -420,7 +424,7 @@ def build_gpu_agent(
     plugin_client = DevicePluginClient(cluster)
     lister = _pod_resources_lister(pod_resources_socket)
     if mode == constants.KIND_MIG:
-        client = FakeGpuDeviceClient(gpu_count, mig_validator(model_or_memory))
+        client = FakeGpuDeviceClient(gpu_count, mig_validator(model))
         return GpuAgent(
             cluster,
             node_name,
@@ -429,16 +433,15 @@ def build_gpu_agent(
             pod_resources_lister=lister,
         )
     if mode == constants.KIND_HYBRID:
-        # model_or_memory: (gpu model, memory GB) — the node serves MIG and
-        # MPS slices simultaneously (constants.KIND_HYBRID), so the agent
-        # validates both modes' rules and maps both resource namespaces.
+        # The node serves MIG and MPS slices simultaneously
+        # (constants.KIND_HYBRID), so the agent validates both modes'
+        # rules and maps both resource namespaces.
         from nos_tpu.controllers.gpu_agent import (
             hybrid_parse_profile,
             hybrid_resource_of,
             hybrid_validator,
         )
 
-        model, memory_gb = model_or_memory
         client = FakeGpuDeviceClient(
             gpu_count, hybrid_validator(model, int(memory_gb))
         )
@@ -451,7 +454,7 @@ def build_gpu_agent(
             plugin_client=plugin_client,
             pod_resources_lister=lister,
         )
-    client = FakeGpuDeviceClient(gpu_count, mps_validator(int(model_or_memory)))
+    client = FakeGpuDeviceClient(gpu_count, mps_validator(int(memory_gb)))
     return GpuAgent(
         cluster,
         node_name,

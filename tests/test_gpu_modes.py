@@ -657,3 +657,18 @@ def test_infeasible_node_geometry_skipped_not_fatal():
     names = {n.name for n in snap.get_candidate_nodes()}
     assert "healthy" in names
     assert "stale" not in names
+
+
+def test_cli_gpu_agent_modes_start():
+    """`gpu-agent --mode mig|mps|hybrid --once` builds the right agent and
+    completes one report cycle over the bus. Pins the per-mode device
+    identity plumbing — `--mode mps` used to hand the agent the MODEL
+    string (--model has a non-empty default) and die in int() at startup;
+    hybrid takes (model, memory)."""
+    from nos_tpu import cli
+
+    for mode in ("mig", "mps", "hybrid"):
+        rc = cli.main([
+            "gpu-agent", "--node", f"{mode}-node", "--mode", mode, "--once",
+        ])
+        assert rc == 0, mode
