@@ -113,6 +113,8 @@ EXPERIMENTS = {
     "wide_fused_chunk512": lambda: run_gpt(
         "wide_fused_chunk512", hidden=1024, layers=8, fused=True, loss_chunk=512
     ),
+    "xl8": lambda: run_gpt("xl8", hidden=2048, layers=8),
+    "xl12": lambda: run_gpt("xl12", hidden=2048, layers=12),
     "batch16": lambda: run_gpt("batch16", batch=16),
     "batch16_fused_chunk512": lambda: run_gpt(
         "batch16_fused_chunk512", batch=16, fused=True, loss_chunk=512

@@ -7,8 +7,8 @@ the backward recomputes probabilities from the saved log-sum-exp
 (FlashAttention-2), so the T x T score matrix exists in neither direction.
 On this project's v5e training shape the pair turned the GPT train step
 from 85.6 ms (XLA-reference backward) to 44.7 ms — 21.8% -> 41.7% MFU at
-the round-4 512-wide config; the round-5 1024-wide flagship config runs
-63.0% on the same kernels (causal-convention numerator, runtime/mfu.py;
+the round-4 512-wide config; the round-5 2048-wide flagship config runs
+71.3% on the same kernels (causal-convention numerator, runtime/mfu.py;
 docs/benchmark.md).
 
 On non-TPU backends (tests run on a CPU mesh) the reference XLA path is used;

@@ -222,22 +222,24 @@ def gpt_train_mfu(
     batch: int = 8, seq: Optional[int] = None, cfg=None, **kw
 ) -> Optional[dict]:
     """MFU of the GPT training step (fwd + bwd + optimizer) at the flagship
-    single-chip bench config: hidden 1024 x 8 layers (~167M params), batch
+    single-chip bench config: hidden 2048 x 8 layers (~600M params), batch
     8 x seq 2048. Width chosen by measurement, not taste (r5 lever sweep,
     hack/mfu_experiments.py): the old hidden-512/4-layer config topped out
     at ~42-43% MFU with every software lever flat (loss-chunk sizes, fused
-    projections, batch 16 — all within noise), while 1024x8 measures ~62%
-    on v5e — the small config was arithmetic-intensity-bound, exactly as
-    docs/benchmark.md:256 suspected, not software-bound. The analytic FLOP
-    numerator (gpt_train_flops: causal, remat-excluded) is unchanged.
-    Pass a TrainConfig to measure a variant."""
+    projections, batch 16 — all within noise) — arithmetic-intensity-bound,
+    exactly as docs/benchmark.md:256 suspected. The width ladder on v5e:
+    512 -> 42.7%, 1024 -> 63.1%, 2048 -> 71.3% (step 445 ms); 2048x12 OOMs
+    (16.7 G > 15.75 G HBM — per-block remat would fit it but its recompute
+    is excluded from the numerator, so it would only read LOWER). The
+    analytic FLOP numerator (gpt_train_flops: causal, remat-excluded) is
+    unchanged across the ladder. Pass a TrainConfig to measure a variant."""
     import jax
     import jax.numpy as jnp
 
     from nos_tpu.models.gpt import GPTConfig
     from nos_tpu.models.train import TrainConfig, init_train_state, make_train_step
 
-    cfg = cfg or TrainConfig(model=GPTConfig(hidden=1024, layers=8))
+    cfg = cfg or TrainConfig(model=GPTConfig(hidden=2048, layers=8))
     seq = seq or cfg.model.max_seq
     params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg)
     step_fn = make_train_step(cfg)
