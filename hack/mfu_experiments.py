@@ -28,12 +28,15 @@ def run_flash():
     _emit("flash", out)
 
 
-def _train_cfg(loss_chunk=256, fused=False, hidden=512, layers=4):
+def _train_cfg(loss_chunk=256, fused=False, hidden=512, layers=4, remat=False):
     from nos_tpu.models.gpt import GPTConfig
     from nos_tpu.models.train import TrainConfig
 
     return TrainConfig(
-        model=GPTConfig(hidden=hidden, layers=layers, fuse_projections=fused),
+        model=GPTConfig(
+            hidden=hidden, layers=layers, fuse_projections=fused,
+            remat_blocks=remat,
+        ),
         loss_chunk=loss_chunk,
     )
 
@@ -115,6 +118,7 @@ EXPERIMENTS = {
     ),
     "xl8": lambda: run_gpt("xl8", hidden=2048, layers=8),
     "xl12": lambda: run_gpt("xl12", hidden=2048, layers=12),
+    "xl12_remat": lambda: run_gpt("xl12_remat", hidden=2048, layers=12, remat=True),
     "batch16": lambda: run_gpt("batch16", batch=16),
     "batch16_fused_chunk512": lambda: run_gpt(
         "batch16_fused_chunk512", batch=16, fused=True, loss_chunk=512

@@ -37,6 +37,14 @@ class GPTConfig:
     # default on meshes: concatenating tp-sharded weights inside pjit can
     # force reshards, so the sharded train path opts in explicitly.
     fuse_projections: bool = False
+    # jax.checkpoint each transformer block: the backward recomputes block
+    # activations instead of storing them — FLOPs for HBM, the standard
+    # single-chip memory lever. Measured necessity on v5e (r5): 2048-hidden
+    # x 12 layers OOMs without it (16.7 G > 15.75 G HBM, the bf16 MLP
+    # activations dominating) and trains WITH it. Reported MFU drops
+    # honestly when enabled — the numerator (runtime/mfu.py
+    # gpt_train_flops) deliberately excludes recompute.
+    remat_blocks: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -168,8 +176,17 @@ def gpt_hidden(params, tokens, cfg: GPTConfig, mesh=None):
     b, t = tokens.shape
     x = params["tok_emb"][tokens]
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-    for i in range(cfg.layers):
-        x = _block(x, params["layers"][str(i)], cfg, positions, mesh)
+    if cfg.remat_blocks:
+
+        def run_block(x, p, positions):
+            return _block(x, p, cfg, positions, mesh)
+
+        run_block = jax.checkpoint(run_block)
+        for i in range(cfg.layers):
+            x = run_block(x, params["layers"][str(i)], positions)
+    else:
+        for i in range(cfg.layers):
+            x = _block(x, params["layers"][str(i)], cfg, positions, mesh)
     return _rmsnorm(x, params["ln_f"])
 
 
