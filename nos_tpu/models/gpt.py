@@ -176,17 +176,13 @@ def gpt_hidden(params, tokens, cfg: GPTConfig, mesh=None):
     b, t = tokens.shape
     x = params["tok_emb"][tokens]
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    def run_block(x, p, positions):
+        return _block(x, p, cfg, positions, mesh)
+
     if cfg.remat_blocks:
-
-        def run_block(x, p, positions):
-            return _block(x, p, cfg, positions, mesh)
-
         run_block = jax.checkpoint(run_block)
-        for i in range(cfg.layers):
-            x = run_block(x, params["layers"][str(i)], positions)
-    else:
-        for i in range(cfg.layers):
-            x = _block(x, params["layers"][str(i)], cfg, positions, mesh)
+    for i in range(cfg.layers):
+        x = run_block(x, params["layers"][str(i)], positions)
     return _rmsnorm(x, params["ln_f"])
 
 
