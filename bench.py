@@ -128,7 +128,10 @@ def _decode_phase(jax, jnp) -> dict:
     Scenarios mirror docs/benchmark.md's serving table: the 512-hidden /
     8-layer GQA decoder, 16-token prompts / 32 new at 1 and 8 streams
     (K=16 macro-stepping), one 4k-context point, and the speculative
-    on/off A/B on repetitive 8-stream traffic (VERDICT r4 #4)."""
+    on/off A/B on repetitive SINGLE-stream traffic (VERDICT r4 #4;
+    measure(1, 1024, ...) below — one stream, so the A/B isolates the
+    speculating slot from the batch-wide neighbor penalty the
+    DecodeServer docstring discloses)."""
     import numpy as np
 
     from nos_tpu.models.gpt import GPTConfig, init_gpt

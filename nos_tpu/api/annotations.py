@@ -226,3 +226,54 @@ def parse_layout(value: Optional[str]) -> List[SliceLayoutEntry]:
 
 def get_layout(annotations: Mapping[str, str]) -> List[SliceLayoutEntry]:
     return parse_layout(annotations.get(constants.ANNOTATION_STATUS_LAYOUT))
+
+
+# -- migration holds (move protocol) ------------------------------------------
+def profile_of_resource(resource_name: str) -> Optional[str]:
+    """Extract the mode-agnostic profile name from a slice resource name
+    ("google.com/tpu-4x4" -> "4x4", "nvidia.com/mig-1g.5gb" -> "1g.5gb",
+    "nvidia.com/gpu-10gb" -> "10gb"); None for non-slice resources."""
+    m = constants.RESOURCE_TPU_SLICE_REGEX.match(resource_name)
+    if m:
+        return m.group(1)
+    m = constants.RESOURCE_MIG_REGEX.match(resource_name)
+    if m:
+        return resource_name[len(constants.RESOURCE_MIG_PREFIX):]
+    m = constants.RESOURCE_MPS_REGEX.match(resource_name)
+    if m:
+        return f"{m.group(1)}gb"
+    return None
+
+
+def format_migration_hold(holds: Mapping[str, int]) -> str:
+    """"<profile>:<count>[,...]" sorted, zero/negative counts dropped; ""
+    when nothing is held (the caller then removes the annotation)."""
+    return ",".join(
+        f"{profile}:{count}"
+        for profile, count in sorted(holds.items())
+        if count > 0
+    )
+
+
+def parse_migration_hold(value: Optional[str]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    if not value:
+        return out
+    for part in value.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        profile, _, count_s = part.rpartition(":")
+        try:
+            count = int(count_s)
+        except ValueError:
+            continue
+        if profile and count > 0:
+            out[profile] = out.get(profile, 0) + count
+    return out
+
+
+def get_migration_hold(annotations: Mapping[str, str]) -> Dict[str, int]:
+    return parse_migration_hold(
+        annotations.get(constants.ANNOTATION_MIGRATION_HOLD)
+    )

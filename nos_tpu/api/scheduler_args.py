@@ -70,10 +70,21 @@ class CapacitySchedulingArgsV1Beta3:
                     f"unknown field {key!r} for {KIND_CAPACITY} {V1BETA3} "
                     f"(known: {sorted(cls._FIELDS)})"
                 )
-            try:
-                setattr(args, attr, float(value))
-            except (TypeError, ValueError) as e:
-                raise PluginArgsError(f"field {key!r}: {value!r} is not a number") from e
+            # The reference wire type is *int64 (scheduler args codegen):
+            # YAML booleans are a distinct type there, so `true` must be a
+            # decode error — Python's bool subclasses int and float(True)
+            # would silently yield 1.0. Strings are likewise rejected (the
+            # YAML loader already gives numbers for numeric scalars; a
+            # string reaching here is a quoted typo), and non-finite floats
+            # (inf/nan survive float() untouched) fail the same check.
+            import math
+
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                raise PluginArgsError(f"field {key!r}: {value!r} is not a number")
+            number = float(value)
+            if not math.isfinite(number):
+                raise PluginArgsError(f"field {key!r}: {value!r} is not finite")
+            setattr(args, attr, number)
         return args
 
 

@@ -443,7 +443,11 @@ def cmd_simulate(args) -> int:
     topos = {}
     for i in range(args.nodes):
         topos[f"tpu-node-{i}"] = args.topology
-    sim = WorkloadSim(topos=topos, generation_label=generation_label)
+    sim = WorkloadSim(
+        topos=topos,
+        generation_label=generation_label,
+        defrag_budget=args.defrag_budget if args.defrag else 0,
+    )
     sim.plane.scheduler.queue_policy = args.queue_policy
     from nos_tpu.sim import cli_single_host_trace
 
@@ -496,6 +500,7 @@ def _simulate_multihost(args) -> int:
     sim = MultiHostSim(
         groups={group_name: (args.topology, args.host_topology, grid)},
         generation_label=args.generation,
+        defrag_budget=args.defrag_budget if args.defrag else 0,
     )
     sim.plane.scheduler.queue_policy = args.queue_policy
     jobs = mixed_gang_workload(
@@ -620,6 +625,20 @@ def main(argv=None) -> int:
     p_sim.add_argument("--window-start", type=float, default=180.0)
     p_sim.add_argument("--window-end", type=float, default=900.0)
     p_sim.add_argument("--max-seconds", type=float, default=86400.0)
+    p_sim.add_argument(
+        "--defrag",
+        action="store_true",
+        help="arm the defragmentation pass: once the add-only replan "
+        "saturates, the planner may migrate small running slices "
+        "(checkpoint-resumable gangs in --multihost mode) so freed "
+        "fragments coalesce for stranded large workloads",
+    )
+    p_sim.add_argument(
+        "--defrag-budget",
+        type=int,
+        default=1,
+        help="slice migrations allowed per plan window when --defrag is set",
+    )
     p_sim.add_argument(
         "--multihost",
         action="store_true",

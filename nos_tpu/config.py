@@ -59,6 +59,17 @@ class PartitionerConfig:
     # Per-model MIG geometry overrides (knownMigGeometries analog):
     # {"NVIDIA-A100-PCIE-40GB": [{"1g.5gb": 7}, ...]}
     known_mig_geometries: Dict[str, List[Dict[str, int]]] = field(default_factory=dict)
+    # Defragmentation: slice migrations the planner may schedule per plan
+    # window once the add-only search saturates (0 disables — the
+    # reference's behavior). Each migration drains one small mover into a
+    # pre-created destination slice so the freed fragments coalesce for a
+    # stranded pod; `migration_hold_s` bounds how long the destination
+    # reservation survives a mover that never rebinds.
+    defrag_budget: int = 0
+    migration_hold_s: float = 120.0
+    # A gang must have been stranded this long before defrag may move a
+    # running workload for it — transient backlogs resolve by natural drains.
+    defrag_after_s: float = 120.0
     # After a stranded pod waits this long, consolidation may drain a node of
     # ALL-checkpointable victims without the provable-rebind guarantee (they
     # resume from checkpoint). None disables; only fires for pods annotated
@@ -83,6 +94,12 @@ class PartitionerConfig:
             # 0 means "immediately eligible"; negative is a typo that would
             # also pin the resync age gate permanently open.
             raise ConfigError("checkpoint_preempt_after_s must be >= 0 or null")
+        if self.defrag_budget < 0:
+            raise ConfigError("defrag_budget must be >= 0")
+        if self.migration_hold_s <= 0:
+            raise ConfigError("migration_hold_s must be positive")
+        if self.defrag_after_s < 0:
+            raise ConfigError("defrag_after_s must be >= 0")
         if self.checkpoint_min_gain_s < 0:
             raise ConfigError("checkpoint_min_gain_s must be >= 0")
         if self.checkpoint_victim_cooldown_s < 0:

@@ -127,6 +127,14 @@ ANNOTATION_BOUND_AT = f"{DOMAIN}/bound-at"
 # guarantee when a stranded pod has aged past the configured threshold —
 # eviction costs a requeue, not lost work.
 ANNOTATION_CHECKPOINTABLE = f"{DOMAIN}/checkpointable"
+# In-flight slice-migration hold (move protocol, written by the partitioner
+# controller on a migration's DESTINATION node): "<profile>:<count>[,...]".
+# The node agents' delete ladders treat up to <count> free slices of each
+# held profile as undeletable — delete-free-first extended to moves, so a
+# replan racing the mover's rebind can't tear down the destination slice the
+# drain already depends on. Cleared when the mover rebinds or the
+# reservation expires.
+ANNOTATION_MIGRATION_HOLD = f"{DOMAIN}/spec-migration-hold"
 
 ANNOTATION_SPEC_REGEX = re.compile(
     rf"^{re.escape(ANNOTATION_SPEC_PREFIX)}(\d+)-(.+)$"

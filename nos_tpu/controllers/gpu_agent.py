@@ -268,11 +268,12 @@ class GpuAgent:
             if s.quantity > 0:
                 desired[(s.device_index, s.profile)] = s.quantity
         self.sync_usage_from_pods()
+        holds = ann.get_migration_hold(node.metadata.annotations)
         # Mutation flag survives a mid-apply exception: devices already
         # deleted/created before the failure still require a plugin restart.
         self._apply_changed = False
         try:
-            self._apply(desired)
+            self._apply(desired, holds)
         except TpuLibError:
             logger.exception("gpuagent %s: apply failed; reporting actual state", self.node_name)
         changed = self._apply_changed
@@ -288,19 +289,27 @@ class GpuAgent:
         self.shared.on_apply()
         self.report()
 
-    def _apply(self, desired: Dict[Tuple[int, str], int]) -> None:
+    def _apply(
+        self,
+        desired: Dict[Tuple[int, str], int],
+        holds: Optional[Dict[str, int]] = None,
+    ) -> None:
         """Diff-apply the desired geometry; sets self._apply_changed when any
         device is created or deleted (the device plugin must then
         re-register) — a flag rather than a return value so mutations that
         precede a mid-apply failure still trigger the restart.
 
-        Per GPU: delete surplus free devices (never used ones), then create
-        the missing profiles. Device creation can be order-sensitive (MIG
-        placement constraints), so when creating we (a) also delete + recreate
-        the GPU's surviving *free* devices to widen the space of valid
-        creation orders (plan/plan.go:94-109 extractResourcesToRecreate) and
-        (b) try bounded distinct permutations of the creation order with
-        cleanup between attempts (nvml/client.go:225-340)."""
+        Per GPU: delete surplus free devices (never used ones — and never a
+        `holds`-protected free device: an in-flight migration's destination
+        counts as used until the mover rebinds, the delete-free-first ladder
+        extended to moves), then create the missing profiles. Device
+        creation can be order-sensitive (MIG placement constraints), so when
+        creating we (a) also delete + recreate the GPU's surviving *free*
+        devices to widen the space of valid creation orders
+        (plan/plan.go:94-109 extractResourcesToRecreate) and (b) try bounded
+        distinct permutations of the creation order with cleanup between
+        attempts (nvml/client.go:225-340)."""
+        holds = dict(holds or {})
         current: Dict[Tuple[int, str], List[GpuDevice]] = {}
         for d in self.client.list_devices():
             current.setdefault((d.gpu_index, d.profile), []).append(d)
@@ -308,13 +317,14 @@ class GpuAgent:
             {gi for gi, _ in current} | {gi for gi, _ in desired}
         )
         for gpu_index in gpu_indices:
-            # Delete surplus (free first, never used).
+            # Delete surplus (free first, never used, never held).
             for (gi, profile), devices in sorted(current.items()):
                 if gi != gpu_index:
                     continue
                 surplus = len(devices) - desired.get((gi, profile), 0)
                 free = [d for d in devices if not d.in_use]
-                for d in free[: max(0, surplus)]:
+                held = holds.get(profile, 0)
+                for d in free[held:held + max(0, surplus)]:
                     self.client.delete_device(d.device_id)
                     self._apply_changed = True
             # Creates still missing on this GPU.
@@ -328,9 +338,16 @@ class GpuAgent:
                     creates.extend([profile] * max(0, want - have.get(profile, 0)))
             if not creates:
                 continue
-            # Recreate surviving free devices alongside the new ones.
-            for d in self.client.list_devices():
+            # Recreate surviving free devices alongside the new ones; held
+            # devices stay put — a recreate window is a deletion window.
+            spare = dict(holds)
+            for d in sorted(
+                self.client.list_devices(), key=lambda d: d.device_id
+            ):
                 if d.gpu_index == gpu_index and not d.in_use:
+                    if spare.get(d.profile, 0) > 0:
+                        spare[d.profile] -= 1
+                        continue
                     self.client.delete_device(d.device_id)
                     creates.append(d.profile)
                     self._apply_changed = True

@@ -46,6 +46,8 @@ class PartitionerController:
         batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
         resync_s: float = constants.DEFAULT_PARTITIONER_RESYNC_S,
         enable_consolidation: bool = True,
+        defrag_budget: int = 0,
+        migration_hold_s: float = 120.0,
         checkpoint_preempt_after_s: float = 120.0,
         checkpoint_min_gain_s: float = 60.0,
         checkpoint_victim_cooldown_s: float = 300.0,
@@ -57,8 +59,18 @@ class PartitionerController:
         self.state = state
         self.kind = kind
         self.snapshot_taker = snapshot_taker
-        self.planner = Planner(sim_scheduler)
-        self.actuator = Actuator(partitioner, self._current_partitioning)
+        # defrag_budget > 0 arms the planner's slice-migration pass; each
+        # migration is actuated through the ordered move protocol and
+        # reserved in ClusterState for `migration_hold_s` so concurrent
+        # replans can't double-claim the destination before the mover
+        # rebinds (a lost mover lapses the reservation at expiry).
+        self.defrag_budget = defrag_budget
+        self.migration_hold_s = migration_hold_s
+        self.planner = Planner(sim_scheduler, defrag_budget=defrag_budget)
+        self.actuator = Actuator(
+            partitioner, self._current_partitioning, evict=self._evict
+        )
+        self._hold_nodes: set = set()  # nodes carrying our hold annotation
         import time as _time
 
         # Wall clock, NOT monotonic: pending-age math compares against pod
@@ -192,13 +204,80 @@ class PartitionerController:
             # resync_s first elapsed.
             self._last_cycle_at = self._mono()
             return False
+        self.state.prune_migrations(self._now())
         snapshot = self.snapshot_taker.take_snapshot(self.state)
         plan = self.planner.plan(snapshot, pods)
+        if plan.migrations:
+            # Note the reservations BEFORE actuating: the moment the drain
+            # deletes a mover pod, watch-driven replans may fire, and they
+            # must already see the destination claim.
+            from nos_tpu.partitioning.state import MigrationNote
+
+            now = self._now()
+            for m in plan.migrations:
+                self.state.note_migration(
+                    MigrationNote(
+                        pod_key=m.pod_key,
+                        source_node=m.source_node,
+                        dest_node=m.dest_node,
+                        request=snapshot.slice_spec.pod_slice_request(m.pod),
+                        expires_at=now + self.migration_hold_s,
+                    )
+                )
+            from nos_tpu.observability import metrics
+
+            metrics.inc(
+                "nos_tpu_slice_migrations", kind=self.kind, n=len(plan.migrations)
+            )
+        self._sync_migration_holds()
         self.actuator.apply(plan)
         if self.enable_consolidation:
             self._consolidate(snapshot, pods, plan.placed)
         self._last_cycle_at = self._mono()
         return True
+
+    # -- migration hold annotations (the agents' ladder reads these) --------
+    def _sync_migration_holds(self) -> None:
+        """Reconcile the per-node migration-hold annotation with the active
+        reservations: the node agents' delete ladders must not drop a free
+        slice that is an in-flight migration's destination — delete-free-
+        first extended to moves. Runs every cycle so expired/cleared
+        reservations release their holds promptly."""
+        desired: Dict[str, Dict[str, int]] = {}
+        for note in self.state.active_migrations():
+            per_node = desired.setdefault(note.dest_node, {})
+            for resource_name, qty in note.request.items():
+                profile = ann.profile_of_resource(resource_name)
+                if profile is None or qty <= 0:
+                    continue
+                per_node[profile] = per_node.get(profile, 0) + int(round(qty))
+        for node_name in sorted(self._hold_nodes | set(desired)):
+            value = ann.format_migration_hold(desired.get(node_name, {}))
+
+            def mutate(node, value=value):
+                if value:
+                    node.metadata.annotations[
+                        constants.ANNOTATION_MIGRATION_HOLD
+                    ] = value
+                else:
+                    node.metadata.annotations.pop(
+                        constants.ANNOTATION_MIGRATION_HOLD, None
+                    )
+
+            from nos_tpu.cluster.client import NotFoundError
+
+            node = self.state.get_node(node_name)
+            current = (
+                node.metadata.annotations.get(constants.ANNOTATION_MIGRATION_HOLD)
+                if node is not None
+                else None
+            )
+            if node is not None and (current or None) != (value or None):
+                try:
+                    self.cluster.patch("Node", "", node_name, mutate)
+                except NotFoundError:
+                    pass
+        self._hold_nodes = set(desired)
 
     # -- consolidation (defragmentation preemption) --------------------------
     # The reference never migrates running pods: a pending MIG profile that no

@@ -163,14 +163,25 @@ class TpuAgent:
             self.report()
             return
         self.sync_usage_from_pods()
+        holds = ann.get_migration_hold(node.metadata.annotations)
         try:
-            self._apply(desired)
+            self._apply(desired, holds)
         except TpuLibError:
             logger.exception("tpuagent %s: apply failed; reporting actual state", self.node_name)
         self.shared.on_apply()
         self.report()
 
-    def _apply(self, desired: Dict[Profile, int]) -> None:
+    def _apply(
+        self, desired: Dict[Profile, int], holds: Optional[Dict[str, int]] = None
+    ) -> None:
+        # `holds` (profile name -> count) marks free slices that are
+        # in-flight migration DESTINATIONS: the delete-free-first ladder is
+        # extended to moves by treating up to <count> free slices of each
+        # held profile exactly like used ones — undeletable — until the
+        # mover rebinds (or the controller's reservation expires and clears
+        # the annotation). Without this, the fragmentation fallback below
+        # could tear down the very slice a drain already depends on.
+        holds = dict(holds or {})
         slices = self.client.list_slices()
         current: Dict[Profile, List[SliceHandle]] = {}
         for s in slices:
@@ -183,7 +194,8 @@ class TpuAgent:
             if surplus <= 0:
                 continue
             free = [h for h in handles if not h.in_use]
-            for h in free[:surplus]:
+            held = holds.get(profile.name, 0)
+            for h in free[held:held + surplus]:
                 self.client.delete_slice(h.slice_id)
 
         # 2. Create missing slices around the kept ones.
@@ -204,9 +216,16 @@ class TpuAgent:
         if placements is None:
             # Fragmentation: drop remaining free slices and retry
             # (the widened-permutation-space analog of plan/plan.go:94-109).
-            for s in kept:
-                if not s.in_use:
-                    self.client.delete_slice(s.slice_id)
+            # Held (migration-destination) free slices survive the drop,
+            # first-listed per profile for determinism.
+            spare = dict(holds)
+            for s in sorted(kept, key=lambda s: s.slice_id):
+                if s.in_use:
+                    continue
+                if spare.get(s.profile.name, 0) > 0:
+                    spare[s.profile.name] -= 1
+                    continue
+                self.client.delete_slice(s.slice_id)
             kept = self.client.list_slices()
             kept_counts = {}
             for s in kept:

@@ -14,10 +14,21 @@ class Snapshot:
     edit; `commit` keeps it; `revert` rolls back. The planner forks once per
     candidate node (planner.go:139-145)."""
 
-    def __init__(self, nodes: Dict[str, PartitionableNode], slice_spec: SliceSpec):
+    def __init__(
+        self,
+        nodes: Dict[str, PartitionableNode],
+        slice_spec: SliceSpec,
+        reserved_pod_keys=frozenset(),
+    ):
         self._nodes = dict(nodes)
         self._forked: Optional[Dict[str, PartitionableNode]] = None
         self.slice_spec = slice_spec
+        # Pods with an in-flight migration destination (namespaced names):
+        # their capacity is already reserved on the destination node by the
+        # snapshot taker, so the planner and tracker must not carve for them
+        # again — a concurrent replan double-claiming the destination is
+        # exactly the race the reservation exists to close.
+        self.reserved_pod_keys = frozenset(reserved_pod_keys)
 
     # -- fork/commit/revert ------------------------------------------------
     def fork(self) -> None:

@@ -19,8 +19,15 @@ class SliceTracker:
         self._spec = slice_spec
         self._requested: Dict[str, ResourceList] = {}
         self._lacking: Dict[str, ResourceList] = {}
+        # Pods with an in-flight migration reservation are already accounted
+        # on their destination node (snapshot taker marks the capacity used):
+        # counting them lacking would carve a second slice for the same pod
+        # — the double-claim the reservation protocol forbids.
+        reserved = getattr(snapshot, "reserved_pod_keys", frozenset())
         for pod in pods:
             key = pod.metadata.namespaced_name
+            if key in reserved:
+                continue
             req = slice_spec.pod_slice_request(pod)
             if not req:
                 continue

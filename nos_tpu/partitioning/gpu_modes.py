@@ -368,6 +368,26 @@ class MpsSnapshotTaker:
 # ---------------------------------------------------------------------------
 # Partitioners (actuation channels)
 # ---------------------------------------------------------------------------
+def hybrid_contended_indexes(
+    node: Node, accepts_own: Callable[[str], bool]
+) -> set:
+    """Device indexes on a hybrid node whose CURRENT spec annotations carry
+    the other mode's profiles with nonzero quantity. Re-read at apply time
+    (ADVICE r5, gpu_modes.py:245): when the MIG and MPS planners both claim
+    the same uncarved GPU within one batch window, the snapshot-time
+    `_claimed_by_other_mode` check sees neither spec yet — the tie-break is
+    that the FIRST plan to land owns the GPU, and the second writer drops
+    the contended index instead of publishing a merged geometry the agent's
+    hybrid validator would reject (reject/replan churn until convergence)."""
+    if node.metadata.labels.get(constants.LABEL_PARTITIONING) != constants.KIND_HYBRID:
+        return set()
+    return {
+        s.device_index
+        for s in ann.parse_spec(node.metadata.annotations)
+        if s.quantity > 0 and not accepts_own(s.profile)
+    }
+
+
 class AnnotationPartitioner:
     """Spec-annotation writer shared by TPU and MIG modes. `profile_filter`
     scopes the rewrite to one mode's profiles so that on a hybrid node the
@@ -389,9 +409,32 @@ class AnnotationPartitioner:
             node_kind = node.metadata.labels.get(constants.LABEL_PARTITIONING)
             if node_kind != constants.KIND_HYBRID:
                 profile_filter = None
+            desired = partitioning
+            if profile_filter is not None:
+                # Deterministic same-window contention tie-break: first
+                # writer owns the GPU; we (the second) drop the contended
+                # index — our own stale claim on it (if any) is stripped
+                # below and never re-added, so a half-committed contention
+                # actively converges instead of churning replans.
+                contended = hybrid_contended_indexes(node, profile_filter)
+                if contended:
+                    desired = {
+                        idx: profs
+                        for idx, profs in partitioning.items()
+                        if idx not in contended
+                    }
+                    dropped = sorted(set(partitioning) & contended)
+                    logger.info(
+                        "hybrid contention on %s: GPU index(es) %s already "
+                        "claimed by the other mode's spec; dropping them "
+                        "from plan %s",
+                        node_name,
+                        dropped,
+                        plan_id,
+                    )
             ann.strip_spec_annotations(node.metadata.annotations, profile_filter)
             specs = []
-            for device_index, profiles in partitioning.items():
+            for device_index, profiles in desired.items():
                 specs.extend(
                     ann.SpecAnnotation(device_index, prof, qty)
                     for prof, qty in profiles.items()
@@ -449,6 +492,21 @@ class MpsPartitioner:
     def apply_partitioning(
         self, node_name: str, plan_id: str, partitioning: NodePartitioning
     ) -> None:
+        # The device-plugin ConfigMap and the handshake annotations must
+        # describe the SAME geometry: apply the hybrid contention tie-break
+        # (first spec writer owns the GPU) before the payload is rendered,
+        # not just inside the annotation mutate.
+        try:
+            node = self._cluster.get("Node", "", node_name)
+        except NotFoundError:
+            return
+        contended = hybrid_contended_indexes(node, _parses_as(MpsProfile.parse))
+        if contended:
+            partitioning = {
+                idx: profs
+                for idx, profs in partitioning.items()
+                if idx not in contended
+            }
         config_key = f"{node_name}-{plan_id}"
         payload = json.dumps(self.plugin_config(partitioning), sort_keys=True)
 

@@ -9,6 +9,7 @@ SURVEY.md §5 checkpoint/resume).
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from nos_tpu import constants
@@ -18,11 +19,30 @@ from nos_tpu.cluster.client import Cluster, Event, EventType
 from nos_tpu.util import pod as podutil
 
 
+@dataclass
+class MigrationNote:
+    """One in-flight slice migration: `pod_key`'s slice was drained from
+    `source_node` after an equivalent slice was created on `dest_node`, and
+    the mover has not yet rebound. While a note is active, the snapshot
+    takers mark `request` used on the destination so a CONCURRENT replan
+    cannot double-claim the reserved slice, and the tracker skips the
+    mover's resubmitted pod (its capacity already exists). `expires_at`
+    bounds a lost mover (deleted instead of resubmitted): after it, the
+    reservation lapses and the slice returns to the free pool."""
+
+    pod_key: str
+    source_node: str
+    dest_node: str
+    request: ResourceList
+    expires_at: float
+
+
 class ClusterState:
     def __init__(self):
         self._lock = threading.RLock()
         self._nodes: Dict[str, Node] = {}
         self._pods: Dict[str, Pod] = {}  # key: ns/name, only scheduled+active pods
+        self._migrations: Dict[str, MigrationNote] = {}  # key: mover pod key
 
     # -- feeding -----------------------------------------------------------
     def update_node(self, node: Node) -> None:
@@ -41,6 +61,11 @@ class ClusterState:
             key = pod.metadata.namespaced_name
             if podutil.is_active(pod):
                 self._pods[key] = pod
+                if pod.spec.node_name:
+                    # The mover rebound: its migration completed, the
+                    # reservation's job is done (the pod itself now holds
+                    # the destination slice in the usage accounting).
+                    self._migrations.pop(key, None)
             else:
                 self._pods.pop(key, None)
 
@@ -106,6 +131,29 @@ class ClusterState:
                 if p.spec.node_name == node_name:
                     out = out.add(compute_pod_request(p))
             return out
+
+    # -- in-flight migration accounting -------------------------------------
+    def note_migration(self, note: MigrationNote) -> None:
+        with self._lock:
+            self._migrations[note.pod_key] = note
+
+    def clear_migration(self, pod_key: str) -> None:
+        with self._lock:
+            self._migrations.pop(pod_key, None)
+
+    def prune_migrations(self, now: float) -> None:
+        """Expire reservations whose mover never came back (clock injected:
+        the caller's controller clock drives expiry, never wall time — the
+        simulations run on a virtual timeline)."""
+        with self._lock:
+            for key in [
+                k for k, n in self._migrations.items() if now >= n.expires_at
+            ]:
+                del self._migrations[key]
+
+    def active_migrations(self) -> List[MigrationNote]:
+        with self._lock:
+            return sorted(self._migrations.values(), key=lambda n: n.pod_key)
 
     def partitioning_enabled(self, kind: str) -> bool:
         """Any node labeled for this partitioning mode — a hybrid-labeled

@@ -159,6 +159,24 @@ class TpuNode:
         self.pods.append(pod)
         self.requested = self.requested.add(request)
 
+    def reserve_capacity(self, request: ResourceList) -> None:
+        """Claim capacity for an in-flight migration destination: the slice
+        the actuator created for the mover must read as USED to every
+        concurrent replan until the mover rebinds, or the planner would
+        reshape it / hand it to another pod (the double-claim race). Marks
+        the slice in-use on the mesh when it already exists; either way the
+        request lands in `requested` so plain resource fit blocks it too.
+        Conservative by design: if the agent has not created the slice yet,
+        the reservation still subtracts from the node's schedulable free."""
+        for resource_name, qty in request.items():
+            profile = Profile.from_resource(resource_name)
+            if profile is not None and qty > 0:
+                try:
+                    self.mesh.mark_used(profile, int(round(qty)))
+                except (ValueError, KeyError):
+                    pass  # slice not materialized yet: requested covers it
+        self.requested = self.requested.add(request)
+
     def evict_pods(self, pods: List[Pod]) -> None:
         """What-if removal of bound pods: release their slices (and pinned
         placements) so a consolidation re-carve can plan through the freed
@@ -261,4 +279,15 @@ class TpuSnapshotTaker:
                 pods=cluster_state.node_pods(name),
                 requested=cluster_state.node_requested(name),
             )
-        return Snapshot(nodes, self.slice_spec)
+        # In-flight migrations: reserve each mover's capacity on its
+        # destination and remember the mover keys, so this plan neither
+        # reshapes the reserved slice nor carves a duplicate for the
+        # mover's resubmitted pod (state.MigrationNote).
+        reserved_keys = set()
+        for note in cluster_state.active_migrations():
+            dest = nodes.get(note.dest_node)
+            if dest is None:
+                continue  # destination left the snapshot; note will expire
+            dest.reserve_capacity(note.request)
+            reserved_keys.add(note.pod_key)
+        return Snapshot(nodes, self.slice_spec, reserved_pod_keys=reserved_keys)

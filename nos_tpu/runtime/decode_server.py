@@ -165,7 +165,17 @@ class DecodeServer:
         histories (blocking) before every drafts probe — deterministic
         speculation scheduling, and the right choice when dispatch latency
         is negligible (a locally attached chip) or draft reactivity beats
-        pipelining (heavily repetitive traffic)."""
+        pipelining (heavily repetitive traffic).
+
+        NEIGHBOR PENALTY (ADVICE r5): verify rounds are BATCH-wide. While
+        any one slot holds a draft, every co-batched slot — including
+        non-repetitive streams that never draft — is pulled out of the
+        K-step macro pipeline and advances one token per verify round,
+        each round paying a synchronous host read (measured 117 -> 10.3
+        tok/s on a network-attached chip). One repetitive stream can
+        therefore serialize the whole batch; on an RTT-dominated rig keep
+        spec_k=0 for mixed traffic, or give repetitive streams their own
+        server instance."""
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots

@@ -140,6 +140,8 @@ def build_partitioner_controllers(
             sim_scheduler=sim,
             batch_timeout_s=config.batch_window_timeout_s,
             batch_idle_s=config.batch_window_idle_s,
+            defrag_budget=config.defrag_budget,
+            migration_hold_s=config.migration_hold_s,
             checkpoint_preempt_after_s=config.checkpoint_preempt_after_s,
             checkpoint_min_gain_s=config.checkpoint_min_gain_s,
             checkpoint_victim_cooldown_s=config.checkpoint_victim_cooldown_s,
@@ -288,6 +290,16 @@ class ControlPlane:
                 batch_timeout_s=p_cfg.batch_window_timeout_s,
                 batch_idle_s=p_cfg.batch_window_idle_s,
                 unit_key=self.scheduler._unit_key,
+                defrag_budget=p_cfg.defrag_budget,
+                defrag_after_s=p_cfg.defrag_after_s,
+                migration_hold_s=p_cfg.migration_hold_s,
+                # The move drain is a checkpoint eviction: it shares the
+                # checkpoint family's gain/pacing knobs so one churn policy
+                # governs every evict-and-resume path.
+                defrag_min_gain_s=p_cfg.checkpoint_min_gain_s,
+                defrag_victim_cooldown_s=p_cfg.checkpoint_victim_cooldown_s,
+                defrag_victim_budget=p_cfg.checkpoint_victim_budget,
+                defrag_victim_window_s=p_cfg.checkpoint_victim_window_s,
                 now=now,
             )
         self.host_agents: Dict[str, HostAgent] = {}

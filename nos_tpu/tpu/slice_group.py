@@ -32,6 +32,20 @@ from nos_tpu.tpu.topology import Topology
 Coord = Tuple[int, ...]
 
 
+def _area(dims: Coord) -> int:
+    out = 1
+    for d in dims:
+        out *= d
+    return out
+
+
+def _overlaps(a_origin: Coord, a_dims: Coord, b_origin: Coord, b_dims: Coord) -> bool:
+    return all(
+        ao < bo + bd and bo < ao + ad
+        for ao, ad, bo, bd in zip(a_origin, a_dims, b_origin, b_dims)
+    )
+
+
 @dataclass(frozen=True)
 class HostInfo:
     """One member host of a slice group."""
@@ -41,6 +55,12 @@ class HostInfo:
     subslice_id: Optional[str]  # acknowledged assignment (status side)
     spec_subslice_id: Optional[str]  # desired assignment (spec side)
     reported_plan: bool  # status plan id == spec plan id
+    # Declared chip topology of the spec sub-slice (the CANONICAL profile
+    # name gang selectors match, e.g. "16x8" carved rotated as an 8x16 host
+    # footprint). Geometry alone cannot recover it, and a replan that
+    # re-actuates a kept sub-slice with the reconstructed orientation would
+    # silently break every selector pointing at the canonical name.
+    spec_subslice_topology: Optional[str] = None
 
 
 @dataclass
@@ -152,6 +172,9 @@ class SliceGroup:
                 subslice_id=ann.get(constants.ANNOTATION_STATUS_SUBSLICE_ID),
                 spec_subslice_id=ann.get(constants.ANNOTATION_SPEC_SUBSLICE_ID),
                 reported_plan=spec_plan is None or spec_plan == status_plan,
+                spec_subslice_topology=ann.get(
+                    constants.ANNOTATION_SPEC_SUBSLICE_TOPOLOGY
+                ),
             )
         return cls(slice_id, topology, host_shape, hosts)
 
@@ -179,10 +202,27 @@ class SliceGroup:
             chip_dims = tuple(
                 d * h for d, h in zip(dims, self.host_shape.dims)
             )
+            # Prefer the DECLARED topology (canonical orientation — what gang
+            # selectors match) over the geometric reconstruction: a kept
+            # sub-slice re-actuated with the oriented name would break the
+            # selector of the very gang it was carved for (a "16x8" carve
+            # placed rotated reconstructs as "8x16").
+            profile = Profile(Shape(chip_dims))
+            declared = next(
+                (m.spec_subslice_topology for m in members if m.spec_subslice_topology),
+                None,
+            )
+            if declared:
+                try:
+                    declared_profile = Profile.parse(declared)
+                    if sorted(declared_profile.shape.dims) == sorted(chip_dims):
+                        profile = declared_profile
+                except ValueError:
+                    pass
             out.append(
                 SubSlice(
                     id=sid,
-                    profile=Profile(Shape(chip_dims)),
+                    profile=profile,
                     host_origin=origin,
                     host_dims=dims,
                     hosts=[m.node_name for m in members],
@@ -223,15 +263,10 @@ class SliceGroup:
         # stays congruent to the requested profile. On uniform hosts (v5e
         # 2x2) every rotation qualifies; on anisotropic hosts (v4/v5p 2x2x1)
         # only chip-profile orientations that stay host-aligned do.
-        allowed: Dict[Profile, Tuple[Coord, ...]] = {}
-        for bp, (chip_profile, _) in wanted.items():
-            dims_set = []
-            for o in chip_profile.shape.orientations():
-                if all(c % h == 0 for c, h in zip(o.dims, self.host_shape.dims)):
-                    dims_set.append(
-                        tuple(c // h for c, h in zip(o.dims, self.host_shape.dims))
-                    )
-            allowed[bp] = tuple(dims_set)
+        allowed: Dict[Profile, Tuple[Coord, ...]] = {
+            bp: self._allowed_block_dims(chip_profile)
+            for bp, (chip_profile, _) in wanted.items()
+        }
 
         # Attempt ladder (the agent-side delete-free-then-retry heuristic,
         # lifted to hosts): (1) full pack keeping free sub-slices in place,
@@ -280,6 +315,117 @@ class SliceGroup:
                 )
             )
         return result
+
+    def _allowed_block_dims(self, chip_profile: Profile) -> Tuple[Coord, ...]:
+        """Host-unit footprints (oriented) whose chip region stays congruent
+        to `chip_profile` AND host-aligned — the legal rotations of its host
+        block on this group's grid."""
+        dims_set = []
+        for o in chip_profile.shape.orientations():
+            if all(c % h == 0 for c, h in zip(o.dims, self.host_shape.dims)):
+                dims_set.append(
+                    tuple(c // h for c, h in zip(o.dims, self.host_shape.dims))
+                )
+        return tuple(dims_set)
+
+    # -- defragmentation (sub-slice migration) -------------------------------
+    def plan_defrag(
+        self,
+        profile: Profile,
+        node_has_workload,
+        movable,
+        max_movers: int = 8,
+    ):
+        """Search for ONE sub-slice migration that unblocks a `profile` carve
+        this grid cannot host today: pick an in-use mover sub-slice (smallest
+        host footprint first, `movable` filters to whole checkpointable
+        gangs etc.), place the demanded block as if the mover's block were
+        free, then place the mover's OWN block at a destination that
+        overlaps neither the remaining pinned blocks, the demanded block,
+        nor the mover's current block — the create-destination-first
+        requirement of the move protocol (source and destination must
+        coexist while the gang drains). Free sub-slices are dropped unless
+        they survive without overlapping the new carves.
+
+        Returns (desired_subslices, mover, dest_subslice, pending_subslice)
+        or None when no single migration coalesces a window."""
+        current = self.current_subslices(node_has_workload)
+        pinned = [s for s in current if s.in_use]
+        free = [s for s in current if not s.in_use]
+        block = chip_to_host_block(profile, self.host_shape)
+        if block is None:
+            return None
+        target_bp = Profile(block)
+        target_allowed = self._allowed_block_dims(profile)
+        if not target_allowed:
+            return None
+
+        movers = sorted(
+            (s for s in pinned if movable(s)),
+            key=lambda s: (_area(s.host_dims), s.id),
+        )
+        for mover in movers[:max_movers]:
+            others = [s for s in pinned if s.id != mover.id]
+            occ_others = [(s.host_origin, s.host_dims) for s in others]
+            pend_pl = pack_into(
+                self.host_grid,
+                occ_others,
+                {target_bp: 1},
+                {target_bp: target_allowed},
+                align=True,
+            )
+            if not pend_pl:
+                continue
+            mover_block = chip_to_host_block(mover.profile, self.host_shape)
+            if mover_block is None:
+                continue
+            mover_bp = Profile(mover_block)
+            occ_dest = (
+                occ_others
+                + [(pl.origin, pl.dims) for pl in pend_pl]
+                + [(mover.host_origin, mover.host_dims)]
+            )
+            dest_pl = pack_into(
+                self.host_grid,
+                occ_dest,
+                {mover_bp: 1},
+                {mover_bp: self._allowed_block_dims(mover.profile)},
+                align=True,
+            )
+            if not dest_pl:
+                continue
+            pending_ss = self._subslice_at(profile, pend_pl[0])
+            dest_ss = self._subslice_at(mover.profile, dest_pl[0])
+            carves = [
+                (pending_ss.host_origin, pending_ss.host_dims),
+                (dest_ss.host_origin, dest_ss.host_dims),
+            ]
+            kept_free = [
+                s
+                for s in free
+                if not any(
+                    _overlaps(s.host_origin, s.host_dims, o, d)
+                    for o, d in carves
+                )
+            ]
+            desired = others + kept_free + [dest_ss, pending_ss]
+            return desired, mover, dest_ss, pending_ss
+        return None
+
+    def _subslice_at(self, chip_profile: Profile, placement) -> SubSlice:
+        return SubSlice(
+            id=subslice_id_for(
+                self.slice_id, chip_profile, placement.origin, placement.dims
+            ),
+            profile=chip_profile,
+            host_origin=placement.origin,
+            host_dims=placement.dims,
+            hosts=[
+                self.hosts[c].node_name
+                for c in self._block_coords(placement.origin, placement.dims)
+                if c in self.hosts
+            ],
+        )
 
     def _block_coords(self, origin: Coord, dims: Coord) -> List[Coord]:
         coords: List[Coord] = [()]

@@ -1,0 +1,459 @@
+"""Defragmentation (slice migration) tests — planner pass, move-protocol
+actuation, in-flight reservation accounting, and the GroupPartitioner's
+whole-gang migration, per the ISSUE-1 safety invariants:
+
+- a migration is found only when it provably unblocks a stranded pod,
+- the migration budget is respected (0 disables the pass entirely),
+- gang/multislice members and higher-priority pods are never movers,
+- the destination is created before the source is drained, and the source
+  geometry only lands after the drain (delete-free-first extended to moves),
+- an in-flight migration's reservation blocks concurrent double-claims.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from nos_tpu import constants
+from nos_tpu.api.objects import Container, ObjectMeta, Pod, PodSpec
+from nos_tpu.api.resources import ResourceList
+from nos_tpu.config import PartitionerConfig
+from nos_tpu.partitioning.core import Actuator, Planner, Snapshot
+from nos_tpu.partitioning.core.interface import FitSimScheduler
+from nos_tpu.partitioning.core.planner import PartitioningPlan, SliceMigration
+from nos_tpu.partitioning.state import ClusterState, MigrationNote
+from nos_tpu.partitioning.tpu_mode import TpuNode, TpuSliceSpec, TpuSnapshotTaker
+from nos_tpu.tpu import Profile, Topology, TpuMesh
+
+from test_multihost import Clock, make_group, submit_gang  # noqa: E402
+
+
+def P(name):
+    return Profile.parse(name)
+
+
+def tpu_node(name, topo="4x4", geometry=None, used=None):
+    mesh = TpuMesh(Topology.parse("v5e", topo), geometry, used)
+    return TpuNode(
+        name=name,
+        mesh=mesh,
+        labels={constants.LABEL_PARTITIONING: constants.KIND_TPU},
+        base_allocatable=ResourceList.of({"cpu": 64}),
+    )
+
+
+def slice_pod(name, profile, priority=0, gang=None, ns="default"):
+    labels = (
+        {constants.LABEL_GANG: gang, constants.LABEL_GANG_SIZE: "2"} if gang else {}
+    )
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    resources=ResourceList.of(
+                        {f"google.com/tpu-{profile}": 1, "cpu": "100m"}
+                    )
+                )
+            ],
+            priority=priority,
+        ),
+    )
+
+
+def fragmented_snapshot(mover_gang=None, mover_priority=0, dest_topo="2x2"):
+    """Node a: 4x4 mesh carved into 2x2s, one held by the mover — a pending
+    4x4 pod is stranded unless the mover leaves. Node b: room for exactly
+    the mover (dest_topo 2x2), never for the 4x4."""
+    a = tpu_node("a", geometry={P("2x2"): 4})
+    mover = slice_pod("mover", "2x2", priority=mover_priority, gang=mover_gang)
+    mover.spec.node_name = "a"
+    a.add_pod(mover)
+    b = tpu_node("b", topo=dest_topo)
+    return Snapshot({"a": a, "b": b}, TpuSliceSpec())
+
+
+# -- planner: the defrag pass ------------------------------------------------
+def test_defrag_migration_found_and_validated():
+    snap = fragmented_snapshot()
+    plan = Planner(FitSimScheduler(), defrag_budget=1).plan(
+        snap, [slice_pod("big", "4x4")]
+    )
+    assert len(plan.migrations) == 1
+    m = plan.migrations[0]
+    assert (m.pod_key, m.source_node, m.dest_node) == ("default/mover", "a", "b")
+    assert m.unblocks == "default/big"
+    # The committed fork reflects the whole move: source re-carved for the
+    # stranded pod (simulated as schedulable there), dest hosts the mover.
+    assert plan.state["a"][0] == {"4x4": 1}
+    assert plan.state["b"][0] == {"2x2": 1}
+    assert "default/big" in plan.placed
+    assert snap.get_node("a").mesh.used == {P("4x4"): 1}
+    assert snap.get_node("b").mesh.used == {P("2x2"): 1}
+
+
+def test_defrag_budget_zero_disables_the_pass():
+    plan = Planner(FitSimScheduler(), defrag_budget=0).plan(
+        fragmented_snapshot(), [slice_pod("big", "4x4")]
+    )
+    assert plan.migrations == []
+    assert plan.placed == set()
+
+
+def test_defrag_budget_caps_migrations_per_plan():
+    # Two stranded 4x4 pods, budget 1: at most one migration per window.
+    snap = fragmented_snapshot()
+    plan = Planner(FitSimScheduler(), defrag_budget=1).plan(
+        snap, [slice_pod("big1", "4x4"), slice_pod("big2", "4x4")]
+    )
+    assert len(plan.migrations) <= 1
+
+
+def test_defrag_rejected_without_destination():
+    # No node can host the mover with its source slice still allocated ->
+    # the move is unactuatable (create-destination-first) -> no migration.
+    a = tpu_node("a", geometry={P("2x2"): 4})
+    mover = slice_pod("mover", "2x2")
+    mover.spec.node_name = "a"
+    a.add_pod(mover)
+    snap = Snapshot({"a": a}, TpuSliceSpec())
+    plan = Planner(FitSimScheduler(), defrag_budget=1).plan(
+        snap, [slice_pod("big", "4x4")]
+    )
+    assert plan.migrations == []
+    # And the failed search left no partial state behind.
+    assert plan.state["a"][0] == {"2x2": 4}
+
+
+def test_defrag_never_moves_gang_members():
+    plan = Planner(FitSimScheduler(), defrag_budget=1).plan(
+        fragmented_snapshot(mover_gang="g1"), [slice_pod("big", "4x4")]
+    )
+    assert plan.migrations == []
+
+
+def test_defrag_never_moves_higher_priority_pods():
+    plan = Planner(FitSimScheduler(), defrag_budget=1).plan(
+        fragmented_snapshot(mover_priority=100),
+        [slice_pod("big", "4x4", priority=0)],
+    )
+    assert plan.migrations == []
+
+
+def test_defrag_skips_reserved_pods():
+    # A pod with an in-flight migration reservation is already capacitized
+    # on its destination: the planner must not carve for it again.
+    snap = Snapshot(
+        {"a": tpu_node("a")},
+        TpuSliceSpec(),
+        reserved_pod_keys={"default/resub"},
+    )
+    plan = Planner(FitSimScheduler(), defrag_budget=1).plan(
+        snap, [slice_pod("resub", "2x2")]
+    )
+    assert plan.state["a"][0] == {}  # nothing carved for the reserved pod
+    assert "default/resub" not in plan.placed
+
+
+# -- in-flight migration accounting (state + snapshot taker) -----------------
+def _cluster_state_with_node(topo="4x4"):
+    from nos_tpu.api.objects import Node, NodeStatus
+    from nos_tpu.api import annotations as ann
+
+    state = ClusterState()
+    topology = Topology.parse("v5e", topo)
+    node = Node(
+        metadata=ObjectMeta(
+            name="a",
+            labels={
+                constants.LABEL_PARTITIONING: constants.KIND_TPU,
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: topo,
+            },
+        ),
+        status=NodeStatus(
+            allocatable=ResourceList.of(
+                {"cpu": 64, constants.RESOURCE_TPU: topology.chips}
+            )
+        ),
+    )
+    state.update_node(node)
+    return state
+
+
+def test_migration_note_reserves_destination_capacity():
+    state = _cluster_state_with_node()
+    state.note_migration(
+        MigrationNote(
+            pod_key="default/mover",
+            source_node="b",
+            dest_node="a",
+            request=ResourceList.of({"google.com/tpu-2x2": 1}),
+            expires_at=1000.0,
+        )
+    )
+    snap = TpuSnapshotTaker().take_snapshot(state)
+    assert "default/mover" in snap.reserved_pod_keys
+    # The reservation subtracts from schedulable free capacity.
+    node = snap.get_node("a")
+    assert node.requested.get("google.com/tpu-2x2") == 1
+    # A concurrent replan cannot double-claim: the mover's resubmitted pod
+    # is skipped by the tracker/planner (reserved), so nothing new is carved.
+    plan = Planner(FitSimScheduler(), defrag_budget=0).plan(
+        snap, [slice_pod("mover", "2x2")]
+    )
+    assert plan.placed == set()
+
+
+def test_migration_note_lifecycle():
+    state = _cluster_state_with_node()
+    note = MigrationNote(
+        pod_key="default/mover",
+        source_node="b",
+        dest_node="a",
+        request=ResourceList.of({"google.com/tpu-2x2": 1}),
+        expires_at=100.0,
+    )
+    state.note_migration(note)
+    assert [n.pod_key for n in state.active_migrations()] == ["default/mover"]
+    # Expiry lapses the reservation (lost mover).
+    state.prune_migrations(now=99.0)
+    assert state.active_migrations()
+    state.prune_migrations(now=100.0)
+    assert state.active_migrations() == []
+    # A rebound mover clears its own note.
+    state.note_migration(note)
+    rebound = slice_pod("mover", "2x2")
+    rebound.spec.node_name = "a"
+    rebound.status.phase = "Running"
+    state.update_pod(rebound)
+    assert state.active_migrations() == []
+
+
+# -- actuator: the ordered move protocol -------------------------------------
+class RecordingPartitioner:
+    def __init__(self, log):
+        self.log = log
+
+    def apply_partitioning(self, node_name, plan_id, partitioning):
+        self.log.append(("apply", node_name))
+
+
+def _migration_plan():
+    return PartitioningPlan(
+        state={"src": {0: {"4x4": 1}}, "dst": {0: {"2x2": 1}}},
+        migrations=[
+            SliceMigration(
+                pod=slice_pod("mover", "2x2"),
+                source_node="src",
+                dest_node="dst",
+                unblocks="default/big",
+            )
+        ],
+    )
+
+
+def test_actuator_orders_destination_before_drain_before_source():
+    log = []
+    actuator = Actuator(
+        RecordingPartitioner(log),
+        get_current=lambda name: {},
+        evict=lambda pod: log.append(("evict", pod.metadata.namespaced_name)),
+    )
+    actuator.apply(_migration_plan())
+    assert log == [
+        ("apply", "dst"),  # 1. create destination
+        ("evict", "default/mover"),  # 2. drain the mover
+        ("apply", "src"),  # 3. only then the source shrink
+    ]
+
+
+def test_actuator_refuses_migrations_without_evict_channel():
+    actuator = Actuator(RecordingPartitioner([]), get_current=lambda name: {})
+    with pytest.raises(RuntimeError, match="evict"):
+        actuator.apply(_migration_plan())
+
+
+def test_actuator_plain_plan_needs_no_evict_channel():
+    log = []
+    actuator = Actuator(RecordingPartitioner(log), get_current=lambda name: {})
+    applied = actuator.apply(PartitioningPlan(state={"n": {0: {"2x2": 1}}}))
+    assert applied == {"n": True}
+    assert log == [("apply", "n")]
+
+
+# -- group partitioner: whole-gang migration ---------------------------------
+def build_fragmented_plane():
+    """8x8 slice group (4x4 grid of 2x2 hosts), fragmented BY CONSTRUCTION
+    so every aligned 4x2/2x4-host window for an 8x4 gang is blocked:
+
+      - sub-slice M (2x2) on host (0,0): a checkpointable single-pod gang
+        — the legal mover; blocks the left (cols 0-1) and top (rows 0-1)
+        windows.
+      - sub-slice B (2x2) on host (2,2): NON-checkpointable — immovable;
+        blocks the right (cols 2-3) and bottom (rows 2-3) windows.
+
+    14 of 16 hosts are free (capacity is plentiful), so an 8x4 gang is
+    fragmentation-blocked — exactly the defrag pass's target."""
+    from nos_tpu.system import ControlPlane
+
+    clock = Clock()
+    cfg = PartitionerConfig(defrag_budget=1, defrag_after_s=0.0)
+    plane = ControlPlane(partitioner_config=cfg, now=clock)
+    make_group(plane, "s0", global_topo="8x8", host_topo="2x2", grid=(4, 4))
+    plane.start()
+
+    def carve(node_name, sid):
+        def mutate(n):
+            a = n.metadata.annotations
+            a[constants.ANNOTATION_SPEC_SUBSLICE_ID] = sid
+            a[constants.ANNOTATION_SPEC_SUBSLICE_TOPOLOGY] = "2x2"
+            a[constants.ANNOTATION_SPEC_PLAN] = "seed-plan"
+
+        plane.cluster.patch("Node", "", node_name, mutate)
+
+    carve("s0-host-0-0", "s0-subslice-m")
+    carve("s0-host-2-2", "s0-subslice-b")
+    plane.tick()  # host agents ack, labels flip
+
+    def running_pod(name, host, gang, checkpointable):
+        ann = {constants.ANNOTATION_CHECKPOINTABLE: "true"} if checkpointable else {}
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=name,
+                namespace="ml",
+                labels={
+                    constants.LABEL_GANG: gang,
+                    constants.LABEL_GANG_SIZE: "1",
+                },
+                annotations=ann,
+            ),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        resources=ResourceList.of({"google.com/tpu": 4, "cpu": 1})
+                    )
+                ],
+                scheduler_name=constants.SCHEDULER_NAME,
+                node_selector={constants.LABEL_TPU_SUBSLICE_TOPOLOGY: "2x2"},
+            ),
+        )
+        pod.spec.node_name = host
+        pod.status.phase = "Running"
+        plane.cluster.create(pod)
+
+    running_pod("mover-0", "s0-host-0-0", "mover", checkpointable=True)
+    running_pod("blocker-0", "s0-host-2-2", "blocker", checkpointable=False)
+    return plane, clock
+
+
+def drive(plane, clock, rounds=6, dt=11.0):
+    for _ in range(rounds):
+        clock.t += dt
+        plane.tick()
+
+
+def test_group_defrag_migrates_whole_gang_with_move_protocol():
+    plane, clock = build_fragmented_plane()
+
+    # Event log: node spec writes and pod deletions, in store order (the
+    # fake cluster dispatches watch callbacks synchronously per write).
+    events = []
+
+    def on_node(ev):
+        sid = ev.obj.metadata.annotations.get(constants.ANNOTATION_SPEC_SUBSLICE_ID)
+        events.append(("node", ev.obj.metadata.name, sid))
+
+    def on_pod(ev):
+        from nos_tpu.cluster.client import EventType
+
+        if ev.type == EventType.DELETED:
+            events.append(("pod-deleted", ev.obj.metadata.namespaced_name, None))
+
+    plane.cluster.watch("Node", on_node, replay=False)
+    plane.cluster.watch("Pod", on_pod, replay=False)
+
+    # The stranded gang: 8x4 = a 4x2-host window no current layout offers.
+    submit_gang(plane, "big", "ml", "8x4", 8)
+    drive(plane, clock, rounds=8)
+
+    deleted = [e[1] for e in events if e[0] == "pod-deleted"]
+    assert "ml/mover-0" in deleted, "the checkpointable mover gang must drain"
+    assert "ml/blocker-0" not in deleted, (
+        "a non-checkpointable gang must never be migration-drained"
+    )
+    # Move protocol: before the mover deletion, the destination carve (a
+    # spec sub-slice id that is neither seed carve) already landed.
+    first_delete_at = next(
+        i for i, e in enumerate(events) if e[0] == "pod-deleted"
+    )
+    new_spec_writes_before = [
+        e
+        for e in events[:first_delete_at]
+        if e[0] == "node"
+        and e[2] not in (None, "s0-subslice-m", "s0-subslice-b")
+    ]
+    assert new_spec_writes_before, "destination spec must land before the drain"
+    # The stranded gang eventually binds into the freed window.
+    big_members = [
+        plane.cluster.peek("Pod", "ml", f"big-{i}", lambda p: p.spec.node_name)
+        for i in range(8)
+    ]
+    assert all(big_members), "stranded gang must bind after the migration"
+    # The blocker's sub-slice survived untouched (never-delete-used).
+    assert (
+        plane.cluster.get("Node", "", "s0-host-2-2")
+        .metadata.annotations.get(constants.ANNOTATION_SPEC_SUBSLICE_ID)
+        == "s0-subslice-b"
+    )
+
+
+def test_group_defrag_budget_and_hold_block_double_claim():
+    plane, clock = build_fragmented_plane()
+    submit_gang(plane, "big", "ml", "8x4", 8)
+    clock.t += 11
+    plane.scheduler.schedule_pending()
+    gp = plane.group_partitioner
+    assert gp.process_batch_if_ready()
+    holds = dict(gp._migration_holds)
+    assert holds, "a migration must record its reservation holds"
+    # While the holds are live, an immediate replan must neither drop the
+    # reserved carves nor carve a second window for the held gangs.
+    before = {
+        n.metadata.name: n.metadata.annotations.get(
+            constants.ANNOTATION_SPEC_SUBSLICE_ID
+        )
+        for n in plane.cluster.list("Node")
+    }
+    gp.process_batch_if_ready()
+    after = {
+        n.metadata.name: n.metadata.annotations.get(
+            constants.ANNOTATION_SPEC_SUBSLICE_ID
+        )
+        for n in plane.cluster.list("Node")
+    }
+    held_ids = set(holds)
+    assert any(sid in held_ids for sid in before.values())
+    for name, sid in before.items():
+        if sid in held_ids:
+            assert after[name] == sid, (
+                f"replan dropped reserved sub-slice {sid} on {name}"
+            )
+    # Budget respected (1 per window): the immovable gang survived, and only
+    # the one mover was drained.
+    assert plane.cluster.peek("Pod", "ml", "blocker-0", lambda p: True) is not None
+    assert plane.cluster.peek("Pod", "ml", "mover-0", lambda p: True) is None
+
+
+def test_group_defrag_disabled_by_default():
+    from nos_tpu.system import ControlPlane
+
+    clock = Clock()
+    plane = ControlPlane(now=clock)
+    make_group(plane, "s0", global_topo="8x8", host_topo="2x2", grid=(4, 4))
+    plane.start()
+    assert plane.group_partitioner.defrag_budget == 0

@@ -672,3 +672,68 @@ def test_cli_gpu_agent_modes_start():
             "gpu-agent", "--node", f"{mode}-node", "--mode", mode, "--once",
         ])
         assert rc == 0, mode
+
+
+def test_hybrid_same_window_contention_tie_break():
+    """When the MIG and MPS planners claim the same uncarved GPU of a hybrid
+    node within ONE batch window, neither snapshot sees the other's spec yet
+    — the tie-break is that the FIRST plan to land owns the GPU and the
+    second writer DROPS the contended index (deterministic convergence, no
+    reject/replan churn), while its claims on other GPUs still land."""
+    from nos_tpu.partitioning.gpu_modes import (
+        MigPartitioner,
+        MpsPartitioner,
+        hybrid_contended_indexes,
+        _parses_as,
+    )
+    from nos_tpu.gpu.mig import MigProfile
+
+    cluster = Cluster()
+    cluster.create(
+        Node(
+            metadata=ObjectMeta(
+                name="hy-0",
+                labels={
+                    constants.LABEL_PARTITIONING: constants.KIND_HYBRID,
+                    constants.LABEL_GPU_PRODUCT: A100_40,
+                    constants.LABEL_GPU_COUNT: "2",
+                },
+            ),
+            status=NodeStatus(allocatable=ResourceList.of({"cpu": 64})),
+        )
+    )
+    # MIG lands first, claiming GPU 0.
+    MigPartitioner(cluster).apply_partitioning("hy-0", "plan-a", {0: {"3g.20gb": 2}})
+    node = cluster.get("Node", "", "hy-0")
+    mig_specs = ann.parse_spec(node.metadata.annotations)
+    assert {s.device_index for s in mig_specs if s.quantity > 0} == {0}
+    # The MPS writer (same window, stale snapshot) claims GPU 0 AND GPU 1:
+    # the contended index 0 is dropped, GPU 1 lands.
+    contended = hybrid_contended_indexes(
+        node, _parses_as(lambda n: MigProfile.parse(n))
+    )
+    assert contended == set()  # MIG's own filter sees its own profiles
+    MpsPartitioner(cluster).apply_partitioning(
+        "hy-0", "plan-b", {0: {"10gb": 4}, 1: {"10gb": 4}}
+    )
+    node = cluster.get("Node", "", "hy-0")
+    specs = ann.parse_spec(node.metadata.annotations)
+    by_index = {}
+    for s in specs:
+        if s.quantity > 0:
+            by_index.setdefault(s.device_index, set()).add(s.profile)
+    assert by_index[0] == {"3g.20gb"}, "first writer keeps the contended GPU"
+    assert by_index[1] == {"10gb"}, "second writer's uncontended claim lands"
+    # And the device-plugin ConfigMap payload matches the annotations (the
+    # tie-break applies to the rendered geometry too, not just the spec).
+    cm = cluster.get(
+        "ConfigMap",
+        constants.DEFAULT_DEVICE_PLUGIN_CM_NAMESPACE,
+        constants.DEFAULT_DEVICE_PLUGIN_CM_NAME,
+    )
+    payload = json.loads(cm.data["hy-0-plan-b"])
+    replicas = payload["sharing"]["mps"]["resources"]
+    assert [r["devices"] for r in replicas] == [[1]], (
+        "the rendered plugin config must exclude the contended GPU 0"
+    )
+    assert replicas[0]["replicas"] == 4

@@ -198,3 +198,45 @@ def test_pack_cache_speedup():
         pack(mesh, geom)
     warm = (time.perf_counter() - t0) / 100
     assert warm < cold, f"cache not faster: warm={warm*1e6:.0f}us cold={cold*1e6:.0f}us"
+
+
+def test_control_round_with_defrag_armed_single_host():
+    """ISSUE-1: the defrag pass must not blow the control-round ceiling.
+    Worst case for the migration search: a saturated backlog (every node
+    full, many stranded pods) makes every _find_migration attempt fork the
+    snapshot and fail — the bounded-attempts discipline (3 stranded pods,
+    per-node early break) keeps the round inside the same 2 s ceiling."""
+    controller, _ = build_single_node_env(4, "8x8", 100)
+    controller.defrag_budget = 2
+    controller.planner.defrag_budget = 2
+    dt = timed_round(controller)
+    assert dt < 2.0, f"defrag-armed control round took {dt:.2f}s"
+
+
+def test_control_round_with_defrag_armed_slice_group():
+    """The north-star shape with the whole-gang migration pass armed: one
+    64-host group, 100 pending gangs — worst case again, since the deep
+    backlog leaves the head gang unplaced and the defrag search (head-only,
+    free-capacity gated) runs every cycle."""
+    from test_multihost import make_group, submit_gang
+
+    from nos_tpu.config import PartitionerConfig
+    from nos_tpu.system import ControlPlane
+
+    clock = Clock()
+    cfg = PartitionerConfig(defrag_budget=1, defrag_after_s=0.0)
+    plane = ControlPlane(partitioner_config=cfg, now=clock).start()
+    make_group(plane, "s0", global_topo="16x16", host_topo="2x2", grid=(8, 8))
+    rng = random.Random(0)
+    shapes = [("2x2", 1), ("2x4", 2), ("4x4", 4), ("4x8", 8), ("8x8", 16)]
+    weights = [2.0 ** -i for i in range(len(shapes))]
+    for j in range(100):
+        topo, size = rng.choices(shapes, weights)[0]
+        submit_gang(plane, f"g{j}", "ml", topo, size)
+    t0 = time.perf_counter()
+    plane.scheduler.schedule_pending()
+    clock.t += 61
+    assert plane.group_partitioner.process_batch_if_ready()
+    plane.scheduler.schedule_pending()
+    dt = time.perf_counter() - t0
+    assert dt < 3.0, f"defrag-armed group round took {dt:.2f}s"

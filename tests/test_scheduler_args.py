@@ -103,3 +103,27 @@ def test_plugin_config_applies_on_programmatic_construction():
     assert cfg.tpu_chip_memory_gb == 24.0
     with pytest.raises(ConfigError, match="plugin_config"):
         SchedulerConfig(plugin_config=[{"apiVersion": "nope/v1", "kind": "X"}])
+
+
+def test_bool_rejected_as_number():
+    """The reference wire type is *int64: YAML `true` is a distinct type
+    there and must be a decode error — Python's bool subclasses int, so an
+    unguarded float() would silently decode tpuChipMemoryGB: true to 1.0."""
+    with pytest.raises(PluginArgsError, match="not a number"):
+        decode_plugin_args(_doc(tpuChipMemoryGB=True))
+    with pytest.raises(PluginArgsError, match="not a number"):
+        decode_plugin_args(_doc(nvidiaGpuResourceMemoryGB=False))
+
+
+def test_non_finite_rejected():
+    with pytest.raises(PluginArgsError, match="not finite"):
+        decode_plugin_args(_doc(tpuChipMemoryGB=float("inf")))
+    with pytest.raises(PluginArgsError, match="not finite"):
+        decode_plugin_args(_doc(tpuChipMemoryGB=float("nan")))
+
+
+def test_string_rejected_as_number():
+    # The YAML loader yields numbers for numeric scalars; a string reaching
+    # the decoder is a quoted typo, not a convertible value.
+    with pytest.raises(PluginArgsError, match="not a number"):
+        decode_plugin_args(_doc(tpuChipMemoryGB="32"))
