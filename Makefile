@@ -3,13 +3,25 @@
 PY ?= python
 
 .PHONY: all test test-tpu native bench dryrun demo simulate example clean \
-	render cluster kind-cluster docker-build e2e-kind
+	render cluster kind-cluster docker-build e2e-kind lint
 
 all: native test
 
 # Unit + integration tests on the virtual 8-device CPU mesh (SURVEY.md §4).
 test:
 	$(PY) -m pytest tests/ -q
+
+# Domain-aware static analysis (docs/static-analysis.md): the go vet /
+# staticcheck analog, also gated in tier-1 by tests/test_static_analysis.py.
+# ruff rides along when installed (pip install -e .[dev]); the analyzer
+# itself has zero dependencies beyond the stdlib.
+lint:
+	$(PY) -m nos_tpu.cli lint nos_tpu --baseline lint-baseline.txt
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check nos_tpu tests bench.py; \
+	else \
+		echo "ruff not installed (pip install -e .[dev]); skipped"; \
+	fi
 
 # Same suite against the real accelerator (slow: per-test compiles).
 test-tpu:

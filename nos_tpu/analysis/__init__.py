@@ -1,0 +1,59 @@
+"""Domain-aware static analysis for the nos-tpu tree (`nos-tpu lint`).
+
+The system's correctness hangs off conventions no general-purpose linter
+checks: the `tpu.nos/...` annotation/label wire protocol between the central
+partitioner and node agents (constants.py), hand-rolled lock discipline in the
+threaded controllers/runtimes, and JAX trace purity in the workload plane.
+The reference `nos` operator gets `go vet`/staticcheck for this class of bug;
+this package is the Python rebuild's equivalent — a single-pass AST framework
+with pluggable domain checkers, structured `file:line` findings, and a
+committed suppression baseline (lint-baseline.txt), gated in tier-1 by
+tests/test_static_analysis.py.
+
+Checker codes:
+  NOS001  wire-protocol string literal outside constants.py
+  NOS002  one-sided/dead protocol constant (no writer or no reader)
+  NOS003  broad `except` swallows the error silently
+  NOS004  bare `except:`
+  NOS005  lock-guarded attribute mutated without holding the lock
+  NOS006  lock-order inversion in the static lock-acquisition graph
+  NOS007  impure call inside a jit/pallas-traced function
+  NOS008  float `==`/`!=` comparison in numeric code
+  NOS009  unseeded global-RNG draw on a simulation/planner path
+"""
+
+from __future__ import annotations
+
+from nos_tpu.analysis.baseline import (
+    BaselineEntry,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from nos_tpu.analysis.checkers import all_checkers
+from nos_tpu.analysis.core import Checker, Engine, FileContext, Finding
+
+__all__ = [
+    "BaselineEntry",
+    "Checker",
+    "Engine",
+    "FileContext",
+    "Finding",
+    "all_checkers",
+    "apply_baseline",
+    "load_baseline",
+    "run",
+    "write_baseline",
+]
+
+
+def run(paths, baseline_path=None, checkers=None, root=None):
+    """One-call entry point: analyze `paths`, apply the baseline, return
+    (findings, suppressed, stale_entries). Used by the CLI and the tier-1
+    gate so both agree bit-for-bit."""
+    engine = Engine(checkers if checkers is not None else all_checkers(), root=root)
+    findings = engine.run(paths)
+    if baseline_path is None:
+        return findings, [], []
+    entries = load_baseline(baseline_path)
+    return apply_baseline(findings, entries)

@@ -1,0 +1,24 @@
+"""Checker registry. Adding a checker = write the module, list it here,
+document it in docs/static-analysis.md."""
+
+from __future__ import annotations
+
+from typing import List
+
+from nos_tpu.analysis.core import Checker
+
+
+def all_checkers() -> List[Checker]:
+    from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+    from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
+    from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+    from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
+    from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
+
+    return [
+        WireLiteralChecker(),
+        ProtocolRoundTripChecker(),
+        ExceptionHygieneChecker(),
+        LockDisciplineChecker(),
+        TraceSafetyChecker(),
+    ]

@@ -1,0 +1,225 @@
+"""NOS007/NOS008/NOS009 — JAX trace-safety and simulation determinism.
+
+NOS007 — impure call inside a traced function. A function staged by
+`jax.jit`/`pl.pallas_call` runs its Python body ONCE at trace time; a
+`time.time()`, unseeded `random`/`np.random` draw, `print`, or `global`
+mutation inside it bakes a single stale value into the compiled program (or
+silently does nothing per step). Detected for functions that are decorated
+with jit/pallas_call, wrapped via `jax.jit(fn)` / `pl.pallas_call(fn, ...)`
+anywhere in the module, or lambdas passed directly to a jit wrapper.
+`jax.debug.print`/`jax.debug.callback` are the sanctioned escape hatches and
+stay legal. Scope: ops/, models/, parallel/, runtime/.
+
+NOS008 — float `==`/`!=` against a float literal in numeric code
+(ops/, models/, parallel/, runtime/, tpulib/): accumulated rounding makes
+exact equality a latent heisenbug; compare with a tolerance (or suppress
+inline where the arithmetic is provably exact).
+
+NOS009 — unseeded global-RNG draw on simulation/planner paths (sim.py,
+sim_oracle.py, partitioning/, scheduler/, tpu/): the CI-pinned simulation
+points are bit-for-bit reproductions; one `random.random()` on the module
+RNG (instead of an injected `random.Random(seed)`) destabilizes every pinned
+number. Seeded constructors (`random.Random(...)`, `np.random.default_rng`,
+`np.random.RandomState`) are fine; draws on the global RNG are not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from nos_tpu.analysis.core import Checker, FileContext, Report
+
+_JIT_SCOPE = {"ops", "models", "parallel", "runtime"}
+_FLOAT_EQ_SCOPE = _JIT_SCOPE | {"tpulib"}
+_SIM_SCOPE_DIRS = {"partitioning", "scheduler", "tpu"}
+_SIM_SCOPE_FILES = {"sim.py", "sim_oracle.py"}
+
+_TIME_FUNCS = {"time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic"}
+_SEEDED_RANDOM_CTORS = {"Random", "SystemRandom", "getstate", "setstate"}
+_SEEDED_NP_CTORS = {"default_rng", "RandomState", "Generator", "SeedSequence"}
+_JIT_WRAPPERS = {"jit", "pallas_call", "pjit"}
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_wrapper(node: ast.expr) -> bool:
+    """jit / jax.jit / pl.pallas_call / functools.partial(jax.jit, ...)."""
+    dotted = _dotted(node)
+    if dotted and dotted.split(".")[-1] in _JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fn_dotted = _dotted(node.func)
+        if fn_dotted and fn_dotted.split(".")[-1] in _JIT_WRAPPERS:
+            return True  # jax.jit(..., donate_argnums=...) used as decorator factory
+        if fn_dotted and fn_dotted.split(".")[-1] == "partial":
+            return any(_is_jit_wrapper(a) for a in node.args[:1])
+    return False
+
+
+class TraceSafetyChecker(Checker):
+    name = "trace-safety"
+    codes = ("NOS007", "NOS008", "NOS009")
+    description = "purity inside traced functions; deterministic sim/planner paths"
+
+    def __init__(self) -> None:
+        self._jitted_names: Set[str] = set()
+        self._jitted_lambdas: Set[ast.Lambda] = set()
+        self._aliases: Dict[str, str] = {}
+        self._in_jit_scope = False
+        self._in_float_scope = False
+        self._in_sim_scope = False
+
+    # -- per-file prescan ----------------------------------------------------
+    def begin_file(self, ctx: FileContext) -> None:
+        segs = set(ctx.segments[:-1])
+        self._in_jit_scope = bool(segs & _JIT_SCOPE)
+        self._in_float_scope = bool(segs & _FLOAT_EQ_SCOPE)
+        self._in_sim_scope = bool(segs & _SIM_SCOPE_DIRS) or ctx.basename in _SIM_SCOPE_FILES
+        self._jitted_names = set()
+        self._jitted_lambdas = set()
+        self._aliases = {}
+        if not (self._in_jit_scope or self._in_sim_scope):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self._aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_jit_wrapper(d) for d in node.decorator_list):
+                    self._jitted_names.add(node.name)
+            elif isinstance(node, ast.Call) and _is_jit_wrapper(node.func):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        self._jitted_names.add(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        self._jitted_lambdas.add(arg)
+
+    # -- helpers -------------------------------------------------------------
+    def _module_of(self, name: str) -> str:
+        return self._aliases.get(name, name)
+
+    def _in_traced_function(self, ctx: FileContext, node: ast.AST) -> bool:
+        for anc in ctx.stack:
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if anc.name in self._jitted_names:
+                    return True
+            elif isinstance(anc, ast.Lambda) and anc in self._jitted_lambdas:
+                return True
+        return False
+
+    def _impurity(self, node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            return "print() (trace-time only; use jax.debug.print)"
+        dotted = _dotted(fn)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        module = self._module_of(head)
+        if module == "time" and rest in _TIME_FUNCS:
+            return f"time.{rest}() (baked in at trace time)"
+        if module == "random" and rest and rest.split(".")[0] not in _SEEDED_RANDOM_CTORS:
+            return f"random.{rest}() (global RNG at trace time)"
+        if module in ("numpy", "np") or module.endswith(".numpy"):
+            sub = rest.split(".")
+            if len(sub) >= 2 and sub[0] == "random" and sub[1] not in _SEEDED_NP_CTORS:
+                return f"np.random.{sub[1]}() (global RNG at trace time)"
+        if module == "os" and rest == "urandom":
+            return "os.urandom() (host entropy at trace time)"
+        if module == "uuid" and rest.startswith("uuid"):
+            return f"uuid.{rest}() (host entropy at trace time)"
+        return None
+
+    # -- visit ---------------------------------------------------------------
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if self._in_jit_scope:
+            self._check_traced(ctx, node, report)
+        if self._in_float_scope and isinstance(node, ast.Compare):
+            self._check_float_eq(ctx, node, report)
+        if self._in_sim_scope and isinstance(node, ast.Call):
+            self._check_sim_rng(ctx, node, report)
+
+    def _check_traced(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if isinstance(node, ast.Global):
+            if self._in_traced_function(ctx, node):
+                report.add(
+                    ctx.rel,
+                    node.lineno,
+                    "NOS007",
+                    "global mutation inside a traced function (runs once at "
+                    "trace time, not per step)",
+                )
+            return
+        if not isinstance(node, ast.Call):
+            return
+        # jax.debug.print / jax.debug.callback are the sanctioned hatches.
+        dotted = _dotted(node.func)
+        if dotted and ".debug." in f".{dotted}.":
+            return
+        reason = self._impurity(node)
+        if reason and self._in_traced_function(ctx, node):
+            report.add(
+                ctx.rel,
+                node.lineno,
+                "NOS007",
+                f"impure call in jit/pallas-traced function: {reason}",
+            )
+
+    @staticmethod
+    def _check_float_eq(ctx: FileContext, node: ast.Compare, report: Report) -> None:
+        if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            return
+        operands = [node.left, *node.comparators]
+        for operand in operands:
+            if isinstance(operand, ast.UnaryOp):
+                operand = operand.operand
+            if (
+                isinstance(operand, ast.Constant)
+                and isinstance(operand.value, float)
+            ):
+                report.add(
+                    ctx.rel,
+                    node.lineno,
+                    "NOS008",
+                    f"float equality against {operand.value!r} in numeric code; "
+                    "compare with a tolerance",
+                )
+                return
+
+    def _check_sim_rng(self, ctx: FileContext, node: ast.Call, report: Report) -> None:
+        dotted = _dotted(node.func)
+        if dotted is None:
+            return
+        head, _, rest = dotted.partition(".")
+        module = self._module_of(head)
+        first = rest.split(".")[0] if rest else ""
+        if module == "random" and first and first not in _SEEDED_RANDOM_CTORS:
+            draw = f"random.{first}()"
+        elif module in ("numpy", "np") or module.endswith(".numpy"):
+            sub = rest.split(".")
+            if not (len(sub) >= 2 and sub[0] == "random" and sub[1] not in _SEEDED_NP_CTORS):
+                return
+            draw = f"np.random.{sub[1]}()"
+        else:
+            return
+        report.add(
+            ctx.rel,
+            node.lineno,
+            "NOS009",
+            f"unseeded global-RNG draw {draw} on a simulation/planner path; "
+            "inject a seeded random.Random / np.random.default_rng instead",
+        )

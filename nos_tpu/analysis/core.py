@@ -1,0 +1,207 @@
+"""Single-pass AST analysis engine.
+
+Each file is read and parsed ONCE; one recursive traversal maintains an
+ancestor stack and fans every node out to every registered checker (the
+kube-scheduler framework idiom: one pass, pluggable per-node plugins).
+Checkers accumulate per-file or cross-file state and emit findings either
+inline (visit) or at end-of-run (finish — used by the cross-file protocol
+round-trip and lock-graph checkers).
+
+Inline suppression: a finding is dropped when its source line carries a
+`# nos-lint: ignore[CODE]` (or blanket `# nos-lint: ignore`) comment.
+File-level suppression with a rationale lives in the committed baseline
+(see baseline.py) so the tree stays greppable for WHY a finding is allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_IGNORE_RE = re.compile(r"#\s*nos-lint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One structured finding: stable identity is (code, path, message) —
+    line numbers churn with unrelated edits, so the baseline keys off the
+    message, not the line."""
+
+    path: str  # posix-style, relative to the engine root
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class FileContext:
+    """Per-file traversal context handed to checkers on every visit."""
+
+    def __init__(self, root: str, path: str, source: str, tree: ast.Module):
+        self.root = root
+        self.path = path
+        self.rel = os.path.relpath(path, root).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # Ancestor stack maintained by the engine during traversal:
+        # stack[-1] is the direct parent of the node being visited.
+        self.stack: List[ast.AST] = []
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.path)
+
+    @property
+    def segments(self) -> Tuple[str, ...]:
+        return tuple(self.rel.split("/"))
+
+    def parent(self, depth: int = 1) -> Optional[ast.AST]:
+        return self.stack[-depth] if len(self.stack) >= depth else None
+
+    def enclosing(self, *types) -> Optional[ast.AST]:
+        """Innermost ancestor of one of `types`, or None."""
+        for node in reversed(self.stack):
+            if isinstance(node, types):
+                return node
+        return None
+
+    def enclosing_all(self, *types) -> List[ast.AST]:
+        """All ancestors of the given types, innermost first."""
+        return [n for n in reversed(self.stack) if isinstance(n, types)]
+
+    def is_docstring(self, node: ast.AST) -> bool:
+        """True when `node` is the docstring literal of its enclosing
+        module/class/function (wire literals quoted in prose are fine)."""
+        if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+            return False
+        p = self.parent()
+        if not isinstance(p, ast.Expr):
+            return False
+        gp = self.parent(2)
+        return (
+            isinstance(gp, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef))
+            and bool(gp.body)
+            and gp.body[0] is p
+        )
+
+
+class Report:
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []
+
+    def add(self, rel: str, line: int, code: str, message: str) -> None:
+        self.findings.append(Finding(rel, line, code, message))
+
+
+class Checker:
+    """Base class for domain checkers. Override any subset of the hooks;
+    `codes` lists every finding code the checker can emit (used by --select
+    and the docs)."""
+
+    name = "checker"
+    codes: Tuple[str, ...] = ()
+    description = ""
+
+    def begin_file(self, ctx: FileContext) -> None:  # pragma: no cover - hook
+        pass
+
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        pass
+
+    def end_file(self, ctx: FileContext, report: Report) -> None:  # pragma: no cover - hook
+        pass
+
+    def finish(self, report: Report) -> None:  # pragma: no cover - hook
+        pass
+
+
+class Engine:
+    def __init__(self, checkers: Sequence[Checker], root: Optional[str] = None):
+        self.checkers = list(checkers)
+        self.root = os.path.abspath(root) if root else os.getcwd()
+
+    # -- discovery -----------------------------------------------------------
+    @staticmethod
+    def discover(paths: Iterable[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = sorted(
+                        d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+                    )
+                    for f in sorted(filenames):
+                        if f.endswith(".py"):
+                            out.append(os.path.join(dirpath, f))
+            elif p.endswith(".py"):
+                out.append(p)
+        return out
+
+    # -- the single pass -----------------------------------------------------
+    def run(self, paths: Iterable[str], select: Optional[Iterable[str]] = None) -> List[Finding]:
+        checkers = self.checkers
+        if select is not None:
+            wanted = set(select)
+            checkers = [c for c in checkers if wanted.intersection(c.codes)]
+        report = Report()
+        ignore_lines: Dict[str, Dict[int, Optional[set]]] = {}
+        for path in self.discover(paths):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError) as e:
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                line = getattr(e, "lineno", 1) or 1
+                report.add(rel, line, "NOS000", f"unparseable file: {e.__class__.__name__}")
+                continue
+            ctx = FileContext(self.root, path, source, tree)
+            ignore_lines[ctx.rel] = self._scan_ignores(ctx.lines)
+            for c in checkers:
+                c.begin_file(ctx)
+            self._walk(ctx, tree, checkers, report)
+            for c in checkers:
+                c.end_file(ctx, report)
+        for c in checkers:
+            c.finish(report)
+        findings = self._apply_inline_ignores(report.findings, ignore_lines)
+        if select is not None:
+            wanted = set(select)
+            findings = [f for f in findings if f.code in wanted]
+        return sorted(set(findings))
+
+    def _walk(self, ctx: FileContext, node: ast.AST, checkers, report: Report) -> None:
+        for c in checkers:
+            c.visit(ctx, node, report)
+        ctx.stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(ctx, child, checkers, report)
+        ctx.stack.pop()
+
+    # -- inline ignores ------------------------------------------------------
+    @staticmethod
+    def _scan_ignores(lines: List[str]) -> Dict[int, Optional[set]]:
+        """line number -> set of ignored codes (None = ignore everything)."""
+        out: Dict[int, Optional[set]] = {}
+        for i, line in enumerate(lines, start=1):
+            m = _IGNORE_RE.search(line)
+            if not m:
+                continue
+            codes = m.group(1)
+            out[i] = {c.strip() for c in codes.split(",")} if codes else None
+        return out
+
+    @staticmethod
+    def _apply_inline_ignores(findings, ignore_lines) -> List[Finding]:
+        kept = []
+        for f in findings:
+            codes = ignore_lines.get(f.path, {}).get(f.line, "missing")
+            if codes == "missing" or (codes is not None and f.code not in codes):
+                kept.append(f)
+        return kept

@@ -10,6 +10,7 @@ metricsexporter; SURVEY.md §2.1).
     python -m nos_tpu.cli telemetry       [--share]
     python -m nos_tpu.cli demo            # single-process full system demo
     python -m nos_tpu.cli simulate        # north-star capacity simulation
+    python -m nos_tpu.cli lint            # domain-aware static analysis
 
 Outside a k8s deployment these run against the in-process cluster bus; the
 `demo` subcommand assembles the whole control plane, carves a mesh for a
@@ -385,7 +386,7 @@ def cmd_demo(args) -> int:
                 },
             ),
             status=NodeStatus(
-                allocatable=ResourceList.of({"cpu": 64, "google.com/tpu": 16})
+                allocatable=ResourceList.of({"cpu": 64, constants.RESOURCE_TPU: 16})
             ),
         )
     )
@@ -394,7 +395,11 @@ def cmd_demo(args) -> int:
         metadata=ObjectMeta(name="jax-job", namespace="demo"),
         spec=PodSpec(
             containers=[
-                Container(resources=ResourceList.of({"google.com/tpu-2x2": 1, "cpu": 1}))
+                Container(
+                    resources=ResourceList.of(
+                        {f"{constants.RESOURCE_TPU_SLICE_PREFIX}2x2": 1, "cpu": 1}
+                    )
+                )
             ],
             scheduler_name=constants.SCHEDULER_NAME,
         ),
@@ -515,6 +520,40 @@ def _simulate_multihost(args) -> int:
     report = sim.run(jobs, measure_window=window, max_s=args.max_seconds)
     print(json.dumps(report.to_dict()))
     return 0
+
+
+def cmd_lint(args) -> int:
+    """Domain-aware static analysis (docs/static-analysis.md): wire-protocol
+    literals, protocol round-trips, exception hygiene, lock discipline, JAX
+    trace-safety. Exit 0 iff every finding is covered by the baseline."""
+    from nos_tpu import analysis
+
+    baseline = args.baseline
+    if baseline is None and not args.no_baseline and os.path.exists("lint-baseline.txt"):
+        baseline = "lint-baseline.txt"
+    engine = analysis.Engine(analysis.all_checkers(), root=args.root)
+    select = [c.strip() for c in args.select.split(",")] if args.select else None
+    findings = engine.run(args.paths, select=select)
+    if args.write_baseline:
+        analysis.write_baseline(findings, args.write_baseline)
+        print(f"wrote {len(findings)} entries to {args.write_baseline} "
+              "(fill in the rationale comments before committing)")
+        return 0
+    suppressed, stale = [], []
+    if baseline and not args.no_baseline:
+        entries = analysis.load_baseline(baseline)
+        findings, suppressed, stale = analysis.apply_baseline(findings, entries)
+    for f in findings:
+        print(f.render())
+    for e in stale:
+        print(f"stale baseline entry (matches nothing, remove it): {e.render()}",
+              file=sys.stderr)
+    print(
+        f"nos-tpu lint: {len(findings)} finding(s), "
+        f"{len(suppressed)} suppressed by baseline, {len(stale)} stale entr(y/ies)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
 
 
 def _wait(args) -> int:
@@ -650,6 +689,29 @@ def main(argv=None) -> int:
         help="chips per host VM in --multihost mode",
     )
 
+    p_lint = sub.add_parser("lint", help="domain-aware static analysis")
+    p_lint.add_argument("paths", nargs="*", default=["nos_tpu"])
+    p_lint.add_argument(
+        "--baseline",
+        default=None,
+        help="suppression baseline file (default: ./lint-baseline.txt when present)",
+    )
+    p_lint.add_argument(
+        "--no-baseline", action="store_true", help="report findings ignoring any baseline"
+    )
+    p_lint.add_argument(
+        "--write-baseline",
+        default=None,
+        metavar="FILE",
+        help="write current findings as a fresh baseline to FILE and exit 0",
+    )
+    p_lint.add_argument(
+        "--select", default=None, help="comma-separated checker codes (e.g. NOS001,NOS005)"
+    )
+    p_lint.add_argument(
+        "--root", default=None, help="path findings are reported relative to (default: cwd)"
+    )
+
     args = parser.parse_args(argv)
     handlers = {
         "operator": cmd_operator,
@@ -661,6 +723,7 @@ def main(argv=None) -> int:
         "apiserver": cmd_apiserver,
         "demo": cmd_demo,
         "simulate": cmd_simulate,
+        "lint": cmd_lint,
     }
     return handlers[args.command](args)
 

@@ -237,7 +237,7 @@ class _Informer:
                 pass
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, HTTPException):
                 pass
 
     @staticmethod
@@ -336,7 +336,7 @@ class _Informer:
                 pass
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, HTTPException):
                 pass
 
 
@@ -425,7 +425,7 @@ class KubeCluster:
                 self._local.conn = None
                 try:
                     conn.close()
-                except Exception:  # noqa: BLE001
+                except (OSError, HTTPException):
                     pass
                 # Retry only when it cannot double-apply: idempotent reads, or
                 # a send-phase failure on a stale keep-alive connection (the
@@ -458,7 +458,9 @@ class KubeCluster:
             body = json.loads(raw)
             reason = body.get("reason", "")
             message = body.get("message", raw.decode(errors="replace"))
-        except Exception:  # noqa: BLE001
+        except (ValueError, AttributeError):
+            # Not a JSON Status object (proxy error page, truncated body):
+            # fall back to the raw text.
             reason, message = "", raw.decode(errors="replace")
         if status == 404:
             raise NotFoundError(message)

@@ -45,6 +45,14 @@ from nos_tpu.api.quota_types import (
     ElasticQuotaStatus,
 )
 from nos_tpu.api.resources import ResourceList, parse_quantity
+from nos_tpu.constants import DOMAIN
+
+# The CRD API group IS the protocol domain (deploy/crds.yaml): a drifted
+# apiVersion here desynchronizes every EQ/CEQ round-trip with the emulator
+# and the chart, so both derive from the one constant.
+QUOTA_API_GROUP = DOMAIN
+QUOTA_API_VERSION = "v1alpha1"
+QUOTA_APIVERSION = f"{QUOTA_API_GROUP}/{QUOTA_API_VERSION}"
 
 
 # -- quantities --------------------------------------------------------------
@@ -362,7 +370,7 @@ def eq_to_wire(eq: ElasticQuota) -> Dict[str, Any]:
     if eq.spec.max is not None:
         spec["max"] = resources_to_wire(eq.spec.max)
     return {
-        "apiVersion": "tpu.nos/v1alpha1",
+        "apiVersion": QUOTA_APIVERSION,
         "kind": "ElasticQuota",
         "metadata": meta_to_wire(eq.metadata),
         "spec": spec,
@@ -391,7 +399,7 @@ def ceq_to_wire(ceq: CompositeElasticQuota) -> Dict[str, Any]:
     if ceq.spec.max is not None:
         spec["max"] = resources_to_wire(ceq.spec.max)
     return {
-        "apiVersion": "tpu.nos/v1alpha1",
+        "apiVersion": QUOTA_APIVERSION,
         "kind": "CompositeElasticQuota",
         "metadata": meta_to_wire(ceq.metadata),
         "spec": spec,
@@ -456,12 +464,12 @@ KINDS: Dict[str, KindInfo] = {
         lease_to_wire, lease_from_wire,
     ),
     "ElasticQuota": KindInfo(
-        "ElasticQuota", "tpu.nos", "v1alpha1", "elasticquotas", True,
+        "ElasticQuota", QUOTA_API_GROUP, QUOTA_API_VERSION, "elasticquotas", True,
         eq_to_wire, eq_from_wire, True,
     ),
     "CompositeElasticQuota": KindInfo(
-        "CompositeElasticQuota", "tpu.nos", "v1alpha1", "compositeelasticquotas", True,
-        ceq_to_wire, ceq_from_wire, True,
+        "CompositeElasticQuota", QUOTA_API_GROUP, QUOTA_API_VERSION,
+        "compositeelasticquotas", True, ceq_to_wire, ceq_from_wire, True,
     ),
 }
 

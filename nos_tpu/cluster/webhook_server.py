@@ -146,4 +146,4 @@ class AdmissionWebhookServer:
                 req.send_header("Content-Length", "0")
                 req.end_headers()
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("could not send 500 reply", exc_info=True)

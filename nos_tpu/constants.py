@@ -30,6 +30,7 @@ RESOURCE_TPU_SLICE_REGEX = re.compile(r"^google\.com/tpu-(\d+x\d+(?:x\d+)?)$")
 RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
 RESOURCE_MIG_PREFIX = "nvidia.com/mig-"
 RESOURCE_MIG_REGEX = re.compile(r"^nvidia\.com/mig-(\d+)g\.(\d+)gb$")
+RESOURCE_MPS_PREFIX = "nvidia.com/gpu-"
 RESOURCE_MPS_REGEX = re.compile(r"^nvidia\.com/gpu-(\d+)gb$")
 
 # Synthetic resource injected into pod requests so Elastic Quotas can meter

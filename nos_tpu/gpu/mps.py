@@ -28,8 +28,8 @@ class MpsProfile:
     @classmethod
     def parse(cls, name: str) -> "MpsProfile":
         """Parse '10gb' or 'nvidia.com/gpu-10gb'."""
-        if name.startswith("nvidia.com/gpu-"):
-            name = name[len("nvidia.com/gpu-"):]
+        if name.startswith(constants.RESOURCE_MPS_PREFIX):
+            name = name[len(constants.RESOURCE_MPS_PREFIX):]
         if not name.endswith("gb"):
             raise ValueError(f"invalid MPS profile {name!r}")
         gb = int(name[:-2])
@@ -48,7 +48,7 @@ class MpsProfile:
 
     @property
     def resource(self) -> str:
-        return f"nvidia.com/gpu-{self.name}"
+        return f"{constants.RESOURCE_MPS_PREFIX}{self.name}"
 
     def __lt__(self, other: "MpsProfile") -> bool:
         return self.memory_gb < other.memory_gb

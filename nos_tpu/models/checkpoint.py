@@ -34,7 +34,8 @@ def _try_orbax():
         import orbax.checkpoint as ocp  # type: ignore
 
         return ocp
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — any import failure means "no orbax"
+        logger.debug("orbax unavailable, using npz checkpoint codec", exc_info=True)
         return None
 
 

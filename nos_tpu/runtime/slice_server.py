@@ -15,6 +15,7 @@ futures.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -24,6 +25,8 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 class SliceServer:
@@ -168,6 +171,10 @@ class SliceServer:
                 else:
                     self._fetch(out, futures, n, dispatched_at)
             except Exception as e:  # noqa: BLE001
+                # Scatter to the waiting clients, but ALSO log: when every
+                # future is already done (timed-out callers) the error would
+                # otherwise vanish without a trace.
+                logger.warning("batched execution failed: %s", e, exc_info=True)
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(e)
@@ -183,6 +190,7 @@ class SliceServer:
             try:
                 self._fetch(out, futures, n, dispatched_at)
             except Exception as e:  # noqa: BLE001
+                logger.warning("result fetch failed: %s", e, exc_info=True)
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(e)

@@ -34,6 +34,7 @@ from nos_tpu.api.objects import (
     PodSpec,
 )
 from nos_tpu.api.resources import ResourceList
+from nos_tpu.cluster.client import NotFoundError
 from nos_tpu.config import PartitionerConfig
 from nos_tpu.system import ControlPlane
 from nos_tpu.tpu import Profile, Topology
@@ -568,8 +569,8 @@ class MultiHostSim(_TraceRunner):
             if m is not None:
                 try:
                     self.plane.cluster.delete("Pod", job.namespace, f"{job.name}-{i}")
-                except Exception:  # noqa: BLE001
-                    pass
+                except NotFoundError:
+                    pass  # member already gone: eviction raced completion
 
     def _collect_bound(self, waiting: Dict[str, JobRecord]) -> Dict[str, str]:
         bound: Dict[str, str] = {}
@@ -629,8 +630,8 @@ class MultiHostSim(_TraceRunner):
                 self.plane.cluster.patch(
                     "Pod", job.namespace, f"{job.name}-{i}", mutate
                 )
-            except Exception:  # noqa: BLE001
-                pass
+            except NotFoundError:
+                pass  # member already deleted (eviction raced the finish)
 
 
 def mixed_gang_workload(

@@ -472,7 +472,7 @@ def build_gpu_agent(
         node_name,
         client,
         parse_profile=MpsProfile.from_resource,
-        resource_of=lambda p: f"nvidia.com/gpu-{p}",
+        resource_of=lambda p: f"{constants.RESOURCE_MPS_PREFIX}{p}",
         plugin_client=plugin_client,
         pod_resources_lister=lister,
     )

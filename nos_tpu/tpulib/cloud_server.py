@@ -106,7 +106,7 @@ class FakeCloudTpuServer:
             if qr["state"]["state"] in ("ACTIVE", "PROVISIONING", "ACCEPTED")
         )
 
-    def _materialize_node(self, qr_id: str) -> None:
+    def _materialize_node_locked(self, qr_id: str) -> None:
         """Provisioning completed: the Node now exists, born with the
         nodeSpec's labels (the last moment spec and live labels agree)."""
         spec_labels = (
@@ -123,14 +123,14 @@ class FakeCloudTpuServer:
         self.ops[name] = op
         return op
 
-    def _settle(self) -> None:
+    def _settle_locked(self) -> None:
         """Advance time-driven state: PROVISIONING -> ACTIVE after the delay."""
         now = time.monotonic()
         for qr_id, at in list(self._ready_at.items()):
             if now >= at and qr_id in self.qrs:
                 if self.qrs[qr_id]["state"]["state"] == "PROVISIONING":
                     self.qrs[qr_id]["state"]["state"] = "ACTIVE"
-                    self._materialize_node(qr_id)
+                    self._materialize_node_locked(qr_id)
                 del self._ready_at[qr_id]
 
     # -- request handling ------------------------------------------------------
@@ -155,7 +155,7 @@ class FakeCloudTpuServer:
             if self.fail_next_requests > 0:
                 self.fail_next_requests -= 1
                 return 500, _err(500, "INTERNAL", "injected transient failure"), {}
-            self._settle()
+            self._settle_locked()
 
             m = _OP_RE.match(path)
             if m and method == "GET":
@@ -233,7 +233,7 @@ class FakeCloudTpuServer:
                         return 200, self._new_op(parent, done=True), {}
                     qr["state"] = {"state": "ACTIVE"}
                     self.qrs[want_id] = qr
-                    self._materialize_node(want_id)
+                    self._materialize_node_locked(want_id)
                     return 200, self._new_op(parent, done=True), {}
                 if method == "DELETE" and qr_id:
                     if qr_id not in self.qrs:

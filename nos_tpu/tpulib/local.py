@@ -47,12 +47,15 @@ themselves.
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Tuple
 
 from nos_tpu.tpu import Topology
 from nos_tpu.tpu.shape import Shape
 from nos_tpu.tpulib.fake import FakeTpuClient
 from nos_tpu.tpulib.interface import TpuLibError
+
+logger = logging.getLogger(__name__)
 
 # device_kind prefix -> generation (topology.py _ACCELERATOR_GENERATIONS is
 # keyed by GKE label values; this table is keyed by what the PJRT runtime
@@ -231,6 +234,7 @@ class LocalChipClient(FakeTpuClient):
                 self._wedged[id(d)] = f"memory_stats: {e}"
                 stats = None
             except Exception:  # noqa: BLE001 — optional surface
+                logger.debug("memory_stats probe failed", exc_info=True)
                 stats = None
             if stats:
                 for src, dst in (
@@ -258,7 +262,8 @@ def _probe_chip(device, timeout_s: float) -> Tuple[Optional[str], bool]:
 
         x = jax.device_put(jnp.ones((), jnp.float32), device)
         val = float(jax.block_until_ready(x + x))
-        return None if val == 2.0 else f"probe returned {val}"
+        # 1.0 + 1.0 is IEEE-exact; anything else means a broken device.
+        return None if val == 2.0 else f"probe returned {val}"  # nos-lint: ignore[NOS008]
 
     try:
         return _call_with_deadline(probe, timeout_s), False

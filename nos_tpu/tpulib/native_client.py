@@ -166,7 +166,8 @@ class NativeTpuClient:
             if getattr(self, "_ctx", None):
                 self._lib.tpuslice_destroy(self._ctx)
                 self._ctx = None
-        except Exception:  # noqa: BLE001
+        except Exception:  # nos-lint: ignore[NOS003] — __del__ must never
+            # raise, and logging during interpreter teardown can itself fail.
             pass
 
     # -- TpuClient ----------------------------------------------------------

@@ -138,6 +138,12 @@ class LeaderElector:
             self._last_renew_ok = self._now()
             return True
         except Exception:  # noqa: BLE001 — Conflict, NotFound, or transport
+            logger.debug(
+                "leader election: takeover of %s/%s failed",
+                self.namespace,
+                self.lease_name,
+                exc_info=True,
+            )
             return False
 
     def _renew(self) -> str:
@@ -172,7 +178,13 @@ class LeaderElector:
         try:
             self.cluster.patch("Lease", self.namespace, self.lease_name, clear)
         except (ConflictError, NotFoundError):
-            pass
+            # Someone already took (or deleted) the lease: nothing to release,
+            # but worth a trace when debugging a contested shutdown.
+            logger.debug(
+                "leader election: release of %s/%s skipped (lease gone or stolen)",
+                self.namespace,
+                self.lease_name,
+            )
         self._leading.clear()
 
     # -- campaign loop -------------------------------------------------------

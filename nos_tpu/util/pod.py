@@ -154,5 +154,7 @@ def wanted_subslice_topology(pod: Pod):
 
     try:
         return Profile.parse(value)
-    except Exception:  # noqa: BLE001
+    except ValueError:
+        # Malformed selector value: the pod simply doesn't gang-select a
+        # sub-slice shape.
         return None

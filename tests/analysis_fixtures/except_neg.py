@@ -1,0 +1,43 @@
+"""NOS003/NOS004 negatives: logged, re-raised, forwarded, or narrow."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def logs(cluster):
+    try:
+        cluster.renew()
+    except Exception:
+        logger.exception("renew failed")
+        return False
+
+
+def reraises(cluster, once):
+    try:
+        cluster.renew()
+    except Exception:
+        if once:
+            raise
+        logger.warning("retrying")
+
+
+def forwards(cluster, fut):
+    try:
+        cluster.renew()
+    except Exception as e:
+        fut.set_exception(e)
+
+
+def returns_bound(cluster):
+    try:
+        cluster.renew()
+    except Exception as e:
+        return e  # the error object survives
+
+
+def narrow(cluster):
+    try:
+        cluster.renew()
+    except KeyError:
+        pass  # deliberate control flow on a specific type

@@ -1,0 +1,29 @@
+"""NOS003/NOS004 positives: silent broad handlers and bare excepts."""
+
+
+def silent_swallow(cluster):
+    try:
+        cluster.renew()
+    except Exception:
+        return False  # error vanishes: no log, no raise, no use of it
+
+
+def silent_pass(cluster):
+    try:
+        cluster.release()
+    except BaseException:
+        pass
+
+
+def bare(cluster):
+    try:
+        cluster.poke()
+    except:  # noqa: E722
+        return None
+
+
+def broad_in_tuple(cluster):
+    try:
+        cluster.poke()
+    except (ValueError, Exception):
+        return None

@@ -1,0 +1,48 @@
+"""NOS005/NOS006 negatives: disciplined locking patterns."""
+
+import threading
+
+
+class CleanCache:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._items = {}
+        self._count = 0
+        self._thread = None  # never touched under the lock: not shared
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._count += 1
+
+    def evict(self, key):
+        with self._lock:
+            self._items.pop(key, None)
+            self._count -= 1
+
+    def _drop_locked(self, key):
+        # `_locked` suffix == caller-holds-the-lock convention.
+        self._items.pop(key, None)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.put)  # unshared attr
+        self._thread.start()
+
+
+class Ordered:
+    """Consistent A-then-B nesting: edges, but no cycle."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._n = 0
+
+    def both(self):
+        with self._lock_a:
+            with self._lock_b:
+                self._n += 1
+
+    def also_both(self):
+        with self._lock_a:
+            with self._lock_b:
+                self._n -= 1

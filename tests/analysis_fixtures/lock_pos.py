@@ -1,0 +1,54 @@
+"""NOS005/NOS006 positives: unlocked shared mutation + lock-order cycle."""
+
+import threading
+
+
+class RacyCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._count = 0
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._count += 1
+
+    def evict(self, key):
+        # BUG: _items/_count are lock-guarded in put() but mutated bare here.
+        self._items.pop(key, None)
+        self._count -= 1
+
+
+class AlphaManager:
+    """Holding alpha -> acquires beta (via step); Beta.poll does the reverse:
+    a classic AB/BA inversion across two modules."""
+
+    def __init__(self, beta):
+        self._alpha_lock = threading.Lock()
+        self._beta = beta
+        self._state = {}
+
+    def step(self):
+        with self._alpha_lock:
+            self._state["x"] = 1
+            self._beta.beta_refresh()
+
+    def alpha_touch(self):
+        with self._alpha_lock:
+            self._state["y"] = 2
+
+
+class BetaManager:
+    def __init__(self, alpha):
+        self._beta_lock = threading.Lock()
+        self._alpha = alpha
+        self._view = {}
+
+    def beta_refresh(self):
+        with self._beta_lock:
+            self._view["fresh"] = True
+
+    def poll(self):
+        with self._beta_lock:
+            self._alpha.alpha_touch()

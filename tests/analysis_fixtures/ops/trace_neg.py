@@ -1,0 +1,28 @@
+"""NOS007/NOS008 negatives: pure traced code; impurity outside tracing."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure(x, key):
+    noise = jax.random.uniform(key, x.shape)  # keyed: fine
+    jax.debug.print("x sum {}", x.sum())  # sanctioned hatch
+    return x + noise
+
+
+def host_side_timing(fn, x):
+    t0 = time.perf_counter()  # not traced: fine
+    y = jax.block_until_ready(fn(x))
+    print("elapsed", time.perf_counter() - t0)
+    return y
+
+
+def int_compare(n):
+    return n == 0  # integer equality: fine
+
+
+def tolerant(x):
+    return jnp.abs(x - 0.1) < 1e-6

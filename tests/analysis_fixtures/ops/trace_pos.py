@@ -1,0 +1,36 @@
+"""NOS007/NOS008 positives (lives under an `ops/` segment: in scope)."""
+
+import random
+import time
+from functools import partial
+
+import jax
+import numpy as np
+
+COUNTER = 0
+
+
+@jax.jit
+def decorated_impure(x):
+    t = time.time()  # baked in at trace time
+    print("tracing", x.shape)  # trace-time only
+    return x * t
+
+
+@partial(jax.jit, static_argnums=0)
+def partial_decorated(n, x):
+    noise = np.random.uniform(size=n)  # global RNG at trace time
+    return x + noise
+
+
+def _wrapped_later(x):
+    global COUNTER
+    COUNTER += 1  # global mutation: runs once, not per step
+    return x + random.random()
+
+
+step = jax.jit(_wrapped_later)
+
+
+def threshold(x):
+    return x == 0.1  # float equality in numeric code

@@ -1,0 +1,25 @@
+"""Miniature protocol-constants module for the round-trip checker fixture."""
+
+import re
+
+DOMAIN = "tpu.nos"
+
+# Round-trips (writer in writer.py, reader in reader.py): clean.
+ANNOTATION_SPEC_THING = f"{DOMAIN}/spec-thing"
+LABEL_MODE = f"{DOMAIN}/mode"
+
+# Prefix whose reads arrive only via the derived regex below.
+ANNOTATION_PREFIXED = f"{DOMAIN}/pre-"
+ANNOTATION_PREFIXED_REGEX = re.compile(rf"^{re.escape(ANNOTATION_PREFIXED)}(.+)$")
+
+# One-sided: written in writer.py, never read anywhere.
+ANNOTATION_WRITE_ONLY = f"{DOMAIN}/write-only"
+
+# One-sided: read in reader.py, never written anywhere.
+LABEL_READ_ONLY = f"{DOMAIN}/read-only"
+
+# Dead: defined, never referenced at all.
+ANNOTATION_DEAD = f"{DOMAIN}/dead"
+
+# Externally owned (not domain-prefixed): exempt even though read-only.
+LABEL_EXTERNAL = "cloud.google.com/gke-thing"

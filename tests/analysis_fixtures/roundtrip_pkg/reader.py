@@ -1,0 +1,12 @@
+"""Reader side of the fixture protocol."""
+
+from tests.analysis_fixtures.roundtrip_pkg import constants
+
+
+def consume(annotations, labels):
+    thing = annotations.get(constants.ANNOTATION_SPEC_THING)
+    mode = labels.get(constants.LABEL_MODE)
+    ro = labels.get(constants.LABEL_READ_ONLY)
+    ext = labels.get(constants.LABEL_EXTERNAL)
+    pre = [k for k in annotations if constants.ANNOTATION_PREFIXED_REGEX.match(k)]
+    return thing, mode, ro, ext, pre

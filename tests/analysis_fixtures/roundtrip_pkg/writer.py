@@ -1,0 +1,10 @@
+"""Writer side of the fixture protocol."""
+
+from tests.analysis_fixtures.roundtrip_pkg import constants
+
+
+def stamp(annotations, labels, value):
+    annotations[constants.ANNOTATION_SPEC_THING] = value
+    annotations[constants.ANNOTATION_WRITE_ONLY] = value
+    labels.update({constants.LABEL_MODE: "tpu"})
+    annotations[f"{constants.ANNOTATION_PREFIXED}{value}"] = value

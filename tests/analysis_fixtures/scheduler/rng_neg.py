@@ -1,0 +1,11 @@
+"""NOS009 negatives: seeded/injected RNGs on sim/planner paths."""
+
+import random
+
+import numpy as np
+
+
+def make_trace(seed):
+    rng = random.Random(seed)
+    nprng = np.random.default_rng(seed)
+    return rng.random(), nprng.uniform()

@@ -1,0 +1,13 @@
+"""NOS009 positives (lives under a `scheduler/` segment: sim/planner scope)."""
+
+import random
+
+import numpy as np
+
+
+def jitter_delay():
+    return random.random() * 0.5  # global RNG: destabilizes pinned sim points
+
+
+def sample_nodes(nodes):
+    return np.random.choice(nodes)

@@ -1,0 +1,12 @@
+"""NOS001 negatives: constants-derived names and unrelated literals."""
+
+from nos_tpu import constants
+
+DERIVED = f"{constants.DOMAIN}/v1alpha1"
+SLICE = f"{constants.RESOURCE_TPU_SLICE_PREFIX}2x2"
+UNRELATED = "example.com/other-domain"
+PROSE = "see the google docs"
+
+
+def lookup(labels):
+    return labels.get(constants.LABEL_PARTITIONING)

@@ -1,0 +1,15 @@
+"""NOS001 positives: wire-protocol literals outside constants.py.
+
+Mentioning google.com/tpu in this docstring is fine (docstrings are prose).
+"""
+
+API_VERSION = "tpu.nos/v1alpha1"  # plain literal
+RESOURCE = "google.com/tpu"
+
+
+def resource_of(profile):
+    return f"nvidia.com/gpu-{profile}"  # f-string literal fragment
+
+
+def lookup(labels):
+    return labels.get("tpu.nos/partitioning")

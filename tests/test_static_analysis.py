@@ -1,0 +1,282 @@
+"""Tier-1 gate + unit tests for the domain static-analysis suite
+(nos_tpu/analysis/, docs/static-analysis.md).
+
+The headline test runs every checker over the real `nos_tpu/` tree and
+asserts zero non-baselined findings: a new hardcoded `tpu.nos/` literal,
+one-sided protocol constant, silent exception swallow, unlocked shared
+mutation, or impure jitted call turns into a TEST FAILURE here instead of a
+0.05-utilization regression five PRs later. The rest exercises each checker
+against synthetic fixtures in tests/analysis_fixtures/.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from nos_tpu import analysis
+from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
+from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
+from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+TREE = os.path.join(REPO, "nos_tpu")
+BASELINE = os.path.join(REPO, "lint-baseline.txt")
+
+
+def run_checkers(paths, checkers):
+    engine = analysis.Engine(checkers, root=REPO)
+    return engine.run(paths if isinstance(paths, list) else [paths])
+
+
+def codes_of(findings):
+    return sorted({f.code for f in findings})
+
+
+# -- THE tier-1 gate ---------------------------------------------------------
+def test_tree_has_zero_non_baselined_findings():
+    findings, suppressed, stale = analysis.run(
+        [TREE], baseline_path=BASELINE, root=REPO
+    )
+    assert not findings, "new static-analysis findings:\n" + "\n".join(
+        f.render() for f in findings
+    )
+    # The baseline must stay honest too: entries that no longer match
+    # anything have healed and must be removed.
+    assert not stale, "stale baseline entries:\n" + "\n".join(
+        e.render() for e in stale
+    )
+    # The committed baseline is rationale-annotated, not a dumping ground.
+    for entry in analysis.load_baseline(BASELINE):
+        assert entry.rationale, f"baseline entry without rationale: {entry.render()}"
+
+
+def test_tree_gate_actually_detects_an_injected_literal(tmp_path):
+    # End-to-end sanity that the gate has teeth: a file with a drifted
+    # protocol literal makes the suite non-clean.
+    bad = tmp_path / "drift.py"
+    bad.write_text('APIV = "tpu.nos/v2broken"\n')
+    findings = run_checkers(str(tmp_path), [WireLiteralChecker()])
+    assert codes_of(findings) == ["NOS001"]
+
+
+# -- NOS001 wire literals ----------------------------------------------------
+def test_wire_literal_positives():
+    findings = run_checkers(os.path.join(FIXTURES, "wire_pos.py"), [WireLiteralChecker()])
+    assert codes_of(findings) == ["NOS001"]
+    assert len(findings) == 4  # two plain, one f-string fragment, one .get()
+    assert all("derive it from nos_tpu.constants" in f.message for f in findings)
+
+
+def test_wire_literal_negatives():
+    findings = run_checkers(os.path.join(FIXTURES, "wire_neg.py"), [WireLiteralChecker()])
+    assert findings == []
+
+
+# -- NOS002 protocol round-trip ----------------------------------------------
+def test_protocol_roundtrip_fixture():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "roundtrip_pkg"), [ProtocolRoundTripChecker()]
+    )
+    assert codes_of(findings) == ["NOS002"]
+    by_name = {f.message.split()[2]: f.message for f in findings}
+    assert set(by_name) == {"ANNOTATION_WRITE_ONLY", "LABEL_READ_ONLY", "ANNOTATION_DEAD"}
+    assert "no reader" in by_name["ANNOTATION_WRITE_ONLY"]
+    assert "no writer" in by_name["LABEL_READ_ONLY"]
+    assert "dead protocol key" in by_name["ANNOTATION_DEAD"]
+    # Round-tripped, regex-read, and externally-owned constants stay clean.
+    clean = {"ANNOTATION_SPEC_THING", "LABEL_MODE", "ANNOTATION_PREFIXED", "LABEL_EXTERNAL"}
+    assert not clean & set(by_name)
+
+
+def test_protocol_roundtrip_findings_point_at_constants_py():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "roundtrip_pkg"), [ProtocolRoundTripChecker()]
+    )
+    assert all(f.path.endswith("roundtrip_pkg/constants.py") for f in findings)
+    assert all(f.line > 0 for f in findings)
+
+
+# -- NOS003/NOS004 exception hygiene -----------------------------------------
+def test_exception_hygiene_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "except_pos.py"), [ExceptionHygieneChecker()]
+    )
+    assert codes_of(findings) == ["NOS003", "NOS004"]
+    assert sum(f.code == "NOS003" for f in findings) == 3  # swallow, pass, tuple
+    assert sum(f.code == "NOS004" for f in findings) == 1  # bare
+
+
+def test_exception_hygiene_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "except_neg.py"), [ExceptionHygieneChecker()]
+    )
+    assert findings == []
+
+
+# -- NOS005/NOS006 lock discipline -------------------------------------------
+def test_lock_discipline_positives():
+    findings = run_checkers(os.path.join(FIXTURES, "lock_pos.py"), [LockDisciplineChecker()])
+    nos5 = [f for f in findings if f.code == "NOS005"]
+    nos6 = [f for f in findings if f.code == "NOS006"]
+    # Both bare mutations in evict() are caught, attributed to the lock.
+    assert {m for f in nos5 for m in ("_items", "_count") if m in f.message} == {
+        "_items",
+        "_count",
+    }
+    assert len(nos5) == 2
+    assert all("RacyCache._lock" in f.message for f in nos5)
+    # The AB/BA inversion across AlphaManager/BetaManager closes a cycle.
+    assert len(nos6) == 1
+    assert "lock-order inversion" in nos6[0].message
+    assert "_alpha_lock" in nos6[0].message and "_beta_lock" in nos6[0].message
+
+
+def test_lock_discipline_negatives():
+    findings = run_checkers(os.path.join(FIXTURES, "lock_neg.py"), [LockDisciplineChecker()])
+    assert findings == []
+
+
+# -- NOS007/NOS008/NOS009 trace safety ---------------------------------------
+def test_trace_safety_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "ops", "trace_pos.py"), [TraceSafetyChecker()]
+    )
+    nos7 = [f for f in findings if f.code == "NOS007"]
+    nos8 = [f for f in findings if f.code == "NOS008"]
+    reasons = " | ".join(f.message for f in nos7)
+    assert "time." in reasons
+    assert "print()" in reasons
+    assert "np.random" in reasons
+    assert "global mutation" in reasons
+    assert "random." in reasons  # jax.jit(_wrapped_later)-wrapped function
+    assert len(nos8) == 1 and "0.1" in nos8[0].message
+
+
+def test_trace_safety_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "ops", "trace_neg.py"), [TraceSafetyChecker()]
+    )
+    assert findings == []
+
+
+def test_sim_rng_positives_and_negatives():
+    pos = run_checkers(
+        os.path.join(FIXTURES, "scheduler", "rng_pos.py"), [TraceSafetyChecker()]
+    )
+    assert codes_of(pos) == ["NOS009"]
+    assert len(pos) == 2
+    neg = run_checkers(
+        os.path.join(FIXTURES, "scheduler", "rng_neg.py"), [TraceSafetyChecker()]
+    )
+    assert neg == []
+
+
+def test_scope_gating_out_of_scope_file_is_clean(tmp_path):
+    # Same float-eq code OUTSIDE ops/models/parallel/runtime/tpulib: no scope,
+    # no finding (the rule targets numeric code only).
+    f = tmp_path / "controllers_like.py"
+    f.write_text("def check(x):\n    return x == 0.1\n")
+    findings = run_checkers(str(f), [TraceSafetyChecker()])
+    assert findings == []
+
+
+# -- engine: inline suppression ----------------------------------------------
+def test_inline_ignore_suppresses_only_named_code(tmp_path):
+    f = tmp_path / "inline.py"
+    f.write_text(
+        'A = "tpu.nos/explicitly-allowed"  # nos-lint: ignore[NOS001]\n'
+        'B = "tpu.nos/not-allowed"\n'
+        'C = "tpu.nos/wrong-code"  # nos-lint: ignore[NOS999]\n'
+        'D = "tpu.nos/blanket"  # nos-lint: ignore\n'
+    )
+    findings = run_checkers(str(f), [WireLiteralChecker()])
+    assert [f"line{x.line}" for x in findings] == ["line2", "line3"]
+
+
+# -- baseline: round-trip + staleness ----------------------------------------
+def test_baseline_roundtrip(tmp_path):
+    findings = run_checkers(os.path.join(FIXTURES, "wire_pos.py"), [WireLiteralChecker()])
+    assert findings
+    path = str(tmp_path / "baseline.txt")
+    analysis.write_baseline(findings, path)
+    entries = analysis.load_baseline(path)
+    assert len(entries) == len(findings)
+    assert all(e.rationale for e in entries)  # write_baseline stubs a rationale
+    kept, suppressed, stale = analysis.apply_baseline(findings, entries)
+    assert kept == [] and len(suppressed) == len(findings) and stale == []
+
+
+def test_baseline_stale_entry_detected(tmp_path):
+    path = tmp_path / "baseline.txt"
+    path.write_text(
+        "# healed long ago\n"
+        "NOS001 nos_tpu/nowhere.py :: wire-protocol literal*\n"
+    )
+    entries = analysis.load_baseline(str(path))
+    kept, suppressed, stale = analysis.apply_baseline([], entries)
+    assert stale == entries
+
+
+def test_baseline_globs_match_families():
+    from nos_tpu.analysis.baseline import parse_baseline
+
+    entries = parse_baseline(
+        "# everything in one dir\nNOS003 nos_tpu/cluster/* :: broad exception*\n"
+    )
+    hit = analysis.Finding("nos_tpu/cluster/kube.py", 7, "NOS003", "broad exception x")
+    miss = analysis.Finding("nos_tpu/util/pod.py", 7, "NOS003", "broad exception x")
+    kept, suppressed, stale = analysis.apply_baseline([hit, miss], entries)
+    assert suppressed == [hit] and kept == [miss]
+
+
+def test_baseline_rejects_malformed_lines():
+    from nos_tpu.analysis.baseline import parse_baseline
+
+    with pytest.raises(ValueError):
+        parse_baseline("NOS001 missing-separator\n")
+
+
+# -- CLI ----------------------------------------------------------------------
+def test_cli_lint_exit_codes(tmp_path, capsys):
+    from nos_tpu.cli import main
+
+    fixture = os.path.join(FIXTURES, "wire_pos.py")
+    assert main(["lint", fixture, "--no-baseline", "--root", REPO]) == 1
+    out = capsys.readouterr().out
+    assert "NOS001" in out and "wire_pos.py" in out
+
+    # Writing a baseline then linting against it goes green.
+    bl = str(tmp_path / "bl.txt")
+    assert main(["lint", fixture, "--root", REPO, "--write-baseline", bl]) == 0
+    assert main(["lint", fixture, "--root", REPO, "--baseline", bl]) == 0
+
+
+def test_cli_lint_select_filters_checkers():
+    from nos_tpu.cli import main
+
+    fixture = os.path.join(FIXTURES, "except_pos.py")
+    assert main(["lint", fixture, "--no-baseline", "--root", REPO,
+                 "--select", "NOS001"]) == 0
+    assert main(["lint", fixture, "--no-baseline", "--root", REPO,
+                 "--select", "NOS003"]) == 1
+
+
+# -- engine robustness --------------------------------------------------------
+def test_engine_reports_unparseable_file(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def oops(:\n")
+    findings = run_checkers(str(f), [WireLiteralChecker()])
+    assert codes_of(findings) == ["NOS000"]
+
+
+def test_findings_are_sorted_and_deduplicated(tmp_path):
+    f = tmp_path / "two.py"
+    f.write_text('B = "tpu.nos/b"\nA = "tpu.nos/a"\n')
+    findings = run_checkers(str(f), [WireLiteralChecker(), WireLiteralChecker()])
+    assert len(findings) == 2  # same checker registered twice: no dupes
+    assert findings == sorted(findings)
