@@ -293,12 +293,22 @@ def paged_verify_window(
     new pool).
 
     This is `paged_prefill_chunk` batched across slots — the DecodeServer's
-    speculative rounds verify every slot's prompt-lookup draft in ONE
-    dispatch (the multi-stream composition of models/speculative.py, which
-    verifies a single stream per dispatch). Rejected rows leave stale K/V
-    beyond the accepted position; the next round's window starts there and
-    overwrites before anything attends that far (same argument as the
-    sidecar's)."""
+    speculative rounds verify every DRAFTING slot's prompt-lookup draft in
+    ONE dispatch (the multi-stream composition of models/speculative.py,
+    which verifies a single stream per dispatch). Rejected rows leave stale
+    K/V beyond the accepted position; the next round's window starts there
+    and overwrites before anything attends that far (same argument as the
+    sidecar's).
+
+    COMPOSITION CONTRACT (decoupled rounds): this program and
+    `paged_decode_step`'s macro loop are dispatched back-to-back within
+    one engine tick against the SAME donated pool, with DISJOINT active
+    masks — each program's masked-off lanes write only the scratch page
+    (block 0) and never its table-owned blocks, so the drafting slots'
+    verify windows and the macro slots' decode steps cannot clobber each
+    other regardless of device execution order within the tick. Anything
+    that would make an inactive lane touch a non-scratch page breaks the
+    DecodeServer's per-tick drafting/macro split."""
     b, w = tokens.shape
     positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
     valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]

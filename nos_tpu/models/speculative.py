@@ -31,6 +31,7 @@ programs total (prompt bucket + verify window), reused every round.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -39,6 +40,57 @@ import numpy as np
 
 from nos_tpu.models.decode import init_paged_cache, paged_prefill_chunk
 from nos_tpu.models.gpt import GPTConfig
+
+
+@dataclass
+class AdaptiveSpec:
+    """Per-slot adaptive speculation controller (DecodeServer).
+
+    Speculation pays only when drafts get accepted: a verify window of W
+    rows costs one dispatch whether 1 or W tokens come back, and a slot
+    whose drafts keep missing is better served by the K-step macro
+    pipeline. This controller keeps an EWMA of each slot's draft
+    acceptance RATE (accepted drafted tokens / drafted tokens per resolved
+    round) and uses it two ways:
+
+      - `cap(k)` shrinks the slot's draft window proportionally to the
+        EWMA, so a half-accepting stream verifies half-width windows
+        (fewer wasted query rows, cheaper rejected tail);
+      - `observe(...)` DEMOTES the slot — drafting denied for `cooldown`
+        generated tokens — when the EWMA falls below `demote_below`, and
+        re-enters with fresh optimism afterwards (repetition is bursty:
+        a stream that stopped repeating may start again).
+
+    Everything here is a pure function of the slot's OWN acceptance
+    history, so adaptive windows never break the engine's determinism: a
+    request's draft schedule does not depend on its co-tenants."""
+
+    alpha: float = 0.5  # EWMA weight of the newest round
+    demote_below: float = 0.2  # EWMA floor; crossing it demotes the slot
+    cooldown: int = 32  # generated tokens drafting stays denied after demotion
+    rate: float = 1.0  # optimistic start: first draft gets the full window
+    denied_until: int = 0  # drafting allowed once `generated` reaches this
+
+    def observe(self, drafted: int, accepted: int, generated: int) -> bool:
+        """Fold one resolved verify round (`drafted` draft tokens sent,
+        `accepted` of them kept; `generated` = the slot's tokens so far).
+        Returns True when this round demoted the slot."""
+        if drafted <= 0:
+            return False
+        self.rate += self.alpha * (accepted / drafted - self.rate)
+        if self.rate < self.demote_below:
+            self.denied_until = generated + self.cooldown
+            self.rate = 1.0  # fresh optimism when the cooldown expires
+            return True
+        return False
+
+    def allowed(self, generated: int) -> bool:
+        return generated >= self.denied_until
+
+    def cap(self, k: int) -> int:
+        """Effective draft window: full `k` at rate 1.0, shrinking with the
+        EWMA, never below 1 (a 1-draft probe is how the rate recovers)."""
+        return max(1, min(k, int(round(k * self.rate))))
 
 
 def find_prompt_lookup_draft(

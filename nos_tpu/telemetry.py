@@ -4,6 +4,14 @@ Mirrors cmd/metricsexporter (metricsexporter.go:33-91, metrics/metrics.go:24-42)
 collect anonymous cluster facts (node/accelerator counts, component versions)
 and POST them once at install time. Opt-in via `share_telemetry`; the sink is
 injectable (and defaults to a no-op logger in zero-egress environments).
+
+The serving plane has the same shape of surface: `ServingReport` /
+`collect_serving` snapshot a DecodeServer's engine counters (dispatches,
+speculative rounds and acceptance, the decoupled drafting/macro split,
+in-flight queue depths) — pure numbers, no tokens, prompts, or request
+content. Live scraping goes through the engine's optional `metrics`
+registry (observability.Metrics, `nos_tpu_decode_*` series); this module
+is the one-shot, opt-in export of the same facts.
 """
 
 from __future__ import annotations
@@ -67,6 +75,74 @@ def export(
     if sink is None:
         # Zero-egress default: log instead of POSTing.
         logger.info("telemetry report: %s", payload)
+    else:
+        sink(payload)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Serving-plane counters (DecodeServer)
+# ---------------------------------------------------------------------------
+@dataclass
+class ServingReport:
+    """Counter snapshot of one DecodeServer engine. The field list IS the
+    schema — counts only, never request content."""
+
+    steps_run: int = 0
+    macro_dispatches: int = 0
+    spec_rounds: int = 0
+    spec_tokens_accepted: int = 0
+    spec_demotions: int = 0
+    # Decoupled-round shape: ticks that dispatched a verify AND a macro
+    # window (neighbors kept the pipeline while a slot speculated), and
+    # the per-slot split totals.
+    both_dispatch_ticks: int = 0
+    macro_tokens_by_slot: Dict[str, int] = field(default_factory=dict)
+    spec_rounds_by_slot: Dict[str, int] = field(default_factory=dict)
+    # Queue depths at snapshot time.
+    inflight_dispatches: int = 0
+    pending_verifies: int = 0
+    waiting_requests: int = 0
+
+
+def collect_serving(server) -> ServingReport:
+    """Snapshot `server`'s engine counters (duck-typed: anything exposing
+    the DecodeServer counter attributes works, so tests and future engines
+    need no import cycle through the runtime package)."""
+    report = ServingReport(
+        steps_run=int(getattr(server, "steps_run", 0)),
+        macro_dispatches=int(getattr(server, "macro_dispatches", 0)),
+        spec_rounds=int(getattr(server, "spec_rounds", 0)),
+        spec_tokens_accepted=int(getattr(server, "spec_tokens_accepted", 0)),
+        spec_demotions=int(getattr(server, "spec_demotions", 0)),
+        both_dispatch_ticks=int(getattr(server, "both_dispatch_ticks", 0)),
+        inflight_dispatches=len(getattr(server, "_inflight", ())),
+        pending_verifies=len(getattr(server, "_pending_verifies", ())),
+        waiting_requests=len(getattr(server, "_waiting", ())),
+    )
+    for name, into in (
+        ("macro_tokens_by_slot", report.macro_tokens_by_slot),
+        ("spec_rounds_by_slot", report.spec_rounds_by_slot),
+    ):
+        for idx, value in enumerate(getattr(server, name, ())):
+            into[str(idx)] = int(value)
+    return report
+
+
+def export_serving(
+    server,
+    share_telemetry: bool = False,
+    sink: Optional[Callable[[str], None]] = None,
+) -> Optional[ServingReport]:
+    """Collect and (when opted in) ship the serving report — the same
+    opt-in/zero-egress contract as `export`."""
+    if not share_telemetry:
+        logger.debug("serving telemetry disabled (share_telemetry=false)")
+        return None
+    report = collect_serving(server)
+    payload = json.dumps(asdict(report), sort_keys=True)
+    if sink is None:
+        logger.info("serving telemetry report: %s", payload)
     else:
         sink(payload)
     return report

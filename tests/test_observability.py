@@ -119,6 +119,88 @@ class TestEndpoints:
         assert not errors, errors
 
 
+class TestDecodeServerCounters:
+    """The serving plane's counters flow out two ways: live `nos_tpu_decode_*`
+    series through an injected Metrics registry (scraped here over real
+    HTTP), and the one-shot opt-in telemetry ServingReport."""
+
+    def test_decode_server_publishes_metrics_over_http(self):
+        import jax
+
+        from nos_tpu.models.gpt import GPTConfig, init_gpt
+        from nos_tpu.runtime.decode_server import DecodeServer
+
+        cfg = GPTConfig(
+            vocab=97, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=64
+        )
+        params = init_gpt(jax.random.PRNGKey(0), cfg)
+        registry = Metrics()
+        srv = ObservabilityServer(registry, HealthManager(), port=0).start()
+        engine = DecodeServer(
+            params, cfg, n_slots=2, max_len=64, metrics=registry
+        ).start()
+        try:
+            engine.generate([5, 11, 3], max_new=6, timeout=120)
+        finally:
+            engine.stop()
+        try:
+            status, body = get(srv, "/metrics")
+        finally:
+            srv.stop()
+        assert status == 200
+        # Dispatch counters moved...
+        assert "nos_tpu_decode_steps_total" in body
+        assert "nos_tpu_decode_macro_dispatches_total" in body
+        assert registry.get("nos_tpu_decode_steps") >= 1
+        # ...and the per-tick split/queue-depth gauges are exposed.
+        for gauge in (
+            "nos_tpu_decode_slots_drafting",
+            "nos_tpu_decode_slots_macro",
+            "nos_tpu_decode_inflight_dispatches",
+            "nos_tpu_decode_pending_verifies",
+            "nos_tpu_decode_waiting_requests",
+        ):
+            assert gauge in body, gauge
+
+    def test_serving_report_snapshot_and_optin_export(self):
+        import json
+
+        from nos_tpu.telemetry import collect_serving, export_serving
+
+        class FakeEngine:
+            steps_run = 12
+            macro_dispatches = 9
+            spec_rounds = 3
+            spec_tokens_accepted = 7
+            spec_demotions = 1
+            both_dispatch_ticks = 2
+            macro_tokens_by_slot = [64, 40]
+            spec_rounds_by_slot = [3, 0]
+            _inflight = [object()]
+            _pending_verifies = []
+            _waiting = []
+
+        report = collect_serving(FakeEngine())
+        assert report.steps_run == 12
+        assert report.macro_dispatches == 9
+        assert report.spec_rounds == 3
+        assert report.spec_tokens_accepted == 7
+        assert report.both_dispatch_ticks == 2
+        assert report.macro_tokens_by_slot == {"0": 64, "1": 40}
+        assert report.spec_rounds_by_slot == {"0": 3, "1": 0}
+        assert report.inflight_dispatches == 1
+        assert report.pending_verifies == 0
+        # Opt-in contract: default off -> None and nothing sunk.
+        sunk = []
+        assert export_serving(FakeEngine(), sink=sunk.append) is None
+        assert sunk == []
+        got = export_serving(FakeEngine(), share_telemetry=True, sink=sunk.append)
+        assert got is not None
+        payload = json.loads(sunk[0])
+        assert payload["spec_rounds"] == 3
+        assert payload["macro_tokens_by_slot"] == {"0": 64, "1": 40}
+
+
 def test_metrics_bearer_token_guard():
     """With a token configured, /metrics requires the exact bearer token
     (401 otherwise) while /healthz and /readyz stay open for kubelet

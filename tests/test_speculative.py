@@ -159,3 +159,53 @@ def test_max_new_budget_exact(params):
         got = speculative_generate(params, CFG, prompt, max_new=budget, prompt_chunk=16)
         assert len(got) == budget
         assert got == solo_greedy(params, prompt, budget)
+
+
+# -- the adaptive per-slot controller (DecodeServer decoupled rounds) ---------
+
+
+def test_adaptive_spec_full_acceptance_keeps_full_window():
+    from nos_tpu.models.speculative import AdaptiveSpec
+
+    a = AdaptiveSpec()
+    assert a.cap(8) == 8  # optimistic start: first draft gets everything
+    for g in range(10):
+        assert not a.observe(drafted=6, accepted=6, generated=g * 7)
+    assert a.cap(8) == 8
+    assert a.allowed(1000)
+
+
+def test_adaptive_spec_shrinks_window_then_demotes_and_recovers():
+    from nos_tpu.models.speculative import AdaptiveSpec
+
+    a = AdaptiveSpec()  # alpha .5, demote below .2, cooldown 32
+    # One all-rejected round halves the EWMA -> half the window.
+    assert not a.observe(drafted=6, accepted=0, generated=10)
+    assert a.cap(8) == 4
+    assert not a.observe(drafted=4, accepted=0, generated=11)
+    assert a.cap(8) == 2
+    # Third consecutive miss crosses the floor: demoted, cooldown armed.
+    assert a.observe(drafted=2, accepted=0, generated=12)
+    assert not a.allowed(12)
+    assert not a.allowed(43)
+    # Cooldown expiry re-enters with fresh optimism (full window again).
+    assert a.allowed(44)
+    assert a.cap(8) == 8
+
+
+def test_adaptive_spec_cap_never_below_one():
+    from nos_tpu.models.speculative import AdaptiveSpec
+
+    a = AdaptiveSpec(demote_below=0.0)  # never demote: probe the cap floor
+    for g in range(20):
+        a.observe(drafted=8, accepted=0, generated=g)
+    assert a.cap(8) == 1  # the 1-draft probe is how the rate can recover
+
+
+def test_adaptive_spec_ignores_draftless_rounds():
+    from nos_tpu.models.speculative import AdaptiveSpec
+
+    a = AdaptiveSpec()
+    rate = a.rate
+    assert not a.observe(drafted=0, accepted=0, generated=5)
+    assert a.rate == rate
