@@ -134,11 +134,16 @@ def _decode_phase(jax, jnp) -> dict:
     which exercises the decoupled per-tick drafting/macro split (the old
     batch-wide verify rounds collapsed this scenario to ~10 tok/s for
     every stream; the split keeps non-drafting neighbors on the K-step
-    pipeline while the repetitive slot speculates)."""
+    pipeline while the repetitive slot speculates). PR 4 adds decode
+    latency tails (queue-wait + TTFT p50/p95 from the engine's own
+    samples) and the prefill/decode INTERFERENCE scenario: 7 short
+    decode streams with a 4k prompt arriving mid-flight, the prefill
+    budget swept over {0 (inline baseline), 256, 1024}."""
     import numpy as np
 
     from nos_tpu.models.gpt import GPTConfig, init_gpt
     from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.telemetry import percentile
 
     cfg = GPTConfig(
         vocab=32000, hidden=512, layers=8, heads=8, kv_heads=2, max_seq=8192
@@ -191,12 +196,23 @@ def _decode_phase(jax, jnp) -> dict:
             server.generate(prompts[0], max_new=max_new, timeout=600)
             warm_rounds = server.spec_rounds
             warm_accepted = server.spec_tokens_accepted
+            warm_ttft = len(server.ttft_s)
+            warm_qw = len(server.queue_wait_s)
             t0 = time.perf_counter()
             futs = [server.submit(p, max_new=max_new) for p in prompts]
             for f in futs:
                 f.result(timeout=600)
             wall = time.perf_counter() - t0
+            # Latency tails of the timed run only (warm-up sliced off):
+            # TTFT = submit -> final-prefill-chunk dispatch, queue wait =
+            # submit -> slot reservation, from the engine's own samples.
+            timed_ttft = server.ttft_s[warm_ttft:]
+            timed_qw = server.queue_wait_s[warm_qw:]
             stats = {
+                "ttft_p50_s": round(percentile(timed_ttft, 50), 4),
+                "ttft_p95_s": round(percentile(timed_ttft, 95), 4),
+                "queue_wait_p50_s": round(percentile(timed_qw, 50), 4),
+                "queue_wait_p95_s": round(percentile(timed_qw, 95), 4),
                 "spec_rounds": server.spec_rounds - warm_rounds,
                 "spec_accepted": server.spec_tokens_accepted - warm_accepted,
                 # Decoupling witnesses (engine-lifetime; the warm request
@@ -226,10 +242,14 @@ def _decode_phase(jax, jnp) -> dict:
         "decode:1stream", lambda: measure(1, 16, 32, max_len=128)
     )
     out["tok_s_1_stream"] = round(tok_s, 1)
-    tok_s, _ = _retry(
+    tok_s, sstats = _retry(
         "decode:8stream", lambda: measure(8, 16, 32, max_len=128)
     )
     out["tok_s_8_stream"] = round(tok_s, 1)
+    # Decode latency percentiles (VERDICT: the decode section reported no
+    # tails): queue-wait and TTFT of the 8 concurrent streams.
+    for key in ("ttft_p50_s", "ttft_p95_s", "queue_wait_p50_s", "queue_wait_p95_s"):
+        out[f"{key}_8_stream"] = sstats[key]
     tok_s, _ = _retry(
         "decode:4k_context",
         lambda: measure(1, 4096, 128, max_len=8192),
@@ -295,6 +315,80 @@ def _decode_phase(jax, jnp) -> dict:
     out["mixed_both_dispatch_ticks"] = mstats["both_dispatch_ticks"]
     out["mixed_macro_tok_per_dispatch"] = mstats["macro_tok_per_dispatch"]
     out["mixed_spec_demotions"] = mstats["spec_demotions"]
+
+    # Prefill/decode interference (PR 4): 7 short decode streams running,
+    # then ONE 4k-token prompt arrives mid-flight. Reports the decode
+    # throughput the 7 streams sustain DURING the arrival's prefill window
+    # (submit -> final-chunk dispatch) and the arrival's TTFT, swept over
+    # the prefill budget: 0 = the inline-prefill baseline (admission-tick
+    # drain freezes decode for the whole prompt), 256 = one bounded chunk
+    # per tick, 1024 = four chunks per tick (the latency/throughput knob's
+    # other end).
+    def interference(budget):
+        srng = np.random.default_rng([4096, 7, budget])
+        short_prompts = [
+            srng.integers(1, cfg.vocab, 128).tolist() for _ in range(7)
+        ]
+        long_prompt = srng.integers(1, cfg.vocab, 4096).tolist()
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=8,
+            max_len=8192,
+            prompt_buckets=(16, 32, 64, 128, 256),
+            steps_per_dispatch=16,
+            prefill_budget_tokens=budget,
+        ).start()
+        try:
+            # Warm BOTH shapes: the short streams' programs and the long
+            # prompt's chunk/window programs, so the measured window holds
+            # no compiles.
+            server.generate(short_prompts[0], max_new=32, timeout=600)
+            server.generate(long_prompt, max_new=2, timeout=600)
+            warm_macro = server.macro_dispatches
+            warm_ttft = len(server.ttft_s)
+            t0 = time.perf_counter()
+            shorts = [server.submit(p, max_new=512) for p in short_prompts]
+            # All 7 shorts prefilled AND steady-state decode underway before
+            # the long prompt arrives — so the next TTFT sample is provably
+            # the 4k arrival's.
+            while (
+                len(server.ttft_s) < warm_ttft + 7
+                or server.macro_dispatches < warm_macro + 4
+            ):
+                if time.perf_counter() - t0 > 300:
+                    raise RuntimeError("interference: decode never started")
+                time.sleep(0.002)
+            n_ttft = len(server.ttft_s)
+            base_tokens = int(server.macro_tokens_by_slot.sum())
+            t_long = time.perf_counter()
+            flong = server.submit(long_prompt, max_new=16)
+            while len(server.ttft_s) <= n_ttft:
+                if time.perf_counter() - t_long > 600:
+                    raise RuntimeError("interference: 4k prefill never finished")
+                time.sleep(0.002)
+            window = time.perf_counter() - t_long
+            during = int(server.macro_tokens_by_slot.sum()) - base_tokens
+            for f in shorts:
+                f.result(timeout=600)
+            flong.result(timeout=600)
+            wall = time.perf_counter() - t0
+            return {
+                "prefill_budget_tokens": budget,
+                "decode_tok_s_during_4k_prefill": round(during / window, 1),
+                "prefill_window_s": round(window, 3),
+                "ttft_4k_s": round(server.ttft_s[n_ttft], 3),
+                "tok_s_7_streams_overall": round(7 * 512 / wall, 1),
+                "ticks_with_prefill_and_macro": server.ticks_with_prefill_and_macro,
+                "prefill_dispatches": server.prefill_dispatches,
+            }
+        finally:
+            server.stop()
+
+    out["interference_4k"] = [
+        _retry(f"decode:interference_b{b}", lambda b=b: interference(b))
+        for b in (0, 256, 1024)
+    ]
     return out
 
 

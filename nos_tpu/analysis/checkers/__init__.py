@@ -10,6 +10,7 @@ from nos_tpu.analysis.core import Checker
 
 def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+    from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
     from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
@@ -21,4 +22,5 @@ def all_checkers() -> List[Checker]:
         ExceptionHygieneChecker(),
         LockDisciplineChecker(),
         TraceSafetyChecker(),
+        HostSyncChecker(),
     ]

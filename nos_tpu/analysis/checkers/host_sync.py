@@ -1,0 +1,136 @@
+"""NOS010 — blocking host sync on the serving engine's tick path.
+
+The DecodeServer's whole design is that the tick NEVER waits on the device:
+tokens ride device-resident (`_TokRef`), verify reads pipeline behind macro
+dispatches, and prefill scatters its first token on device. One stray
+`.item()`, `jax.device_get(...)`, `np.asarray(device_value)`, or
+`.block_until_ready()` inside a tick-path method re-introduces the
+synchronous device->host round trip that collapsed the round-5 engine
+(117 -> 10.3 tok/s batch-wide) — on a network-attached chip each such call
+costs a full link RTT per tick.
+
+Scope: files under `runtime/` that contain an ENGINE class (a class
+defining `_tick`). Flagged regions are the engine class's methods reachable
+from `_tick`/`_run` via `self.method()` calls (client-side methods like
+`submit`/`generate` are off the tick path and stay legal), plus every
+method of helper classes in the same file — helpers like `_TokRef` exist to
+be called from the tick, so they are tick-path by construction; move
+genuinely client-side helpers to another module or suppress inline.
+Sanctioned sites (the ONE deliberate materialization point; `np.asarray`
+over a host-side list) carry `# nos-lint: ignore[NOS010]` with a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from nos_tpu.analysis.core import Checker, FileContext, Report
+from nos_tpu.analysis.checkers.trace_safety import _dotted
+
+_ROOTS = ("_tick", "_run")
+
+_BLOCKING = {
+    "jax.device_get": "jax.device_get() (synchronous device->host transfer)",
+    "numpy.asarray": "np.asarray() on a device value (synchronous "
+    "device->host transfer)",
+}
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    codes = ("NOS010",)
+    description = "blocking host syncs on the serving engine's tick path"
+
+    def __init__(self) -> None:
+        self._active = False
+        self._aliases: Dict[str, str] = {}
+        self._scope_funcs: Set[ast.AST] = set()
+
+    # -- per-file prescan ----------------------------------------------------
+    def begin_file(self, ctx: FileContext) -> None:
+        self._active = "runtime" in ctx.segments[:-1]
+        self._aliases = {}
+        self._scope_funcs = set()
+        if not self._active:
+            return
+        engine: List[Dict[str, ast.AST]] = []
+        helpers: List[Dict[str, ast.AST]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self._aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif isinstance(node, ast.ClassDef):
+                methods = {
+                    n.name: n
+                    for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                (engine if "_tick" in methods else helpers).append(methods)
+        if not engine:
+            self._active = False
+            return
+        for methods in engine:
+            for name in self._reachable(methods):
+                self._scope_funcs.add(methods[name])
+        for methods in helpers:
+            self._scope_funcs.update(methods.values())
+
+    @staticmethod
+    def _reachable(methods: Dict[str, ast.AST]) -> Set[str]:
+        """Methods reachable from the tick roots via `self.method()` calls
+        (the same unambiguous local resolution NOS006 uses for callees)."""
+        seen = {r for r in _ROOTS if r in methods}
+        queue = list(seen)
+        while queue:
+            body = methods[queue.pop()]
+            for node in ast.walk(body):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                    continue
+                target = node.func
+                if (
+                    isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and target.attr in methods
+                    and target.attr not in seen
+                ):
+                    seen.add(target.attr)
+                    queue.append(target.attr)
+        return seen
+
+    # -- visit ---------------------------------------------------------------
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if not self._active or not isinstance(node, ast.Call):
+            return
+        if not any(
+            f in self._scope_funcs
+            for f in ctx.enclosing_all(ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            return
+        reason = self._blocking_reason(node)
+        if reason is not None:
+            report.add(
+                ctx.rel,
+                node.lineno,
+                "NOS010",
+                f"blocking host sync on the engine tick path: {reason}; keep "
+                "the read pipelined (_TokRef) or move it off the tick path",
+            )
+
+    def _blocking_reason(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not node.args and not node.keywords:
+                return ".item() (synchronous device->host scalar read)"
+            if fn.attr == "block_until_ready":
+                return ".block_until_ready() (waits out the whole dispatch queue)"
+        dotted = _dotted(fn)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        module = self._aliases.get(head, head)
+        full = f"{module}.{rest}" if rest else module
+        return _BLOCKING.get(full)

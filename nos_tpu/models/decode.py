@@ -272,7 +272,7 @@ def paged_prefill_chunk(
     return logits[0], new_cache
 
 
-def paged_verify_window(
+def _paged_window_core(
     params,
     tokens,
     cfg: GPTConfig,
@@ -283,32 +283,11 @@ def paged_verify_window(
     mask,
     block_size: int,
 ):
-    """Batched speculative-verify window over the shared paged pool: tokens
-    [B, W] are per-slot draft windows (window[0] = the slot's last accepted
-    token), each slot writing K/V at its own positions pos[b]..pos[b]+
-    lengths[b]-1 into its own pages and attending causally over its
-    confirmed prefix plus the window. Rows beyond lengths[b] (window
-    padding) and lanes with mask[b]=False write to the scratch page and
-    yield garbage logits the caller ignores. Returns (logits [B, W, vocab],
-    new pool).
-
-    This is `paged_prefill_chunk` batched across slots — the DecodeServer's
-    speculative rounds verify every DRAFTING slot's prompt-lookup draft in
-    ONE dispatch (the multi-stream composition of models/speculative.py,
-    which verifies a single stream per dispatch). Rejected rows leave stale
-    K/V beyond the accepted position; the next round's window starts there
-    and overwrites before anything attends that far (same argument as the
-    sidecar's).
-
-    COMPOSITION CONTRACT (decoupled rounds): this program and
-    `paged_decode_step`'s macro loop are dispatched back-to-back within
-    one engine tick against the SAME donated pool, with DISJOINT active
-    masks — each program's masked-off lanes write only the scratch page
-    (block 0) and never its table-owned blocks, so the drafting slots'
-    verify windows and the macro slots' decode steps cannot clobber each
-    other regardless of device execution order within the tick. Anything
-    that would make an inactive lane touch a non-scratch page breaks the
-    DecodeServer's per-tick drafting/macro split."""
+    """Shared body of the batched per-slot window programs
+    (`paged_verify_window`, `paged_prefill_window`): tokens [B, W] written
+    at per-row positions pos[b]..pos[b]+lengths[b]-1 into each row's own
+    pages, attending causally over the confirmed prefix plus the window.
+    Returns (pre-final-norm activations [B, W, h], new pool)."""
     b, w = tokens.shape
     positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
     valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]
@@ -337,9 +316,84 @@ def paged_verify_window(
             )
 
         x = _block_core(x, p, cfg, positions, attend)
+    return x, new_cache
+
+
+def paged_verify_window(
+    params,
+    tokens,
+    cfg: GPTConfig,
+    pcache,
+    table,
+    pos,
+    lengths,
+    mask,
+    block_size: int,
+):
+    """Batched speculative-verify window over the shared paged pool: tokens
+    [B, W] are per-slot draft windows (window[0] = the slot's last accepted
+    token), each slot writing K/V at its own positions pos[b]..pos[b]+
+    lengths[b]-1 into its own pages and attending causally over its
+    confirmed prefix plus the window. Rows beyond lengths[b] (window
+    padding) and lanes with mask[b]=False write to the scratch page and
+    yield garbage logits the caller ignores. Returns (logits [B, W, vocab],
+    new pool).
+
+    This is `paged_prefill_chunk` batched across slots — the DecodeServer's
+    speculative rounds verify every DRAFTING slot's prompt-lookup draft in
+    ONE dispatch (the multi-stream composition of models/speculative.py,
+    which verifies a single stream per dispatch). Rejected rows leave stale
+    K/V beyond the accepted position; the next round's window starts there
+    and overwrites before anything attends that far (same argument as the
+    sidecar's).
+
+    COMPOSITION CONTRACT (decoupled rounds): this program,
+    `paged_prefill_window`'s chunk waves, and `paged_decode_step`'s macro
+    loop are dispatched back-to-back within one engine tick against the
+    SAME donated pool, with DISJOINT active masks — each program's
+    masked-off lanes write only the scratch page (block 0) and never its
+    table-owned blocks, so the prefilling slots' chunk windows, the
+    drafting slots' verify windows, and the macro slots' decode steps
+    cannot clobber each other regardless of device execution order within
+    the tick. Anything that would make an inactive lane touch a
+    non-scratch page breaks the DecodeServer's per-tick
+    prefill/drafting/macro split."""
+    x, new_cache = _paged_window_core(
+        params, tokens, cfg, pcache, table, pos, lengths, mask, block_size
+    )
     x = _rmsnorm(x, params["ln_f"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
+
+
+def paged_prefill_window(
+    params,
+    tokens,
+    cfg: GPTConfig,
+    pcache,
+    table,
+    pos,
+    lengths,
+    mask,
+    block_size: int,
+):
+    """Multi-slot batched prefill chunk: `paged_prefill_chunk` batched
+    across slots, via the same windowed core as `paged_verify_window`.
+    Each active row b writes its chunk's K/V at positions
+    pos[b]..pos[b]+lengths[b]-1 into its own pages; inactive rows and
+    window padding hit the scratch page. The DecodeServer's budgeted
+    prefill scheduler uses this to dispatch same-bucket mid-prompt chunks
+    from DIFFERENT admitting slots as ONE program — a prefill wave that
+    composes with the macro and verify dispatches of the same tick under
+    the composition contract above. Mid-prompt chunks only feed the
+    cache, so the [B, W, vocab] head projection is skipped entirely (the
+    `with_logits=False` reasoning of `paged_prefill_chunk`); final chunks
+    go through the per-slot `_prefill_last` variant instead, which samples
+    the first token. Returns the new pool."""
+    _, new_cache = _paged_window_core(
+        params, tokens, cfg, pcache, table, pos, lengths, mask, block_size
+    )
+    return new_cache
 
 
 # -- ragged (per-row position) decoding --------------------------------------

@@ -18,6 +18,14 @@ TPU-shaped by construction:
     n_slots x max_len. Prompts are PREFILLED IN CHUNKS (bucket-sized padded
     dispatches), so admission cost is bounded regardless of prompt length
     and 1k+-token prompts serve through the same compiled programs;
+  - prefill is TOKEN-BUDGETED per tick (Sarathi-Serve-style stall-free
+    batching): admission only RESERVES a slot, serial, and KV blocks and
+    enqueues a prefill cursor; each tick then spends at most
+    `prefill_budget_tokens` of chunked-prefill work — same-bucket
+    mid-prompt chunks from different admitting slots batched through one
+    `paged_prefill_window` dispatch — in the SAME tick as the macro
+    K-step program and any speculative verify, so one 4k-token arrival
+    no longer freezes every active decode slot for its whole prefill;
   - the token loop is DEVICE-RESIDENT: each step's sampled tokens feed the
     next step directly on device, and prefill scatters its first token into
     the device-side token vector, so neither admission nor steady-state
@@ -41,6 +49,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -56,6 +65,7 @@ from nos_tpu.models.decode import (
     init_paged_cache,
     paged_decode_step,
     paged_prefill_chunk,
+    paged_prefill_window,
     paged_verify_window,
 )
 from nos_tpu.models.gpt import GPTConfig
@@ -75,8 +85,11 @@ class _TokRef:
         self._np = None
 
     def np(self):
+        # THE sanctioned materialization point: every tick-path host read
+        # funnels through here, deliberately deferred until the value is
+        # needed (or ready — see _resolve_verifies' pipelined reads).
         if self._np is None:
-            self._np = np.asarray(self._arr)
+            self._np = np.asarray(self._arr)  # nos-lint: ignore[NOS010]
             self._arr = None
         return self._np
 
@@ -99,6 +112,19 @@ class _TokRef:
 @dataclass
 class _Slot:
     active: bool = False
+    # Budgeted-prefill state machine: "idle" -> (admission reserves slot,
+    # serial, KV blocks, and a prefill cursor) "reserved" -> (first chunk
+    # dispatched) "prefilling" -> (final chunk dispatched, first token
+    # sampled) "decoding". Only "decoding" slots join the macro and draft
+    # active masks — prefilling slots are masked out of both, mirroring
+    # the drafter masking of the decoupled verify split.
+    phase: str = "idle"
+    # Prompt tokens not yet dispatched to the device: pending_prompt holds
+    # the full prompt until the final chunk dispatches; prefill_cursor is
+    # the next prompt offset the budget scheduler will dispatch.
+    pending_prompt: Optional[list] = None
+    prefill_cursor: int = 0
+    t_submit: float = 0.0  # monotonic clock at submit(), for TTFT/queue-wait
     pos: int = 0  # next cache write index (dispatched, not materialized)
     remaining: int = 0  # generated tokens still to dispatch
     # Token sources in generation order: (ref, lane, row) — row None = the
@@ -149,6 +175,7 @@ class DecodeServer:
         spec_k: int = 0,
         spec_ngram: int = 3,
         spec_sync: bool = False,
+        prefill_budget_tokens: Optional[int] = None,
         metrics=None,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
@@ -216,6 +243,25 @@ class DecodeServer:
         tests/test_decode_server.py), and the verify round's host read is
         pipelined behind continuing macro dispatches.
 
+        `prefill_budget_tokens` bounds how many PROMPT tokens of
+        chunked-prefill work one tick may dispatch (the latency/throughput
+        knob of Sarathi-Serve-style stall-free batching). Admission no
+        longer runs a prompt's whole prefill inline: it reserves the slot,
+        serial, and KV blocks and enqueues a prefill cursor; the tick's
+        budget scheduler then spends up to this many tokens per tick on
+        prefill chunks, round-robin across admitted slots, batching
+        same-bucket mid-prompt chunks from different slots through one
+        `paged_prefill_window` dispatch — in the same tick as (and
+        device-ordered with) the macro and verify dispatches, over
+        disjoint page sets. Default None = the largest prompt bucket (one
+        bounded chunk per tick); 0 = UNBUDGETED, draining every admitted
+        prompt's prefill in its admission tick (the pre-budget inline
+        behavior — the interference baseline). The first chunk of a tick
+        always dispatches even when it alone exceeds the budget, so
+        prefill can never stall outright. Greedy exactness is unaffected:
+        per slot, chunk boundaries and the first-token sample/scatter are
+        identical to the inline path — only WHEN chunks dispatch moves.
+
         `metrics` (optional) is an observability.Metrics-style registry
         (duck-typed: inc/set_gauge); when provided the engine publishes
         its counters and per-tick drafting/macro split under
@@ -250,7 +296,7 @@ class DecodeServer:
         self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
         # FIFO head-of-line admission: a request the pool cannot host yet
         # waits here (never reordered past).
-        self._waiting: Deque[Tuple[list, int, Future]] = deque()
+        self._waiting: Deque[Tuple[list, int, Future, float]] = deque()
         self._queue: "queue.Queue" = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
         self._last_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
@@ -273,6 +319,25 @@ class DecodeServer:
         self.macro_dispatches_by_slot = np.zeros((n_slots,), dtype=np.int64)
         self.spec_rounds_by_slot = np.zeros((n_slots,), dtype=np.int64)
         self._pending_verifies: Deque[_PendingVerify] = deque()
+        # Budgeted prefill: per-tick token cap (None param -> largest
+        # bucket; 0 -> unbudgeted/inline), round-robin fairness pointer,
+        # and the interference counters the regression gate reads.
+        if prefill_budget_tokens is None:
+            prefill_budget_tokens = self.prompt_buckets[-1]
+        self.prefill_budget_tokens = max(0, int(prefill_budget_tokens))
+        self._prefill_rr = 0
+        self.prefill_dispatches = 0
+        self.prefill_tokens = 0
+        # Ticks that dispatched BOTH prefill work and a macro window — the
+        # direct witness that a prefilling prompt did not stall active
+        # decode slots (the prompt-axis analogue of both_dispatch_ticks).
+        self.ticks_with_prefill_and_macro = 0
+        # Per-request latency samples (seconds, monotonic clock):
+        # queue-wait = submit -> slot reservation; TTFT = submit -> final
+        # prefill chunk DISPATCHED (the first token exists on device; host
+        # materialization adds the pipeline delay, which is the point).
+        self.queue_wait_s: List[float] = []
+        self.ttft_s: List[float] = []
         self.metrics = metrics
         self.temperature = float(temperature)
         self.spec_k = max(0, int(spec_k))
@@ -385,6 +450,18 @@ class DecodeServer:
 
             self._verify_fn = jax.jit(_verify, donate_argnums=(2,))
 
+        # Batched multi-slot mid-prompt chunks: one program per bucket,
+        # always [n_slots, bucket]-shaped (inactive rows write scratch), so
+        # the compiled-program set does not depend on which slots happen to
+        # prefill together. Used only when >= 2 slots have same-bucket mid
+        # chunks in one wave — singleton chunks keep the batch-1 program,
+        # so a solo prompt's numerics are bit-identical to the inline path.
+        def _prefill_window(params, tokens, cache, table, pos, lengths, active):
+            return paged_prefill_window(
+                params, tokens, cfg, cache, table, pos, lengths, active, bs
+            )
+
+        self._prefill_window = jax.jit(_prefill_window, donate_argnums=(2,))
         self._prefill_chunk = jax.jit(_prefill_chunk, donate_argnums=(2,))
         # first_vec is deliberately NOT donated: earlier admission waves'
         # _TokRefs still hold previous versions of the vector — donating it
@@ -398,7 +475,7 @@ class DecodeServer:
         if max_new <= 0:
             fut.set_result([])
             return fut
-        self._queue.put((list(prompt), max_new, fut))
+        self._queue.put((list(prompt), max_new, fut, time.monotonic()))
         return fut
 
     def generate(self, prompt: Sequence[int], max_new: int = 16, timeout=None):
@@ -427,12 +504,12 @@ class DecodeServer:
         # Unresolved verify rounds refer to slots that no longer exist.
         self._pending_verifies.clear()
         while self._waiting:
-            _, _, fut = self._waiting.popleft()
+            _, _, fut, _ = self._waiting.popleft()
             if not fut.done():
                 fut.set_exception(exc)
         while True:
             try:
-                _, _, fut = self._queue.get_nowait()
+                _, _, fut, _ = self._queue.get_nowait()
             except queue.Empty:
                 break
             if not fut.done():
@@ -470,51 +547,64 @@ class DecodeServer:
             return None
 
     def _admit(self) -> None:
-        admitted: List[int] = []
+        """Admission only RESERVES: the slot, its serial, its KV blocks,
+        and a prefill cursor. Not one prompt token is dispatched here —
+        the per-tick budget scheduler (_pump_prefill) spends them, so a
+        long arrival can no longer freeze active decode slots behind an
+        admission-time monolithic prefill. A rejected request does not
+        burn its slot for the wave: the SAME slot pulls the next queued
+        request until one admits (or the line drains)."""
         for idx, slot in enumerate(self._slots):
             if slot.active:
                 continue
-            item = self._next_request()
-            if item is None:
-                break
-            prompt, max_new, fut = item
-            if len(prompt) >= self.max_len:
-                fut.set_exception(
-                    ValueError(f"prompt length {len(prompt)} >= max_len {self.max_len}")
-                )
-                continue
-            if len(prompt) + max_new - 1 > self.max_len:
-                # The request cannot complete inside the per-sequence window
-                # — reject rather than silently resolve with fewer tokens
-                # than asked for (a generation finishing at pos == max_len
-                # with remaining == 0 is the exact boundary, hence the -1).
-                fut.set_exception(
-                    ValueError(
-                        f"prompt length {len(prompt)} + max_new {max_new} "
-                        f"exceeds max_len {self.max_len}: output would be "
-                        f"truncated"
+            while True:
+                item = self._next_request()
+                if item is None:
+                    return
+                prompt, max_new, fut, t_submit = item
+                if len(prompt) >= self.max_len:
+                    fut.set_exception(
+                        ValueError(
+                            f"prompt length {len(prompt)} >= max_len {self.max_len}"
+                        )
                     )
-                )
-                continue
-            # Block accounting: cache holds positions 0..len+max_new-2 (the
-            # final sampled token is never re-attended).
-            n_blocks = max(1, -(-(len(prompt) + max_new - 1) // self.block_size))
-            if n_blocks > self.total_blocks - 1:
-                # Bigger than the ENTIRE pool: waiting would hang this
-                # request forever and head-of-line-block everything behind
-                # it. Reject like any other un-servable request.
-                fut.set_exception(
-                    ValueError(
-                        f"request needs {n_blocks} KV blocks; the pool has "
-                        f"{self.total_blocks - 1}"
+                    continue  # same slot: try the next queued request
+                if len(prompt) + max_new - 1 > self.max_len:
+                    # The request cannot complete inside the per-sequence
+                    # window — reject rather than silently resolve with
+                    # fewer tokens than asked for (a generation finishing
+                    # at pos == max_len with remaining == 0 is the exact
+                    # boundary, hence the -1).
+                    fut.set_exception(
+                        ValueError(
+                            f"prompt length {len(prompt)} + max_new {max_new} "
+                            f"exceeds max_len {self.max_len}: output would be "
+                            f"truncated"
+                        )
                     )
+                    continue
+                # Block accounting: cache holds positions 0..len+max_new-2
+                # (the final sampled token is never re-attended).
+                n_blocks = max(
+                    1, -(-(len(prompt) + max_new - 1) // self.block_size)
                 )
-                continue
-            if n_blocks > len(self._free_blocks):
-                # Pool exhausted: wait for running sequences to finish.
-                # FIFO head-of-line — later requests must not starve this
-                # one by sneaking into blocks as they free.
-                self._waiting.appendleft((prompt, max_new, fut))
+                if n_blocks > self.total_blocks - 1:
+                    # Bigger than the ENTIRE pool: waiting would hang this
+                    # request forever and head-of-line-block everything
+                    # behind it. Reject like any other un-servable request.
+                    fut.set_exception(
+                        ValueError(
+                            f"request needs {n_blocks} KV blocks; the pool "
+                            f"has {self.total_blocks - 1}"
+                        )
+                    )
+                    continue
+                if n_blocks > len(self._free_blocks):
+                    # Pool exhausted: wait for running sequences to finish.
+                    # FIFO head-of-line — later requests must not starve
+                    # this one by sneaking into blocks as they free.
+                    self._waiting.appendleft(item)
+                    return
                 break
             blocks = [self._free_blocks.pop() for _ in range(n_blocks)]
             self._slot_blocks[idx] = blocks
@@ -524,45 +614,95 @@ class DecodeServer:
             serial = self._next_serial
             self._next_serial += 1
             self._slot_serial[idx] = serial
-            # Bind the future to the slot BEFORE the chunk loop: if a prefill
-            # dispatch raises mid-loop, the engine's failure sweep
-            # (_fail_outstanding) must find and fail this request — a future
-            # held only in a local would strand its client forever.
+            # Bind the future to the slot at reservation: if a prefill
+            # dispatch raises on a later tick, the engine's failure sweep
+            # (_fail_outstanding) must find and fail this request — a
+            # future held only in a local would strand its client forever.
             slot.active = True
+            slot.phase = "reserved"
             slot.future = fut
-            slot.remaining = 0
+            slot.pending_prompt = list(prompt)
+            slot.prefill_cursor = 0
+            slot.t_submit = t_submit
+            slot.pos = 0
+            slot.remaining = max_new - 1
             slot.refs = []
+            slot.eos_scanned = 0
             slot.prompt = list(prompt) if self.spec_k > 0 else None
             slot.history = None
             slot.lookup = None
             slot.adapt = AdaptiveSpec() if self.spec_k > 0 else None
-            # Chunked prefill: bounded bucket-padded dispatches; the final
-            # chunk's variant samples the request's first token directly
-            # into the device token vector (no host materialization).
-            chunk = self.prompt_buckets[-1]
-            start = 0
-            while True:
-                piece = prompt[start : start + chunk]
-                last_chunk = start + len(piece) >= len(prompt)
-                bucket = self._bucket(len(piece))
+            self.queue_wait_s.append(time.monotonic() - t_submit)
+
+    # -- budgeted prefill ------------------------------------------------------
+    def _pump_prefill(self) -> int:
+        """Spend up to `prefill_budget_tokens` prompt tokens of chunked
+        prefill this tick. Work proceeds in WAVES: one chunk per admitted
+        (reserved/prefilling) slot per wave, scanned round-robin from a
+        rotating start slot so a tight budget cannot starve high slot
+        indices; each wave dispatches same-bucket mid-prompt chunks from
+        different slots as ONE batched `paged_prefill_window` program.
+        The tick's first chunk always dispatches even when it alone
+        exceeds the budget (progress guarantee); once a chunk does not
+        fit, the tick's prefill closes (no size-based queue jumping).
+        Returns the number of device dispatches."""
+        rr = self._prefill_rr % self.n_slots
+        order = [
+            idx
+            for idx in (*range(rr, self.n_slots), *range(rr))
+            if self._slots[idx].active
+            and self._slots[idx].phase in ("reserved", "prefilling")
+        ]
+        if not order:
+            return 0
+        self._prefill_rr = (self._prefill_rr + 1) % self.n_slots
+        budget = self.prefill_budget_tokens  # 0 = unbudgeted (inline drain)
+        chunk = self.prompt_buckets[-1]
+        spent = 0
+        dispatches = 0
+        exhausted = False
+        while not exhausted:
+            wave: List[Tuple[int, int, list]] = []
+            for idx in order:
+                slot = self._slots[idx]
+                if slot.phase not in ("reserved", "prefilling"):
+                    continue  # finished in an earlier wave of this tick
+                start = slot.prefill_cursor
+                piece = slot.pending_prompt[start : start + chunk]
+                if budget and spent and spent + len(piece) > budget:
+                    exhausted = True
+                    break
+                wave.append((idx, start, piece))
+                spent += len(piece)
+            if not wave:
+                break
+            dispatches += self._dispatch_prefill_wave(wave)
+            if budget and spent >= budget:
+                break
+        return dispatches
+
+    def _dispatch_prefill_wave(self, wave: List[Tuple[int, int, list]]) -> int:
+        """Dispatch one wave (at most one chunk per slot). Mid-prompt
+        chunks sharing a bucket go through the batched multi-slot program;
+        singleton mid chunks keep the batch-1 program (bit-identical to
+        the inline path for solo traffic). Final chunks ALWAYS go through
+        the per-slot `_prefill_last` program, so the first-token sample
+        and its device-side scatter are unchanged per slot — only when
+        chunks dispatch moves, never what they compute."""
+        mids: Dict[int, List[Tuple[int, int, list]]] = {}
+        finals: List[Tuple[int, int, list]] = []
+        for entry in wave:
+            idx, start, piece = entry
+            if start + len(piece) >= len(self._slots[idx].pending_prompt):
+                finals.append(entry)
+            else:
+                mids.setdefault(self._bucket(len(piece)), []).append(entry)
+        dispatches = 0
+        for bucket, entries in sorted(mids.items()):
+            if len(entries) == 1:
+                idx, start, piece = entries[0]
                 padded = np.zeros((1, bucket), dtype=np.int32)
                 padded[0, : len(piece)] = piece
-                if last_chunk:
-                    self.cache, self._last_dev, self._first_dev = (
-                        self._prefill_last(
-                            self.params,
-                            jnp.asarray(padded),
-                            self.cache,
-                            self._table[idx],
-                            start,
-                            len(piece),
-                            self._last_dev,
-                            self._first_dev,
-                            idx,
-                            serial,
-                        )
-                    )
-                    break
                 self.cache = self._prefill_chunk(
                     self.params,
                     jnp.asarray(padded),
@@ -571,21 +711,73 @@ class DecodeServer:
                     start,
                     len(piece),
                 )
-                start += len(piece)
-            slot.pos = len(prompt)
-            slot.remaining = max_new - 1
-            slot.eos_scanned = 0
-            admitted.append(idx)
-        if admitted:
-            # ONE _TokRef over the cumulative first-token vector for the
-            # whole admission wave: every wave member's value is present in
-            # the latest array (each scatter built on the previous), so the
-            # wave costs a single device->host transfer instead of one RTT
-            # per slot.
+            else:
+                tokens = np.zeros((self.n_slots, bucket), dtype=np.int32)
+                pos = np.zeros((self.n_slots,), dtype=np.int32)
+                lengths = np.zeros((self.n_slots,), dtype=np.int32)
+                active = np.zeros((self.n_slots,), dtype=bool)
+                for idx, start, piece in entries:
+                    tokens[idx, : len(piece)] = piece
+                    pos[idx] = start
+                    lengths[idx] = len(piece)
+                    active[idx] = True
+                self.cache = self._prefill_window(
+                    self.params,
+                    jnp.asarray(tokens),
+                    self.cache,
+                    self._table,
+                    jnp.asarray(pos),
+                    jnp.asarray(lengths),
+                    jnp.asarray(active),
+                )
+            dispatches += 1
+        for idx, start, piece in finals:
+            bucket = self._bucket(len(piece))
+            padded = np.zeros((1, bucket), dtype=np.int32)
+            padded[0, : len(piece)] = piece
+            self.cache, self._last_dev, self._first_dev = self._prefill_last(
+                self.params,
+                jnp.asarray(padded),
+                self.cache,
+                self._table[idx],
+                start,
+                len(piece),
+                self._last_dev,
+                self._first_dev,
+                idx,
+                int(self._slot_serial[idx]),
+            )
+            dispatches += 1
+        for idx, start, piece in wave:
+            slot = self._slots[idx]
+            slot.prefill_cursor = start + len(piece)
+            slot.pos = slot.prefill_cursor
+            if slot.phase == "reserved":
+                slot.phase = "prefilling"
+            self.prefill_tokens += len(piece)
+        if finals:
+            # ONE _TokRef over the cumulative first-token vector for every
+            # slot finishing in this wave (each scatter built on the
+            # previous), so the wave costs a single device->host transfer
+            # instead of one RTT per slot.
+            now = time.monotonic()
             ref = _TokRef(self._first_dev)
-            for idx in admitted:
-                self._slots[idx].refs.insert(0, (ref, idx, None))
+            for idx, _, _ in finals:
+                slot = self._slots[idx]
+                slot.phase = "decoding"
+                slot.pos = len(slot.pending_prompt)
+                slot.pending_prompt = None
+                slot.refs.append((ref, idx, None))
+                self.ttft_s.append(now - slot.t_submit)
                 self._finish_if_done(idx)
+        self.prefill_dispatches += dispatches
+        if self.metrics is not None:
+            self.metrics.inc("nos_tpu_decode_prefill_dispatches", dispatches)
+            self.metrics.inc(
+                "nos_tpu_decode_prefill_tokens",
+                sum(len(piece) for _, _, piece in wave),
+            )
+        return dispatches
 
     @staticmethod
     def _token_at(ref: _TokRef, lane: Optional[int], row: Optional[int]) -> int:
@@ -611,7 +803,10 @@ class DecodeServer:
         known at dispatch time (slot.pos is the NEXT write index; a step at
         pos == max_len-1 is still valid, decode.generate's own bound)."""
         slot = self._slots[idx]
-        if not slot.active:
+        if not slot.active or slot.phase != "decoding":
+            # A reserved/prefilling slot's remaining may already be 0
+            # (max_new == 1) — completion waits for the final chunk's
+            # first-token dispatch.
             return
         if slot.remaining <= 0 or slot.pos >= self.max_len:
             slot.future.set_result(self._finalize(slot))
@@ -678,7 +873,9 @@ class DecodeServer:
         the pipelined macro path."""
         drafts = {}
         for idx, slot in enumerate(self._slots):
-            if not slot.active or slot.verifying or slot.remaining <= 1:
+            if not slot.active or slot.phase != "decoding":
+                continue  # prefilling slots are masked out of drafting too
+            if slot.verifying or slot.remaining <= 1:
                 continue
             if slot.adapt is not None and not slot.adapt.allowed(len(slot.refs)):
                 continue
@@ -768,7 +965,11 @@ class DecodeServer:
                 continue  # failure sweep reset this slot mid-flight
             slot.verifying = False
             accepted = accept_prefix(window, preds[idx, : len(window)])
-            ref = _TokRef(np.asarray(accepted, dtype=np.int32).reshape(-1, 1))
+            # `accepted` is a host-side list of ints — this asarray never
+            # touches a device buffer, it just shapes the ref's backing.
+            ref = _TokRef(
+                np.asarray(accepted, dtype=np.int32).reshape(-1, 1)  # nos-lint: ignore[NOS010]
+            )
             for j in range(len(accepted)):
                 slot.refs.append((ref, 0, j))
             slot.pos += len(accepted)
@@ -812,12 +1013,16 @@ class DecodeServer:
                 self._reset_device_state()
 
     def _tick(self) -> None:
-        """One engine iteration: admit, fold any READY verify outcomes in
-        (non-blocking), then PARTITION the active slots — drafting slots
-        get a verify dispatch, everyone else gets the K-step macro
-        dispatch, both in this tick on the shared donated cache. The only
-        blocking read happens when the drafting slots are the sole
-        possible progress (e.g. a lone repetitive stream)."""
+        """One engine iteration — the three-way scheduler. Composition
+        contract (in dispatch order, all device-ordered on the one donated
+        cache over DISJOINT page sets): (1) admission reserves slots and
+        pages, (2) the prefill budget dispatches bounded chunk waves for
+        reserved/prefilling slots, (3) drafting slots get a verify
+        dispatch, (4) every remaining decoding slot gets the K-step macro
+        program — prefilling slots are masked out of the draft and macro
+        masks exactly as drafters are masked out of the macro mask. The
+        only blocking read happens when unresolved verifies are the
+        engine's sole possible progress."""
         self._admit()
         if self._pending_verifies:
             self._resolve_verifies(block=False)
@@ -825,6 +1030,7 @@ class DecodeServer:
         if not any(s.active for s in self._slots):
             self._stop.wait(0.005)
             return
+        n_prefill = self._pump_prefill()
         n_drafting = 0
         if self.spec_k > 0:
             drafts = self._spec_drafts()
@@ -839,13 +1045,21 @@ class DecodeServer:
                 self._dispatch_verify(drafts)
                 n_drafting = len(drafts)
         macro = [
-            i for i, s in enumerate(self._slots) if s.active and not s.verifying
+            i
+            for i, s in enumerate(self._slots)
+            if s.active and s.phase == "decoding" and not s.verifying
         ]
         if macro:
             self._dispatch_macro(macro)
         if n_drafting and macro:
             self.both_dispatch_ticks += 1
-        if not n_drafting and not macro:
+        if n_prefill and macro:
+            # The prompt-axis decoupling witness: prefill chunks and a
+            # macro window landed in the SAME tick.
+            self.ticks_with_prefill_and_macro += 1
+            if self.metrics is not None:
+                self.metrics.inc("nos_tpu_decode_ticks_with_prefill_and_macro")
+        if not n_drafting and not macro and not n_prefill:
             # Every active slot is awaiting its verify outcome: the
             # drafting slots themselves need it — the one blocking read.
             self._resolve_verifies(block=True)
@@ -908,6 +1122,10 @@ class DecodeServer:
         m = self.metrics
         m.set_gauge("nos_tpu_decode_slots_drafting", n_drafting)
         m.set_gauge("nos_tpu_decode_slots_macro", n_macro)
+        m.set_gauge(
+            "nos_tpu_decode_slots_prefilling",
+            sum(1 for s in self._slots if s.active and s.phase != "decoding"),
+        )
         m.set_gauge("nos_tpu_decode_inflight_dispatches", len(self._inflight))
         m.set_gauge("nos_tpu_decode_pending_verifies", len(self._pending_verifies))
         m.set_gauge("nos_tpu_decode_waiting_requests", len(self._waiting))

@@ -93,6 +93,19 @@ class ServingReport:
     spec_rounds: int = 0
     spec_tokens_accepted: int = 0
     spec_demotions: int = 0
+    # Budgeted-prefill shape: bounded chunk dispatches per tick, and the
+    # ticks where prefill and a macro window landed together (the
+    # prompt-axis analogue of both_dispatch_ticks).
+    prefill_dispatches: int = 0
+    prefill_tokens: int = 0
+    ticks_with_prefill_and_macro: int = 0
+    # Per-request latency tails (seconds; 0.0 when no samples yet).
+    # TTFT is submit -> final-prefill-chunk dispatch; queue wait is
+    # submit -> slot reservation.
+    ttft_p50_s: float = 0.0
+    ttft_p95_s: float = 0.0
+    queue_wait_p50_s: float = 0.0
+    queue_wait_p95_s: float = 0.0
     # Decoupled-round shape: ticks that dispatched a verify AND a macro
     # window (neighbors kept the pipeline while a slot speculated), and
     # the per-slot split totals.
@@ -105,10 +118,22 @@ class ServingReport:
     waiting_requests: int = 0
 
 
+def percentile(samples, q: float) -> float:
+    """Nearest-rank percentile of a sequence (0.0 when empty) — enough for
+    counter snapshots without dragging numpy into the telemetry surface."""
+    values = sorted(float(v) for v in samples)
+    if not values:
+        return 0.0
+    rank = max(0, min(len(values) - 1, round(q / 100.0 * (len(values) - 1))))
+    return values[int(rank)]
+
+
 def collect_serving(server) -> ServingReport:
     """Snapshot `server`'s engine counters (duck-typed: anything exposing
     the DecodeServer counter attributes works, so tests and future engines
     need no import cycle through the runtime package)."""
+    ttft = list(getattr(server, "ttft_s", ()))
+    queue_wait = list(getattr(server, "queue_wait_s", ()))
     report = ServingReport(
         steps_run=int(getattr(server, "steps_run", 0)),
         macro_dispatches=int(getattr(server, "macro_dispatches", 0)),
@@ -116,6 +141,15 @@ def collect_serving(server) -> ServingReport:
         spec_tokens_accepted=int(getattr(server, "spec_tokens_accepted", 0)),
         spec_demotions=int(getattr(server, "spec_demotions", 0)),
         both_dispatch_ticks=int(getattr(server, "both_dispatch_ticks", 0)),
+        prefill_dispatches=int(getattr(server, "prefill_dispatches", 0)),
+        prefill_tokens=int(getattr(server, "prefill_tokens", 0)),
+        ticks_with_prefill_and_macro=int(
+            getattr(server, "ticks_with_prefill_and_macro", 0)
+        ),
+        ttft_p50_s=percentile(ttft, 50),
+        ttft_p95_s=percentile(ttft, 95),
+        queue_wait_p50_s=percentile(queue_wait, 50),
+        queue_wait_p95_s=percentile(queue_wait, 95),
         inflight_dispatches=len(getattr(server, "_inflight", ())),
         pending_verifies=len(getattr(server, "_pending_verifies", ())),
         waiting_requests=len(getattr(server, "_waiting", ())),

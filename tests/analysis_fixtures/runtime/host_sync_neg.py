@@ -1,0 +1,19 @@
+"""NOS010 negatives: a runtime file WITHOUT an engine class (no `_tick`)
+is out of scope — host syncs here are batch/benchmark code (mfu.py's
+`block_until_ready` walls are the real-tree example), not a serving tick
+path. `jnp.asarray` is host->device and must never be flagged anywhere.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def helper(x):
+    return np.asarray(x)
+
+
+class BatchRunner:
+    def step(self, x):
+        x.block_until_ready()
+        return jax.device_get(x), x.item(), jnp.asarray([1, 2, 3])

@@ -1,0 +1,36 @@
+"""NOS010 positives: blocking host syncs on an engine class's tick path.
+
+Expected findings: `.item()` in `_tick`, `jax.device_get` and
+`.block_until_ready()` in the reachable `_drain`, and the helper class's
+`np.asarray` (helpers in an engine file are tick-path by construction).
+`submit` is client-side (unreachable from `_tick`/`_run`) and stays legal.
+"""
+
+import jax
+import numpy as np
+
+
+class _Ref:
+    def __init__(self, arr):
+        self._arr = arr
+
+    def materialize(self):
+        return np.asarray(self._arr)
+
+
+class Engine:
+    def __init__(self):
+        self.queue = []
+
+    def _tick(self):
+        val = self.queue[0].item()
+        self._drain()
+        return val
+
+    def _drain(self):
+        arr = jax.device_get(self.queue)
+        self.queue[0].block_until_ready()
+        return arr
+
+    def submit(self, x):
+        return x.item()  # off the tick path: legal

@@ -411,6 +411,114 @@ def test_request_larger_than_pool_rejected_not_hung(params):
         server.stop()
 
 
+# -- budgeted prefill (PR 4: token-budgeted prefill/decode interleaving) ------
+def test_rejected_request_does_not_burn_the_slot_for_the_wave(params):
+    """Admission fairness: a rejected request must not consume its slot for
+    the wave — the SAME slot pulls the next queued request, so one bad
+    arrival no longer delays a good one behind it by a tick."""
+    server = DecodeServer(params, CFG, n_slots=1, max_len=16)
+    bad = server.submit(list(range(20)), max_new=4)  # prompt >= max_len
+    good = server.submit([1, 2, 3], max_new=2)
+    server._admit()  # one admission wave, engine thread not running
+    assert isinstance(bad.exception(timeout=10), ValueError)
+    slot = server._slots[0]
+    assert slot.active and slot.phase == "reserved"
+    assert slot.future is good  # the same slot admitted the next request
+
+
+def test_chunked_prefill_bucket_boundary_exactness(params):
+    """Satellite oracle: prompts of length exactly `bucket`, `bucket±1`,
+    and spanning multiple buckets must produce bit-identical greedy output
+    to the monolithic `prefill()` reference, with interleaving enabled
+    (budgeted) and disabled (prefill_budget_tokens=0 drains inline). Per
+    slot the chunk boundaries and programs are identical to the
+    admission-time path — only WHEN chunks dispatch moves, which the
+    dispatch counters pin: both schedules run the same 4 chunks for the
+    25-token prompt."""
+    bucket = 8
+    lengths = (7, 8, 9, 25)
+    prompts = {n: [((i * 7) % 91) + 1 for i in range(n)] for n in lengths}
+    want = {n: solo_greedy(params, prompts[n], 4) for n in lengths}
+    chunk_counts = {}
+    for budget in (0, bucket):
+        # One engine per budget: every length reuses its compiled programs.
+        server = DecodeServer(
+            params, CFG, n_slots=2, max_len=64,
+            prompt_buckets=(bucket,), prefill_budget_tokens=budget,
+        ).start()
+        try:
+            for n in lengths:
+                before = server.prefill_dispatches
+                got = server.generate(prompts[n], max_new=4, timeout=120)
+                assert got == want[n], (n, budget)
+                chunk_counts[(budget, n)] = server.prefill_dispatches - before
+        finally:
+            server.stop()
+    # The budget moves WHEN chunks run, never how many: a 25-token prompt
+    # is 4 bucket-8 chunks whether drained inline (one tick) or budgeted
+    # (one chunk per tick).
+    assert chunk_counts[(0, 25)] == chunk_counts[(bucket, 25)] == 4
+
+
+def test_prefill_interleaves_with_active_decode(long_params):
+    """THE PR-4 regression gate, counter-based (wall-time-free, CI-stable):
+    while a long prompt prefills under the default budget, already-active
+    decode slots keep receiving ~K tokens per macro dispatch — the old
+    admission-time monolithic prefill froze them for the whole prompt —
+    and `ticks_with_prefill_and_macro` witnesses prefill chunks and macro
+    windows landing in the SAME ticks. Greedy exactness must survive the
+    interleaving for every stream."""
+    K = 8
+    rng = np.random.default_rng(5)
+    long_prompt = [int(x) for x in rng.integers(1, 96, size=200)]
+    shorts = [[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]]
+    server = DecodeServer(
+        long_params, LONG_CFG, n_slots=3, max_len=320,
+        prompt_buckets=(32,), block_size=64, steps_per_dispatch=K,
+    )  # default budget = largest bucket = 32 prompt tokens per tick
+    futs = [server.submit(p, max_new=49) for p in shorts]
+    flong = server.submit(long_prompt, max_new=4)
+    server.start()
+    try:
+        outs = [f.result(timeout=600) for f in futs]
+        out_long = flong.result(timeout=600)
+    finally:
+        server.stop()
+
+    def dense_reference(prompt, max_new):
+        tokens = jnp.asarray([prompt], dtype=jnp.int32)
+        logits, cache = prefill(long_params, tokens, LONG_CFG, 320)
+        want = [int(jnp.argmax(logits[0]))]
+        pos = len(prompt)
+        for _ in range(max_new - 1):
+            logits, cache = decode_step(
+                long_params, jnp.asarray([want[-1]], dtype=jnp.int32),
+                LONG_CFG, cache, pos,
+            )
+            want.append(int(jnp.argmax(logits[0])))
+            pos += 1
+        return want
+
+    for prompt, got, max_new in zip(
+        [*shorts, long_prompt], [*outs, out_long], [49, 49, 4]
+    ):
+        assert got == dense_reference(prompt, max_new)
+    # Prefill chunks and macro windows landed in the same ticks.
+    assert server.ticks_with_prefill_and_macro > 0
+    assert server.prefill_dispatches > 0
+    assert server.prefill_tokens == len(long_prompt) + sum(len(p) for p in shorts)
+    # The neighbor gate: decode slots sustained >= 0.9*K tokens per macro
+    # dispatch throughout the long prompt's prefill window.
+    for i in (0, 1):
+        per_dispatch = (
+            server.macro_tokens_by_slot[i] / server.macro_dispatches_by_slot[i]
+        )
+        assert per_dispatch >= 0.9 * K, (i, per_dispatch)
+    # Per-request latency samples recorded for every admitted request.
+    assert len(server.ttft_s) == 3
+    assert len(server.queue_wait_s) == 3
+
+
 # -- speculative decoding inside the continuous batch -------------------------
 # float32 model: spec-vs-nonspec comparisons cross differently-shaped
 # programs (verify window vs single-step), where the tiny random bf16
@@ -668,6 +776,34 @@ def test_spec_adaptive_demotes_unprofitable_drafting(spec_params, monkeypatch):
     assert server.spec_demotions >= 1
     # ...and the demoted slot kept advancing through the macro path.
     assert server.macro_dispatches_by_slot[0] > 0
+
+
+@cpu_only
+def test_concurrent_long_prompts_batch_through_prefill_window(spec_params):
+    """Two long prompts admitted together push their same-bucket mid-prompt
+    chunks through the batched multi-slot `paged_prefill_window` program
+    (one dispatch per wave instead of one per slot) — and the outputs stay
+    bit-identical to the monolithic reference. float32 model: the batched
+    window is a different compiled program than the batch-1 chunk, where
+    the tiny bf16 model's exact logit ties would test tie-breaking luck
+    (the SPEC_CFG reasoning)."""
+    rng = np.random.default_rng(3)
+    prompts = [[int(x) for x in rng.integers(1, 96, size=n)] for n in (40, 52)]
+    server = DecodeServer(
+        spec_params, SPEC_CFG, n_slots=2, max_len=256,
+        prompt_buckets=(16,), prefill_budget_tokens=64,
+    )
+    futs = [server.submit(p, max_new=4) for p in prompts]
+    server.start()
+    try:
+        outs = [f.result(timeout=300) for f in futs]
+    finally:
+        server.stop()
+    for prompt, got in zip(prompts, outs):
+        assert got == spec_solo_greedy(spec_params, prompt, 4)
+    # 3 + 4 chunks total; batched waves merged at least two of them.
+    assert server.prefill_tokens == 92
+    assert 0 < server.prefill_dispatches < 7
 
 
 def test_tok_ref_deleted_buffer_reports_not_ready():

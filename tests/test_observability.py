@@ -152,10 +152,16 @@ class TestDecodeServerCounters:
         assert "nos_tpu_decode_steps_total" in body
         assert "nos_tpu_decode_macro_dispatches_total" in body
         assert registry.get("nos_tpu_decode_steps") >= 1
+        # Budgeted prefill moved admission work onto the tick: its
+        # dispatch/token counters flow through the same registry.
+        assert "nos_tpu_decode_prefill_dispatches_total" in body
+        assert "nos_tpu_decode_prefill_tokens_total" in body
+        assert registry.get("nos_tpu_decode_prefill_tokens") >= 3  # the prompt
         # ...and the per-tick split/queue-depth gauges are exposed.
         for gauge in (
             "nos_tpu_decode_slots_drafting",
             "nos_tpu_decode_slots_macro",
+            "nos_tpu_decode_slots_prefilling",
             "nos_tpu_decode_inflight_dispatches",
             "nos_tpu_decode_pending_verifies",
             "nos_tpu_decode_waiting_requests",
@@ -174,6 +180,11 @@ class TestDecodeServerCounters:
             spec_tokens_accepted = 7
             spec_demotions = 1
             both_dispatch_ticks = 2
+            prefill_dispatches = 5
+            prefill_tokens = 130
+            ticks_with_prefill_and_macro = 4
+            ttft_s = [0.2, 0.4, 0.1, 0.3]
+            queue_wait_s = [0.05]
             macro_tokens_by_slot = [64, 40]
             spec_rounds_by_slot = [3, 0]
             _inflight = [object()]
@@ -186,6 +197,13 @@ class TestDecodeServerCounters:
         assert report.spec_rounds == 3
         assert report.spec_tokens_accepted == 7
         assert report.both_dispatch_ticks == 2
+        assert report.prefill_dispatches == 5
+        assert report.prefill_tokens == 130
+        assert report.ticks_with_prefill_and_macro == 4
+        # Nearest-rank percentiles over the latency samples.
+        assert report.ttft_p50_s == 0.3
+        assert report.ttft_p95_s == 0.4
+        assert report.queue_wait_p50_s == 0.05
         assert report.macro_tokens_by_slot == {"0": 64, "1": 40}
         assert report.spec_rounds_by_slot == {"0": 3, "1": 0}
         assert report.inflight_dispatches == 1

@@ -17,6 +17,7 @@ import pytest
 
 from nos_tpu import analysis
 from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
 from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
@@ -183,6 +184,58 @@ def test_scope_gating_out_of_scope_file_is_clean(tmp_path):
     f.write_text("def check(x):\n    return x == 0.1\n")
     findings = run_checkers(str(f), [TraceSafetyChecker()])
     assert findings == []
+
+
+# -- NOS010 host syncs on the engine tick path --------------------------------
+def test_host_sync_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "host_sync_pos.py"), [HostSyncChecker()]
+    )
+    assert codes_of(findings) == ["NOS010"]
+    # .item() in _tick, device_get + block_until_ready in the reachable
+    # _drain, np.asarray in the helper class — and NOT submit()'s .item().
+    assert len(findings) == 4
+    msgs = " | ".join(f.message for f in findings)
+    assert ".item()" in msgs
+    assert "device_get" in msgs
+    assert "block_until_ready" in msgs
+    assert "asarray" in msgs
+
+
+def test_host_sync_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "host_sync_neg.py"), [HostSyncChecker()]
+    )
+    assert findings == []
+
+
+def test_host_sync_scope_needs_runtime_dir(tmp_path):
+    # The same engine class OUTSIDE a runtime/ directory is out of scope.
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "class Engine:\n"
+        "    def _tick(self):\n"
+        "        return self.queue[0].item()\n"
+    )
+    assert run_checkers(str(f), [HostSyncChecker()]) == []
+
+
+def test_host_sync_sanctioned_site_suppressed_inline(tmp_path):
+    runtime = tmp_path / "runtime"
+    runtime.mkdir()
+    f = runtime / "engine.py"
+    f.write_text(
+        "import numpy as np\n"
+        "class Engine:\n"
+        "    def _tick(self):\n"
+        "        a = np.asarray(self._host_list())  # nos-lint: ignore[NOS010]\n"
+        "        b = np.asarray(self._dev)\n"
+        "        return a, b\n"
+        "    def _host_list(self):\n"
+        "        return [1]\n"
+    )
+    findings = run_checkers(str(runtime), [HostSyncChecker()])
+    assert [x.line for x in findings] == [5]
 
 
 # -- engine: inline suppression ----------------------------------------------
