@@ -138,7 +138,10 @@ def _decode_phase(jax, jnp) -> dict:
     latency tails (queue-wait + TTFT p50/p95 from the engine's own
     samples) and the prefill/decode INTERFERENCE scenario: 7 short
     decode streams with a 4k prompt arriving mid-flight, the prefill
-    budget swept over {0 (inline baseline), 256, 1024}."""
+    budget swept over {0 (inline baseline), 256, 1024}. PR 5 adds the
+    SHARED-PREFIX scenario: 8 streams sharing a 512-token system prompt
+    (distinct 64-token suffixes), prefix cache off vs on — hit rate,
+    prefill tokens skipped, and streams-2..8 TTFT tails."""
     import numpy as np
 
     from nos_tpu.models.gpt import GPTConfig, init_gpt
@@ -388,6 +391,79 @@ def _decode_phase(jax, jnp) -> dict:
     out["interference_4k"] = [
         _retry(f"decode:interference_b{b}", lambda b=b: interference(b))
         for b in (0, 256, 1024)
+    ]
+
+    # Shared-prefix KV reuse (PR 5): 8 streams sharing a 512-token system
+    # prompt with distinct 64-token suffixes, prefix cache off vs on.
+    # Stream 1 runs to completion first (it is the cache POPULATOR — the
+    # realistic shape: a deployed system prompt is warm); streams 2..8
+    # then arrive together. Cache on, each should take its 16 full prefix
+    # blocks (block_size 32) from the index and be charged prefill work
+    # only for its 64-token suffix + tail — the hit rate, tokens skipped,
+    # and the TTFT tails (through telemetry.ServingReport, like every
+    # serving counter here) are the measurement; cache off is the same
+    # traffic recomputing the prefix 8 times.
+    def shared_prefix(cache_on):
+        from nos_tpu.telemetry import collect_serving
+
+        srng = np.random.default_rng([512, 64, 8])
+        sys_prompt = srng.integers(1, cfg.vocab, 512).tolist()
+        prompts = [
+            sys_prompt + srng.integers(1, cfg.vocab, 64).tolist()
+            for _ in range(8)
+        ]
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=8,
+            max_len=1024,
+            prompt_buckets=(16, 32, 64, 128, 256),
+            steps_per_dispatch=16,
+            prefix_cache=cache_on,
+        ).start()
+        try:
+            # Warm every program shape (and, cache on, the prefix index).
+            # TWICE with the cache on: the second pass takes the HIT path,
+            # whose final chunk starts at the hit boundary and may be a
+            # differently-bucketed — so differently-compiled — program
+            # than the cold path's final chunk.
+            for _ in range(2 if cache_on else 1):
+                server.generate(prompts[0], max_new=32, timeout=600)
+            t0 = time.perf_counter()
+            server.generate(prompts[0], max_new=32, timeout=600)
+            # Counter snapshots AFTER stream 1: the hit rate / charged
+            # tokens below are streams 2..8's alone.
+            n_ttft = len(server.ttft_s)
+            hits0 = server.prefix_hit_blocks
+            skipped0 = server.prefix_hit_tokens
+            charged0 = server.prefill_tokens
+            futs = [server.submit(p, max_new=32) for p in prompts[1:]]
+            for f in futs:
+                f.result(timeout=600)
+            wall = time.perf_counter() - t0
+            report = collect_serving(server)
+            ttft_rest = server.ttft_s[n_ttft:]
+            full_prefix_blocks = len(sys_prompt) // server.block_size
+            return {
+                "prefix_cache": cache_on,
+                "tok_s_8_streams": round(8 * 32 / wall, 1),
+                "ttft_p50_s": round(percentile(ttft_rest, 50), 4),
+                "ttft_p95_s": round(percentile(ttft_rest, 95), 4),
+                "prefix_hit_rate_streams_2_8": round(
+                    (report.prefix_hit_blocks - hits0)
+                    / (7 * full_prefix_blocks),
+                    3,
+                ),
+                "prefill_tokens_charged": server.prefill_tokens - charged0,
+                "prefill_tokens_skipped": report.prefix_hit_tokens - skipped0,
+            }
+        finally:
+            server.stop()
+
+    out["shared_prefix_512"] = [
+        _retry(f"decode:shared_prefix_cache_{'on' if c else 'off'}",
+               lambda c=c: shared_prefix(c))
+        for c in (False, True)
     ]
     return out
 

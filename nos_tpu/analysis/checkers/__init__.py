@@ -9,6 +9,7 @@ from nos_tpu.analysis.core import Checker
 
 
 def all_checkers() -> List[Checker]:
+    from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
     from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
     from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
@@ -23,4 +24,5 @@ def all_checkers() -> List[Checker]:
         LockDisciplineChecker(),
         TraceSafetyChecker(),
         HostSyncChecker(),
+        BlockDisciplineChecker(),
     ]

@@ -161,7 +161,16 @@ def init_paged_cache(cfg: GPTConfig, total_blocks: int, block_size: int) -> Dict
     to the dense [n_slots, max_len] cache, capacity is pooled: admission
     charges a request for the blocks IT needs, so one long sequence and
     several short ones share memory that the dense layout would reserve at
-    n_slots x max_len worst case."""
+    n_slots x max_len worst case.
+
+    Block OWNERSHIP is not exclusive (PR 5, runtime/block_manager.py): a
+    full prompt block may be mapped into several slots' table rows at once
+    (shared-prefix reuse, per-block refcounts). The write discipline that
+    makes this safe: a slot's dispatched programs only ever WRITE at
+    positions >= its prefill cursor at admission — which the BlockManager
+    places past every shared block — so shared blocks are read-only for
+    every program of every tick; all writes (tail prefill chunks, decode
+    steps, verify windows) land in pages exactly one table row maps."""
     shape = (total_blocks, cfg.n_kv, block_size, cfg.head_dim)
     return {
         str(i): {
@@ -357,7 +366,16 @@ def paged_verify_window(
     cannot clobber each other regardless of device execution order within
     the tick. Anything that would make an inactive lane touch a
     non-scratch page breaks the DecodeServer's per-tick
-    prefill/drafting/macro split."""
+    prefill/drafting/macro split.
+
+    With prefix-cache sharing (PR 5) the disjointness is over WRITE sets,
+    not table rows: a shared prompt block appears in several rows, but
+    every active lane's window starts at or past its private-page
+    boundary (the BlockManager admits hits only below the prompt's
+    last-token block and the engine starts the prefill cursor at the
+    first miss), so shared blocks are only ever gathered/read — no
+    dispatched program of any tick may write a page mapped by more than
+    one row."""
     x, new_cache = _paged_window_core(
         params, tokens, cfg, pcache, table, pos, lengths, mask, block_size
     )

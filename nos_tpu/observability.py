@@ -5,6 +5,14 @@ healthz/readyz probes (SURVEY.md §5): a small Prometheus-text metrics
 registry, a health manager every component registers checks with, and leveled
 logging setup (zap analog). An optional HTTP server exposes /metrics,
 /healthz and /readyz for deployments.
+
+The serving engine publishes onto a registry handed to it as
+`DecodeServer(..., metrics=registry)`: `nos_tpu_decode_*` counters
+(dispatches, speculative rounds, budgeted-prefill work, and the PR-5
+prefix-cache series `nos_tpu_decode_prefix_{lookups,hit_blocks,
+hit_tokens,evictions}`) plus per-tick gauges for the slot split, queue
+depths, and the paged-pool state (`nos_tpu_decode_kv_blocks_{free,
+cached,shared}`) — see docs/telemetry.md for the full series list.
 """
 
 from __future__ import annotations

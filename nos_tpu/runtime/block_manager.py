@@ -1,0 +1,244 @@
+"""BlockManager: refcounted, content-addressed bookkeeping for the paged
+KV pool — shared-prefix block reuse for the DecodeServer.
+
+The serving engine pages its KV cache into fixed-size blocks with per-slot
+page tables (models/decode.py `init_paged_cache`); before PR 5 every
+admitted request prefilled its full prompt from scratch, so 8 concurrent
+streams sharing one 512-token system prompt recomputed identical K/V
+blocks 8 times. This module is the standard next lever (PagedAttention's
+cross-request block sharing, SGLang-RadixAttention's hash-chained prefix
+lookup): every FULL prompt block is keyed by a hash CHAINED over
+(parent key, the block's token ids), so a key identifies the block's
+entire token prefix, not just its own tokens. Admission walks the chain,
+maps the longest run of cached blocks straight into the new slot's page
+table with refcount bumps, and the engine starts the prefill cursor at
+the first miss boundary — the request is charged (budget, pool, dispatch)
+only for the blocks it actually misses.
+
+Sharing stays safe because shared blocks are IMMUTABLE by construction:
+the block holding the prompt's LAST token is always recomputed privately
+(never served from cache), so every write a slot dispatches after
+admission — tail prefill, decode steps, verify windows — lands at
+positions `>= prefill_cursor` inside the slot's private pages. A hit
+block appears in many page tables but is only ever READ, which preserves
+the disjoint-page-SET composition contract of the per-tick
+prefill/verify/macro split (paged_verify_window's docstring): programs
+compose over disjoint WRITE sets; read sharing is free.
+
+Lifecycle: `release()` decrements instead of freeing. A block reaching
+refcount 0 retires to the LRU `cached-free` list — still indexed, its
+K/V intact in the pool — where a later admission can revive it (hit) or
+allocation pressure can evict it (index entry dropped, block reused).
+Unkeyed blocks (partial tails, decode pages) return to the plain free
+list. `reset()` drops everything: after an engine failure the device
+pool is reallocated, so cached content is garbage by definition.
+
+Every mutation of the pool state (`_free_blocks`, `_slot_blocks`,
+`_refcount`, `_cached_free`, `_prefix_index`, `_block_key`) lives inside
+this class — enforced by the NOS011 checker (docs/static-analysis.md):
+bookkeeping scattered back into the engine is a lint finding, not a
+review comment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def chain_key(parent: str, tokens: Sequence[int]) -> str:
+    """Content key of one full block: sha256 chained over (parent key,
+    the block's token ids). The chain makes a key a commitment to the
+    whole prefix ending at this block — equal keys mean equal token
+    prefixes (sha256 collisions are the only exception, which is the
+    standard bet prefix caches make; an exact-compare radix tree is the
+    alternative if it ever stops being acceptable)."""
+    payload = parent + ":" + ",".join(str(int(t)) for t in tokens)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+class BlockManager:
+    """Host-side accounting for the paged KV pool: free/cached/owned
+    block sets, per-block refcounts, per-slot block lists, and the
+    content-addressed prefix index. Block 0 is the scratch page and is
+    never managed here."""
+
+    def __init__(self, total_blocks: int, block_size: int, n_slots: int):
+        if total_blocks < 2:
+            raise ValueError("total_blocks must be >= 2 (scratch + 1)")
+        self.total_blocks = int(total_blocks)
+        self.block_size = int(block_size)
+        self.n_slots = int(n_slots)
+        # Pool state. A managed block is in exactly ONE of: the plain
+        # free list, the cached-free LRU (refcount 0, content indexed),
+        # or in use (refcount == number of page tables mapping it).
+        self._free_blocks: List[int] = list(range(1, self.total_blocks))
+        self._cached_free: "OrderedDict[int, str]" = OrderedDict()  # LRU: oldest first
+        self._refcount: List[int] = [0] * self.total_blocks
+        self._slot_blocks: List[List[int]] = [[] for _ in range(self.n_slots)]
+        # Content index: chain key -> block, and its inverse for the
+        # blocks that are indexed (full prompt blocks only).
+        self._prefix_index: Dict[str, int] = {}
+        self._block_key: Dict[int, str] = {}
+        # Per-slot chain state for incremental registration: the prompt's
+        # full-block keys, and how many of them are already indexed.
+        self._slot_keys: List[List[str]] = [[] for _ in range(self.n_slots)]
+        self._slot_indexed: List[int] = [0] * self.n_slots
+        # Counters (monotonic; the engine mirrors them into metrics).
+        self.lookups = 0
+        self.hit_blocks = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+
+    # -- queries -------------------------------------------------------------
+    def available(self) -> int:
+        """Blocks an allocation could obtain right now (plain free +
+        evictable cached)."""
+        return len(self._free_blocks) + len(self._cached_free)
+
+    def slot_blocks(self, idx: int) -> Tuple[int, ...]:
+        return tuple(self._slot_blocks[idx])
+
+    def counts(self) -> Dict[str, int]:
+        """Pool-state gauge snapshot: free / cached (refcount-0, content
+        retained) / in_use (distinct blocks mapped by >= 1 table) /
+        shared (mapped by >= 2)."""
+        in_use = sum(1 for rc in self._refcount if rc > 0)
+        shared = sum(1 for rc in self._refcount if rc > 1)
+        return {
+            "free": len(self._free_blocks),
+            "cached": len(self._cached_free),
+            "in_use": in_use,
+            "shared": shared,
+        }
+
+    def prompt_keys(self, prompt: Sequence[int]) -> List[str]:
+        """Chain keys for every block FULLY covered by the prompt."""
+        bs = self.block_size
+        keys: List[str] = []
+        parent = ""
+        for b in range(len(prompt) // bs):
+            parent = chain_key(parent, prompt[b * bs : (b + 1) * bs])
+            keys.append(parent)
+        return keys
+
+    # -- admission -----------------------------------------------------------
+    def admit(
+        self, idx: int, prompt: Sequence[int], n_blocks: int, use_cache: bool = True
+    ) -> Optional[Tuple[List[int], int]]:
+        """Reserve `n_blocks` for slot `idx`, serving the longest cached
+        prefix of `prompt` from the index first. Returns (blocks, n_hit)
+        — blocks[:n_hit] are shared cache hits in prefix order, the rest
+        fresh private pages — or None when the pool cannot host the
+        misses, in which case NOTHING is retained: the hit blocks'
+        refcount bumps are rolled back (resting blocks rejoin the cached
+        LRU) before returning, so repeated rejected admissions cannot
+        leak pool capacity.
+
+        The hit run is capped BELOW the block holding the prompt's last
+        token: that block is always recomputed privately, which (a)
+        guarantees the final prefill chunk is non-empty (the first-token
+        sample needs logits at the true last position) and (b) keeps
+        every post-admission write inside private pages, so shared
+        blocks stay immutable."""
+        if self._slot_blocks[idx]:
+            raise RuntimeError(f"slot {idx} already holds blocks")
+        keys = self.prompt_keys(prompt) if use_cache else []
+        hits: List[int] = []
+        if use_cache:
+            self.lookups += 1
+            cap = (len(prompt) - 1) // self.block_size
+            for key in keys[:cap]:
+                block = self._prefix_index.get(key)
+                if block is None:
+                    break
+                hits.append(block)
+        # Take the hits: refcount bumps; a resting block leaves the LRU.
+        for block in hits:
+            if self._refcount[block] == 0:
+                self._cached_free.pop(block)
+            self._refcount[block] += 1
+        if n_blocks - len(hits) > self.available():
+            # Leak-guard: the pool cannot host the misses. Return every
+            # block already taken — drop the hit bumps, restore resting
+            # blocks to the cached LRU (MRU end: they were just touched)
+            # — before reporting failure. Checked BEFORE any fresh
+            # allocation, so the failure path never evicts cache either.
+            for block in reversed(hits):
+                self._refcount[block] -= 1
+                if self._refcount[block] == 0:
+                    self._cached_free[block] = self._block_key[block]
+            return None
+        blocks = list(hits)
+        for _ in range(n_blocks - len(hits)):
+            block = self._alloc_one()
+            self._refcount[block] += 1
+            blocks.append(block)
+        self._slot_blocks[idx] = blocks
+        self._slot_keys[idx] = keys
+        self._slot_indexed[idx] = len(hits)
+        self.hit_blocks += len(hits)
+        self.hit_tokens += len(hits) * self.block_size
+        return blocks, len(hits)
+
+    def _alloc_one(self) -> int:
+        """One block off the plain free list, else evict the LRU
+        cached-free block (its index entry dies with it). Callers check
+        `available()` first; an empty pool here is a bookkeeping bug."""
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        block, key = self._cached_free.popitem(last=False)
+        del self._prefix_index[key]
+        del self._block_key[block]
+        self.evictions += 1
+        return block
+
+    # -- prefill progress ----------------------------------------------------
+    def note_progress(self, idx: int, cursor: int) -> None:
+        """The slot's prefill cursor advanced to `cursor` (dispatched):
+        every full prompt block now completely written becomes
+        shareable — index it under its chain key. Already-indexed keys
+        (a concurrent slot won the race with identical content) keep
+        their existing block; this slot's duplicate stays private and
+        returns to the plain free list on release."""
+        keys = self._slot_keys[idx]
+        done = min(len(keys), cursor // self.block_size)
+        for b in range(self._slot_indexed[idx], done):
+            block = self._slot_blocks[idx][b]
+            if keys[b] not in self._prefix_index and block not in self._block_key:
+                self._prefix_index[keys[b]] = block
+                self._block_key[block] = keys[b]
+        self._slot_indexed[idx] = max(self._slot_indexed[idx], done)
+
+    # -- release / reset -----------------------------------------------------
+    def release(self, idx: int) -> None:
+        """Return slot `idx`'s references. Refcounts decrement instead
+        of freeing; a block reaching 0 retires to the cached-free LRU if
+        its content is indexed (reusable on a later hit) and to the
+        plain free list otherwise."""
+        for block in self._slot_blocks[idx]:
+            self._refcount[block] -= 1
+            if self._refcount[block] == 0:
+                key = self._block_key.get(block)
+                if key is None:
+                    self._free_blocks.append(block)
+                else:
+                    self._cached_free[block] = key
+        self._slot_blocks[idx] = []
+        self._slot_keys[idx] = []
+        self._slot_indexed[idx] = 0
+
+    def reset(self) -> None:
+        """Forget everything — including cached content. Used when the
+        engine reallocates the device pool after a failure: the blocks'
+        K/V no longer exists, so serving the index would be serving
+        zeros."""
+        self._free_blocks = list(range(1, self.total_blocks))
+        self._cached_free = OrderedDict()
+        self._refcount = [0] * self.total_blocks
+        self._slot_blocks = [[] for _ in range(self.n_slots)]
+        self._prefix_index = {}
+        self._block_key = {}
+        self._slot_keys = [[] for _ in range(self.n_slots)]
+        self._slot_indexed = [0] * self.n_slots

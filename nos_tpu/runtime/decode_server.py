@@ -36,6 +36,14 @@ TPU-shaped by construction:
     that would otherwise serialize every token;
   - the step donates its cache buffer, so a deep dispatch pipeline keeps a
     single cache allocation in flight;
+  - admitted prompts REUSE shared-prefix KV blocks (prefix_cache=True,
+    PagedAttention-style sharing keyed by a hash chained over block
+    contents — runtime/block_manager.py): admission maps the longest
+    cached run of full prompt blocks into the slot's page table with
+    refcount bumps and starts the prefill cursor at the first miss, so
+    8 streams sharing a 512-token system prompt pay for it once; shared
+    blocks are immutable (the last-token block is always recomputed
+    privately), greedy output is bit-identical cache-on vs cache-off;
   - speculative decoding (spec_k > 0) is DECOUPLED per tick: slots holding
     a prompt-lookup draft verify it through `paged_verify_window` while
     every other active slot keeps the K-step macro pipeline — the two
@@ -70,6 +78,7 @@ from nos_tpu.models.decode import (
 )
 from nos_tpu.models.gpt import GPTConfig
 from nos_tpu.models.speculative import AdaptiveSpec, _LookupIndex, accept_prefix
+from nos_tpu.runtime.block_manager import BlockManager
 
 logger = logging.getLogger(__name__)
 
@@ -176,6 +185,7 @@ class DecodeServer:
         spec_ngram: int = 3,
         spec_sync: bool = False,
         prefill_budget_tokens: Optional[int] = None,
+        prefix_cache: bool = True,
         metrics=None,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
@@ -262,6 +272,27 @@ class DecodeServer:
         per slot, chunk boundaries and the first-token sample/scatter are
         identical to the inline path — only WHEN chunks dispatch moves.
 
+        `prefix_cache` (default True) enables cross-request KV block
+        reuse (runtime/block_manager.py): every full prompt block is
+        indexed under a hash chained over (parent key, block tokens)
+        once its prefill chunk dispatches, and admission maps the
+        longest cached run of a new prompt's full blocks into the slot's
+        page table with refcount bumps instead of recomputing them —
+        the prefill cursor starts at the first miss boundary, so the
+        request is charged prefill budget and pool blocks only for what
+        it misses. The block holding the prompt's LAST token is always
+        recomputed privately (the final chunk must sample the first
+        token at the true last position), so every post-admission write
+        targets private pages and shared blocks stay immutable — the
+        disjoint-page-set tick composition contract is untouched
+        because hit pages are only ever READ. Released blocks retire to
+        an LRU cached-free list (reused on hit, evicted under
+        allocation pressure). Greedy output is bit-identical cache-on
+        vs cache-off: hits change which chunks DISPATCH, never what any
+        dispatched chunk computes. False disables lookup and
+        registration (the A/B baseline; per-request block accounting is
+        unchanged either way).
+
         `metrics` (optional) is an observability.Metrics-style registry
         (duck-typed: inc/set_gauge); when provided the engine publishes
         its counters and per-tick drafting/macro split under
@@ -292,8 +323,11 @@ class DecodeServer:
             raise ValueError("total_blocks must be >= 2 (scratch + 1)")
         self.cache = init_paged_cache(cfg, self.total_blocks, self.block_size)
         self._table = jnp.zeros((n_slots, self.max_pages), dtype=jnp.int32)
-        self._free_blocks = list(range(1, self.total_blocks))
-        self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
+        # ALL pool bookkeeping (free/cached lists, refcounts, per-slot
+        # block lists, the prefix index) lives in the BlockManager —
+        # NOS011 flags pool-state mutation anywhere else.
+        self.prefix_cache = bool(prefix_cache)
+        self._block_mgr = BlockManager(self.total_blocks, self.block_size, n_slots)
         # FIFO head-of-line admission: a request the pool cannot host yet
         # waits here (never reordered past).
         self._waiting: Deque[Tuple[list, int, Future, float]] = deque()
@@ -516,9 +550,10 @@ class DecodeServer:
                 fut.set_exception(exc)
 
     def _release_slot(self, idx: int) -> None:
-        """Return the slot's pages to the pool and clear its lane."""
-        self._free_blocks.extend(self._slot_blocks[idx])
-        self._slot_blocks[idx] = []
+        """Return the slot's page references to the pool and clear its
+        lane. Shared blocks only DECREMENT; refcount-0 indexed blocks
+        retire to the cached-free LRU for the next prefix hit."""
+        self._block_mgr.release(idx)
         self._slots[idx] = _Slot()
 
     def _reset_device_state(self) -> None:
@@ -526,8 +561,9 @@ class DecodeServer:
         start from a fresh allocation."""
         self.cache = init_paged_cache(self.cfg, self.total_blocks, self.block_size)
         self._table = jnp.zeros((self.n_slots, self.max_pages), dtype=jnp.int32)
-        self._free_blocks = list(range(1, self.total_blocks))
-        self._slot_blocks = [[] for _ in range(self.n_slots)]
+        # The prefix index dies with the pool: cached blocks' K/V was in
+        # the reallocated buffers, so serving a hit would serve zeros.
+        self._block_mgr.reset()
         self._last_dev = jnp.zeros((self.n_slots,), dtype=jnp.int32)
         self._first_dev = jnp.zeros((self.n_slots,), dtype=jnp.int32)
 
@@ -599,15 +635,31 @@ class DecodeServer:
                         )
                     )
                     continue
-                if n_blocks > len(self._free_blocks):
-                    # Pool exhausted: wait for running sequences to finish.
-                    # FIFO head-of-line — later requests must not starve
-                    # this one by sneaking into blocks as they free.
+                evict0 = self._block_mgr.evictions
+                admitted = self._block_mgr.admit(
+                    idx, prompt, n_blocks, use_cache=self.prefix_cache
+                )
+                if admitted is None:
+                    # Pool exhausted (after prefix hits): wait for running
+                    # sequences to finish. FIFO head-of-line — later
+                    # requests must not starve this one by sneaking into
+                    # blocks as they free. The manager rolled back any
+                    # partial prefix-hit reservation before refusing.
                     self._waiting.appendleft(item)
                     return
                 break
-            blocks = [self._free_blocks.pop() for _ in range(n_blocks)]
-            self._slot_blocks[idx] = blocks
+            blocks, n_hit = admitted
+            if self.metrics is not None and self.prefix_cache:
+                self.metrics.inc("nos_tpu_decode_prefix_lookups")
+                if n_hit:
+                    self.metrics.inc("nos_tpu_decode_prefix_hit_blocks", n_hit)
+                    self.metrics.inc(
+                        "nos_tpu_decode_prefix_hit_tokens",
+                        n_hit * self.block_size,
+                    )
+                evicted = self._block_mgr.evictions - evict0
+                if evicted:
+                    self.metrics.inc("nos_tpu_decode_prefix_evictions", evicted)
             row = np.zeros((self.max_pages,), dtype=np.int32)
             row[: len(blocks)] = blocks
             self._table = self._table.at[idx].set(jnp.asarray(row))
@@ -622,9 +674,14 @@ class DecodeServer:
             slot.phase = "reserved"
             slot.future = fut
             slot.pending_prompt = list(prompt)
-            slot.prefill_cursor = 0
+            # Prefix hits are already in the page table: the prefill
+            # cursor starts at the first MISS boundary, so the budget
+            # scheduler spends tokens only on blocks the request missed
+            # (the hit run is capped below the last-token block, so the
+            # final chunk — and its first-token sample — always remains).
+            slot.prefill_cursor = n_hit * self.block_size
             slot.t_submit = t_submit
-            slot.pos = 0
+            slot.pos = slot.prefill_cursor
             slot.remaining = max_new - 1
             slot.refs = []
             slot.eos_scanned = 0
@@ -755,6 +812,12 @@ class DecodeServer:
             if slot.phase == "reserved":
                 slot.phase = "prefilling"
             self.prefill_tokens += len(piece)
+            # Full prompt blocks behind the (dispatched) cursor become
+            # shareable: index them now, so even a concurrent same-prefix
+            # arrival can hit them — its chunks dispatch after this wave
+            # on the same donated cache chain, so device ordering makes
+            # the reads see these writes.
+            self._block_mgr.note_progress(idx, slot.prefill_cursor)
         if finals:
             # ONE _TokRef over the cumulative first-token vector for every
             # slot finishing in this wave (each scatter built on the
@@ -1117,8 +1180,29 @@ class DecodeServer:
         while len(self._inflight) > self.pipeline_depth:
             self._inflight.popleft().np()
 
+    # -- prefix-cache counters (read-through to the BlockManager; telemetry's
+    # collect_serving duck-types these as plain attributes) -------------------
+    @property
+    def prefix_lookups(self) -> int:
+        return self._block_mgr.lookups
+
+    @property
+    def prefix_hit_blocks(self) -> int:
+        return self._block_mgr.hit_blocks
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        """Prompt tokens served from cached blocks instead of prefill
+        dispatches — the budget the prefix cache gave back."""
+        return self._block_mgr.hit_tokens
+
+    @property
+    def prefix_evictions(self) -> int:
+        return self._block_mgr.evictions
+
     def _publish_gauges(self, n_drafting: int, n_macro: int) -> None:
-        """Per-tick split and queue-depth gauges (metrics registry only)."""
+        """Per-tick split, queue-depth, and pool-state gauges (metrics
+        registry only)."""
         m = self.metrics
         m.set_gauge("nos_tpu_decode_slots_drafting", n_drafting)
         m.set_gauge("nos_tpu_decode_slots_macro", n_macro)
@@ -1129,3 +1213,7 @@ class DecodeServer:
         m.set_gauge("nos_tpu_decode_inflight_dispatches", len(self._inflight))
         m.set_gauge("nos_tpu_decode_pending_verifies", len(self._pending_verifies))
         m.set_gauge("nos_tpu_decode_waiting_requests", len(self._waiting))
+        pool = self._block_mgr.counts()
+        m.set_gauge("nos_tpu_decode_kv_blocks_free", pool["free"])
+        m.set_gauge("nos_tpu_decode_kv_blocks_cached", pool["cached"])
+        m.set_gauge("nos_tpu_decode_kv_blocks_shared", pool["shared"])

@@ -8,8 +8,9 @@ injectable (and defaults to a no-op logger in zero-egress environments).
 The serving plane has the same shape of surface: `ServingReport` /
 `collect_serving` snapshot a DecodeServer's engine counters (dispatches,
 speculative rounds and acceptance, the decoupled drafting/macro split,
-in-flight queue depths) — pure numbers, no tokens, prompts, or request
-content. Live scraping goes through the engine's optional `metrics`
+prefix-cache hits and pool-state gauges, in-flight queue depths) — pure
+numbers, no tokens, prompts, or request content (the prefix index keys
+are hashes and never leave the engine). Live scraping goes through the engine's optional `metrics`
 registry (observability.Metrics, `nos_tpu_decode_*` series); this module
 is the one-shot, opt-in export of the same facts.
 """
@@ -99,6 +100,18 @@ class ServingReport:
     prefill_dispatches: int = 0
     prefill_tokens: int = 0
     ticks_with_prefill_and_macro: int = 0
+    # Shared-prefix KV reuse (PR 5): admissions that looked up the
+    # content index, full blocks served from cache, the prompt tokens
+    # those hits saved the prefill budget, blocks evicted from the
+    # cached-free LRU under allocation pressure — plus a pool-state
+    # snapshot (free / cached-but-reusable / mapped-by->=2-tables).
+    prefix_lookups: int = 0
+    prefix_hit_blocks: int = 0
+    prefix_hit_tokens: int = 0
+    prefix_evictions: int = 0
+    kv_blocks_free: int = 0
+    kv_blocks_cached: int = 0
+    kv_blocks_shared: int = 0
     # Per-request latency tails (seconds; 0.0 when no samples yet).
     # TTFT is submit -> final-prefill-chunk dispatch; queue wait is
     # submit -> slot reservation.
@@ -146,6 +159,10 @@ def collect_serving(server) -> ServingReport:
         ticks_with_prefill_and_macro=int(
             getattr(server, "ticks_with_prefill_and_macro", 0)
         ),
+        prefix_lookups=int(getattr(server, "prefix_lookups", 0)),
+        prefix_hit_blocks=int(getattr(server, "prefix_hit_blocks", 0)),
+        prefix_hit_tokens=int(getattr(server, "prefix_hit_tokens", 0)),
+        prefix_evictions=int(getattr(server, "prefix_evictions", 0)),
         ttft_p50_s=percentile(ttft, 50),
         ttft_p95_s=percentile(ttft, 95),
         queue_wait_p50_s=percentile(queue_wait, 50),
@@ -160,6 +177,12 @@ def collect_serving(server) -> ServingReport:
     ):
         for idx, value in enumerate(getattr(server, name, ())):
             into[str(idx)] = int(value)
+    mgr = getattr(server, "_block_mgr", None)
+    if mgr is not None:
+        pool = mgr.counts()
+        report.kv_blocks_free = int(pool["free"])
+        report.kv_blocks_cached = int(pool["cached"])
+        report.kv_blocks_shared = int(pool["shared"])
     return report
 
 

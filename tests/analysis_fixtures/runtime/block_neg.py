@@ -1,0 +1,38 @@
+"""NOS011 negatives: the BlockManager owns its pool state — mutations
+inside the class body are the sanctioned site; engines that route through
+manager METHODS and merely read the state stay clean. Similarly-named
+attributes that are not pool state (`_block_size`) are out of scope.
+"""
+
+
+class BlockManager:
+    def __init__(self, total):
+        self._free_blocks = list(range(1, total))
+        self._slot_blocks = [[] for _ in range(2)]
+        self._refcount = [0] * total
+        self._cached_free = {}
+        self._prefix_index = {}
+        self._block_key = {}
+
+    def admit(self, idx):
+        block = self._free_blocks.pop()
+        self._refcount[block] += 1
+        self._slot_blocks[idx] = [block]
+        return block
+
+    def release(self, idx):
+        for block in self._slot_blocks[idx]:
+            self._refcount[block] -= 1
+            self._free_blocks.append(block)
+        self._slot_blocks[idx] = []
+
+
+class Engine:
+    def __init__(self):
+        self._mgr = BlockManager(8)
+        self._block_size = 32
+
+    def _tick(self, idx):
+        self._mgr.admit(idx)  # method call: the sanctioned route
+        self._block_size = 64  # not pool state
+        return len(self._mgr._free_blocks)  # read: legal

@@ -1,0 +1,32 @@
+"""NOS011 positives: paged-pool bookkeeping mutated outside BlockManager.
+
+Expected findings (7): the engine's direct `_free_blocks.append`, the
+`_slot_blocks[idx]` subscript assignment, the reach-through
+`self._mgr._refcount[b] += 1`, a `del` on the manager's `_cached_free`,
+a module-level function popping `_prefix_index` — and the constructor's
+two pool-state assignments: unlike NOS005 there is no constructor
+exemption, because pool state EXISTING outside the BlockManager is the
+drift the rule guards against, not just racing on it. Reads
+(`len(...)`, iteration) stay legal.
+"""
+
+
+class Engine:
+    def __init__(self, mgr):
+        self._mgr = mgr
+        self._free_blocks = [1, 2, 3]
+        self._slot_blocks = [[], []]
+
+    def _tick(self, idx, block):
+        self._free_blocks.append(block)
+        self._slot_blocks[idx] = []
+        self._mgr._refcount[block] += 1
+        del self._mgr._cached_free[block]
+        return len(self._free_blocks)  # read: legal
+
+    def depth(self):
+        return sum(len(b) for b in self._slot_blocks)  # read: legal
+
+
+def sweep(mgr, key):
+    return mgr._prefix_index.pop(key)

@@ -1,0 +1,245 @@
+"""BlockManager (runtime/block_manager.py): refcounted, content-addressed
+bookkeeping for the paged KV pool. Pure host-side tests — no model, no
+device: the manager's invariants are what make cross-request block
+sharing safe, so they are pinned here independently of the engine."""
+
+import random
+
+import pytest
+
+from nos_tpu.runtime.block_manager import BlockManager, chain_key
+
+BS = 4
+
+
+def mk(total=16, n_slots=3):
+    return BlockManager(total, BS, n_slots)
+
+
+def n_blocks_for(prompt_len, max_new):
+    return max(1, -(-(prompt_len + max_new - 1) // BS))
+
+
+def check_invariants(mgr):
+    """The conservation law of the pool (the ISSUE's gate, stated on
+    DISTINCT blocks: a shared block counts once however many tables map
+    it): every managed block is in exactly one of in-use / free /
+    cached-free, and a block's refcount equals the number of page tables
+    mapping it — so no block can sit in two tables with refcount < 2."""
+    blocks = range(1, mgr.total_blocks)
+    in_use = {b for b in blocks if mgr._refcount[b] > 0}
+    free = set(mgr._free_blocks)
+    cached = set(mgr._cached_free)
+    assert len(free) == len(mgr._free_blocks), "free list holds a duplicate"
+    assert not in_use & free, f"in-use blocks on the free list: {in_use & free}"
+    assert not in_use & cached, f"in-use blocks in cached-free: {in_use & cached}"
+    assert not free & cached, f"blocks both free and cached: {free & cached}"
+    # sum over states == total_blocks - 1 (scratch excluded).
+    assert len(in_use) + len(free) + len(cached) == mgr.total_blocks - 1
+    owners = {}
+    for row in mgr._slot_blocks:
+        assert len(set(row)) == len(row), "one table maps a block twice"
+        for b in row:
+            owners[b] = owners.get(b, 0) + 1
+    for b in blocks:
+        assert mgr._refcount[b] == owners.get(b, 0), (
+            f"block {b}: refcount {mgr._refcount[b]} != {owners.get(b, 0)} tables"
+        )
+    # Index consistency: the index and its inverse agree; every
+    # cached-free resident is indexed (that is what makes it reusable).
+    for key, b in mgr._prefix_index.items():
+        assert mgr._block_key.get(b) == key
+    for b, key in mgr._block_key.items():
+        assert mgr._prefix_index.get(key) == b
+    for b in cached:
+        assert b in mgr._block_key
+
+
+# -- chain keys ----------------------------------------------------------------
+def test_chain_keys_commit_to_the_whole_prefix():
+    mgr = mk()
+    a = mgr.prompt_keys([1, 2, 3, 4, 5, 6, 7, 8])
+    b = mgr.prompt_keys([1, 2, 3, 4, 5, 6, 7, 8])
+    assert a == b and len(a) == 2
+    # Same second block, different first block -> different chained key.
+    c = mgr.prompt_keys([9, 2, 3, 4, 5, 6, 7, 8])
+    assert c[1] != a[1]
+    # Partial tail blocks are never keyed.
+    assert len(mgr.prompt_keys([1, 2, 3, 4, 5])) == 1
+    assert chain_key("", [1, 2]) != chain_key("x", [1, 2])
+
+
+# -- admission / reuse ---------------------------------------------------------
+def test_full_prefix_reuse_and_refcounts():
+    mgr = mk()
+    prompt = list(range(10))  # 2 full blocks + tail
+    blocks1, hit1 = mgr.admit(0, prompt, n_blocks_for(10, 4))
+    assert hit1 == 0
+    mgr.note_progress(0, 10)
+    blocks2, hit2 = mgr.admit(1, prompt, n_blocks_for(10, 4))
+    assert hit2 == 2
+    assert blocks2[:2] == blocks1[:2]  # the shared run, in prefix order
+    assert blocks2[2] != blocks1[2]  # the tail is private
+    assert mgr.counts()["shared"] == 2
+    check_invariants(mgr)
+
+
+def test_last_token_block_never_served_from_cache():
+    """A prompt whose length is an exact block multiple keeps its final
+    block private: the final prefill chunk must exist to sample the
+    first token, and decode writes start right after it."""
+    mgr = mk()
+    prompt = list(range(8))  # exactly 2 blocks
+    mgr.admit(0, prompt, n_blocks_for(8, 4))
+    mgr.note_progress(0, 8)  # both full blocks indexed
+    _, hits = mgr.admit(1, prompt, n_blocks_for(8, 4))
+    assert hits == 1  # block holding token 7 is recomputed privately
+    check_invariants(mgr)
+
+
+def test_release_retires_keyed_blocks_to_lru_and_revives_on_hit():
+    mgr = mk()
+    prompt = list(range(10))
+    blocks, _ = mgr.admit(0, prompt, n_blocks_for(10, 4))
+    mgr.note_progress(0, 10)
+    mgr.release(0)
+    counts = mgr.counts()
+    assert counts["in_use"] == 0
+    assert counts["cached"] == 2  # the keyed full blocks, content retained
+    _, hits = mgr.admit(1, prompt, n_blocks_for(10, 4))
+    assert hits == 2
+    assert mgr.counts()["cached"] == 0  # revived out of the LRU
+    check_invariants(mgr)
+
+
+def test_eviction_under_pressure_is_lru_ordered():
+    mgr = BlockManager(1 + 6, BS, 3)
+    pa, pb = [1] * 8, [2] * 8  # 2 full blocks each, both keyed
+    mgr.admit(0, pa, 2)
+    mgr.note_progress(0, 8)
+    mgr.release(0)
+    mgr.admit(0, pb, 2)
+    mgr.note_progress(0, 8)
+    mgr.release(0)  # cached LRU: A1, A2 (older), B1, B2 (newer); free: 2
+    assert mgr.counts() == {"free": 2, "cached": 4, "in_use": 0, "shared": 0}
+    # A 4-block no-hit admission drains the free list then evicts the
+    # OLDEST cached blocks — A's, not B's.
+    mgr.admit(1, [3] * 13, 4)
+    assert mgr.evictions == 2
+    a_keys, b_keys = mgr.prompt_keys(pa), mgr.prompt_keys(pb)
+    assert not any(k in mgr._prefix_index for k in a_keys)  # A evicted...
+    assert all(k in mgr._prefix_index for k in b_keys)  # ...B survived
+    _, hits_b = mgr.admit(2, pb, 2)
+    assert hits_b == 1  # and still hits (capped below its last-token block)
+    check_invariants(mgr)
+
+
+def test_reset_forgets_cached_content():
+    mgr = mk()
+    prompt = list(range(10))
+    mgr.admit(0, prompt, 3)
+    mgr.note_progress(0, 10)
+    mgr.reset()
+    check_invariants(mgr)
+    assert mgr.counts() == {
+        "free": mgr.total_blocks - 1, "cached": 0, "in_use": 0, "shared": 0
+    }
+    _, hits = mgr.admit(0, prompt, 3)
+    assert hits == 0  # the index died with the device pool
+
+
+# -- the leak-guard satellite --------------------------------------------------
+def test_failed_admission_after_partial_hit_returns_every_block():
+    """ISSUE 5 satellite: admission failure after a partial prefix hit
+    must return every block already taken — including dropping the hit
+    refcount bumps — before the slot is offered to the next request.
+    Exhausting the pool via REPEATED rejected admissions is the
+    regression: a per-attempt leak drains the pool in a few ticks."""
+    mgr = BlockManager(1 + 6, BS, 3)
+    donor = list(range(8))  # 2 full blocks, keyed below
+    mgr.admit(0, donor, 2)
+    mgr.note_progress(0, 8)
+    mgr.admit(1, [7] * 7, 2)  # filler pins 2 more blocks
+    # Pool: 4 in use, 2 free. A same-prefix request (hits donor's 2
+    # shared blocks) still misses 4 > 2 available -> must be refused
+    # CLEANLY every time.
+    big = donor + list(range(8, 18))  # 18 + 4 - 1 -> 6 blocks, 2 hit
+    before = mgr.counts()
+    for _ in range(50):
+        assert mgr.admit(2, big, n_blocks_for(len(big), 4)) is None
+        assert mgr.counts() == before, "rejected admission leaked pool state"
+        check_invariants(mgr)
+    # The FILLER's release un-wedges the same request: 2 shared (with the
+    # still-live donor) + 4 private == the whole pool, exactly.
+    mgr.release(1)
+    admitted = mgr.admit(2, big, n_blocks_for(len(big), 4))
+    assert admitted is not None
+    assert admitted[1] == 2  # the prefix hits survived the earlier rollbacks
+    assert mgr.counts()["shared"] == 2
+    check_invariants(mgr)
+
+
+def test_failed_admission_restores_resting_hits_to_the_lru():
+    mgr = BlockManager(1 + 3, BS, 2)
+    donor = list(range(8))
+    mgr.admit(0, donor, 2)
+    mgr.note_progress(0, 8)
+    mgr.release(0)  # 1 cached (hit candidate), 1 free... and 1 unkeyed free
+    cached_before = set(mgr._cached_free)
+    assert mgr.admit(1, donor + list(range(8, 20)), 5) is None
+    assert set(mgr._cached_free) == cached_before
+    check_invariants(mgr)
+
+
+def test_double_admit_same_slot_is_a_bug():
+    mgr = mk()
+    mgr.admit(0, [1, 2, 3], 1)
+    with pytest.raises(RuntimeError, match="already holds"):
+        mgr.admit(0, [4, 5, 6], 1)
+
+
+# -- the randomized invariant satellite ---------------------------------------
+def test_randomized_interleaving_preserves_invariants():
+    """ISSUE 5 satellite: after ANY admit/prefill/decode/finish/evict
+    interleaving, the conservation law holds — every managed block in
+    exactly one of in-use/free/cached-free (their sizes summing to
+    total_blocks - 1, scratch excluded) and no block mapped by two page
+    tables with refcount < 2 (refcount == number of mapping tables).
+    Seeded: failures replay."""
+    rng = random.Random(20260804)
+    mgr = BlockManager(1 + 10, BS, 4)  # small pool: constant eviction pressure
+    live = {}  # slot -> (prompt, cursor)
+    for step in range(3000):
+        op = rng.random()
+        idle = [i for i in range(mgr.n_slots) if i not in live]
+        if op < 0.4 and idle:
+            idx = rng.choice(idle)
+            # Small vocab + short lengths: frequent genuine prefix
+            # collisions AND frequent pool-exhaustion rejections.
+            plen = rng.randint(1, 20)
+            prompt = [rng.randint(0, 2) for _ in range(plen)]
+            max_new = rng.randint(1, 6)
+            n = n_blocks_for(plen, max_new)
+            if n <= mgr.total_blocks - 1:
+                got = mgr.admit(idx, prompt, n, use_cache=rng.random() < 0.8)
+                if got is not None:
+                    live[idx] = (prompt, got[1] * BS)
+        elif op < 0.7 and live:
+            idx = rng.choice(list(live))
+            prompt, cursor = live[idx]
+            cursor = min(len(prompt), cursor + rng.randint(1, 8))
+            mgr.note_progress(idx, cursor)
+            live[idx] = (prompt, cursor)
+        elif op < 0.95 and live:
+            idx = rng.choice(list(live))
+            del live[idx]
+            mgr.release(idx)
+        elif op >= 0.99:
+            mgr.reset()
+            live.clear()
+        check_invariants(mgr)
+    assert mgr.lookups > 0 and mgr.hit_blocks > 0 and mgr.evictions > 0
+    for idx in list(live):
+        mgr.release(idx)
+    check_invariants(mgr)
+    assert mgr.counts()["in_use"] == 0

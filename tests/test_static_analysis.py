@@ -16,6 +16,7 @@ import os
 import pytest
 
 from nos_tpu import analysis
+from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
 from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
@@ -236,6 +237,56 @@ def test_host_sync_sanctioned_site_suppressed_inline(tmp_path):
     )
     findings = run_checkers(str(runtime), [HostSyncChecker()])
     assert [x.line for x in findings] == [5]
+
+
+# -- NOS011 pool bookkeeping outside the BlockManager -------------------------
+def test_block_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "block_pos.py"), [BlockDisciplineChecker()]
+    )
+    assert codes_of(findings) == ["NOS011"]
+    # append, subscript assign, reach-through augassign, del, module-level
+    # .pop, and the constructor's two pool-state assignments (no
+    # constructor exemption: the state existing outside the manager IS
+    # the finding) — and NOT the len()/iteration reads.
+    assert len(findings) == 7
+    msgs = " | ".join(f.message for f in findings)
+    assert "_free_blocks" in msgs
+    assert "_slot_blocks" in msgs
+    assert "_refcount" in msgs
+    assert "_cached_free" in msgs
+    assert "_prefix_index" in msgs
+    assert all("BlockManager" in f.message for f in findings)
+
+
+def test_block_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "block_neg.py"), [BlockDisciplineChecker()]
+    )
+    assert findings == []
+
+
+def test_block_discipline_scope_needs_runtime_dir(tmp_path):
+    # The same mutation OUTSIDE a runtime/ directory is out of scope —
+    # the rule guards the serving engine's pool, not every list named
+    # _free_blocks in the tree.
+    f = tmp_path / "pool_like.py"
+    f.write_text(
+        "class Engine:\n"
+        "    def free(self, b):\n"
+        "        self._free_blocks.append(b)\n"
+    )
+    assert run_checkers(str(f), [BlockDisciplineChecker()]) == []
+
+
+def test_block_discipline_real_engine_is_clean():
+    # The refactored DecodeServer must route every pool mutation through
+    # the BlockManager — the tentpole's enforcement, checked directly so
+    # a regression names this test instead of the tree-wide gate.
+    findings = run_checkers(
+        os.path.join(TREE, "runtime", "decode_server.py"), [BlockDisciplineChecker()]
+    )
+    assert findings == []
 
 
 # -- engine: inline suppression ----------------------------------------------
