@@ -141,7 +141,10 @@ def _decode_phase(jax, jnp) -> dict:
     budget swept over {0 (inline baseline), 256, 1024}. PR 5 adds the
     SHARED-PREFIX scenario: 8 streams sharing a 512-token system prompt
     (distinct 64-token suffixes), prefix cache off vs on — hit rate,
-    prefill tokens skipped, and streams-2..8 TTFT tails."""
+    prefill tokens skipped, and streams-2..8 TTFT tails. PR 6 adds the
+    AVAILABILITY scenario: 8 streams with a transient + a device-lost
+    fault injected mid-flight, surgical recovery vs the fail-all
+    baseline — goodput retention and restore-latency tails."""
     import numpy as np
 
     from nos_tpu.models.gpt import GPTConfig, init_gpt
@@ -464,6 +467,90 @@ def _decode_phase(jax, jnp) -> dict:
         _retry(f"decode:shared_prefix_cache_{'on' if c else 'off'}",
                lambda c=c: shared_prefix(c))
         for c in (False, True)
+    ]
+
+    # Availability under injected faults (PR 6, docs/robustness.md): 8
+    # streams decoding, a transient dispatch flake and a device-lost
+    # fault injected mid-flight at deterministic macro-dispatch
+    # occurrences. Surgical recovery (classify -> backoff-retry /
+    # checkpoint -> replay through budgeted prefill) vs the legacy
+    # fail-all baseline, SAME traffic and schedule: goodput retention
+    # (requests completed / submitted — the legacy sweep fails every
+    # in-flight future at the first fault), tokens actually delivered,
+    # restore-latency tails (fault detection -> the restored slot's
+    # replayed final chunk dispatches), and the recovery counters, all
+    # through telemetry.ServingReport.
+    def availability(surgical):
+        from nos_tpu.runtime.faults import (
+            FAULT_DEVICE_LOST,
+            FAULT_TRANSIENT,
+            FaultInjector,
+            FaultSpec,
+        )
+        from nos_tpu.telemetry import collect_serving
+
+        srng = np.random.default_rng([8, 128, 64])
+        prompts = [
+            srng.integers(1, cfg.vocab, 128).tolist() for _ in range(8)
+        ]
+        # At K=16 one macro dispatch advances every decoding slot 16
+        # tokens, so the 8-stream/128-token phase runs ~8-10 macro
+        # dispatches: occurrence 3 lands mid-flight with all streams
+        # partially generated, occurrence 6 after the transient healed.
+        injector = FaultInjector(
+            [
+                FaultSpec("dispatch_macro", 3, FAULT_TRANSIENT),
+                FaultSpec("dispatch_macro", 6, FAULT_DEVICE_LOST),
+            ],
+            armed=False,  # the warm-up request runs fault-free
+        )
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=8,
+            max_len=512,
+            prompt_buckets=(16, 32, 64, 128, 256),
+            steps_per_dispatch=16,
+            fault_injector=injector,
+            surgical_recovery=surgical,
+        ).start()
+        try:
+            server.generate(prompts[0], max_new=32, timeout=600)
+            injector.arm()
+            t0 = time.perf_counter()
+            futs = [server.submit(p, max_new=128) for p in prompts]
+            completed = 0
+            tokens = 0
+            for f in futs:
+                try:
+                    tokens += len(f.result(timeout=600))
+                    completed += 1
+                except Exception as e:  # noqa: BLE001 — the measured outcome
+                    _log(f"availability: request failed: {type(e).__name__}")
+            wall = time.perf_counter() - t0
+            report = collect_serving(server)
+            return {
+                "surgical_recovery": surgical,
+                "goodput_retention": round(completed / 8, 3),
+                "tokens_delivered": tokens,
+                "tok_s_8_stream_faulted": round(tokens / wall, 1),
+                "recoveries": report.recoveries,
+                "transient_retries": report.transient_retries,
+                "slots_restored": report.slots_restored,
+                "replay_tokens": report.replay_tokens,
+                "fail_all_recoveries": report.fail_all_recoveries,
+                "restore_latency_p50_s": round(report.restore_latency_p50_s, 4),
+                "restore_latency_p95_s": round(report.restore_latency_p95_s, 4),
+            }
+        finally:
+            server.stop()
+
+    out["availability_8_stream"] = [
+        _retry(
+            f"decode:availability_{'surgical' if s else 'fail_all'}",
+            lambda s=s: availability(s),
+        )
+        for s in (False, True)
     ]
     return out
 

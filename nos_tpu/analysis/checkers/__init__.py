@@ -11,6 +11,7 @@ from nos_tpu.analysis.core import Checker
 def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
     from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+    from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
     from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
@@ -25,4 +26,5 @@ def all_checkers() -> List[Checker]:
         TraceSafetyChecker(),
         HostSyncChecker(),
         BlockDisciplineChecker(),
+        FaultDisciplineChecker(),
     ]

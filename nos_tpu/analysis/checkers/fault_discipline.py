@@ -1,0 +1,103 @@
+"""NOS012 — unclassified broad except on the serving tick/recovery path.
+
+The serving engine's failure model (runtime/faults.py, docs/robustness.md)
+only works if every tick-path exception actually REACHES the classifier:
+poison faults must fail exactly one slot, transients must retry instead of
+tearing down, device-lost must checkpoint-and-restore. A broad
+`except Exception:` in the engine loop that logs-and-continues (or fails
+futures directly) silently reinstates the old all-or-nothing behavior —
+it *looks* handled, NOS003 is satisfied by the log call, and the taxonomy
+never sees the error. That drift is invisible in tests that don't inject
+faults, which is exactly why it gets its own checker.
+
+Scope: files under `runtime/` containing an engine-loop class (a class
+defining `_tick` or `_run`). Flagged regions are those classes' methods
+reachable from the `_tick`/`_run` roots via `self.method()` calls (the
+NOS010 reachability). In scope, a handler for Exception/BaseException must
+show the error is ROUTED, not just observed: a `raise` (re-raise or
+escalation), or a call into the taxonomy/recovery machinery
+(`classify_fault`, `poison_slot_of`, `self._recover(...)`). Narrow
+handlers (`except RuntimeError:` around a checkpoint materialization)
+remain deliberate control flow; bare `except:` stays NOS004's.
+Deliberately-unclassified last-resort backstops carry an inline
+`# nos-lint: ignore[NOS012]` with a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from nos_tpu.analysis.core import Checker, FileContext, Report
+from nos_tpu.analysis.checkers.exception_hygiene import _is_broad
+from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
+
+_ROUTERS = {"classify_fault", "poison_slot_of", "_recover", "recover"}
+
+
+def _routes_through_taxonomy(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _ROUTERS:
+                return True
+            if isinstance(fn, ast.Attribute) and fn.attr in _ROUTERS:
+                return True
+    return False
+
+
+class FaultDisciplineChecker(Checker):
+    name = "fault-discipline"
+    codes = ("NOS012",)
+    description = "tick/recovery-path broad excepts must route through the fault taxonomy"
+
+    def __init__(self) -> None:
+        self._active = False
+        self._scope_funcs: Set[ast.AST] = set()
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._active = "runtime" in ctx.segments[:-1]
+        self._scope_funcs = set()
+        if not self._active:
+            return
+        found_engine = False
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = {
+                n.name: n
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            if "_tick" not in methods and "_run" not in methods:
+                continue
+            found_engine = True
+            # Same reachability NOS010 uses: roots `_tick`/`_run`, closed
+            # over `self.method()` calls.
+            for name in HostSyncChecker._reachable(methods):
+                self._scope_funcs.add(methods[name])
+        if not found_engine:
+            self._active = False
+
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if not self._active or not isinstance(node, ast.ExceptHandler):
+            return
+        if not any(
+            f in self._scope_funcs
+            for f in ctx.enclosing_all(ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            return
+        if node.type is None:
+            return  # bare except is NOS004's finding, tick path or not
+        if _is_broad(node.type) and not _routes_through_taxonomy(node):
+            report.add(
+                ctx.rel,
+                node.lineno,
+                "NOS012",
+                "broad except on the engine tick/recovery path bypasses fault "
+                "classification; route it through the taxonomy "
+                "(classify_fault/_recover) or re-raise so recovery stays "
+                "surgical",
+            )

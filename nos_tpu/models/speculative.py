@@ -92,6 +92,27 @@ class AdaptiveSpec:
         EWMA, never below 1 (a 1-draft probe is how the rate recovers)."""
         return max(1, min(k, int(round(k * self.rate))))
 
+    def snapshot(self, generated: int) -> Dict[str, float]:
+        """Host-serializable controller state for a slot checkpoint
+        (runtime/checkpoint.py). `denied_until` is stored RELATIVE to the
+        slot's current generated count: a restored slot's count restarts
+        at zero (the replayed tokens become prompt), so the absolute
+        threshold would silently extend or truncate the cooldown."""
+        return {
+            "rate": self.rate,
+            "denied_for": max(0, self.denied_until - generated),
+        }
+
+    @classmethod
+    def restore(cls, snap: Dict[str, float]) -> "AdaptiveSpec":
+        """Rebuild the controller from `snapshot()` output: same learned
+        acceptance EWMA, cooldown re-anchored at the restored slot's fresh
+        generated count."""
+        spec = cls()
+        spec.rate = float(snap.get("rate", 1.0))
+        spec.denied_until = int(snap.get("denied_for", 0))
+        return spec
+
 
 def find_prompt_lookup_draft(
     history: Sequence[int], ngram: int = 3, k: int = 8

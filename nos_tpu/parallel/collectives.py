@@ -16,9 +16,21 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def axis_size(axis_name: str) -> int:
+    """Static size of the named mesh axis, across jax versions: newer jax
+    exposes `jax.lax.axis_size`; the 0.4.x line spells the same lookup
+    `jax.core.axis_frame(name)` (returns the int directly). Every
+    collective in this package sizes its ring/stage math through here."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    import jax.core as _core
+
+    return _core.axis_frame(axis_name)
+
+
 def ring_perm(axis_name: str, shift: int = 1):
     """The (src, dst) permutation for a unidirectional ring over an axis."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     return [(i, (i + shift) % n) for i in range(n)]
 
 

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from nos_tpu.parallel.collectives import axis_size
+
 try:
     from jax import shard_map
 except ImportError:  # pragma: no cover - older jax
@@ -49,7 +51,7 @@ def _moe_local(
     """Per-rank program. x: [tokens_local, hidden]; experts sharded on ep —
     this rank holds n_experts/ep experts (leading axis already sliced).
     Returns (y, aux_loss)."""
-    ep = lax.axis_size(axis_name)
+    ep = axis_size(axis_name)
     local_experts = params["w_in"].shape[0]
     t, h = x.shape
 

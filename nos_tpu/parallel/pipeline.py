@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nos_tpu.parallel.collectives import axis_size
+
 try:
     from jax import shard_map
 except ImportError:  # pragma: no cover - older jax
@@ -38,7 +40,7 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str):
     stage 0 consumes it (other stages take handoffs).
     Returns [M, mb, ...] outputs, valid on the LAST stage (zeros elsewhere).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m = microbatches.shape[0]
     ticks = m + n_stages - 1
@@ -50,7 +52,11 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str):
     def mark_varying(x):
         if hasattr(lax, "pcast"):
             return lax.pcast(x, (axis_name,), to="varying")
-        return lax.pvary(x, (axis_name,))
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, (axis_name,))
+        # jax 0.4.x predates varying-axis annotations entirely: shard_map's
+        # replication checker infers everything, so the mark is a no-op.
+        return x
 
     carry_in = mark_varying(jnp.zeros(mb_shape, microbatches.dtype) + microbatches[0] * 0)
     outputs = mark_varying(

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nos_tpu.parallel.collectives import axis_size
+
 try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
@@ -48,7 +50,7 @@ def _block_attn(q, k, v, bias=None):
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
     """The per-device program: stream K/V around the ring."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     q = (q * scale).astype(q.dtype)
     b, h, t_q, d = q.shape

@@ -1,4 +1,13 @@
 """Workload runtime: the serving side of a carved sub-slice."""
 
+from nos_tpu.runtime.checkpoint import SlotCheckpoint  # noqa: F401
 from nos_tpu.runtime.decode_server import DecodeServer  # noqa: F401
+from nos_tpu.runtime.faults import (  # noqa: F401
+    DeviceLostError,
+    FaultInjector,
+    FaultSpec,
+    PoisonRequestError,
+    TransientDispatchError,
+    classify_fault,
+)
 from nos_tpu.runtime.slice_server import SliceServer  # noqa: F401

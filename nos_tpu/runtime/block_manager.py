@@ -64,12 +64,20 @@ class BlockManager:
     content-addressed prefix index. Block 0 is the scratch page and is
     never managed here."""
 
-    def __init__(self, total_blocks: int, block_size: int, n_slots: int):
+    def __init__(
+        self, total_blocks: int, block_size: int, n_slots: int, fault_injector=None
+    ):
         if total_blocks < 2:
             raise ValueError("total_blocks must be >= 2 (scratch + 1)")
         self.total_blocks = int(total_blocks)
         self.block_size = int(block_size)
         self.n_slots = int(n_slots)
+        # Deterministic chaos harness (runtime/faults.py FaultInjector):
+        # the `block_admit` site fires at admission ENTRY, before any pool
+        # mutation, so an injected fault can never leave half-taken state
+        # — conservation under injection is by construction, and the
+        # randomized invariant test exercises exactly that.
+        self._faults = fault_injector
         # Pool state. A managed block is in exactly ONE of: the plain
         # free list, the cached-free LRU (refcount 0, content indexed),
         # or in use (refcount == number of page tables mapping it).
@@ -113,6 +121,20 @@ class BlockManager:
             "shared": shared,
         }
 
+    def conserved(self) -> bool:
+        """The pool conservation law, as one cheap predicate: every managed
+        block in exactly one of in-use / free / cached-free (the three
+        summing to total - 1, scratch excluded) and no duplicate on the
+        free list. The recovery paths assert this after every restore —
+        a leaked or double-freed block surfaces at the recovery that
+        caused it, not as cross-request KV corruption under later load."""
+        c = self.counts()
+        return (
+            len(set(self._free_blocks)) == len(self._free_blocks)
+            and not set(self._free_blocks) & set(self._cached_free)
+            and c["in_use"] + c["free"] + c["cached"] == self.total_blocks - 1
+        )
+
     def prompt_keys(self, prompt: Sequence[int]) -> List[str]:
         """Chain keys for every block FULLY covered by the prompt."""
         bs = self.block_size
@@ -144,6 +166,8 @@ class BlockManager:
         blocks stay immutable."""
         if self._slot_blocks[idx]:
             raise RuntimeError(f"slot {idx} already holds blocks")
+        if self._faults is not None:
+            self._faults.check("block_admit", slot=idx)
         keys = self.prompt_keys(prompt) if use_cache else []
         hits: List[int] = []
         if use_cache:

@@ -1,0 +1,89 @@
+"""SlotCheckpoint: the host-recoverable state of one serving slot.
+
+A decode slot's device state (its KV pages) is a pure function of its
+host state: prefilling `prompt + generated` through the engine's budgeted
+chunked-prefill path recomputes every page the slot had written, and the
+next sampled token is the same argmax the fault-free run would have taken
+(bit-identical for greedy on a deterministic backend — the replay runs
+through the SAME compiled chunk/window programs a cold prompt of that
+length uses, which is exactly the equality the prefix-cache exactness
+oracles already pin; see docs/robustness.md for the full argument). So a
+checkpoint needs only:
+
+  - the request identity: original prompt, requested ``max_new``, the
+    client's Future, submit timestamp, and the slot's sampling ``serial``
+    (restores preserve the per-request PRNG stream, so temperature>0
+    streams also continue exactly — serial unchanged, step offset by the
+    replayed tokens);
+  - the tokens generated SO FAR that are still materializable (a
+    device-lost fault can strand the newest dispatches; those tokens are
+    simply recomputed by the replay);
+  - the speculative controller's snapshot (models/speculative.py
+    AdaptiveSpec) so a restored slot re-enters with its learned
+    acceptance state instead of fresh optimism;
+  - the prefill cursor at capture time (observability: how much prefill
+    work the fault destroyed).
+
+Everything here is plain host data — `to_dict`/`from_dict` round-trip all
+of it except the Future (process-local by nature), so checkpoints could
+be shipped to another engine/replica; within one engine the Future rides
+along and the restored request resolves the ORIGINAL client future with
+``generated + replayed-continuation``.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class SlotCheckpoint:
+    """Host-recoverable state of one slot. `generated` never contains a
+    token past the request's eos or budget — the engine resolves such
+    requests at capture time instead of checkpointing them."""
+
+    prompt: List[int]
+    generated: List[int]
+    max_new: int
+    serial: int
+    t_submit: float = 0.0
+    prefill_cursor: int = 0
+    spec: Optional[Dict[str, float]] = None
+    future: Optional[Future] = field(default=None, repr=False, compare=False)
+
+    @property
+    def remaining_new(self) -> int:
+        """Tokens the restored request must still produce."""
+        return self.max_new - len(self.generated)
+
+    def replay_prompt(self) -> List[int]:
+        """The token sequence the restored admission prefills: the original
+        prompt plus every already-generated token. Chunk boundaries and the
+        first-token sample position are then exactly those of a cold prompt
+        of this length."""
+        return list(self.prompt) + list(self.generated)
+
+    def to_dict(self) -> dict:
+        return {
+            "prompt": list(self.prompt),
+            "generated": list(self.generated),
+            "max_new": self.max_new,
+            "serial": self.serial,
+            "t_submit": self.t_submit,
+            "prefill_cursor": self.prefill_cursor,
+            "spec": dict(self.spec) if self.spec is not None else None,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SlotCheckpoint":
+        return cls(
+            prompt=list(d["prompt"]),
+            generated=list(d["generated"]),
+            max_new=int(d["max_new"]),
+            serial=int(d["serial"]),
+            t_submit=float(d.get("t_submit", 0.0)),
+            prefill_cursor=int(d.get("prefill_cursor", 0)),
+            spec=dict(d["spec"]) if d.get("spec") is not None else None,
+        )

@@ -50,7 +50,20 @@ TPU-shaped by construction:
     programs dispatch in the SAME tick, device-ordered on the one donated
     cache over disjoint active masks — and the verify predictions stay on
     device as a pipelined _TokRef whose acceptance resolves on a later
-    tick, so one repetitive stream never serializes its neighbors.
+    tick, so one repetitive stream never serializes its neighbors;
+  - the engine has a real FAILURE MODEL (runtime/faults.py taxonomy,
+    docs/robustness.md): tick-path exceptions are CLASSIFIED instead of
+    failing every outstanding request. Poison-request faults fail only
+    the culpable slot; transient dispatch faults retry the tick with
+    capped exponential backoff; device-lost faults (and anything
+    unclassifiable) checkpoint every slot's host-recoverable state
+    (runtime/checkpoint.py SlotCheckpoint: prompt, generated tokens,
+    sampling serial, spec state), reallocate the pool, and re-admit the
+    checkpoints through the normal admission queue — KV is re-derived by
+    replaying prompt+generated through the budgeted prefill path
+    (bit-identical for greedy; the prefix cache makes shared-prefix
+    replay nearly free). A seeded FaultInjector threads deterministic
+    chaos through the named dispatch sites for the recovery tests.
 """
 
 from __future__ import annotations
@@ -79,6 +92,14 @@ from nos_tpu.models.decode import (
 from nos_tpu.models.gpt import GPTConfig
 from nos_tpu.models.speculative import AdaptiveSpec, _LookupIndex, accept_prefix
 from nos_tpu.runtime.block_manager import BlockManager
+from nos_tpu.runtime.checkpoint import SlotCheckpoint
+from nos_tpu.runtime.faults import (
+    FAULT_DEVICE_LOST,
+    FAULT_POISON,
+    FAULT_TRANSIENT,
+    classify_fault,
+    poison_slot_of,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -119,6 +140,27 @@ class _TokRef:
 
 
 @dataclass
+class _Request:
+    """One queued/waiting request. `replay` is non-empty only for
+    checkpoint restores (runtime/checkpoint.py): tokens the request had
+    already produced before a fault, replayed through prefill (their KV
+    re-derived) and prepended to the final result. A restore also carries
+    the original sampling `serial` (so temperature streams continue their
+    PRNG stream exactly), the recovery timestamp (`t_restore`, feeding the
+    restore-latency samples instead of TTFT), and the speculative
+    controller snapshot."""
+
+    prompt: list
+    max_new: int
+    future: Future
+    t_submit: float
+    replay: List[int] = field(default_factory=list)
+    serial: Optional[int] = None
+    t_restore: float = 0.0
+    spec: Optional[dict] = None
+
+
+@dataclass
 class _Slot:
     active: bool = False
     # Budgeted-prefill state machine: "idle" -> (admission reserves slot,
@@ -155,6 +197,17 @@ class _Slot:
     # back to the macro path).
     verifying: bool = False
     adapt: Optional[AdaptiveSpec] = None
+    # Failure-model state: the client's ORIGINAL prompt and requested
+    # max_new (checkpoint identity — pending_prompt holds prompt+replay
+    # for restores and is cleared once prefill finishes), the replayed
+    # tokens prepended to the final result, the PRNG step offset those
+    # replayed tokens occupy, and the recovery timestamp a restored slot
+    # reports its restore latency against (0.0 = never restored).
+    request_prompt: Optional[list] = None
+    max_new: int = 0
+    replay: List[int] = field(default_factory=list)
+    step_base: int = 0
+    t_restore: float = 0.0
 
 
 @dataclass
@@ -187,6 +240,10 @@ class DecodeServer:
         prefill_budget_tokens: Optional[int] = None,
         prefix_cache: bool = True,
         metrics=None,
+        fault_injector=None,
+        surgical_recovery: bool = True,
+        max_transient_retries: int = 4,
+        transient_backoff_s: float = 0.02,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
         softmax sampling with a deterministic per-slot, per-step PRNG stream
@@ -297,7 +354,25 @@ class DecodeServer:
         (duck-typed: inc/set_gauge); when provided the engine publishes
         its counters and per-tick drafting/macro split under
         `nos_tpu_decode_*` (see telemetry.py ServingReport for the
-        one-shot snapshot analog)."""
+        one-shot snapshot analog).
+
+        `surgical_recovery` (default True) selects the engine's failure
+        model. True: tick-path exceptions are classified through the
+        fault taxonomy (runtime/faults.py) — poison faults fail ONLY the
+        culpable slot while every other slot is checkpointed and restored
+        (replayed through the budgeted prefill path, greedy-bit-identical);
+        transient faults retry the tick with capped exponential backoff
+        (`max_transient_retries` retries, `transient_backoff_s` base,
+        doubling, capped at 0.5s; exhaustion escalates to device-lost);
+        device-lost faults checkpoint everyone, reallocate the pool, and
+        re-admit through the normal admission queue. False: the legacy
+        all-or-nothing sweep (fail every outstanding future + pool reset)
+        — kept as the availability benchmark's baseline.
+
+        `fault_injector` (optional, runtime/faults.py FaultInjector)
+        threads deterministic chaos through the engine's named dispatch
+        sites — test/benchmark machinery, never enabled in production
+        serving."""
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -327,10 +402,13 @@ class DecodeServer:
         # block lists, the prefix index) lives in the BlockManager —
         # NOS011 flags pool-state mutation anywhere else.
         self.prefix_cache = bool(prefix_cache)
-        self._block_mgr = BlockManager(self.total_blocks, self.block_size, n_slots)
+        self._fault_injector = fault_injector
+        self._block_mgr = BlockManager(
+            self.total_blocks, self.block_size, n_slots, fault_injector=fault_injector
+        )
         # FIFO head-of-line admission: a request the pool cannot host yet
         # waits here (never reordered past).
-        self._waiting: Deque[Tuple[list, int, Future, float]] = deque()
+        self._waiting: Deque[_Request] = deque()
         self._queue: "queue.Queue" = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
         self._last_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
@@ -372,6 +450,21 @@ class DecodeServer:
         # materialization adds the pipeline delay, which is the point).
         self.queue_wait_s: List[float] = []
         self.ttft_s: List[float] = []
+        # Failure model (docs/robustness.md): recovery counters + the
+        # per-restored-request latency samples (fault detection -> the
+        # restored slot's replayed final chunk dispatches — the TTFT
+        # analog of coming back from the dead).
+        self.surgical_recovery = bool(surgical_recovery)
+        self.max_transient_retries = int(max_transient_retries)
+        self.transient_backoff_s = float(transient_backoff_s)
+        self._transient_streak = 0
+        self.recoveries = 0
+        self.slots_restored = 0
+        self.replay_tokens = 0
+        self.requests_poisoned = 0
+        self.transient_retries = 0
+        self.fail_all_recoveries = 0
+        self.restore_latency_s: List[float] = []
         self.metrics = metrics
         self.temperature = float(temperature)
         self.spec_k = max(0, int(spec_k))
@@ -396,9 +489,24 @@ class DecodeServer:
 
         # Sampling on device; prefill compiles once per prompt bucket
         # (static padded shape), the ragged step once for all traffic.
+        def _greedy(logits):
+            # NOT jnp.argmax: XLA's argmax tie-break is not stable across
+            # differently-fused compiled programs — an EXACT logit tie
+            # (observed on the tiny bf16 test models, where quantized
+            # logits collide) broke toward index 93 in the fused
+            # prefill-last program and toward index 46 in the 1-D
+            # reference argmax of the same logits. min-over-masked-indices
+            # has no tie left to break: the LOWEST index among the exact
+            # maxima, identically in every program shape.
+            top = jnp.max(logits, axis=-1, keepdims=True)
+            idx = jnp.arange(cfg.vocab, dtype=jnp.int32)
+            return jnp.min(
+                jnp.where(logits == top, idx, cfg.vocab), axis=-1
+            ).astype(jnp.int32)
+
         def _sample(logits, serial, step):
             if self.temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return _greedy(logits)
             keys = jax.vmap(
                 lambda s, t: jax.random.fold_in(
                     jax.random.fold_in(self._base_key, s), t
@@ -452,15 +560,18 @@ class DecodeServer:
 
         def _prefill_last(
             params, tokens, cache, table_row, start, length, last, first_vec,
-            slot, serial,
+            slot, serial, step0,
         ):
             logits, cache = paged_prefill_chunk(
                 params, tokens, cfg, cache, table_row, start, length, bs
             )
+            # step0 is 0 for a fresh request; a checkpoint RESTORE passes
+            # the replayed-token count so a temperature stream's PRNG
+            # continues exactly where the fault interrupted it.
             first = _sample(
                 logits[length - 1, :][None, :],
                 jnp.asarray([serial]),
-                jnp.asarray([0]),
+                jnp.asarray([step0]),
             )[0]
             # The first token stays ON DEVICE twice over: scattered into the
             # step-feed vector AND into the per-slot first-token vector.
@@ -479,8 +590,10 @@ class DecodeServer:
                     params, tokens, cfg, cache, table, pos, lengths, active, bs
                 )
                 # Greedy acceptance is argmax-only: ship [B, W] int32 to the
-                # host, never [B, W, vocab] logits.
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                # host, never [B, W, vocab] logits. Same tie-break as the
+                # macro path's _greedy — spec-on must take the exact token
+                # chain spec-off would.
+                return _greedy(logits), cache
 
             self._verify_fn = jax.jit(_verify, donate_argnums=(2,))
 
@@ -509,7 +622,7 @@ class DecodeServer:
         if max_new <= 0:
             fut.set_result([])
             return fut
-        self._queue.put((list(prompt), max_new, fut, time.monotonic()))
+        self._queue.put(_Request(list(prompt), max_new, fut, time.monotonic()))
         return fut
 
     def generate(self, prompt: Sequence[int], max_new: int = 16, timeout=None):
@@ -538,16 +651,16 @@ class DecodeServer:
         # Unresolved verify rounds refer to slots that no longer exist.
         self._pending_verifies.clear()
         while self._waiting:
-            _, _, fut, _ = self._waiting.popleft()
-            if not fut.done():
-                fut.set_exception(exc)
+            req = self._waiting.popleft()
+            if not req.future.done():
+                req.future.set_exception(exc)
         while True:
             try:
-                _, _, fut, _ = self._queue.get_nowait()
+                req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if not fut.done():
-                fut.set_exception(exc)
+            if not req.future.done():
+                req.future.set_exception(exc)
 
     def _release_slot(self, idx: int) -> None:
         """Return the slot's page references to the pool and clear its
@@ -589,46 +702,61 @@ class DecodeServer:
         long arrival can no longer freeze active decode slots behind an
         admission-time monolithic prefill. A rejected request does not
         burn its slot for the wave: the SAME slot pulls the next queued
-        request until one admits (or the line drains)."""
+        request until one admits (or the line drains).
+
+        Checkpoint RESTORES re-enter here at the head of the FIFO line:
+        their effective prompt is prompt + replay (already-generated
+        tokens whose KV the replayed prefill re-derives), their client
+        validation is skipped (the original admission already passed it,
+        and the combined prompt+budget bound is unchanged by
+        construction — only the prompt/max_new split moved), and they
+        keep their original sampling serial."""
         for idx, slot in enumerate(self._slots):
             if slot.active:
                 continue
             while True:
-                item = self._next_request()
-                if item is None:
+                req = self._next_request()
+                if req is None:
                     return
-                prompt, max_new, fut, t_submit = item
-                if len(prompt) >= self.max_len:
-                    fut.set_exception(
-                        ValueError(
-                            f"prompt length {len(prompt)} >= max_len {self.max_len}"
+                full_prompt = list(req.prompt) + list(req.replay)
+                eff_new = req.max_new - len(req.replay)
+                if not req.replay:
+                    if len(full_prompt) >= self.max_len:
+                        req.future.set_exception(
+                            ValueError(
+                                f"prompt length {len(full_prompt)} >= "
+                                f"max_len {self.max_len}"
+                            )
                         )
-                    )
-                    continue  # same slot: try the next queued request
-                if len(prompt) + max_new - 1 > self.max_len:
-                    # The request cannot complete inside the per-sequence
-                    # window — reject rather than silently resolve with
-                    # fewer tokens than asked for (a generation finishing
-                    # at pos == max_len with remaining == 0 is the exact
-                    # boundary, hence the -1).
-                    fut.set_exception(
-                        ValueError(
-                            f"prompt length {len(prompt)} + max_new {max_new} "
-                            f"exceeds max_len {self.max_len}: output would be "
-                            f"truncated"
+                        continue  # same slot: try the next queued request
+                    if len(full_prompt) + eff_new - 1 > self.max_len:
+                        # The request cannot complete inside the
+                        # per-sequence window — reject rather than
+                        # silently resolve with fewer tokens than asked
+                        # for (a generation finishing at pos == max_len
+                        # with remaining == 0 is the exact boundary,
+                        # hence the -1).
+                        req.future.set_exception(
+                            ValueError(
+                                f"prompt length {len(full_prompt)} + max_new "
+                                f"{eff_new} exceeds max_len {self.max_len}: "
+                                f"output would be truncated"
+                            )
                         )
-                    )
-                    continue
+                        continue
                 # Block accounting: cache holds positions 0..len+max_new-2
-                # (the final sampled token is never re-attended).
+                # (the final sampled token is never re-attended). For a
+                # restore this total is identical to the original
+                # admission's — replay moves tokens from max_new into the
+                # prompt, never changes their sum.
                 n_blocks = max(
-                    1, -(-(len(prompt) + max_new - 1) // self.block_size)
+                    1, -(-(len(full_prompt) + eff_new - 1) // self.block_size)
                 )
                 if n_blocks > self.total_blocks - 1:
                     # Bigger than the ENTIRE pool: waiting would hang this
                     # request forever and head-of-line-block everything
                     # behind it. Reject like any other un-servable request.
-                    fut.set_exception(
+                    req.future.set_exception(
                         ValueError(
                             f"request needs {n_blocks} KV blocks; the pool "
                             f"has {self.total_blocks - 1}"
@@ -636,60 +764,105 @@ class DecodeServer:
                     )
                     continue
                 evict0 = self._block_mgr.evictions
-                admitted = self._block_mgr.admit(
-                    idx, prompt, n_blocks, use_cache=self.prefix_cache
-                )
+                try:
+                    admitted = self._block_mgr.admit(
+                        idx, full_prompt, n_blocks, use_cache=self.prefix_cache
+                    )
+                except Exception:
+                    # A fault here (the block_admit injection site, or a
+                    # real bookkeeping error) fires BEFORE the request is
+                    # bound to the slot: re-queue it at the head so the
+                    # classification sweep cannot strand its future, then
+                    # re-raise into the engine's fault handling.
+                    self._waiting.appendleft(req)
+                    raise
                 if admitted is None:
                     # Pool exhausted (after prefix hits): wait for running
                     # sequences to finish. FIFO head-of-line — later
                     # requests must not starve this one by sneaking into
                     # blocks as they free. The manager rolled back any
                     # partial prefix-hit reservation before refusing.
-                    self._waiting.appendleft(item)
+                    self._waiting.appendleft(req)
                     return
                 break
             blocks, n_hit = admitted
-            if self.metrics is not None and self.prefix_cache:
-                self.metrics.inc("nos_tpu_decode_prefix_lookups")
-                if n_hit:
-                    self.metrics.inc("nos_tpu_decode_prefix_hit_blocks", n_hit)
-                    self.metrics.inc(
-                        "nos_tpu_decode_prefix_hit_tokens",
-                        n_hit * self.block_size,
+            bound = False
+            try:
+                if self.metrics is not None and self.prefix_cache:
+                    self.metrics.inc("nos_tpu_decode_prefix_lookups")
+                    if n_hit:
+                        self.metrics.inc("nos_tpu_decode_prefix_hit_blocks", n_hit)
+                        self.metrics.inc(
+                            "nos_tpu_decode_prefix_hit_tokens",
+                            n_hit * self.block_size,
+                        )
+                    evicted = self._block_mgr.evictions - evict0
+                    if evicted:
+                        self.metrics.inc("nos_tpu_decode_prefix_evictions", evicted)
+                row = np.zeros((self.max_pages,), dtype=np.int32)
+                row[: len(blocks)] = blocks
+                self._table = self._table.at[idx].set(jnp.asarray(row))
+                serial = req.serial if req.serial is not None else self._next_serial
+                if req.serial is None:
+                    self._next_serial += 1
+                self._slot_serial[idx] = serial
+                slot.phase = "reserved"
+                slot.future = req.future
+                slot.request_prompt = list(req.prompt)
+                slot.max_new = req.max_new
+                slot.replay = list(req.replay)
+                slot.step_base = len(req.replay)
+                slot.t_restore = req.t_restore
+                slot.pending_prompt = full_prompt
+                # Prefix hits are already in the page table: the prefill
+                # cursor starts at the first MISS boundary, so the budget
+                # scheduler spends tokens only on blocks the request missed
+                # (the hit run is capped below the last-token block, so the
+                # final chunk — and its first-token sample — always remains).
+                slot.prefill_cursor = n_hit * self.block_size
+                slot.t_submit = req.t_submit
+                slot.pos = slot.prefill_cursor
+                slot.remaining = eff_new - 1
+                slot.refs = []
+                slot.eos_scanned = 0
+                slot.prompt = list(full_prompt) if self.spec_k > 0 else None
+                slot.history = None
+                slot.lookup = None
+                if self.spec_k > 0:
+                    slot.adapt = (
+                        AdaptiveSpec.restore(req.spec)
+                        if req.spec is not None
+                        else AdaptiveSpec()
                     )
-                evicted = self._block_mgr.evictions - evict0
-                if evicted:
-                    self.metrics.inc("nos_tpu_decode_prefix_evictions", evicted)
-            row = np.zeros((self.max_pages,), dtype=np.int32)
-            row[: len(blocks)] = blocks
-            self._table = self._table.at[idx].set(jnp.asarray(row))
-            serial = self._next_serial
-            self._next_serial += 1
-            self._slot_serial[idx] = serial
-            # Bind the future to the slot at reservation: if a prefill
-            # dispatch raises on a later tick, the engine's failure sweep
-            # (_fail_outstanding) must find and fail this request — a
-            # future held only in a local would strand its client forever.
-            slot.active = True
-            slot.phase = "reserved"
-            slot.future = fut
-            slot.pending_prompt = list(prompt)
-            # Prefix hits are already in the page table: the prefill
-            # cursor starts at the first MISS boundary, so the budget
-            # scheduler spends tokens only on blocks the request missed
-            # (the hit run is capped below the last-token block, so the
-            # final chunk — and its first-token sample — always remains).
-            slot.prefill_cursor = n_hit * self.block_size
-            slot.t_submit = t_submit
-            slot.pos = slot.prefill_cursor
-            slot.remaining = max_new - 1
-            slot.refs = []
-            slot.eos_scanned = 0
-            slot.prompt = list(prompt) if self.spec_k > 0 else None
-            slot.history = None
-            slot.lookup = None
-            slot.adapt = AdaptiveSpec() if self.spec_k > 0 else None
-            self.queue_wait_s.append(time.monotonic() - t_submit)
+                else:
+                    slot.adapt = None
+                # Bind the future to the slot LAST: if a prefill dispatch
+                # raises on a later tick, the engine's recovery sweep must
+                # find and fail/restore this request — a future held only
+                # in a local would strand its client forever.
+                slot.active = True
+                bound = True
+                if req.t_restore:
+                    self.replay_tokens += len(full_prompt)
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "nos_tpu_decode_replay_tokens", len(full_prompt)
+                        )
+                else:
+                    self.queue_wait_s.append(time.monotonic() - req.t_submit)
+                self._check_fault("admit", idx)
+            except Exception:
+                # A fault between block assignment and slot binding must
+                # not strand the popped request (its future lives nowhere
+                # else yet) nor leak its assigned blocks across a no-reset
+                # (transient) recovery: undo the partial admission, put the
+                # request back at the head of the line, then re-raise into
+                # the engine's fault classification.
+                if not bound:
+                    self._block_mgr.release(idx)
+                    self._slots[idx] = _Slot()
+                    self._waiting.appendleft(req)
+                raise
 
     # -- budgeted prefill ------------------------------------------------------
     def _pump_prefill(self) -> int:
@@ -746,6 +919,7 @@ class DecodeServer:
         the per-slot `_prefill_last` program, so the first-token sample
         and its device-side scatter are unchanged per slot — only when
         chunks dispatch moves, never what they compute."""
+        self._check_fault("dispatch_prefill_wave", wave[0][0])
         mids: Dict[int, List[Tuple[int, int, list]]] = {}
         finals: List[Tuple[int, int, list]] = []
         for entry in wave:
@@ -803,6 +977,7 @@ class DecodeServer:
                 self._first_dev,
                 idx,
                 int(self._slot_serial[idx]),
+                self._slots[idx].step_base,
             )
             dispatches += 1
         for idx, start, piece in wave:
@@ -831,7 +1006,13 @@ class DecodeServer:
                 slot.pos = len(slot.pending_prompt)
                 slot.pending_prompt = None
                 slot.refs.append((ref, idx, None))
-                self.ttft_s.append(now - slot.t_submit)
+                if slot.t_restore:
+                    # A restored slot's "first token" is its replayed
+                    # continuation coming back online: a restore-latency
+                    # sample, not a client-visible TTFT.
+                    self.restore_latency_s.append(now - slot.t_restore)
+                else:
+                    self.ttft_s.append(now - slot.t_submit)
                 self._finish_if_done(idx)
         self.prefill_dispatches += dispatches
         if self.metrics is not None:
@@ -855,11 +1036,14 @@ class DecodeServer:
     def _finalize(self, slot: _Slot) -> List[int]:
         """Materialize the output, truncated at EOS: the countdown can fire
         before a late EOS was scanned (pipelined detection), so the cut is
-        applied at resolution time regardless of which path finishes."""
+        applied at resolution time regardless of which path finishes. A
+        restored slot prepends its replayed tokens — the client sees one
+        uninterrupted generation (replay is always eos-free: a checkpoint
+        containing the eos resolves at capture instead of restoring)."""
         tokens = self._materialize_tokens(slot)
         if self.eos_id is not None and self.eos_id in tokens:
             tokens = tokens[: tokens.index(self.eos_id) + 1]
-        return tokens
+        return list(slot.replay) + tokens
 
     def _finish_if_done(self, idx: int) -> None:
         """Deterministic completion: the countdown and the cache bound are
@@ -967,6 +1151,7 @@ class DecodeServer:
         the round's host read off the batch's critical path. Greedy-exact:
         a draft token is accepted iff it equals the model's argmax given
         all previously accepted tokens."""
+        self._check_fault("dispatch_verify", next(iter(drafts)))
         W = self.spec_k + 1
         tokens = np.zeros((self.n_slots, W), dtype=np.int32)
         lengths = np.zeros((self.n_slots,), dtype=np.int32)
@@ -1008,6 +1193,9 @@ class DecodeServer:
             entry = self._pending_verifies[0]
             if not block and not entry.preds.is_ready():
                 return
+            # Injection BEFORE the popleft: a transient here retries the
+            # same round next tick instead of stranding its drafters.
+            self._check_fault("resolve_verifies", next(iter(entry.windows)))
             self._pending_verifies.popleft()
             block = False  # pay at most one blocking read per call
             self._apply_verify(entry)
@@ -1067,13 +1255,166 @@ class DecodeServer:
         while not self._stop.is_set():
             try:
                 self._tick()
-            except Exception as exc:  # noqa: BLE001
-                # The engine must outlive any single bad request/step: fail
-                # everything currently in flight (their cache state is no
-                # longer trustworthy) and keep serving.
+                self._transient_streak = 0
+            except Exception as exc:  # noqa: BLE001 — classified below
+                # The engine must outlive any single bad request/step —
+                # and (surgical_recovery) outlive it SURGICALLY: classify
+                # the fault and repair only what the classification says
+                # is broken, instead of failing every outstanding future.
                 logger.exception("decode engine step failed")
-                self._fail_outstanding(exc)
-                self._reset_device_state()
+                if not self.surgical_recovery:
+                    # Legacy all-or-nothing sweep (the availability
+                    # benchmark's baseline): every in-flight request
+                    # fails, the pool reallocates.
+                    self.fail_all_recoveries += 1
+                    self._fail_outstanding(exc)
+                    self._reset_device_state()
+                    continue
+                try:
+                    self._recover(exc)
+                except Exception as rexc:  # nos-lint: ignore[NOS012]
+                    # Recovery itself failed (double fault / bookkeeping
+                    # violation): fail-all is the deliberate last-resort
+                    # backstop — no classification can be trusted here.
+                    logger.exception("surgical recovery failed; failing all")
+                    self.fail_all_recoveries += 1
+                    self._fail_outstanding(rexc)
+                    self._reset_device_state()
+
+    def _check_fault(self, site: str, slot: Optional[int] = None) -> None:
+        """Deterministic chaos hook (runtime/faults.py): raises the
+        injector's scheduled fault for this visit of `site`, if any."""
+        if self._fault_injector is not None:
+            self._fault_injector.check(site, slot=slot)
+
+    def _recover(self, exc: Exception) -> None:
+        """Surgical crash recovery — classify, then repair the minimum:
+
+        TRANSIENT: nothing is torn down. The failed dispatch left no
+        partially-applied host state (injection raises before the site's
+        work; a mid-wave real fault re-dispatches chunks that write
+        bit-identical KV to the same pages), so the next tick IS the
+        retry — after a capped exponential backoff. A streak longer than
+        `max_transient_retries` stops being "transient" and escalates.
+
+        POISON: the culpable slot's future fails with the classified
+        exception; every OTHER active slot is checkpointed
+        (runtime/checkpoint.py) and restored through the normal admission
+        queue. Unattributable poison (no bound slot) escalates to
+        device-lost, which still preserves every request.
+
+        DEVICE-LOST: checkpoint everything materializable, reallocate the
+        device pool (the donated-cache chain saw a raised dispatch — it
+        is untrustworthy by definition, and the prefix index dies with
+        it), and re-admit the checkpoints at the head of the FIFO line in
+        their original admission order. Replayed prefill re-derives the
+        KV; greedy outputs are bit-identical to the fault-free run."""
+        kind = classify_fault(exc)
+        if kind == FAULT_TRANSIENT:
+            self._transient_streak += 1
+            if self._transient_streak <= self.max_transient_retries:
+                self.transient_retries += 1
+                if self.metrics is not None:
+                    self.metrics.inc("nos_tpu_decode_transient_retries")
+                delay = min(
+                    0.5,
+                    self.transient_backoff_s * (2 ** (self._transient_streak - 1)),
+                )
+                self._stop.wait(delay)
+                return
+            kind = FAULT_DEVICE_LOST  # retries exhausted: stop trusting it
+        poison_slot = None
+        if kind == FAULT_POISON:
+            poison_slot = poison_slot_of(exc)
+            if poison_slot is None or not self._slots[poison_slot].active:
+                kind = FAULT_DEVICE_LOST
+                poison_slot = None
+        t_fault = time.monotonic()
+        self.recoveries += 1
+        checkpoints: List[SlotCheckpoint] = []
+        for idx, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            if idx == poison_slot:
+                if slot.future is not None and not slot.future.done():
+                    slot.future.set_exception(exc)
+                self.requests_poisoned += 1
+                if self.metrics is not None:
+                    self.metrics.inc("nos_tpu_decode_requests_poisoned")
+                self._release_slot(idx)
+                continue
+            ck = self._checkpoint_slot(idx)
+            self._release_slot(idx)
+            if ck is not None:
+                checkpoints.append(ck)
+        self._inflight.clear()
+        self._pending_verifies.clear()
+        self._reset_device_state()
+        self._transient_streak = 0
+        # Restores re-enter AHEAD of the FIFO line, preserving their
+        # original admission order (serial order): they were already
+        # admitted once — new arrivals queue behind them.
+        for ck in sorted(checkpoints, key=lambda c: c.serial, reverse=True):
+            self._waiting.appendleft(
+                _Request(
+                    prompt=list(ck.prompt),
+                    max_new=ck.max_new,
+                    future=ck.future,
+                    t_submit=ck.t_submit,
+                    replay=list(ck.generated),
+                    serial=ck.serial,
+                    t_restore=t_fault,
+                    spec=ck.spec,
+                )
+            )
+        self.slots_restored += len(checkpoints)
+        if self.metrics is not None:
+            self.metrics.inc("nos_tpu_decode_recoveries", kind=kind)
+            if checkpoints:
+                self.metrics.inc("nos_tpu_decode_slots_restored", len(checkpoints))
+        if not self._block_mgr.conserved():
+            # A leaked/double-freed block would wedge the pool invisibly;
+            # fail loudly instead (_run's backstop turns this into the
+            # fail-all sweep).
+            raise RuntimeError("pool conservation violated after recovery")
+
+    def _checkpoint_slot(self, idx: int) -> Optional[SlotCheckpoint]:
+        """Capture slot `idx`'s host-recoverable state. Every token ref
+        that still CAN materialize is read (through the sanctioned _TokRef
+        funnel — this is the recovery path, not the tick hot path) and the
+        capture truncates at the first dead/donated buffer: the replay
+        recomputes anything dropped. Returns None when the captured tokens
+        already complete the request — its future resolves here (a
+        finished request must not be replayed)."""
+        slot = self._slots[idx]
+        tokens: List[int] = list(slot.replay)
+        for ref, lane, row in slot.refs:
+            try:
+                tokens.append(self._token_at(ref, lane, row))
+            except RuntimeError:
+                # Deleted buffer / device gone: this token and everything
+                # dispatched after it will be regenerated by the replay.
+                break
+        if self.eos_id is not None and self.eos_id in tokens:
+            tokens = tokens[: tokens.index(self.eos_id) + 1]
+            if slot.future is not None and not slot.future.done():
+                slot.future.set_result(tokens)
+            return None
+        if len(tokens) >= slot.max_new:
+            if slot.future is not None and not slot.future.done():
+                slot.future.set_result(tokens[: slot.max_new])
+            return None
+        spec = slot.adapt.snapshot(len(slot.refs)) if slot.adapt is not None else None
+        return SlotCheckpoint(
+            prompt=list(slot.request_prompt or []),
+            generated=tokens,
+            max_new=slot.max_new,
+            serial=int(self._slot_serial[idx]),
+            t_submit=slot.t_submit,
+            prefill_cursor=slot.prefill_cursor,
+            spec=spec,
+            future=slot.future,
+        )
 
     def _tick(self) -> None:
         """One engine iteration — the three-way scheduler. Composition
@@ -1135,13 +1476,14 @@ class DecodeServer:
         lanes coast (scratch-page writes, token held), and their _last_dev
         entry stays untouched until acceptance resolution scatters the
         true last token over it — mixed advances stay coherent."""
+        self._check_fault("dispatch_macro", idxs[0])
         K = self.steps_per_dispatch
         mask = np.zeros((self.n_slots,), dtype=bool)
         mask[idxs] = True
         pos = np.array([s.pos for s in self._slots], dtype=np.int32)
         step = np.array(
-            [len(s.refs) for s in self._slots], dtype=np.int64
-        )  # tokens generated so far = the request's PRNG step index
+            [s.step_base + len(s.refs) for s in self._slots], dtype=np.int64
+        )  # tokens generated so far (incl. replayed) = the PRNG step index
         steps_left = np.array(
             [s.remaining if mask[i] else 0 for i, s in enumerate(self._slots)],
             dtype=np.int32,

@@ -1,0 +1,269 @@
+"""Fault taxonomy + deterministic fault injection for the serving runtime.
+
+The serving engine's original failure model was all-or-nothing: any
+exception on the tick path failed every outstanding future and reallocated
+the device pool. Production faults are not all-or-nothing — the paper's
+operator half exists precisely because hardware serving planes see PARTIAL
+failures (one poisoned request, one flaky dispatch, one lost device) and
+must reconcile around them. This module gives the runtime a vocabulary for
+that:
+
+  - ``PoisonRequestError``: one request's data is the cause (a prefill or
+    admission blew up deterministically). Recovery fails ONLY the culpable
+    slot; everyone else is checkpointed and restored.
+  - ``TransientDispatchError``: the dispatch path hiccuped (tunnel flake,
+    queue timeout) but device state is not known-bad. Recovery retries the
+    tick with capped exponential backoff — no state is torn down.
+  - ``DeviceLostError``: the device (or the donated-cache chain riding on
+    it) is gone/untrustworthy. Recovery checkpoints every slot it can
+    still materialize, reallocates the pool, and re-admits the
+    checkpoints through the normal admission queue.
+
+``classify_fault`` maps ANY exception into one of the three kinds:
+explicit taxonomy types (directly or anywhere on the ``__cause__``/
+``__context__`` chain) pass through; runtime errors whose message matches
+a known transient-transport marker classify transient; everything else is
+conservatively DEVICE-LOST — with checkpoint/restore, "rebuild the pool
+and replay" is the safe default, unlike the old "fail everyone".
+
+``FaultInjector`` is the deterministic chaos harness: a schedule of
+(site, k-th occurrence, kind) triples checked at named injection sites
+threaded through the engine (`_admit`, `_dispatch_macro`,
+`_dispatch_verify`, `_dispatch_prefill_wave`, `_resolve_verifies`) and
+the BlockManager's admission. Same schedule + same traffic => the same
+fault fires at the same point in the engine's deterministic tick
+sequence, which is what lets the chaos tests demand BIT-IDENTICAL
+outputs for every non-poisoned request (tests/test_serving_faults.py).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+FAULT_POISON = "poison"
+FAULT_TRANSIENT = "transient"
+FAULT_DEVICE_LOST = "device-lost"
+
+FAULT_KINDS = (FAULT_POISON, FAULT_TRANSIENT, FAULT_DEVICE_LOST)
+
+# Message fragments that identify a transport-level flake (the remote
+# dispatch tunnel's observed failure modes — bench.py's retry rationale).
+# Anything matching is safe to retry: the dispatch never reached the
+# device, so the donated-cache chain is still the one we dispatched onto.
+_TRANSIENT_MARKERS = (
+    "read body",
+    "connection reset",
+    "connection refused",
+    "socket closed",
+    "broken pipe",
+    "unavailable",
+    "deadline exceeded",
+    "timed out",
+)
+
+
+class EngineFault(RuntimeError):
+    """Base of the serving-plane fault taxonomy."""
+
+    kind = FAULT_DEVICE_LOST
+
+    def __init__(self, message: str = "", site: Optional[str] = None):
+        super().__init__(message or self.__class__.__name__)
+        self.site = site
+
+
+class PoisonRequestError(EngineFault):
+    """One request's data caused the failure; `slot` is the culpable batch
+    lane (None when the fault fired before the request was bound to one —
+    classification then escalates to device-lost, which still preserves
+    every request)."""
+
+    kind = FAULT_POISON
+
+    def __init__(
+        self, message: str = "", site: Optional[str] = None, slot: Optional[int] = None
+    ):
+        super().__init__(message, site)
+        self.slot = slot
+
+
+class TransientDispatchError(EngineFault):
+    kind = FAULT_TRANSIENT
+
+
+class DeviceLostError(EngineFault):
+    kind = FAULT_DEVICE_LOST
+
+
+def _taxonomy_instance(exc: BaseException) -> Optional[EngineFault]:
+    """The first taxonomy instance on the exception's cause/context chain
+    (bounded walk: chains are short, but cycles are possible in principle)."""
+    seen = set()
+    node: Optional[BaseException] = exc
+    while node is not None and id(node) not in seen:
+        if isinstance(node, EngineFault):
+            return node
+        seen.add(id(node))
+        node = node.__cause__ or node.__context__
+    return None
+
+
+def classify_fault(exc: BaseException) -> str:
+    """Map an exception to a fault kind (FAULT_POISON / FAULT_TRANSIENT /
+    FAULT_DEVICE_LOST). Unknown exceptions classify DEVICE-LOST: with
+    checkpoint/restore in place, reallocating the pool and replaying is
+    the conservative choice — retrying an unknown failure against a
+    possibly-consumed donated cache is not."""
+    tagged = _taxonomy_instance(exc)
+    if tagged is not None:
+        return tagged.kind
+    if isinstance(exc, (RuntimeError, OSError, TimeoutError)):
+        msg = str(exc).lower()
+        if any(marker in msg for marker in _TRANSIENT_MARKERS):
+            return FAULT_TRANSIENT
+    return FAULT_DEVICE_LOST
+
+
+def poison_slot_of(exc: BaseException) -> Optional[int]:
+    """The culpable slot of a poison-classified exception, if bound."""
+    tagged = _taxonomy_instance(exc)
+    if isinstance(tagged, PoisonRequestError):
+        return tagged.slot
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Deterministic injection
+# ---------------------------------------------------------------------------
+#: Injection sites threaded through the runtime. Poison specs only make
+#: sense at SLOT-BEARING sites (the fault must be attributable to a bound
+#: request); `seeded()` schedules them only there.
+SITES = (
+    "admit",
+    "dispatch_prefill_wave",
+    "dispatch_macro",
+    "dispatch_verify",
+    "resolve_verifies",
+    "block_admit",
+)
+
+#: Sites whose check() call carries the culpable slot of a bound request.
+POISON_SITES = ("admit", "dispatch_prefill_wave")
+
+_EXC_BY_KIND = {
+    FAULT_POISON: PoisonRequestError,
+    FAULT_TRANSIENT: TransientDispatchError,
+    FAULT_DEVICE_LOST: DeviceLostError,
+}
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Fire a `kind` fault on the `occurrence`-th (1-based) visit of
+    `site`. Occurrences keep counting across recoveries, so a schedule
+    can chain faults (e.g. a transient whose retry hits a device-lost)."""
+
+    site: str
+    occurrence: int
+    kind: str
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown injection site {self.site!r}; sites: {SITES}")
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; kinds: {FAULT_KINDS}")
+        if self.occurrence < 1:
+            raise ValueError("occurrence is 1-based")
+
+
+@dataclass
+class FaultInjector:
+    """Seeded, named-site fault injection. The engine (and BlockManager)
+    call `check(site, slot=...)` at each site; the injector counts visits
+    per site and raises the scheduled fault on the matching occurrence.
+    `armed=False` lets a harness warm up compile caches fault-free and
+    arm the schedule only for the measured/validated window."""
+
+    schedule: Sequence[FaultSpec] = ()
+    armed: bool = True
+
+    def __post_init__(self):
+        self._pending: Dict[Tuple[str, int], FaultSpec] = {
+            (s.site, s.occurrence): s for s in self.schedule
+        }
+        self._visits: Dict[str, int] = {}
+        #: (spec, slot-context) for every fault actually raised.
+        self.fired: List[Tuple[FaultSpec, Optional[int]]] = []
+
+    def arm(self) -> None:
+        self.armed = True
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    def check(self, site: str, slot: Optional[int] = None) -> None:
+        """Raise the scheduled fault for this visit of `site`, if any.
+        Dispatch sites check BEFORE their device work, and `block_admit`
+        before any pool mutation, so injected faults never leave
+        PARTIALLY-applied state behind (what makes transient retry and
+        pool conservation provable in the chaos tests); the `admit` site
+        fires after its request is fully bound — a poison fault needs an
+        attributable slot."""
+        if not self.armed:
+            return
+        self._visits[site] = self._visits.get(site, 0) + 1
+        spec = self._pending.pop((site, self._visits[site]), None)
+        if spec is None:
+            return
+        self.fired.append((spec, slot))
+        exc_type = _EXC_BY_KIND[spec.kind]
+        msg = f"injected {spec.kind} fault at {site}#{spec.occurrence}"
+        if exc_type is PoisonRequestError:
+            raise PoisonRequestError(msg, site=site, slot=slot)
+        raise exc_type(msg, site=site)
+
+    def visits(self, site: str) -> int:
+        return self._visits.get(site, 0)
+
+    def add(self, spec: FaultSpec) -> None:
+        """Add one spec to a live injector. With `visits(site)`, a test
+        can aim a fault at "the NEXT visit of site X" after deterministic
+        manual driving, instead of precomputing occurrence numbers."""
+        self._pending[(spec.site, spec.occurrence)] = spec
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        n_faults: int = 3,
+        kinds: Iterable[str] = FAULT_KINDS,
+        sites: Iterable[str] = SITES,
+        max_occurrence: int = 10,
+        armed: bool = True,
+    ) -> "FaultInjector":
+        """A randomized-but-reproducible schedule: `n_faults` specs drawn
+        from `kinds` x `sites` x [1, max_occurrence]. Poison kinds are
+        constrained to slot-bearing sites; duplicate (site, occurrence)
+        pairs are re-drawn so every spec can fire."""
+        rng = random.Random(seed)
+        kinds = list(kinds)
+        sites = list(sites)
+        poison_sites = [s for s in sites if s in POISON_SITES]
+        specs: List[FaultSpec] = []
+        taken = set()
+        attempts = 0
+        while len(specs) < n_faults and attempts < 100 * n_faults:
+            attempts += 1
+            kind = rng.choice(kinds)
+            pool = poison_sites if kind == FAULT_POISON else sites
+            if not pool:
+                continue
+            site = rng.choice(pool)
+            occurrence = rng.randint(1, max_occurrence)
+            if (site, occurrence) in taken:
+                continue
+            taken.add((site, occurrence))
+            specs.append(FaultSpec(site, occurrence, kind))
+        return cls(schedule=specs, armed=armed)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -25,6 +26,8 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from nos_tpu.runtime.faults import FAULT_POISON, classify_fault
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +42,9 @@ class SliceServer:
         stack_in_program: bool = True,
         pipeline_fetch: bool = True,
         adaptive_wait: bool = True,
+        max_retries: int = 2,
+        retry_backoff_s: float = 0.01,
+        retry_seed: int = 0,
     ):
         """`batched_fn(batch_input)` must accept a leading batch dimension.
         `buckets` are the batch sizes compiled for (requests padded up).
@@ -61,7 +67,19 @@ class SliceServer:
         (dominant when dispatch+sync latency to the device far exceeds the
         execution itself, as over a remote-dispatch link). With a single
         client the window stays at `max_wait_s`, so uncontended latency is
-        unaffected."""
+        unaffected.
+
+        `max_retries` bounds in-place retries of a failed batch execution
+        or result fetch (jittered exponential backoff from
+        `retry_backoff_s`, deterministic via `retry_seed`): over a
+        remote-dispatch tunnel, batch/fetch failures are overwhelmingly
+        transient transport flakes (bench.py's observed "read body"
+        class), and failing every coalesced client on the first hiccup
+        turns one dropped packet into max_batch visible errors. Faults
+        that classify POISON through the runtime taxonomy
+        (runtime/faults.py) skip the retry — re-running a request whose
+        DATA is the problem just burns the budget. Only after the budget
+        is exhausted do the batch's futures fail."""
         self._fn = batched_fn
         self.stack_in_program = stack_in_program
         self._bucket_fns = {}
@@ -86,6 +104,13 @@ class SliceServer:
         self.adaptive_wait = adaptive_wait
         self._cycle_ema: Optional[float] = None  # dispatch -> results-visible
         self._concurrency_ema: float = 1.0  # requests coalesced per batch
+        # Bounded transient-failure retry (executor + fetch threads each
+        # call _call_with_retry; the counters witness it in tests).
+        self.max_retries = max(0, int(max_retries))
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._retry_rng = random.Random(retry_seed)
+        self.retries = 0
+        self.fetch_retries = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "SliceServer":
@@ -131,6 +156,37 @@ class SliceServer:
         return self.submit(x).result(timeout=timeout)
 
     # -- executor ------------------------------------------------------------
+    def _call_with_retry(self, step: str, counter: str, fn):
+        """Run `fn` with up to `max_retries` in-place retries on transient
+        failure (jittered exponential backoff; the jitter RNG is seeded so
+        tests replay). Routes every failure through the runtime fault
+        taxonomy: POISON-classified faults (the request data is the
+        problem) re-raise immediately — retrying them only delays the
+        inevitable for the whole coalesced batch."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — classified + re-raised
+                if (
+                    classify_fault(e) == FAULT_POISON
+                    or attempt >= self.max_retries
+                    or self._stop.is_set()
+                ):
+                    raise
+                attempt += 1
+                setattr(self, counter, getattr(self, counter) + 1)
+                delay = (
+                    self.retry_backoff_s
+                    * (2 ** (attempt - 1))
+                    * (0.5 + self._retry_rng.random())
+                )
+                logger.warning(
+                    "%s failed (%s: %s); retry %d/%d in %.3fs",
+                    step, type(e).__name__, e, attempt, self.max_retries, delay,
+                )
+                self._stop.wait(delay)
+
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
             if b >= n:
@@ -162,7 +218,11 @@ class SliceServer:
                 # no data movement); padded rows are discarded below.
                 args = tuple(inputs) + (inputs[0],) * (bucket - n)
                 dispatched_at = time.perf_counter()
-                out = self._get_bucket_fn(bucket)(*args)
+                out = self._call_with_retry(
+                    "batched execution",
+                    "retries",
+                    lambda: self._get_bucket_fn(bucket)(*args),
+                )
                 self._concurrency_ema = 0.7 * self._concurrency_ema + 0.3 * n
                 if self.pipeline_fetch:
                     # Async dispatch done: hand the on-device result to the
@@ -171,10 +231,15 @@ class SliceServer:
                 else:
                     self._fetch(out, futures, n, dispatched_at)
             except Exception as e:  # noqa: BLE001
-                # Scatter to the waiting clients, but ALSO log: when every
-                # future is already done (timed-out callers) the error would
-                # otherwise vanish without a trace.
-                logger.warning("batched execution failed: %s", e, exc_info=True)
+                # Retries exhausted (or poison): scatter to the waiting
+                # clients with the fault KIND on the log line, but ALSO
+                # log: when every future is already done (timed-out
+                # callers) the error would otherwise vanish without a
+                # trace.
+                logger.warning(
+                    "batched execution failed (%s): %s",
+                    classify_fault(e), e, exc_info=True,
+                )
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(e)
@@ -188,9 +253,16 @@ class SliceServer:
                 return
             out, futures, n, dispatched_at = item
             try:
-                self._fetch(out, futures, n, dispatched_at)
+                self._call_with_retry(
+                    "result fetch",
+                    "fetch_retries",
+                    lambda: self._fetch(out, futures, n, dispatched_at),
+                )
             except Exception as e:  # noqa: BLE001
-                logger.warning("result fetch failed: %s", e, exc_info=True)
+                logger.warning(
+                    "result fetch failed (%s): %s",
+                    classify_fault(e), e, exc_info=True,
+                )
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(e)

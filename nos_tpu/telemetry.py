@@ -119,6 +119,21 @@ class ServingReport:
     ttft_p95_s: float = 0.0
     queue_wait_p50_s: float = 0.0
     queue_wait_p95_s: float = 0.0
+    # Failure model (PR 6, docs/robustness.md): surgical recoveries run
+    # (transient retries counted separately — a retry tears nothing
+    # down), slots checkpointed+re-admitted, prompt+generated tokens
+    # replayed through prefill to re-derive KV, requests failed as
+    # poison, legacy fail-all sweeps (0 while surgical recovery holds),
+    # and the restore-latency tails (fault detection -> the restored
+    # slot's replayed final chunk dispatches).
+    recoveries: int = 0
+    slots_restored: int = 0
+    replay_tokens: int = 0
+    requests_poisoned: int = 0
+    transient_retries: int = 0
+    fail_all_recoveries: int = 0
+    restore_latency_p50_s: float = 0.0
+    restore_latency_p95_s: float = 0.0
     # Decoupled-round shape: ticks that dispatched a verify AND a macro
     # window (neighbors kept the pipeline while a slot speculated), and
     # the per-slot split totals.
@@ -147,6 +162,7 @@ def collect_serving(server) -> ServingReport:
     need no import cycle through the runtime package)."""
     ttft = list(getattr(server, "ttft_s", ()))
     queue_wait = list(getattr(server, "queue_wait_s", ()))
+    restore = list(getattr(server, "restore_latency_s", ()))
     report = ServingReport(
         steps_run=int(getattr(server, "steps_run", 0)),
         macro_dispatches=int(getattr(server, "macro_dispatches", 0)),
@@ -163,6 +179,14 @@ def collect_serving(server) -> ServingReport:
         prefix_hit_blocks=int(getattr(server, "prefix_hit_blocks", 0)),
         prefix_hit_tokens=int(getattr(server, "prefix_hit_tokens", 0)),
         prefix_evictions=int(getattr(server, "prefix_evictions", 0)),
+        recoveries=int(getattr(server, "recoveries", 0)),
+        slots_restored=int(getattr(server, "slots_restored", 0)),
+        replay_tokens=int(getattr(server, "replay_tokens", 0)),
+        requests_poisoned=int(getattr(server, "requests_poisoned", 0)),
+        transient_retries=int(getattr(server, "transient_retries", 0)),
+        fail_all_recoveries=int(getattr(server, "fail_all_recoveries", 0)),
+        restore_latency_p50_s=percentile(restore, 50),
+        restore_latency_p95_s=percentile(restore, 95),
         ttft_p50_s=percentile(ttft, 50),
         ttft_p95_s=percentile(ttft, 95),
         queue_wait_p50_s=percentile(queue_wait, 50),

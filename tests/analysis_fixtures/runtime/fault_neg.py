@@ -1,0 +1,50 @@
+"""NOS012 negative fixture: every broad except on the tick/recovery path
+routes through the fault taxonomy (classify_fault / self._recover / a
+re-raise), so the checker stays silent."""
+
+import logging
+
+from nos_tpu.runtime.faults import classify_fault
+
+logger = logging.getLogger(__name__)
+
+
+class Engine:
+    def _run(self):
+        while True:
+            try:
+                self._tick()
+            except Exception as exc:  # routed into recovery: clean
+                logger.exception("tick failed")
+                self._recover(exc)
+
+    def _tick(self):
+        self._dispatch()
+        self._probe()
+
+    def _dispatch(self):
+        try:
+            self.fn()
+        except Exception as e:  # classified before the terminal decision: clean
+            if classify_fault(e) == "poison":
+                raise
+            self.backoff()
+
+    def _probe(self):
+        try:
+            self.maybe()
+        except Exception:  # re-raised (escalation counts as routing): clean
+            raise RuntimeError("escalated")
+
+    def _recover(self, exc):
+        kind = classify_fault(exc)
+        logger.info("recovering from %s", kind)
+
+
+class NotAnEngine:
+    # No _tick/_run: out of scope however broad the handler.
+    def work(self):
+        try:
+            return self.fn()
+        except Exception:
+            logger.exception("work failed")

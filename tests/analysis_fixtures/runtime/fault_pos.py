@@ -1,0 +1,52 @@
+"""NOS012 positive fixture: broad excepts on the engine tick/recovery
+path that bypass fault classification. Expected findings: the log-only
+handler in _run, the futures-failing handler in the reachable _drain,
+and the tuple-broad handler in the reachable _recover_legacy — and NOT
+the client-side submit() handler or the narrow ValueError handler."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Engine:
+    def _run(self):
+        while True:
+            try:
+                self._tick()
+            except Exception:  # log-only: classification bypassed -> NOS012
+                logger.exception("tick failed")
+
+    def _tick(self):
+        self._drain()
+        self._recover_legacy()
+        self._narrow()
+
+    def _drain(self):
+        try:
+            self.queue.pop()
+        except Exception as e:  # forwards to futures, never classifies -> NOS012
+            for fut in self.futures:
+                fut.set_exception(e)
+
+    def _recover_legacy(self):
+        try:
+            self._reset()
+        except (ValueError, Exception) as e:  # tuple containing Exception -> NOS012
+            logger.warning("reset failed: %s", e)
+
+    def _reset(self):
+        pass
+
+    def _narrow(self):
+        try:
+            return int("x")
+        except ValueError:  # narrow handler: deliberate control flow, clean
+            return 0
+
+    def submit(self, x):
+        # Client-side method: NOT reachable from _tick/_run -> no finding.
+        try:
+            return self.queue.append(x)
+        except Exception:
+            logger.exception("submit failed")

@@ -22,6 +22,24 @@ if not os.environ.get("NOS_TPU_TEST_ON_TPU"):
 
     jax.config.update("jax_platforms", "cpu")
 
+    # Persistent XLA compilation cache (keyed by HLO + compile-options
+    # hash, so staleness is structural, and a loaded executable IS the
+    # same program bit-for-bit). The serving tests construct many engines
+    # whose fresh jitted closures lower to identical HLO; without the
+    # cache every construction recompiles the same handful of programs
+    # (~1-2s each on a 1-CPU CI box), which is what pushes the suite
+    # against its wall-clock budget. Within one run, cross-engine reuse
+    # alone cuts minutes; across runs the warm directory does more.
+    import tempfile
+
+    _cache_dir = os.path.join(tempfile.gettempdir(), "nos-tpu-xla-cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except AttributeError:
+        pass  # older jax without the persistent-cache knobs
+
 
 # -- multi-device gating ------------------------------------------------------
 # Modules whose tests construct multi-device meshes (dp/tp/sp/pp/ep, the

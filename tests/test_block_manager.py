@@ -200,15 +200,31 @@ def test_double_admit_same_slot_is_a_bug():
 
 # -- the randomized invariant satellite ---------------------------------------
 def test_randomized_interleaving_preserves_invariants():
-    """ISSUE 5 satellite: after ANY admit/prefill/decode/finish/evict
-    interleaving, the conservation law holds — every managed block in
-    exactly one of in-use/free/cached-free (their sizes summing to
-    total_blocks - 1, scratch excluded) and no block mapped by two page
-    tables with refcount < 2 (refcount == number of mapping tables).
+    """ISSUE 5 satellite, extended by ISSUE 6: after ANY
+    admit/prefill/decode/finish/evict interleaving — now with
+    FAULT-INJECTED admissions and recovery-shaped reset/restore cycles
+    woven into the schedule — the conservation law holds: every managed
+    block in exactly one of in-use/free/cached-free (their sizes summing
+    to total_blocks - 1, scratch excluded) and no block mapped by two
+    page tables with refcount < 2 (refcount == number of mapping
+    tables). The injector fires at the manager's `block_admit` site
+    (entry, before any mutation), so a raised admission must leave the
+    pool untouched; a "device-lost recovery" op replays the engine's
+    recovery sequence — release all, reset, re-admit the survivors'
+    replay prompts — and the invariants must hold at every sub-step.
     Seeded: failures replay."""
+    from nos_tpu.runtime.faults import FaultInjector, FaultSpec, PoisonRequestError
+
     rng = random.Random(20260804)
-    mgr = BlockManager(1 + 10, BS, 4)  # small pool: constant eviction pressure
+    # Injected faults at randomized block_admit occurrences, re-armed as
+    # the schedule consumes them.
+    injector = FaultInjector(
+        [FaultSpec("block_admit", rng.randint(1, 40), "poison")]
+    )
+    mgr = BlockManager(1 + 10, BS, 4, fault_injector=injector)
     live = {}  # slot -> (prompt, cursor)
+    injected = 0
+    recoveries = 0
     for step in range(3000):
         op = rng.random()
         idle = [i for i in range(mgr.n_slots) if i not in live]
@@ -221,7 +237,21 @@ def test_randomized_interleaving_preserves_invariants():
             max_new = rng.randint(1, 6)
             n = n_blocks_for(plen, max_new)
             if n <= mgr.total_blocks - 1:
-                got = mgr.admit(idx, prompt, n, use_cache=rng.random() < 0.8)
+                before = mgr.counts()
+                try:
+                    got = mgr.admit(idx, prompt, n, use_cache=rng.random() < 0.8)
+                except PoisonRequestError:
+                    # Injection at admission entry: nothing half-taken.
+                    injected += 1
+                    assert mgr.counts() == before, "injected fault mutated pool"
+                    injector.add(
+                        FaultSpec(
+                            "block_admit",
+                            injector.visits("block_admit") + rng.randint(1, 40),
+                            "poison",
+                        )
+                    )
+                    got = None
                 if got is not None:
                     live[idx] = (prompt, got[1] * BS)
         elif op < 0.7 and live:
@@ -234,11 +264,51 @@ def test_randomized_interleaving_preserves_invariants():
             idx = rng.choice(list(live))
             del live[idx]
             mgr.release(idx)
-        elif op >= 0.99:
+        elif op >= 0.985:
+            # Device-lost recovery, as the engine performs it: every slot
+            # checkpoints (host state survives), the pool resets, and the
+            # survivors re-admit their replay prompts — invariants hold
+            # at EVERY sub-step, and conservation (the ISSUE 6 leak
+            # gate) throughout.
+            recoveries += 1
+            survivors = list(live.items())
+            for idx in list(live):
+                mgr.release(idx)
+            check_invariants(mgr)
+            mgr.reset()
+            live.clear()
+            check_invariants(mgr)
+            assert mgr.conserved()
+            for idx, (prompt, _) in survivors:
+                n = n_blocks_for(len(prompt), rng.randint(1, 6))
+                if n > mgr.total_blocks - 1:
+                    continue
+                try:
+                    got = mgr.admit(idx, prompt, n, use_cache=True)
+                except PoisonRequestError:
+                    injected += 1
+                    injector.add(
+                        FaultSpec(
+                            "block_admit",
+                            injector.visits("block_admit") + rng.randint(1, 40),
+                            "poison",
+                        )
+                    )
+                    got = None
+                if got is not None:
+                    # Post-reset the index is empty: a restore never hits
+                    # (the cached K/V died with the device pool).
+                    assert got[1] == 0
+                    live[idx] = (prompt, got[1] * BS)
+                check_invariants(mgr)
+        elif op >= 0.98:
             mgr.reset()
             live.clear()
         check_invariants(mgr)
+        assert mgr.conserved()
     assert mgr.lookups > 0 and mgr.hit_blocks > 0 and mgr.evictions > 0
+    assert injected > 0, "the schedule never exercised an injected fault"
+    assert recoveries > 0, "the schedule never exercised a recovery cycle"
     for idx in list(live):
         mgr.release(idx)
     check_invariants(mgr)

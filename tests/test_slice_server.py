@@ -65,11 +65,93 @@ def test_error_propagates_to_futures():
     def bad_fn(x):
         raise RuntimeError("boom")
 
-    server = SliceServer(bad_fn, max_batch=2).start()
+    server = SliceServer(bad_fn, max_batch=2, retry_backoff_s=0.001).start()
     try:
         fut = server.submit(jnp.ones((1,)))
         with pytest.raises(RuntimeError, match="boom"):
             fut.result(timeout=5)
+        # The retry budget was spent before the futures failed.
+        assert server.retries == server.max_retries
+    finally:
+        server.stop()
+
+
+# -- bounded transient retry (ISSUE 6 satellite) ------------------------------
+def test_flaky_batch_execution_retries_then_succeeds():
+    """A batched_fn that fails transiently N times (N <= max_retries) must
+    retry in place — every coalesced client still gets ITS result, no
+    future ever sees the transient error, and the retry counter witnesses
+    the recovery."""
+    calls = {"n": 0}
+    base = jax.jit(lambda x: x * 2.0 + 1.0)
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("remote_compile: read body: response body closed")
+        return base(x)
+
+    server = SliceServer(
+        flaky, max_batch=4, max_retries=2, retry_backoff_s=0.001,
+        stack_in_program=False, pipeline_fetch=False,
+    ).start()
+    try:
+        out = server.infer(jnp.full((2,), 3.0), timeout=10)
+        np.testing.assert_allclose(np.asarray(out), np.full(2, 7.0))
+        assert server.retries == 2
+        assert server.requests_served == 1
+    finally:
+        server.stop()
+
+
+def test_flaky_fetch_retries_then_succeeds(monkeypatch):
+    """Transient result-fetch (device->host) failures retry on the fetch
+    thread with their own counter."""
+    server = SliceServer(
+        jax.jit(lambda x: x + 1.0), max_batch=2, max_retries=2,
+        retry_backoff_s=0.001, pipeline_fetch=True,
+    )
+    real_fetch = server._fetch
+    calls = {"n": 0}
+
+    def flaky_fetch(out, futures, n, dispatched_at):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("connection reset by peer")
+        return real_fetch(out, futures, n, dispatched_at)
+
+    monkeypatch.setattr(server, "_fetch", flaky_fetch)
+    server.start()
+    try:
+        out = server.infer(jnp.zeros((2,)), timeout=10)
+        np.testing.assert_allclose(np.asarray(out), np.ones(2))
+        assert server.fetch_retries == 1
+    finally:
+        server.stop()
+
+
+def test_poison_classified_failure_skips_the_retry_budget():
+    """A PoisonRequestError (the request DATA is the problem) must fail
+    the batch immediately — burning retries on it just delays every
+    coalesced client."""
+    from nos_tpu.runtime.faults import PoisonRequestError
+
+    calls = {"n": 0}
+
+    def poisoned(x):
+        calls["n"] += 1
+        raise PoisonRequestError("bad request payload")
+
+    server = SliceServer(
+        poisoned, max_batch=2, max_retries=3, retry_backoff_s=0.001,
+        stack_in_program=False, pipeline_fetch=False,
+    ).start()
+    try:
+        fut = server.submit(jnp.ones((1,)))
+        with pytest.raises(PoisonRequestError):
+            fut.result(timeout=5)
+        assert calls["n"] == 1  # no retry
+        assert server.retries == 0
     finally:
         server.stop()
 

@@ -18,6 +18,7 @@ import pytest
 from nos_tpu import analysis
 from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
 from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
 from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
@@ -287,6 +288,52 @@ def test_block_discipline_real_engine_is_clean():
         os.path.join(TREE, "runtime", "decode_server.py"), [BlockDisciplineChecker()]
     )
     assert findings == []
+
+
+# -- NOS012 unclassified broad except on the tick/recovery path ---------------
+def test_fault_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "fault_pos.py"), [FaultDisciplineChecker()]
+    )
+    assert codes_of(findings) == ["NOS012"]
+    # Log-only in _run, futures-forwarding in _drain, tuple-broad in
+    # _recover_legacy — and NOT submit()'s handler (off the tick path)
+    # nor the narrow ValueError handler.
+    assert len(findings) == 3
+    assert all("fault" in f.message and "classif" in f.message for f in findings)
+
+
+def test_fault_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "fault_neg.py"), [FaultDisciplineChecker()]
+    )
+    assert findings == []
+
+
+def test_fault_discipline_scope_needs_runtime_dir(tmp_path):
+    # The same log-only engine handler OUTSIDE a runtime/ directory is out
+    # of scope — the rule guards the serving engine loop specifically.
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "class Engine:\n"
+        "    def _run(self):\n"
+        "        try:\n"
+        "            self._tick()\n"
+        "        except Exception:\n"
+        "            pass\n"
+    )
+    assert run_checkers(str(f), [FaultDisciplineChecker()]) == []
+
+
+def test_fault_discipline_real_engine_is_clean():
+    # The tentpole's enforcement, checked directly: every broad except on
+    # the DecodeServer/SliceServer loops routes through the taxonomy (or
+    # carries a rationale-annotated inline suppression).
+    for fname in ("decode_server.py", "slice_server.py"):
+        findings = run_checkers(
+            os.path.join(TREE, "runtime", fname), [FaultDisciplineChecker()]
+        )
+        assert findings == [], fname
 
 
 # -- engine: inline suppression ----------------------------------------------
