@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all test test-tpu native bench dryrun demo simulate example clean \
-	render cluster kind-cluster docker-build e2e-kind lint
+	render cluster kind-cluster docker-build e2e-kind lint slow-audit
 
 all: native test
 
@@ -22,6 +22,13 @@ lint:
 	else \
 		echo "ruff not installed (pip install -e .[dev]); skipped"; \
 	fi
+
+# Tier-1 wall-clock audit: flag unmarked tests over the per-test budget
+# (default 10s) so the suite's thin headroom (~810s of 870s) is policed,
+# not discovered at timeout. Audit an existing tier-1 log without
+# re-running the suite via SLOW_AUDIT_ARGS="--log /tmp/_t1.log".
+slow-audit:
+	JAX_PLATFORMS=cpu $(PY) hack/slow_audit.py $(SLOW_AUDIT_ARGS)
 
 # Same suite against the real accelerator (slow: per-test compiles).
 test-tpu:

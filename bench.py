@@ -144,7 +144,10 @@ def _decode_phase(jax, jnp) -> dict:
     prefill tokens skipped, and streams-2..8 TTFT tails. PR 6 adds the
     AVAILABILITY scenario: 8 streams with a transient + a device-lost
     fault injected mid-flight, surgical recovery vs the fail-all
-    baseline — goodput retention and restore-latency tails."""
+    baseline — goodput retention and restore-latency tails. PR 7 adds
+    the OVERLOAD_QUOTA scenario: two tenants over a pool sized below
+    their combined working set, elastic quota + preemption on vs off,
+    guaranteed-tenant tok/s and TTFT tails vs its solo run."""
     import numpy as np
 
     from nos_tpu.models.gpt import GPTConfig, init_gpt
@@ -552,6 +555,139 @@ def _decode_phase(jax, jnp) -> dict:
         )
         for s in (False, True)
     ]
+
+    # Overload + elastic quotas (PR 7, docs/robustness.md "Preemption &
+    # spill"): 2 tenants over a pool sized BELOW their combined working
+    # set — a borrower floods 6 long streams (6 x 16 = 96 blocks wanted,
+    # pool holds 64, so 4 fill it completely) while a guaranteed tenant
+    # (min 50% of the decode
+    # token rate) runs short interactive requests in a closed loop.
+    # With the quota armed, each guaranteed arrival the engine cannot
+    # host preempts a borrower slot (checkpoint -> KV spilled to host ->
+    # restore-ordered re-admission, usually into a spilled-prefix
+    # revive), so the guarantee's tok/s and TTFT tails hold near its
+    # solo run and the borrower is throttled by exactly the preempted
+    # share; with no quota the guarantee queues behind the borrower's
+    # whole working set (TTFT = a full borrower stream). Outputs are
+    # bit-identical either way — quota moves WHEN work runs, never what
+    # it computes.
+    def quota_g_traffic(server, g_prompts, warm_macro):
+        """The guaranteed tenant's closed loop; returns (tok/s over its
+        active window, per-request latencies)."""
+        while server.macro_dispatches < warm_macro + 4:
+            time.sleep(0.002)  # borrower decode underway first
+        lat = []
+        tokens = 0
+        t0 = time.perf_counter()
+        for p in g_prompts:
+            tg = time.perf_counter()
+            tokens += len(
+                server.submit(p, max_new=32, tenant="g").result(timeout=600)
+            )
+            lat.append(time.perf_counter() - tg)
+        return tokens / (time.perf_counter() - t0), lat
+
+    def overload_quota(preemption_on):
+        from nos_tpu.runtime.quota import QuotaPolicy, TenantShare
+        from nos_tpu.telemetry import collect_serving
+
+        srng = np.random.default_rng([2026, 7, 64])
+        b_prompts = [
+            srng.integers(1, cfg.vocab, 256).tolist() for _ in range(6)
+        ]
+        g_prompts = [srng.integers(1, cfg.vocab, 64).tolist() for _ in range(4)]
+        policy = (
+            QuotaPolicy(
+                {"g": TenantShare(0.5, 1.0), "b": TenantShare(0.0, 1.0)},
+                window_ticks=128,
+            )
+            if preemption_on
+            else None
+        )
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=8,
+            max_len=1024,
+            prompt_buckets=(16, 32, 64, 128, 256),
+            steps_per_dispatch=16,
+            total_blocks=1 + 64,
+            quota=policy,
+        ).start()
+        try:
+            server.generate(g_prompts[0], max_new=8, timeout=600)
+            server.generate(b_prompts[0], max_new=8, timeout=600)
+            warm_macro = server.macro_dispatches
+            t0 = time.perf_counter()
+            fbs = [
+                server.submit(p, max_new=256, tenant="b") for p in b_prompts
+            ]
+            g_tok_s, g_lat = quota_g_traffic(server, g_prompts, warm_macro)
+            b_tokens = sum(len(f.result(timeout=1200)) for f in fbs)
+            wall = time.perf_counter() - t0
+            report = collect_serving(server)
+            g_ttft = server.ttft_s_by_tenant.get("g", [])
+            return {
+                "preemption": preemption_on,
+                "g_tok_s": round(g_tok_s, 1),
+                "g_ttft_p95_s": round(percentile(g_ttft, 95), 4),
+                "g_latency_p95_s": round(percentile(g_lat, 95), 4),
+                "b_tok_s": round(b_tokens / wall, 1),
+                "preemptions": report.preemptions,
+                "spills": report.spills,
+                "revives": report.revives,
+                "spill_drops": report.spill_drops,
+                "borrowed_ticks": report.borrowed_ticks,
+            }
+        finally:
+            server.stop()
+
+    def quota_g_solo():
+        """The guaranteed tenant's baseline: same engine shape, same
+        closed loop, nobody else on the chip."""
+        srng = np.random.default_rng([2026, 7, 64])
+        _ = [srng.integers(1, cfg.vocab, 256).tolist() for _ in range(6)]
+        g_prompts = [srng.integers(1, cfg.vocab, 64).tolist() for _ in range(4)]
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=8,
+            max_len=1024,
+            prompt_buckets=(16, 32, 64, 128, 256),
+            steps_per_dispatch=16,
+            total_blocks=1 + 64,
+        ).start()
+        try:
+            server.generate(g_prompts[0], max_new=8, timeout=600)
+            lat = []
+            tokens = 0
+            t0 = time.perf_counter()
+            for p in g_prompts:
+                tg = time.perf_counter()
+                tokens += len(
+                    server.submit(p, max_new=32, tenant="g").result(timeout=600)
+                )
+                lat.append(time.perf_counter() - tg)
+            g_tok_s = tokens / (time.perf_counter() - t0)
+            g_ttft = server.ttft_s_by_tenant.get("g", [])
+            return {
+                "g_tok_s": round(g_tok_s, 1),
+                "g_ttft_p95_s": round(percentile(g_ttft, 95), 4),
+                "g_latency_p95_s": round(percentile(lat, 95), 4),
+            }
+        finally:
+            server.stop()
+
+    out["overload_quota"] = {
+        "g_solo": _retry("decode:overload_quota_solo", quota_g_solo),
+        "runs": [
+            _retry(
+                f"decode:overload_quota_{'on' if p else 'off'}",
+                lambda p=p: overload_quota(p),
+            )
+            for p in (False, True)
+        ],
+    }
     return out
 
 

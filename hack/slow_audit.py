@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""slow-audit: flag unmarked tests that exceed the tier-1 per-test budget.
+
+Tier-1 runs `pytest -m 'not slow'` under a hard wall-clock timeout with
+~60s of headroom (ROADMAP.md). A single new 30-second test eats half of
+it silently — nothing fails until the whole suite times out, at which
+point the log points at whatever test happened to be running when the
+axe fell, not at the test that grew. This audit closes that loop:
+
+  - parse a pytest `--durations` section (every run prints one — see
+    pyproject.toml addopts) and report tests whose CALL time exceeds
+    the budget (default 10s);
+  - any such test must carry the `slow` marker (excluded from tier-1)
+    or shrink. Because the audited run itself deselects `-m 'not
+    slow'`, everything it reports is unmarked BY CONSTRUCTION.
+
+Usage:
+    make slow-audit                      # runs the tier-1 suite, audits it
+    python hack/slow_audit.py --log /tmp/_t1.log     # audit an existing log
+    python hack/slow_audit.py --budget 5 --log ...   # tighter budget
+
+Exit 0 when clean, 1 when any over-budget test is found, 2 on a log
+with no durations section (nothing to audit is a failure: the signal
+silently disappearing is exactly what this guards against).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import subprocess
+import sys
+import tempfile
+
+# "12.34s call     tests/test_x.py::test_y" — the --durations line shape.
+# Only `call` rows count: setup/teardown of a module-scoped fixture bills
+# its whole cost to one arbitrary test.
+_DURATION_RE = re.compile(
+    r"^\s*(?P<secs>\d+(?:\.\d+)?)s\s+call\s+(?P<test>\S+)\s*$"
+)
+
+
+def parse_durations(text: str):
+    """[(seconds, test-id)] for every `call` row in a pytest log."""
+    rows = []
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            rows.append((float(m.group("secs")), m.group("test")))
+    return rows
+
+
+def audit(text: str, budget_s: float) -> int:
+    rows = parse_durations(text)
+    if not rows:
+        print(
+            "slow-audit: no durations section found in the log "
+            "(run pytest with --durations=N; pyproject.toml adds it by default)",
+            file=sys.stderr,
+        )
+        return 2
+    over = [(s, t) for s, t in rows if s > budget_s]
+    if not over:
+        print(
+            f"slow-audit: clean — {len(rows)} timed calls, none over "
+            f"{budget_s:g}s (slowest: {max(s for s, _ in rows):.2f}s)"
+        )
+        return 0
+    print(
+        f"slow-audit: {len(over)} unmarked test(s) over the {budget_s:g}s "
+        "tier-1 per-test budget — mark them `@pytest.mark.slow` or shrink them:"
+    )
+    for secs, test in sorted(over, reverse=True):
+        print(f"  {secs:8.2f}s  {test}")
+    return 1
+
+
+def run_suite() -> str:
+    """Run the tier-1 selection with full durations, return its log."""
+    with tempfile.NamedTemporaryFile("w+", suffix=".log", delete=False) as fh:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "pytest", "tests/", "-q",
+                "-m", "not slow", "--durations=0", "--durations-min=0.01",
+                "-p", "no:cacheprovider",
+                "--continue-on-collection-errors",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=None,
+        )
+        fh.write(proc.stdout)
+        print(f"slow-audit: suite exit {proc.returncode}, log at {fh.name}",
+              file=sys.stderr)
+        return proc.stdout
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--log",
+        help="audit an existing pytest log (e.g. the tier-1 /tmp/_t1.log) "
+        "instead of running the suite",
+    )
+    ap.add_argument(
+        "--budget", type=float, default=10.0,
+        help="per-test call-time budget in seconds (default: 10)",
+    )
+    args = ap.parse_args(argv)
+    if args.log:
+        with open(args.log) as fh:
+            text = fh.read()
+    else:
+        text = run_suite()
+    return audit(text, args.budget)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+    from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
     from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
     from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
 
@@ -27,4 +28,5 @@ def all_checkers() -> List[Checker]:
         HostSyncChecker(),
         BlockDisciplineChecker(),
         FaultDisciplineChecker(),
+        SpillDisciplineChecker(),
     ]

@@ -1,23 +1,24 @@
-"""NOS011 — paged-pool bookkeeping mutated outside the BlockManager.
+"""NOS013 — spill-tier state mutated outside the SpillTier.
 
-PR 5 extracted the DecodeServer's pool state — free lists, per-slot block
-lists, per-block refcounts, the cached-free LRU, and the content-addressed
-prefix index — into `runtime/block_manager.py` BlockManager, because the
-shared-prefix invariants (a block's refcount equals the number of page
-tables mapping it; a block is in exactly one of in-use / free /
-cached-free; the index and its inverse agree) only hold if every mutation
-funnels through that class. One stray `self._free_blocks.append(...)` or
-`mgr._refcount[b] -= 1` in engine code silently double-frees or leaks a
-block — the kind of drift that shows up five PRs later as cross-request
-KV corruption under load, not as a test failure.
+PR 7 added the host-RAM tier of the paged KV cache
+(`runtime/spill.py` SpillTier): host payload buffers keyed by chain key
+plus a running byte gauge, with a capacity bound enforced at `put`. The
+tier's invariants — the byte gauge equals the sum of resident payload
+sizes, residency never exceeds capacity, a key resolves to exactly one
+payload — only hold if every mutation funnels through the class, exactly
+the NOS011 argument for the BlockManager's pool state. One stray
+`tier._spill_store[key] = payload` in engine code silently unbalances
+the byte accounting; the drift shows up later as a host-memory leak or a
+revive serving a half-replaced payload, not as a test failure.
 
-Scope: files under `runtime/`. Any WRITE to the protected pool-state
-attributes (attribute/subscript assignment or deletion, augmented
-assignment, or a mutating method call like `.append`/`.pop`/`.update`/
-`.move_to_end`) outside the `BlockManager` class body is flagged — on
-ANY receiver, so reaching through the engine (`self._block_mgr._refcount`)
-is caught the same as `self._free_blocks`. Reads stay legal everywhere:
-gauges and tests may inspect, only the BlockManager may mutate.
+Scope: files under `runtime/`. Any WRITE to the protected tier-state
+attributes (`_spill_store`, `_spill_bytes`) — attribute/subscript
+assignment or deletion, augmented assignment, or a mutating method call
+like `.pop`/`.update`/`.popitem` — outside the `SpillTier` class body is
+flagged, on ANY receiver (reaching through the engine or the
+BlockManager is caught the same as `self._spill_store`). Reads stay
+legal everywhere: gauges, conservation predicates, and tests may
+inspect; only the SpillTier may mutate.
 """
 
 from __future__ import annotations
@@ -26,21 +27,7 @@ import ast
 
 from nos_tpu.analysis.core import Checker, FileContext, Report
 
-_PROTECTED = frozenset(
-    {
-        "_free_blocks",
-        "_slot_blocks",
-        "_refcount",
-        "_refcounts",
-        "_cached_free",
-        "_prefix_index",
-        "_block_key",
-        # PR 7: the spilled device-block state (host-backed, reusable)
-        # is pool state like the rest. The HOST tier's own attributes
-        # are NOS013's (spill_discipline.py).
-        "_spilled",
-    }
-)
+_PROTECTED = frozenset({"_spill_store", "_spill_bytes"})
 
 _MUTATORS = frozenset(
     {
@@ -61,13 +48,13 @@ _MUTATORS = frozenset(
     }
 )
 
-_OWNER = "BlockManager"
+_OWNER = "SpillTier"
 
 
 def _protected_attr(node: ast.AST):
     """The protected attribute name a write target resolves to, if any —
-    unwrapping subscript chains so `x._refcount[b]` and
-    `self._slot_blocks[i][j]` both resolve to their backing attribute."""
+    unwrapping subscript chains so `tier._spill_store[key]` resolves to
+    its backing attribute."""
     while isinstance(node, ast.Subscript):
         node = node.value
     if isinstance(node, ast.Attribute) and node.attr in _PROTECTED:
@@ -75,10 +62,10 @@ def _protected_attr(node: ast.AST):
     return None
 
 
-class BlockDisciplineChecker(Checker):
-    name = "block-discipline"
-    codes = ("NOS011",)
-    description = "paged-pool bookkeeping mutated outside the BlockManager"
+class SpillDisciplineChecker(Checker):
+    name = "spill-discipline"
+    codes = ("NOS013",)
+    description = "spill-tier state mutated outside the SpillTier"
 
     def __init__(self) -> None:
         self._active = False
@@ -90,10 +77,10 @@ class BlockDisciplineChecker(Checker):
         report.add(
             ctx.rel,
             node.lineno,
-            "NOS011",
-            f"pool state `{attr}` {how} outside BlockManager; route the "
-            "mutation through a BlockManager method so the refcount/"
-            "free-list/index invariants stay enforceable in one place",
+            "NOS013",
+            f"spill-tier state `{attr}` {how} outside SpillTier; route the "
+            "mutation through a SpillTier method so the host-byte/"
+            "capacity/index invariants stay enforceable in one place",
         )
 
     def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:

@@ -10,4 +10,6 @@ from nos_tpu.runtime.faults import (  # noqa: F401
     TransientDispatchError,
     classify_fault,
 )
+from nos_tpu.runtime.quota import QuotaPolicy, TenantShare  # noqa: F401
 from nos_tpu.runtime.slice_server import SliceServer  # noqa: F401
+from nos_tpu.runtime.spill import SpillTier  # noqa: F401

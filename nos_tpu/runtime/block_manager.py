@@ -33,18 +33,34 @@ Unkeyed blocks (partial tails, decode pages) return to the plain free
 list. `reset()` drops everything: after an engine failure the device
 pool is reallocated, so cached content is garbage by definition.
 
+TIERED under pressure (PR 7, runtime/spill.py): with a SpillTier
+attached, a cached block about to be evicted first copies its K/V
+contents to a host buffer under the same chain key ("spill before
+eviction"), and its device block joins the `spilled` state — reusable
+like free, but with a host twin one copy-in away. Admission extends the
+hit walk into the host tier: keys missing on device but resident on
+host become PENDING REVIVES — fresh private blocks whose contents the
+engine copies in, charged against the per-tick prefill budget, instead
+of recomputing. `release(spill=True)` (slot preemption) retires keyed
+refcount-0 blocks straight to host, freeing HBM immediately. Host
+payloads are device-independent: `reset()` rebuilds the device pool but
+leaves the tier intact, so post-recovery replays still hit.
+
 Every mutation of the pool state (`_free_blocks`, `_slot_blocks`,
-`_refcount`, `_cached_free`, `_prefix_index`, `_block_key`) lives inside
-this class — enforced by the NOS011 checker (docs/static-analysis.md):
-bookkeeping scattered back into the engine is a lint finding, not a
-review comment.
+`_refcount`, `_cached_free`, `_prefix_index`, `_block_key`, `_spilled`)
+lives inside this class — enforced by the NOS011 checker
+(docs/static-analysis.md): bookkeeping scattered back into the engine
+is a lint finding, not a review comment. The spill tier's own state has
+the same discipline under NOS013 (mutations only inside SpillTier).
 """
 
 from __future__ import annotations
 
 import hashlib
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from nos_tpu.runtime.spill import SpillTier
 
 
 def chain_key(parent: str, tokens: Sequence[int]) -> str:
@@ -93,46 +109,89 @@ class BlockManager:
         # full-block keys, and how many of them are already indexed.
         self._slot_keys: List[List[str]] = [[] for _ in range(self.n_slots)]
         self._slot_indexed: List[int] = [0] * self.n_slots
+        # Host spill tier (optional, runtime/spill.py): `_spilled` holds
+        # device blocks whose contents live on host — allocatable like
+        # free, preferred after it (reusing one destroys nothing the
+        # host does not hold). `_slot_revives` stages each admission's
+        # host hits for the engine to claim: (token offset, block, key).
+        self._spill: Optional[SpillTier] = None
+        self._spill_reader: Optional[Callable[[int], Tuple[object, int]]] = None
+        self._spilled: List[int] = []
+        self._slot_revives: List[List[Tuple[int, int, str]]] = [
+            [] for _ in range(self.n_slots)
+        ]
         # Counters (monotonic; the engine mirrors them into metrics).
         self.lookups = 0
         self.hit_blocks = 0
         self.hit_tokens = 0
         self.evictions = 0
+        self.spill_hit_blocks = 0
+
+    def attach_spill(
+        self,
+        tier: SpillTier,
+        reader: Callable[[int], Tuple[object, int]],
+    ) -> None:
+        """Arm the host tier. `reader(block)` extracts the block's K/V
+        contents from the device pool as (payload, nbytes) — supplied by
+        the engine, which owns the device arrays; the manager decides
+        WHEN content moves between tiers, never touches device state
+        itself."""
+        self._spill = tier
+        self._spill_reader = reader
+
+    def _spill_out(self, block: int, key: str) -> None:
+        """Move one indexed refcount-0 block's contents to the host tier
+        and drop its device index entry. The caller owns the block's
+        next state (`_spilled` or immediate reuse)."""
+        payload, nbytes = self._spill_reader(block)
+        self._spill.put(key, payload, nbytes)
+        del self._prefix_index[key]
+        del self._block_key[block]
 
     # -- queries -------------------------------------------------------------
     def available(self) -> int:
         """Blocks an allocation could obtain right now (plain free +
-        evictable cached)."""
-        return len(self._free_blocks) + len(self._cached_free)
+        host-backed spilled + evictable cached)."""
+        return len(self._free_blocks) + len(self._spilled) + len(self._cached_free)
 
     def slot_blocks(self, idx: int) -> Tuple[int, ...]:
         return tuple(self._slot_blocks[idx])
 
     def counts(self) -> Dict[str, int]:
         """Pool-state gauge snapshot: free / cached (refcount-0, content
-        retained) / in_use (distinct blocks mapped by >= 1 table) /
-        shared (mapped by >= 2)."""
+        retained on device) / spilled (refcount-0, content retained on
+        HOST, device block reusable) / in_use (distinct blocks mapped by
+        >= 1 table) / shared (mapped by >= 2)."""
         in_use = sum(1 for rc in self._refcount if rc > 0)
         shared = sum(1 for rc in self._refcount if rc > 1)
         return {
             "free": len(self._free_blocks),
             "cached": len(self._cached_free),
+            "spilled": len(self._spilled),
             "in_use": in_use,
             "shared": shared,
         }
 
     def conserved(self) -> bool:
         """The pool conservation law, as one cheap predicate: every managed
-        block in exactly one of in-use / free / cached-free (the three
-        summing to total - 1, scratch excluded) and no duplicate on the
-        free list. The recovery paths assert this after every restore —
-        a leaked or double-freed block surfaces at the recovery that
-        caused it, not as cross-request KV corruption under later load."""
+        block in exactly one of in-use / free / cached-free / spilled
+        (the four summing to total - 1, scratch excluded), no duplicates
+        on the free or spilled lists, and the host tier's bytes balance.
+        The recovery paths assert this after every restore — a leaked or
+        double-freed block surfaces at the recovery that caused it, not
+        as cross-request KV corruption under later load."""
         c = self.counts()
+        free = set(self._free_blocks)
+        spilled = set(self._spilled)
         return (
-            len(set(self._free_blocks)) == len(self._free_blocks)
-            and not set(self._free_blocks) & set(self._cached_free)
-            and c["in_use"] + c["free"] + c["cached"] == self.total_blocks - 1
+            len(free) == len(self._free_blocks)
+            and len(spilled) == len(self._spilled)
+            and not free & set(self._cached_free)
+            and not spilled & (free | set(self._cached_free))
+            and c["in_use"] + c["free"] + c["cached"] + c["spilled"]
+            == self.total_blocks - 1
+            and (self._spill is None or self._spill.conserved())
         )
 
     def prompt_keys(self, prompt: Sequence[int]) -> List[str]:
@@ -163,13 +222,20 @@ class BlockManager:
         guarantees the final prefill chunk is non-empty (the first-token
         sample needs logits at the true last position) and (b) keeps
         every post-admission write inside private pages, so shared
-        blocks stay immutable."""
+        blocks stay immutable.
+
+        With a spill tier attached, the hit walk CONTINUES past the
+        device run into the host tier (same cap): host-resident keys
+        become fresh private blocks staged as pending revives
+        (`claim_revives`) — the engine copies their contents in, charged
+        against the prefill budget, instead of recomputing them."""
         if self._slot_blocks[idx]:
             raise RuntimeError(f"slot {idx} already holds blocks")
         if self._faults is not None:
             self._faults.check("block_admit", slot=idx)
         keys = self.prompt_keys(prompt) if use_cache else []
         hits: List[int] = []
+        spill_keys: List[str] = []
         if use_cache:
             self.lookups += 1
             cap = (len(prompt) - 1) // self.block_size
@@ -178,43 +244,104 @@ class BlockManager:
                 if block is None:
                     break
                 hits.append(block)
+            if self._spill is not None:
+                # Contiguous extension of the hit run on the host tier.
+                for key in keys[len(hits) : cap]:
+                    if key not in self._spill:
+                        break
+                    spill_keys.append(key)
         # Take the hits: refcount bumps; a resting block leaves the LRU.
         for block in hits:
             if self._refcount[block] == 0:
                 self._cached_free.pop(block)
             self._refcount[block] += 1
-        if n_blocks - len(hits) > self.available():
-            # Leak-guard: the pool cannot host the misses. Return every
-            # block already taken — drop the hit bumps, restore resting
-            # blocks to the cached LRU (MRU end: they were just touched)
-            # — before reporting failure. Checked BEFORE any fresh
-            # allocation, so the failure path never evicts cache either.
+
+        def _rollback(fresh: List[int]) -> None:
+            # Return every block already taken — fresh allocations back
+            # to the plain free list (a spill-evicted one's content is
+            # already host-resident, nothing is lost), hit bumps dropped,
+            # resting blocks restored to the cached LRU (MRU end: they
+            # were just touched) — so repeated rejected admissions cannot
+            # leak pool capacity.
+            for block in fresh:
+                self._refcount[block] -= 1
+                self._free_blocks.append(block)
             for block in reversed(hits):
                 self._refcount[block] -= 1
                 if self._refcount[block] == 0:
                     self._cached_free[block] = self._block_key[block]
+
+        if n_blocks - len(hits) > self.available():
+            # Leak-guard: the pool cannot host the misses. Checked BEFORE
+            # any fresh allocation, so the failure path never evicts
+            # cache either.
+            _rollback([])
             return None
         blocks = list(hits)
-        for _ in range(n_blocks - len(hits)):
-            block = self._alloc_one()
-            self._refcount[block] += 1
-            blocks.append(block)
+        fresh: List[int] = []
+        try:
+            for _ in range(n_blocks - len(hits)):
+                block = self._alloc_one()
+                self._refcount[block] += 1
+                fresh.append(block)
+        except Exception:
+            # A fault mid-allocation (the `spill` injection site, or a
+            # real extraction error) must leave the pool exactly as it
+            # found it — conservation under injection is the randomized
+            # invariant test's contract.
+            _rollback(fresh)
+            raise
+        blocks.extend(fresh)
         self._slot_blocks[idx] = blocks
         self._slot_keys[idx] = keys
         self._slot_indexed[idx] = len(hits)
+        # Stage the host hits: blocks[len(hits) : len(hits)+len(spill_keys)]
+        # are the revive targets, in prefix order.
+        self._slot_revives[idx] = [
+            ((len(hits) + j) * self.block_size, blocks[len(hits) + j], key)
+            for j, key in enumerate(spill_keys)
+        ]
         self.hit_blocks += len(hits)
         self.hit_tokens += len(hits) * self.block_size
+        self.spill_hit_blocks += len(spill_keys)
         return blocks, len(hits)
 
+    def claim_revives(self, idx: int) -> List[Tuple[int, int, str]]:
+        """Hand the engine slot `idx`'s staged host hits, one-shot:
+        (token offset, destination block, chain key) in prefix order.
+        The engine performs the copy-ins (budget-charged) and falls back
+        to recompute for any key the tier dropped meanwhile."""
+        revives = self._slot_revives[idx]
+        self._slot_revives[idx] = []
+        return revives
+
     def _alloc_one(self) -> int:
-        """One block off the plain free list, else evict the LRU
-        cached-free block (its index entry dies with it). Callers check
-        `available()` first; an empty pool here is a bookkeeping bug."""
+        """One block, cheapest casualty first: the plain free list, then
+        a spilled block (its content already lives on host — reuse
+        destroys nothing), then evict the LRU cached-free block. With a
+        spill tier attached the evicted block's contents move to host
+        FIRST ("spill before eviction" — the tentpole's graceful
+        degradation: pressure demotes the prefix cache a tier instead of
+        destroying it); without one the index entry dies as before.
+        Callers check `available()` first; an empty pool here is a
+        bookkeeping bug."""
         if self._free_blocks:
             return self._free_blocks.pop()
-        block, key = self._cached_free.popitem(last=False)
-        del self._prefix_index[key]
-        del self._block_key[block]
+        if self._spilled:
+            return self._spilled.pop()
+        block = next(iter(self._cached_free))
+        key = self._cached_free[block]
+        if self._spill is not None:
+            if self._faults is not None:
+                # Injection BEFORE the extraction and index drop: a
+                # raised spill leaves the cached entry fully intact.
+                self._faults.check("spill")
+            self._spill_out(block, key)
+            self._cached_free.pop(block)
+        else:
+            self._cached_free.pop(block)
+            del self._prefix_index[key]
+            del self._block_key[block]
         self.evictions += 1
         return block
 
@@ -236,28 +363,48 @@ class BlockManager:
         self._slot_indexed[idx] = max(self._slot_indexed[idx], done)
 
     # -- release / reset -----------------------------------------------------
-    def release(self, idx: int) -> None:
+    def release(self, idx: int, spill: bool = False) -> None:
         """Return slot `idx`'s references. Refcounts decrement instead
         of freeing; a block reaching 0 retires to the cached-free LRU if
         its content is indexed (reusable on a later hit) and to the
-        plain free list otherwise."""
+        plain free list otherwise.
+
+        `spill=True` (slot preemption, runtime/quota.py): keyed
+        refcount-0 blocks go straight to the HOST tier instead of the
+        device LRU — their device blocks join the allocatable `spilled`
+        state, so the preemption frees HBM immediately while the
+        preempted prefix stays one copy-in away. No-op distinction when
+        no tier is attached (falls back to the normal retirement)."""
+        spill = spill and self._spill is not None
+        if spill and self._faults is not None:
+            # Entry-site injection: a raised preemption-spill leaves the
+            # slot's references fully intact (the caller re-raises into
+            # the engine's fault classification).
+            self._faults.check("spill", slot=idx)
         for block in self._slot_blocks[idx]:
             self._refcount[block] -= 1
             if self._refcount[block] == 0:
                 key = self._block_key.get(block)
                 if key is None:
                     self._free_blocks.append(block)
+                elif spill:
+                    self._spill_out(block, key)
+                    self._spilled.append(block)
                 else:
                     self._cached_free[block] = key
         self._slot_blocks[idx] = []
         self._slot_keys[idx] = []
         self._slot_indexed[idx] = 0
+        self._slot_revives[idx] = []
 
     def reset(self) -> None:
-        """Forget everything — including cached content. Used when the
-        engine reallocates the device pool after a failure: the blocks'
-        K/V no longer exists, so serving the index would be serving
-        zeros."""
+        """Forget the DEVICE pool — cached content included. Used when
+        the engine reallocates the pool after a failure: the blocks' K/V
+        no longer exists, so serving the device index would be serving
+        zeros. The host spill tier is deliberately NOT reset: its
+        payloads are plain host memory, valid regardless of device
+        state, and post-recovery replays are exactly the traffic that
+        wants to hit them."""
         self._free_blocks = list(range(1, self.total_blocks))
         self._cached_free = OrderedDict()
         self._refcount = [0] * self.total_blocks
@@ -266,3 +413,5 @@ class BlockManager:
         self._block_key = {}
         self._slot_keys = [[] for _ in range(self.n_slots)]
         self._slot_indexed = [0] * self.n_slots
+        self._spilled = []
+        self._slot_revives = [[] for _ in range(self.n_slots)]

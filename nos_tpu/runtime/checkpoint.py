@@ -37,12 +37,22 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+#: Serialized-checkpoint schema version. Bumped whenever the dict layout
+#: changes meaning (v2 added `tenant` for quota-preserving restores and
+#: the version field itself — v1 is retroactively the unversioned PR-6
+#: layout). `from_dict` REJECTS any other version up front with a clear
+#: error: a stale or foreign dict used to fail deep inside restore as a
+#: KeyError/TypeError long after the bad input was accepted.
+CHECKPOINT_VERSION = 2
+
 
 @dataclass
 class SlotCheckpoint:
     """Host-recoverable state of one slot. `generated` never contains a
     token past the request's eos or budget — the engine resolves such
-    requests at capture time instead of checkpointing them."""
+    requests at capture time instead of checkpointing them. `tenant`
+    rides along so a preempted/restored request keeps its quota identity
+    (runtime/quota.py) across the replay."""
 
     prompt: List[int]
     generated: List[int]
@@ -51,6 +61,7 @@ class SlotCheckpoint:
     t_submit: float = 0.0
     prefill_cursor: int = 0
     spec: Optional[Dict[str, float]] = None
+    tenant: Optional[str] = None
     future: Optional[Future] = field(default=None, repr=False, compare=False)
 
     @property
@@ -67,6 +78,7 @@ class SlotCheckpoint:
 
     def to_dict(self) -> dict:
         return {
+            "version": CHECKPOINT_VERSION,
             "prompt": list(self.prompt),
             "generated": list(self.generated),
             "max_new": self.max_new,
@@ -74,10 +86,21 @@ class SlotCheckpoint:
             "t_submit": self.t_submit,
             "prefill_cursor": self.prefill_cursor,
             "spec": dict(self.spec) if self.spec is not None else None,
+            "tenant": self.tenant,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "SlotCheckpoint":
+        version = d.get("version")
+        if version != CHECKPOINT_VERSION:
+            # Fail at the boundary, not deep inside restore: an engine
+            # replaying a half-understood checkpoint would corrupt the
+            # very request the checkpoint exists to save.
+            raise ValueError(
+                f"unsupported SlotCheckpoint version {version!r} (this "
+                f"engine reads version {CHECKPOINT_VERSION}); refusing a "
+                "stale or foreign checkpoint dict"
+            )
         return cls(
             prompt=list(d["prompt"]),
             generated=list(d["generated"]),
@@ -86,4 +109,5 @@ class SlotCheckpoint:
             t_submit=float(d.get("t_submit", 0.0)),
             prefill_cursor=int(d.get("prefill_cursor", 0)),
             spec=dict(d["spec"]) if d.get("spec") is not None else None,
+            tenant=d.get("tenant"),
         )

@@ -63,7 +63,19 @@ TPU-shaped by construction:
     replaying prompt+generated through the budgeted prefill path
     (bit-identical for greedy; the prefix cache makes shared-prefix
     replay nearly free). A seeded FaultInjector threads deterministic
-    chaos through the named dispatch sites for the recovery tests.
+    chaos through the named dispatch sites for the recovery tests;
+  - the engine DEGRADES GRACEFULLY when demand exceeds HBM (PR 7): the
+    prefix cache is TIERED — a refcount-0 block about to be evicted
+    spills its KV to host RAM (runtime/spill.py) and is revived by a
+    copy-in charged against the prefill budget, so a spilled-prefix hit
+    is bit-identical to a cold run but far cheaper than recompute — and
+    per-tenant ELASTIC QUOTAS (runtime/quota.py, the paper's
+    ElasticQuota min/max ported onto decode ticks) let idle capacity be
+    borrowed while guaranteed tenants can reclaim it: an over-quota
+    borrower slot is preempted (checkpointed, KV spilled to host,
+    re-admitted through the restore-ordered queue) when a starved
+    guaranteed tenant's request cannot be hosted, and its replayed
+    stream is bit-identical to the uninterrupted one.
 """
 
 from __future__ import annotations
@@ -100,6 +112,8 @@ from nos_tpu.runtime.faults import (
     classify_fault,
     poison_slot_of,
 )
+from nos_tpu.runtime.quota import QuotaPolicy
+from nos_tpu.runtime.spill import SpillTier
 
 logger = logging.getLogger(__name__)
 
@@ -158,6 +172,11 @@ class _Request:
     serial: Optional[int] = None
     t_restore: float = 0.0
     spec: Optional[dict] = None
+    # Quota identity (runtime/quota.py): which tenant's token-rate share
+    # this request's work is accounted under. None = the default
+    # best-effort tenant. Preserved across checkpoint restores and
+    # preemption re-admissions.
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -208,6 +227,12 @@ class _Slot:
     replay: List[int] = field(default_factory=list)
     step_base: int = 0
     t_restore: float = 0.0
+    # Tiered-KV state (PR 7): the quota tenant this slot's tokens are
+    # accounted under, and the host-resident prefix blocks the budget
+    # scheduler still has to copy in — (token offset, block, chain key)
+    # in prefix order, consumed front-first as the cursor advances.
+    tenant: Optional[str] = None
+    pending_revives: List[Tuple[int, int, str]] = field(default_factory=list)
 
 
 @dataclass
@@ -239,6 +264,8 @@ class DecodeServer:
         spec_sync: bool = False,
         prefill_budget_tokens: Optional[int] = None,
         prefix_cache: bool = True,
+        spill_blocks: Optional[int] = None,
+        quota: Optional[QuotaPolicy] = None,
         metrics=None,
         fault_injector=None,
         surgical_recovery: bool = True,
@@ -350,6 +377,35 @@ class DecodeServer:
         registration (the A/B baseline; per-request block accounting is
         unchanged either way).
 
+        `spill_blocks` sizes the HOST-RAM spill tier of the prefix cache
+        (runtime/spill.py), in KV blocks: a cached-free block about to be
+        evicted under allocation pressure first copies its contents to a
+        host buffer under the same chain key, and a later admission that
+        misses the device index but hits the host tier REVIVES the block
+        with a copy-in charged against the prefill budget instead of a
+        forward pass — bit-identical to recompute (the payload was
+        produced by the same programs a cold run executes), far cheaper,
+        and the machinery slot preemption releases KV into. Default None
+        sizes the tier at one pool's worth of blocks; 0 disables it
+        (eviction destroys content, the pre-PR-7 behavior). Host
+        payloads survive device resets, so post-recovery replays still
+        hit the tier.
+
+        `quota` (optional, runtime/quota.py QuotaPolicy) arms elastic
+        per-tenant token-rate quotas over decode ticks — the paper's
+        ElasticQuota min/max semantics ported onto the serving plane.
+        Requests carry a `tenant` (submit(..., tenant=...)); idle
+        capacity is borrowable, admission skips tenants at their ceiling
+        in place, and when a GUARANTEED tenant (observed share below its
+        min) has a request the engine cannot host, borrower slots are
+        preempted lowest-priority-first: checkpointed
+        (runtime/checkpoint.py), their KV released to the spill tier,
+        and re-admitted through the restore-ordered FIFO head to replay
+        later — usually into a spilled-prefix hit. Preempted-then-
+        replayed output is bit-identical to the uninterrupted run
+        (greedy and temperature), by the same replay-exactness argument
+        as fault recovery. None = no quota behavior at all.
+
         `metrics` (optional) is an observability.Metrics-style registry
         (duck-typed: inc/set_gauge); when provided the engine publishes
         its counters and per-tick drafting/macro split under
@@ -406,6 +462,32 @@ class DecodeServer:
         self._block_mgr = BlockManager(
             self.total_blocks, self.block_size, n_slots, fault_injector=fault_injector
         )
+        # Host-RAM spill tier (PR 7): sized in blocks, attached to the
+        # BlockManager with this engine's device-copy reader. The engine
+        # owns the device arrays; the manager owns WHEN content moves.
+        if spill_blocks is None:
+            spill_blocks = self.total_blocks
+        self.spill_tier: Optional[SpillTier] = None
+        if spill_blocks > 0:
+            bytes_per_block = (
+                cfg.layers
+                * 2
+                * cfg.n_kv
+                * self.block_size
+                * cfg.head_dim
+                * np.dtype(cfg.jdtype).itemsize
+            )
+            self.spill_tier = SpillTier(int(spill_blocks) * bytes_per_block)
+            self._block_mgr.attach_spill(self.spill_tier, self._extract_block)
+        # Elastic tenant quotas (PR 7, runtime/quota.py): None = no quota
+        # behavior. `_tick_tokens` accumulates one tick's decode tokens
+        # per tenant for the policy's sliding window.
+        self._quota = quota
+        self._tick_tokens: Dict[str, int] = {}
+        self.preemptions = 0
+        # Delta-mirror shadow for monotonic counters owned by the tier /
+        # manager / policy (published into the metrics registry per tick).
+        self._metric_shadow: Dict[str, int] = {}
         # FIFO head-of-line admission: a request the pool cannot host yet
         # waits here (never reordered past).
         self._waiting: Deque[_Request] = deque()
@@ -450,6 +532,10 @@ class DecodeServer:
         # materialization adds the pipeline delay, which is the point).
         self.queue_wait_s: List[float] = []
         self.ttft_s: List[float] = []
+        # TTFT samples attributed per quota tenant (key "" = untenanted):
+        # what the overload bench reads to show a guaranteed tenant's
+        # tails holding while a borrower floods the engine.
+        self.ttft_s_by_tenant: Dict[str, List[float]] = {}
         # Failure model (docs/robustness.md): recovery counters + the
         # per-restored-request latency samples (fault detection -> the
         # restored slot's replayed final chunk dispatches — the TTFT
@@ -616,13 +702,58 @@ class DecodeServer:
         # [n_slots] int32; the copy is nothing.
         self._prefill_last = jax.jit(_prefill_last, donate_argnums=(2, 6))
 
+        # Spill-tier device transfers: one gather program (copy-out: the
+        # cache stays live, NOT donated) and one scatter program
+        # (copy-in: donated, so the revive rides the same donated-cache
+        # chain as every other dispatch and later reads are device-
+        # ordered behind it). `block` is a traced scalar — one compiled
+        # program serves every block id.
+        L = cfg.layers
+
+        def _extract(cache, block):
+            k = jnp.stack([cache[str(i)]["k"][block] for i in range(L)])
+            v = jnp.stack([cache[str(i)]["v"][block] for i in range(L)])
+            return k, v
+
+        def _revive(cache, k, v, block):
+            for i in range(L):
+                cache[str(i)] = {
+                    "k": cache[str(i)]["k"].at[block].set(k[i]),
+                    "v": cache[str(i)]["v"].at[block].set(v[i]),
+                }
+            return cache
+
+        self._extract_fn = jax.jit(_extract)
+        self._revive_fn = jax.jit(_revive, donate_argnums=(0,))
+
+    def _extract_block(self, block: int):
+        """Copy one block's K/V off the device for the spill tier:
+        (payload, nbytes). The reads below are DELIBERATE synchronous
+        device->host transfers — spilling IS the copy-out, it happens
+        only under allocation pressure or preemption (slow paths by
+        definition), and the bytes moved are the point."""
+        k, v = self._extract_fn(self.cache, block)
+        k = np.asarray(k)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
+        v = np.asarray(v)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
+        return (k, v), k.nbytes + v.nbytes
+
     # -- client side ---------------------------------------------------------
-    def submit(self, prompt: Sequence[int], max_new: int = 16) -> Future:
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new: int = 16,
+        tenant: Optional[str] = None,
+    ) -> Future:
+        """`tenant` names the quota account this request's decode tokens
+        bill against (runtime/quota.py); ignored unless the engine was
+        built with a QuotaPolicy."""
         fut: Future = Future()
         if max_new <= 0:
             fut.set_result([])
             return fut
-        self._queue.put(_Request(list(prompt), max_new, fut, time.monotonic()))
+        self._queue.put(
+            _Request(list(prompt), max_new, fut, time.monotonic(), tenant=tenant)
+        )
         return fut
 
     def generate(self, prompt: Sequence[int], max_new: int = 16, timeout=None):
@@ -662,11 +793,13 @@ class DecodeServer:
             if not req.future.done():
                 req.future.set_exception(exc)
 
-    def _release_slot(self, idx: int) -> None:
+    def _release_slot(self, idx: int, spill: bool = False) -> None:
         """Return the slot's page references to the pool and clear its
         lane. Shared blocks only DECREMENT; refcount-0 indexed blocks
-        retire to the cached-free LRU for the next prefix hit."""
-        self._block_mgr.release(idx)
+        retire to the cached-free LRU for the next prefix hit —
+        `spill=True` (preemption) sends them to the HOST tier instead,
+        freeing HBM immediately."""
+        self._block_mgr.release(idx, spill=spill)
         self._slots[idx] = _Slot()
 
     def _reset_device_state(self) -> None:
@@ -686,14 +819,22 @@ class DecodeServer:
                 return b
         return self.prompt_buckets[-1]
 
+    def _drain_queue(self) -> None:
+        """Move every client-queued request onto the waiting line (FIFO
+        preserved) so admission and quota scans see one deterministic
+        sequence instead of racing the thread-shared queue."""
+        while True:
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+
     def _next_request(self):
         """FIFO across the waiting line and the client queue."""
+        self._drain_queue()
         if self._waiting:
             return self._waiting.popleft()
-        try:
-            return self._queue.get_nowait()
-        except queue.Empty:
-            return None
+        return None
 
     def _admit(self) -> None:
         """Admission only RESERVES: the slot, its serial, its KV blocks,
@@ -710,7 +851,31 @@ class DecodeServer:
         validation is skipped (the original admission already passed it,
         and the combined prompt+budget bound is unchanged by
         construction — only the prompt/max_new split moved), and they
-        keep their original sampling serial."""
+        keep their original sampling serial.
+
+        With a QuotaPolicy armed, admission is quota-aware: requests
+        from tenants at their ceiling — or borrowing while a starved
+        guaranteed tenant has work waiting — are SKIPPED IN PLACE (they
+        keep their queue position; everyone else's order is preserved),
+        so a preempted borrower cannot re-take the very capacity its
+        preemption freed for the guarantee."""
+        skipped: List[_Request] = []
+        starved_waiting = False
+        if self._quota is not None:
+            self._drain_queue()
+            starved_waiting = any(
+                self._quota.is_starved(r.tenant) for r in self._waiting
+            )
+        try:
+            self._admit_scan(skipped, starved_waiting)
+        finally:
+            # Skipped requests return to the FRONT in their original
+            # relative order (they were popped before anything now
+            # behind them).
+            for req in reversed(skipped):
+                self._waiting.appendleft(req)
+
+    def _admit_scan(self, skipped: List[_Request], starved_waiting: bool) -> None:
         for idx, slot in enumerate(self._slots):
             if slot.active:
                 continue
@@ -718,6 +883,11 @@ class DecodeServer:
                 req = self._next_request()
                 if req is None:
                     return
+                if self._quota is not None and self._quota.admission_blocked(
+                    req.tenant, starved_waiting
+                ):
+                    skipped.append(req)
+                    continue  # same slot: try the next queued request
                 full_prompt = list(req.prompt) + list(req.replay)
                 eff_new = req.max_new - len(req.replay)
                 if not req.replay:
@@ -813,6 +983,7 @@ class DecodeServer:
                 slot.replay = list(req.replay)
                 slot.step_base = len(req.replay)
                 slot.t_restore = req.t_restore
+                slot.tenant = req.tenant
                 slot.pending_prompt = full_prompt
                 # Prefix hits are already in the page table: the prefill
                 # cursor starts at the first MISS boundary, so the budget
@@ -820,6 +991,10 @@ class DecodeServer:
                 # (the hit run is capped below the last-token block, so the
                 # final chunk — and its first-token sample — always remains).
                 slot.prefill_cursor = n_hit * self.block_size
+                # Host-tier hits right behind the device run: fresh
+                # private blocks the budget scheduler will fill by
+                # copy-in (_pump_revives) instead of recompute.
+                slot.pending_revives = self._block_mgr.claim_revives(idx)
                 slot.t_submit = req.t_submit
                 slot.pos = slot.prefill_cursor
                 slot.remaining = eff_new - 1
@@ -875,7 +1050,13 @@ class DecodeServer:
         The tick's first chunk always dispatches even when it alone
         exceeds the budget (progress guarantee); once a chunk does not
         fit, the tick's prefill closes (no size-based queue jumping).
-        Returns the number of device dispatches."""
+
+        Slots holding PENDING REVIVES (host-tier prefix hits) spend
+        budget on copy-ins first — block_size tokens per revived block,
+        the same tokens the cursor advances — so a spilled hit competes
+        for the tick's prefill bandwidth exactly like the recompute it
+        replaces, just without the forward pass. Returns the number of
+        device dispatches (chunk programs + revive scatters)."""
         rr = self._prefill_rr % self.n_slots
         order = [
             idx
@@ -893,10 +1074,23 @@ class DecodeServer:
         exhausted = False
         while not exhausted:
             wave: List[Tuple[int, int, list]] = []
+            revived = 0
             for idx in order:
                 slot = self._slots[idx]
                 if slot.phase not in ("reserved", "prefilling"):
                     continue  # finished in an earlier wave of this tick
+                if slot.pending_revives:
+                    n_copies, used = self._pump_revives(idx, budget, spent)
+                    revived += n_copies
+                    dispatches += n_copies
+                    spent += used
+                    if slot.pending_revives:
+                        # Budget closed mid-revive: the rest of the run
+                        # (and everything behind it) waits for the next
+                        # tick's budget.
+                        exhausted = True
+                        break
+                    continue  # this wave's visit went to the copy-ins
                 start = slot.prefill_cursor
                 piece = slot.pending_prompt[start : start + chunk]
                 if budget and spent and spent + len(piece) > budget:
@@ -904,12 +1098,55 @@ class DecodeServer:
                     break
                 wave.append((idx, start, piece))
                 spent += len(piece)
-            if not wave:
+            if not wave and not revived:
                 break
-            dispatches += self._dispatch_prefill_wave(wave)
+            if wave:
+                dispatches += self._dispatch_prefill_wave(wave)
             if budget and spent >= budget:
                 break
         return dispatches
+
+    def _pump_revives(self, idx: int, budget: int, spent: int) -> Tuple[int, int]:
+        """Copy slot `idx`'s host-spilled prefix blocks back into its
+        fresh device pages, front-first, charging `block_size` budget
+        tokens per block. Returns (copy-ins dispatched, budget tokens
+        used). A payload the tier dropped meanwhile (host pressure, or a
+        concurrent revive of the same key) downgrades the REST of the
+        run to recompute — bit-identical output, just paid in forward
+        passes."""
+        slot = self._slots[idx]
+        copies = 0
+        used = 0
+        while slot.pending_revives:
+            start, block, key = slot.pending_revives[0]
+            if start != slot.prefill_cursor:
+                # Defensive: a revive not at the cursor means the compute
+                # path already owns this range — recompute the rest.
+                slot.pending_revives = []
+                break
+            cost = self.block_size
+            if budget and (spent + used) and spent + used + cost > budget:
+                break
+            self._check_fault("revive", idx)
+            payload = self.spill_tier.take(key)
+            if payload is None:
+                slot.pending_revives = []
+                break
+            kx, vx = payload
+            self.cache = self._revive_fn(
+                self.cache, jnp.asarray(kx), jnp.asarray(vx), block
+            )
+            slot.pending_revives.pop(0)
+            slot.prefill_cursor = start + cost
+            slot.pos = slot.prefill_cursor
+            if slot.phase == "reserved":
+                slot.phase = "prefilling"
+            copies += 1
+            used += cost
+            # The revived block is device-resident again: re-index it so
+            # concurrent same-prefix arrivals hit the device tier.
+            self._block_mgr.note_progress(idx, slot.prefill_cursor)
+        return copies, used
 
     def _dispatch_prefill_wave(self, wave: List[Tuple[int, int, list]]) -> int:
         """Dispatch one wave (at most one chunk per slot). Mid-prompt
@@ -1013,6 +1250,9 @@ class DecodeServer:
                     self.restore_latency_s.append(now - slot.t_restore)
                 else:
                     self.ttft_s.append(now - slot.t_submit)
+                    self.ttft_s_by_tenant.setdefault(
+                        slot.tenant or "", []
+                    ).append(now - slot.t_submit)
                 self._finish_if_done(idx)
         self.prefill_dispatches += dispatches
         if self.metrics is not None:
@@ -1227,6 +1467,11 @@ class DecodeServer:
             slot.remaining -= len(accepted)
             slot.lookup.extend(accepted)
             self.spec_tokens_accepted += len(accepted)
+            if self._quota is not None and accepted:
+                tenant = slot.tenant or ""
+                self._tick_tokens[tenant] = (
+                    self._tick_tokens.get(tenant, 0) + len(accepted)
+                )
             if self.metrics is not None:
                 self.metrics.inc(
                     "nos_tpu_decode_spec_tokens_accepted", len(accepted)
@@ -1352,10 +1597,12 @@ class DecodeServer:
         self._reset_device_state()
         self._transient_streak = 0
         # Restores re-enter AHEAD of the FIFO line, preserving their
-        # original admission order (serial order): they were already
-        # admitted once — new arrivals queue behind them.
-        for ck in sorted(checkpoints, key=lambda c: c.serial, reverse=True):
-            self._waiting.appendleft(
+        # original admission order (serial order) and INTERLEAVING with
+        # any restore already waiting there (e.g. a quota-preempted slot
+        # a device-lost fault lands on top of) — the queue-ordering
+        # contract _enqueue_restores enforces.
+        self._enqueue_restores(
+            [
                 _Request(
                     prompt=list(ck.prompt),
                     max_new=ck.max_new,
@@ -1365,8 +1612,11 @@ class DecodeServer:
                     serial=ck.serial,
                     t_restore=t_fault,
                     spec=ck.spec,
+                    tenant=ck.tenant,
                 )
-            )
+                for ck in checkpoints
+            ]
+        )
         self.slots_restored += len(checkpoints)
         if self.metrics is not None:
             self.metrics.inc("nos_tpu_decode_recoveries", kind=kind)
@@ -1413,8 +1663,104 @@ class DecodeServer:
             t_submit=slot.t_submit,
             prefill_cursor=slot.prefill_cursor,
             spec=spec,
+            tenant=slot.tenant,
             future=slot.future,
         )
+
+    def _enqueue_restores(self, reqs: List[_Request]) -> None:
+        """Admit restore/preemption re-entries at the head of the FIFO
+        line, merged BY SERIAL with any restores already waiting there.
+        The queue-ordering contract: the head of the line is one
+        serial-sorted restore region (every restore carries the serial
+        of its original admission), fresh arrivals queue behind it. A
+        plain appendleft would let a device-lost restore jump a
+        quota-preempted slot that was admitted before it — two recovery
+        mechanisms composing into an ordering neither has alone."""
+        head: List[_Request] = []
+        while self._waiting and self._waiting[0].serial is not None:
+            head.append(self._waiting.popleft())
+        for req in sorted(head + list(reqs), key=lambda r: r.serial, reverse=True):
+            self._waiting.appendleft(req)
+
+    # -- elastic quotas (runtime/quota.py) ------------------------------------
+    def _preempt_slot(self, idx: int) -> None:
+        """Quota-driven preemption: checkpoint the slot (the same
+        capture fault recovery uses — reversible by construction), spill
+        its KV to the host tier, and re-enqueue the checkpoint through
+        the restore-ordered FIFO head. The replay re-derives the KV
+        through budgeted prefill — typically from a spilled-prefix hit —
+        and the client sees one uninterrupted, bit-identical stream."""
+        slot = self._slots[idx]
+        if not slot.active:
+            return
+        self._check_fault("preempt", idx)
+        t0 = time.monotonic()
+        ck = self._checkpoint_slot(idx)
+        self._release_slot(idx, spill=True)
+        self.preemptions += 1
+        if self.metrics is not None:
+            self.metrics.inc("nos_tpu_decode_preemptions")
+        if ck is None:
+            return  # the capture already resolved the request
+        self._enqueue_restores(
+            [
+                _Request(
+                    prompt=list(ck.prompt),
+                    max_new=ck.max_new,
+                    future=ck.future,
+                    t_submit=ck.t_submit,
+                    replay=list(ck.generated),
+                    serial=ck.serial,
+                    t_restore=t0,
+                    spec=ck.spec,
+                    tenant=ck.tenant,
+                )
+            ]
+        )
+
+    def _enforce_quota(self) -> None:
+        """The preemption side of elastic quotas, once per tick: if a
+        STARVED tenant (observed share below its guaranteed min) has a
+        request waiting that the engine cannot host right now — no idle
+        slot, or not enough pool blocks — preempt borrower slots
+        lowest-priority-first until it fits (or no borrower remains, in
+        which case the guarantee simply waits like everyone else).
+        Borrowing itself needs no action here: idle capacity is taken by
+        ordinary admission."""
+        if self._quota is None:
+            return
+        self._drain_queue()
+        target = None
+        for req in self._waiting:
+            if self._quota.is_starved(req.tenant) and not self._quota.over_ceiling(
+                req.tenant
+            ):
+                target = req
+                break
+        if target is None:
+            return
+        full = len(target.prompt) + len(target.replay)
+        eff_new = target.max_new - len(target.replay)
+        needed = max(1, -(-(full + eff_new - 1) // self.block_size))
+        if needed > self.total_blocks - 1:
+            return  # un-servable regardless; admission will reject it
+        for _ in range(self.n_slots):
+            if (
+                any(not s.active for s in self._slots)
+                and self._block_mgr.available() >= needed
+            ):
+                return
+            victim = self._quota.select_victim(
+                [
+                    (idx, s.tenant, int(self._slot_serial[idx]))
+                    for idx, s in enumerate(self._slots)
+                    if s.active
+                ],
+                target.tenant,
+            )
+            if victim is None:
+                return
+            self._preempt_slot(victim)
 
     def _tick(self) -> None:
         """One engine iteration — the three-way scheduler. Composition
@@ -1426,12 +1772,17 @@ class DecodeServer:
         program — prefilling slots are masked out of the draft and macro
         masks exactly as drafters are masked out of the macro mask. The
         only blocking read happens when unresolved verifies are the
-        engine's sole possible progress."""
+        engine's sole possible progress. With a QuotaPolicy armed, step
+        (0) runs first: quota enforcement may preempt borrower slots
+        (checkpoint + KV spill + restore-ordered re-admission) to make
+        room for a starved guaranteed tenant's waiting request."""
+        self._enforce_quota()
         self._admit()
         if self._pending_verifies:
             self._resolve_verifies(block=False)
         self._scan_eos()
         if not any(s.active for s in self._slots):
+            self._note_quota_tick()
             self._stop.wait(0.005)
             return
         n_prefill = self._pump_prefill()
@@ -1467,8 +1818,19 @@ class DecodeServer:
             # Every active slot is awaiting its verify outcome: the
             # drafting slots themselves need it — the one blocking read.
             self._resolve_verifies(block=True)
+        self._note_quota_tick()
         if self.metrics is not None:
             self._publish_gauges(n_drafting, len(macro))
+
+    def _note_quota_tick(self) -> None:
+        """Fold this tick's per-tenant decode-token production into the
+        quota window. Runs on EVERY tick — including idle ones — so a
+        ceiling-blocked tenant's share decays instead of freezing (the
+        window only moves when ticks are appended)."""
+        if self._quota is None:
+            return
+        self._quota.observe_tick(self._tick_tokens)
+        self._tick_tokens = {}
 
     def _dispatch_macro(self, idxs: List[int]) -> None:
         """One K-step macro dispatch for the non-drafting active slots.
@@ -1516,6 +1878,11 @@ class DecodeServer:
             slot.remaining -= executed
             self.macro_tokens_by_slot[idx] += executed
             self.macro_dispatches_by_slot[idx] += 1
+            if self._quota is not None and executed:
+                tenant = slot.tenant or ""
+                self._tick_tokens[tenant] = (
+                    self._tick_tokens.get(tenant, 0) + executed
+                )
             self._finish_if_done(idx)
         # Backpressure: bound the device dispatch queue; materializing the
         # oldest in-flight dispatch is (amortized) already-complete work.
@@ -1542,9 +1909,39 @@ class DecodeServer:
     def prefix_evictions(self) -> int:
         return self._block_mgr.evictions
 
+    # -- spill-tier / quota counters (read-through; telemetry's
+    # collect_serving duck-types these as plain attributes) -------------------
+    @property
+    def spills(self) -> int:
+        """Blocks whose KV moved device -> host instead of being
+        destroyed at eviction/preemption."""
+        return self.spill_tier.spills if self.spill_tier is not None else 0
+
+    @property
+    def revives(self) -> int:
+        """Host-spilled blocks copied back into device pages in place of
+        a prefill recompute."""
+        return self.spill_tier.revives if self.spill_tier is not None else 0
+
+    @property
+    def spill_drops(self) -> int:
+        """Host-tier entries dropped under host-capacity pressure."""
+        return self.spill_tier.drops if self.spill_tier is not None else 0
+
+    @property
+    def spill_host_bytes(self) -> int:
+        return self.spill_tier.host_bytes if self.spill_tier is not None else 0
+
+    @property
+    def borrowed_ticks(self) -> int:
+        """Ticks where a tenant ran above its guaranteed share — the
+        'idle capacity is borrowable' witness."""
+        return self._quota.borrowed_ticks if self._quota is not None else 0
+
     def _publish_gauges(self, n_drafting: int, n_macro: int) -> None:
-        """Per-tick split, queue-depth, and pool-state gauges (metrics
-        registry only)."""
+        """Per-tick split, queue-depth, and pool-state gauges, plus the
+        delta-mirrored monotonic counters owned by the spill tier and
+        quota policy (metrics registry only)."""
         m = self.metrics
         m.set_gauge("nos_tpu_decode_slots_drafting", n_drafting)
         m.set_gauge("nos_tpu_decode_slots_macro", n_macro)
@@ -1559,3 +1956,15 @@ class DecodeServer:
         m.set_gauge("nos_tpu_decode_kv_blocks_free", pool["free"])
         m.set_gauge("nos_tpu_decode_kv_blocks_cached", pool["cached"])
         m.set_gauge("nos_tpu_decode_kv_blocks_shared", pool["shared"])
+        m.set_gauge("nos_tpu_decode_kv_blocks_spilled", pool["spilled"])
+        m.set_gauge("nos_tpu_decode_spill_host_bytes", self.spill_host_bytes)
+        for name, cur in (
+            ("nos_tpu_decode_spills", self.spills),
+            ("nos_tpu_decode_revives", self.revives),
+            ("nos_tpu_decode_spill_drops", self.spill_drops),
+            ("nos_tpu_decode_borrowed_ticks", self.borrowed_ticks),
+        ):
+            prev = self._metric_shadow.get(name, 0)
+            if cur > prev:
+                m.inc(name, cur - prev)
+                self._metric_shadow[name] = cur

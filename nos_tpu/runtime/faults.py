@@ -29,11 +29,13 @@ and replay" is the safe default, unlike the old "fail everyone".
 ``FaultInjector`` is the deterministic chaos harness: a schedule of
 (site, k-th occurrence, kind) triples checked at named injection sites
 threaded through the engine (`_admit`, `_dispatch_macro`,
-`_dispatch_verify`, `_dispatch_prefill_wave`, `_resolve_verifies`) and
-the BlockManager's admission. Same schedule + same traffic => the same
-fault fires at the same point in the engine's deterministic tick
-sequence, which is what lets the chaos tests demand BIT-IDENTICAL
-outputs for every non-poisoned request (tests/test_serving_faults.py).
+`_dispatch_verify`, `_dispatch_prefill_wave`, `_resolve_verifies`, the
+quota path's `preempt` and the spill tier's `spill`/`revive` transfer
+points) and the BlockManager's admission. Same schedule + same traffic
+=> the same fault fires at the same point in the engine's deterministic
+tick sequence, which is what lets the chaos tests demand BIT-IDENTICAL
+outputs for every non-poisoned request (tests/test_serving_faults.py,
+tests/test_quota_serving.py).
 """
 
 from __future__ import annotations
@@ -147,6 +149,15 @@ SITES = (
     "dispatch_verify",
     "resolve_verifies",
     "block_admit",
+    # PR 7 (tiered spill + preemption): `spill` fires before a block's
+    # contents move device->host (eviction-spill or preemption-release),
+    # `revive` before a host->device copy-in, `preempt` before a
+    # quota-driven slot checkpoint — all BEFORE the site's work, so an
+    # injected fault never leaves a half-transferred block or a
+    # half-preempted slot.
+    "spill",
+    "revive",
+    "preempt",
 )
 
 #: Sites whose check() call carries the culpable slot of a bound request.

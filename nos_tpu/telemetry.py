@@ -112,6 +112,19 @@ class ServingReport:
     kv_blocks_free: int = 0
     kv_blocks_cached: int = 0
     kv_blocks_shared: int = 0
+    # Tiered KV + elastic quotas (PR 7): blocks spilled device -> host
+    # instead of destroyed, host-resident blocks revived by copy-in,
+    # host entries dropped under host-capacity pressure, bytes resident
+    # in the host tier, device blocks in the spilled (host-backed,
+    # reusable) state, quota-driven slot preemptions, and ticks where a
+    # tenant borrowed capacity above its guaranteed share.
+    spills: int = 0
+    revives: int = 0
+    spill_drops: int = 0
+    spill_host_bytes: int = 0
+    kv_blocks_spilled: int = 0
+    preemptions: int = 0
+    borrowed_ticks: int = 0
     # Per-request latency tails (seconds; 0.0 when no samples yet).
     # TTFT is submit -> final-prefill-chunk dispatch; queue wait is
     # submit -> slot reservation.
@@ -179,6 +192,12 @@ def collect_serving(server) -> ServingReport:
         prefix_hit_blocks=int(getattr(server, "prefix_hit_blocks", 0)),
         prefix_hit_tokens=int(getattr(server, "prefix_hit_tokens", 0)),
         prefix_evictions=int(getattr(server, "prefix_evictions", 0)),
+        spills=int(getattr(server, "spills", 0)),
+        revives=int(getattr(server, "revives", 0)),
+        spill_drops=int(getattr(server, "spill_drops", 0)),
+        spill_host_bytes=int(getattr(server, "spill_host_bytes", 0)),
+        preemptions=int(getattr(server, "preemptions", 0)),
+        borrowed_ticks=int(getattr(server, "borrowed_ticks", 0)),
         recoveries=int(getattr(server, "recoveries", 0)),
         slots_restored=int(getattr(server, "slots_restored", 0)),
         replay_tokens=int(getattr(server, "replay_tokens", 0)),
@@ -207,6 +226,7 @@ def collect_serving(server) -> ServingReport:
         report.kv_blocks_free = int(pool["free"])
         report.kv_blocks_cached = int(pool["cached"])
         report.kv_blocks_shared = int(pool["shared"])
+        report.kv_blocks_spilled = int(pool.get("spilled", 0))
     return report
 
 

@@ -1,0 +1,33 @@
+"""NOS013 negatives: the SpillTier owns its state — mutations inside the
+class body are the sanctioned site; engines and managers that route
+through tier METHODS and merely read the state stay clean.
+Similarly-named attributes that are not tier state (`_spill_limit`) are
+out of scope.
+"""
+
+
+class SpillTier:
+    def __init__(self, capacity):
+        self._spill_store = {}
+        self._spill_bytes = 0
+        self.capacity = capacity
+
+    def put(self, key, payload, nbytes):
+        self._spill_store[key] = (payload, nbytes)
+        self._spill_bytes += nbytes
+
+    def take(self, key):
+        payload, nbytes = self._spill_store.pop(key)
+        self._spill_bytes -= nbytes
+        return payload
+
+
+class Engine:
+    def __init__(self):
+        self._tier = SpillTier(1 << 20)
+        self._spill_limit = 8  # not tier state
+
+    def _tick(self, key, payload):
+        self._tier.put(key, payload, 16)  # method call: the sanctioned route
+        self._spill_limit = 4  # not tier state
+        return len(self._tier._spill_store)  # read: legal

@@ -1,0 +1,30 @@
+"""NOS013 positives: spill-tier state mutated outside SpillTier.
+
+Expected findings (6): the engine's direct `_spill_store[key]` subscript
+assignment, the reach-through `self._tier._spill_bytes` augmented
+assignment, a `.pop()` on the tier's store, a `del` on a store entry, a
+module-level function clearing the store — and the constructor's
+tier-state assignment: like NOS011 there is no constructor exemption,
+because spill state EXISTING outside the tier is the drift the rule
+guards against. Reads (`len(...)`, membership, iteration) stay legal.
+"""
+
+
+class Engine:
+    def __init__(self, tier):
+        self._tier = tier
+        self._spill_store = {}
+
+    def _tick(self, key, payload):
+        self._spill_store[key] = payload
+        self._tier._spill_bytes += payload.nbytes
+        self._tier._spill_store.pop(key)
+        del self._tier._spill_store[key]
+        return len(self._tier._spill_store)  # read: legal
+
+    def resident(self, key):
+        return key in self._tier._spill_store  # read: legal
+
+
+def sweep(tier):
+    tier._spill_store.clear()

@@ -57,6 +57,13 @@ def pytest_configure(config):
         "multidevice: test builds a multi-device mesh; skipped when fewer "
         "than 8 devices are visible (single-chip accelerator runs)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: wall-clock-heavy test excluded from the tier-1 budget "
+        "(`-m 'not slow'`); run explicitly or in the full suite. "
+        "`make slow-audit` flags unmarked tests that exceed the per-test "
+        "budget.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -71,3 +71,33 @@ def test_trial_mean_over_all_clients(monkeypatch):
     mean_s = bench._run_trial(jax, jnp, Cfg(), FastServer())
     assert mean_s >= 0.0
     assert mean_s < 1.0
+
+
+# -- slow-audit (PR 7 CI satellite) -------------------------------------------
+def test_slow_audit_parses_durations_and_flags_over_budget():
+    """`make slow-audit` polices the tier-1 wall-clock budget: only
+    `call` rows count (fixture setup bills arbitrarily), over-budget
+    tests fail the audit, a log with no durations section is itself a
+    failure (the signal silently disappearing is the hazard)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "slow_audit",
+        os.path.join(os.path.dirname(__file__), "..", "hack", "slow_audit.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    log = (
+        "============== slowest 25 durations ==============\n"
+        "12.34s call     tests/test_a.py::test_big\n"
+        "0.50s call     tests/test_a.py::test_small\n"
+        "30.00s setup    tests/test_a.py::test_big\n"
+    )
+    rows = mod.parse_durations(log)
+    assert rows == [(12.34, "tests/test_a.py::test_big"),
+                    (0.5, "tests/test_a.py::test_small")]
+    assert mod.audit(log, budget_s=10.0) == 1   # test_big flagged
+    assert mod.audit(log, budget_s=20.0) == 0   # clean under a looser budget
+    assert mod.audit("no durations here", budget_s=10.0) == 2

@@ -24,18 +24,32 @@ def check_invariants(mgr):
     """The conservation law of the pool (the ISSUE's gate, stated on
     DISTINCT blocks: a shared block counts once however many tables map
     it): every managed block is in exactly one of in-use / free /
-    cached-free, and a block's refcount equals the number of page tables
-    mapping it — so no block can sit in two tables with refcount < 2."""
+    cached-free / spilled (host-backed), and a block's refcount equals
+    the number of page tables mapping it — so no block can sit in two
+    tables with refcount < 2. With a spill tier attached, the host
+    tier's bytes must balance too."""
     blocks = range(1, mgr.total_blocks)
     in_use = {b for b in blocks if mgr._refcount[b] > 0}
     free = set(mgr._free_blocks)
     cached = set(mgr._cached_free)
+    spilled = set(mgr._spilled)
     assert len(free) == len(mgr._free_blocks), "free list holds a duplicate"
+    assert len(spilled) == len(mgr._spilled), "spilled list holds a duplicate"
     assert not in_use & free, f"in-use blocks on the free list: {in_use & free}"
     assert not in_use & cached, f"in-use blocks in cached-free: {in_use & cached}"
     assert not free & cached, f"blocks both free and cached: {free & cached}"
+    assert not spilled & (in_use | free | cached), (
+        f"spilled blocks in another state: {spilled & (in_use | free | cached)}"
+    )
     # sum over states == total_blocks - 1 (scratch excluded).
-    assert len(in_use) + len(free) + len(cached) == mgr.total_blocks - 1
+    assert (
+        len(in_use) + len(free) + len(cached) + len(spilled)
+        == mgr.total_blocks - 1
+    )
+    # Host-tier byte conservation: the running gauge equals the sum of
+    # resident payload sizes and respects capacity.
+    if mgr._spill is not None:
+        assert mgr._spill.conserved(), "host-tier bytes out of balance"
     owners = {}
     for row in mgr._slot_blocks:
         assert len(set(row)) == len(row), "one table maps a block twice"
@@ -121,7 +135,9 @@ def test_eviction_under_pressure_is_lru_ordered():
     mgr.admit(0, pb, 2)
     mgr.note_progress(0, 8)
     mgr.release(0)  # cached LRU: A1, A2 (older), B1, B2 (newer); free: 2
-    assert mgr.counts() == {"free": 2, "cached": 4, "in_use": 0, "shared": 0}
+    assert mgr.counts() == {
+        "free": 2, "cached": 4, "spilled": 0, "in_use": 0, "shared": 0
+    }
     # A 4-block no-hit admission drains the free list then evicts the
     # OLDEST cached blocks — A's, not B's.
     mgr.admit(1, [3] * 13, 4)
@@ -142,7 +158,8 @@ def test_reset_forgets_cached_content():
     mgr.reset()
     check_invariants(mgr)
     assert mgr.counts() == {
-        "free": mgr.total_blocks - 1, "cached": 0, "in_use": 0, "shared": 0
+        "free": mgr.total_blocks - 1, "cached": 0, "spilled": 0,
+        "in_use": 0, "shared": 0,
     }
     _, hits = mgr.admit(0, prompt, 3)
     assert hits == 0  # the index died with the device pool
@@ -198,22 +215,139 @@ def test_double_admit_same_slot_is_a_bug():
         mgr.admit(0, [4, 5, 6], 1)
 
 
+# -- the spill tier (PR 7) -----------------------------------------------------
+def mk_spilling(total=16, n_slots=3, capacity_bytes=1 << 10):
+    """Manager with a host tier attached. The reader is a fake: payload
+    identity is the block id (content fidelity is the ENGINE's exactness
+    oracle in test_quota_serving.py; the manager only moves bookkeeping),
+    16 bytes each so capacity pressure is easy to provoke."""
+    from nos_tpu.runtime.spill import SpillTier
+
+    mgr = BlockManager(total, BS, n_slots)
+    tier = SpillTier(capacity_bytes)
+    mgr.attach_spill(tier, lambda block: (f"kv-of-{block}", 16))
+    return mgr, tier
+
+
+def test_eviction_spills_before_destroying_and_stages_revives():
+    """The tentpole's tier-demotion: allocation pressure moves a cached
+    block's content to HOST under its chain key instead of dropping it,
+    and a later same-prefix admission stages the host hits as pending
+    revives on fresh private blocks (claimed one-shot by the engine)."""
+    mgr, tier = mk_spilling(total=1 + 6)
+    donor = list(range(8))  # 2 full blocks, both keyed after progress
+    mgr.admit(0, donor, 2)
+    mgr.note_progress(0, 8)
+    mgr.release(0)  # 2 cached + 4 free
+    mgr.admit(1, [9] * 21, 6)  # no hits: drains free, evicts-with-spill both
+    assert mgr.evictions == 2
+    assert tier.spills == 2
+    assert len(tier) == 2
+    assert tier.host_bytes == 32
+    keys = mgr.prompt_keys(donor)
+    assert all(k in tier for k in keys)
+    assert not any(k in mgr._prefix_index for k in keys)
+    check_invariants(mgr)
+    mgr.release(1)
+    # Same-prefix re-admission: no device hits, ONE host hit (capped
+    # below the last-token block), staged at the right offset.
+    blocks, n_hit = mgr.admit(2, donor, 2)
+    assert n_hit == 0
+    revives = mgr.claim_revives(2)
+    assert revives == [(0, blocks[0], keys[0])]
+    assert mgr.claim_revives(2) == []  # one-shot
+    assert mgr.spill_hit_blocks == 1
+    check_invariants(mgr)
+
+
+def test_release_spill_frees_hbm_and_keeps_host_twin():
+    """The preemption path: release(spill=True) sends keyed refcount-0
+    blocks straight to host; their device blocks join the allocatable
+    `spilled` state (free > spilled > evict order)."""
+    mgr, tier = mk_spilling(total=1 + 6)
+    prompt = list(range(10))  # 2 full blocks + tail
+    mgr.admit(0, prompt, 3)
+    mgr.note_progress(0, 10)
+    mgr.release(0, spill=True)
+    counts = mgr.counts()
+    assert counts == {"free": 4, "cached": 0, "spilled": 2, "in_use": 0, "shared": 0}
+    assert tier.spills == 2
+    assert mgr.available() == 6
+    # Allocation prefers plain free blocks, then spilled ones.
+    mgr.admit(1, [3] * 17, 5, use_cache=False)
+    assert mgr.counts()["spilled"] == 1
+    assert mgr.evictions == 0  # nothing cached was destroyed
+    check_invariants(mgr)
+
+
+def test_release_without_tier_is_unchanged():
+    mgr = mk()
+    prompt = list(range(10))
+    mgr.admit(0, prompt, 3)
+    mgr.note_progress(0, 10)
+    mgr.release(0, spill=True)  # no tier attached: normal retirement
+    assert mgr.counts()["cached"] == 2
+    assert mgr.counts()["spilled"] == 0
+    check_invariants(mgr)
+
+
+def test_spill_tier_capacity_drops_lru():
+    from nos_tpu.runtime.spill import SpillTier
+
+    tier = SpillTier(capacity_bytes=40)
+    tier.put("a", "pa", 16)
+    tier.put("b", "pb", 16)
+    assert tier.host_bytes == 32 and tier.conserved()
+    tier.put("c", "pc", 16)  # over capacity: "a" (LRU) drops
+    assert "a" not in tier and "b" in tier and "c" in tier
+    assert tier.drops == 1 and tier.host_bytes == 32 and tier.conserved()
+    assert tier.take("a") is None  # dropped: caller recomputes
+    assert tier.take("b") == "pb"
+    assert tier.revives == 1
+    # A single payload larger than the whole tier keeps nothing.
+    tier.put("huge", "ph", 1 << 20)
+    assert "huge" not in tier and tier.host_bytes == 16 and tier.conserved()
+
+
+def test_reset_keeps_host_tier_for_replays():
+    """Device reset kills the device index (its K/V died with the pool)
+    but NOT the host tier — payloads are plain host memory, and
+    post-recovery replays are exactly the traffic that wants them."""
+    mgr, tier = mk_spilling(total=1 + 6)
+    donor = list(range(8))
+    mgr.admit(0, donor, 2)
+    mgr.note_progress(0, 8)
+    mgr.release(0, spill=True)
+    assert len(tier) == 2
+    mgr.reset()
+    check_invariants(mgr)
+    assert len(tier) == 2  # host content survives the device loss
+    blocks, n_hit = mgr.admit(0, donor, 2)
+    assert n_hit == 0  # the DEVICE index died with the pool...
+    assert len(mgr.claim_revives(0)) == 1  # ...but the replay hits host
+    check_invariants(mgr)
+
+
 # -- the randomized invariant satellite ---------------------------------------
 def test_randomized_interleaving_preserves_invariants():
-    """ISSUE 5 satellite, extended by ISSUE 6: after ANY
+    """ISSUE 5 satellite, extended by ISSUE 6 and ISSUE 7: after ANY
     admit/prefill/decode/finish/evict interleaving — now with
-    FAULT-INJECTED admissions and recovery-shaped reset/restore cycles
-    woven into the schedule — the conservation law holds: every managed
-    block in exactly one of in-use/free/cached-free (their sizes summing
-    to total_blocks - 1, scratch excluded) and no block mapped by two
-    page tables with refcount < 2 (refcount == number of mapping
-    tables). The injector fires at the manager's `block_admit` site
-    (entry, before any mutation), so a raised admission must leave the
-    pool untouched; a "device-lost recovery" op replays the engine's
-    recovery sequence — release all, reset, re-admit the survivors'
-    replay prompts — and the invariants must hold at every sub-step.
-    Seeded: failures replay."""
+    FAULT-INJECTED admissions, recovery-shaped reset/restore cycles,
+    and SPILL/REVIVE/PREEMPT ops woven into the schedule — the
+    conservation law holds: every managed block in exactly one of
+    in-use/free/cached-free/spilled (their sizes summing to
+    total_blocks - 1, scratch excluded), no block mapped by two page
+    tables with refcount < 2 (refcount == number of mapping tables),
+    and the HOST tier's bytes balance at every step. The injector fires
+    at the manager's `block_admit` site (entry, before any mutation), so
+    a raised admission must leave the pool untouched; a "device-lost
+    recovery" op replays the engine's recovery sequence — release all,
+    reset, re-admit the survivors' replay prompts — and the invariants
+    must hold at every sub-step (the tier deliberately SURVIVES the
+    reset, so post-reset restores may stage host revives). Seeded:
+    failures replay."""
     from nos_tpu.runtime.faults import FaultInjector, FaultSpec, PoisonRequestError
+    from nos_tpu.runtime.spill import SpillTier
 
     rng = random.Random(20260804)
     # Injected faults at randomized block_admit occurrences, re-armed as
@@ -222,9 +356,27 @@ def test_randomized_interleaving_preserves_invariants():
         [FaultSpec("block_admit", rng.randint(1, 40), "poison")]
     )
     mgr = BlockManager(1 + 10, BS, 4, fault_injector=injector)
+    # Small host tier (6 x 16-byte fake payloads): capacity drops fire
+    # alongside spills and revives.
+    tier = SpillTier(capacity_bytes=6 * 16)
+    mgr.attach_spill(tier, lambda block: (f"kv-of-{block}", 16))
     live = {}  # slot -> (prompt, cursor)
     injected = 0
     recoveries = 0
+    preempts = 0
+    revived = 0
+
+    def consume_revives(idx):
+        # The engine's half of a revive, compressed: claim the staged
+        # host hits and take their payloads front-first (a missing
+        # payload downgrades the rest to recompute, exactly like
+        # _pump_revives).
+        nonlocal revived
+        for _, _, key in mgr.claim_revives(idx):
+            if tier.take(key) is None:
+                break
+            revived += 1
+
     for step in range(3000):
         op = rng.random()
         idle = [i for i in range(mgr.n_slots) if i not in live]
@@ -253,6 +405,7 @@ def test_randomized_interleaving_preserves_invariants():
                     )
                     got = None
                 if got is not None:
+                    consume_revives(idx)
                     live[idx] = (prompt, got[1] * BS)
         elif op < 0.7 and live:
             idx = rng.choice(list(live))
@@ -261,9 +414,15 @@ def test_randomized_interleaving_preserves_invariants():
             mgr.note_progress(idx, cursor)
             live[idx] = (prompt, cursor)
         elif op < 0.95 and live:
+            # Release — every third-ish one PREEMPT-shaped (KV straight
+            # to the host tier instead of the device LRU).
             idx = rng.choice(list(live))
             del live[idx]
-            mgr.release(idx)
+            if rng.random() < 0.35:
+                preempts += 1
+                mgr.release(idx, spill=True)
+            else:
+                mgr.release(idx)
         elif op >= 0.985:
             # Device-lost recovery, as the engine performs it: every slot
             # checkpoints (host state survives), the pool resets, and the
@@ -296,9 +455,12 @@ def test_randomized_interleaving_preserves_invariants():
                     )
                     got = None
                 if got is not None:
-                    # Post-reset the index is empty: a restore never hits
-                    # (the cached K/V died with the device pool).
+                    # Post-reset the DEVICE index is empty: a restore
+                    # never hits it (the cached K/V died with the pool)
+                    # — but the host tier survives, so it MAY stage
+                    # revives for the replay.
                     assert got[1] == 0
+                    consume_revives(idx)
                     live[idx] = (prompt, got[1] * BS)
                 check_invariants(mgr)
         elif op >= 0.98:
@@ -309,6 +471,10 @@ def test_randomized_interleaving_preserves_invariants():
     assert mgr.lookups > 0 and mgr.hit_blocks > 0 and mgr.evictions > 0
     assert injected > 0, "the schedule never exercised an injected fault"
     assert recoveries > 0, "the schedule never exercised a recovery cycle"
+    assert preempts > 0, "the schedule never exercised a preempt-shaped release"
+    assert tier.spills > 0, "the schedule never spilled a block to host"
+    assert revived > 0, "the schedule never revived a host-resident block"
+    assert tier.drops > 0, "the schedule never hit host-capacity pressure"
     for idx in list(live):
         mgr.release(idx)
     check_invariants(mgr)

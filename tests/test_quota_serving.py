@@ -1,0 +1,475 @@
+"""Graceful degradation under pressure (ISSUE 7 tentpole): the tiered
+KV spill path (runtime/spill.py), quota-driven slot preemption, and the
+elastic tenant policy (runtime/quota.py) driving it.
+
+The bar extends PR 5/6's bit-identical pattern: a spilled-prefix hit
+must produce output BIT-IDENTICAL to a cold recompute (the payload was
+written by the very programs a cold run executes, and the host
+round-trip preserves bytes); a preempted-then-replayed stream must be
+bit-identical to its uninterrupted run (greedy AND temperature — the
+checkpoint preserves the sampling serial and offsets the PRNG step by
+the replayed tokens). float32 model for the same cross-program-shape
+reasons as test_serving_faults."""
+
+import time
+
+import jax
+import pytest
+
+from nos_tpu.models.gpt import GPTConfig, init_gpt
+from nos_tpu.runtime.checkpoint import CHECKPOINT_VERSION, SlotCheckpoint
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.runtime.faults import (
+    FAULT_TRANSIENT,
+    DeviceLostError,
+    FaultInjector,
+    FaultSpec,
+)
+from nos_tpu.runtime.quota import DEFAULT_TENANT, QuotaPolicy, TenantShare
+from tests.test_block_manager import check_invariants
+
+CFG = GPTConfig(
+    vocab=97, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=128,
+    dtype="float32",
+)
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="replay/revive bit-exactness crosses program shapes: needs the "
+    "deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_gpt(jax.random.PRNGKey(0), CFG)
+
+
+def drive(server, pred, n=400):
+    for _ in range(n):
+        server._tick()
+        if pred():
+            return True
+    return False
+
+
+# -- QuotaPolicy units ---------------------------------------------------------
+def test_tenant_share_validates():
+    TenantShare(0.2, 0.8)
+    with pytest.raises(ValueError, match="min_share"):
+        TenantShare(0.8, 0.2)
+    with pytest.raises(ValueError, match="min_share"):
+        TenantShare(-0.1, 0.5)
+    with pytest.raises(ValueError, match="window_ticks"):
+        QuotaPolicy({}, window_ticks=0)
+
+
+def test_policy_window_shares_and_labels():
+    policy = QuotaPolicy(
+        {"g": TenantShare(0.5, 1.0), "b": TenantShare(0.0, 0.8)}, window_ticks=4
+    )
+    assert policy.usage("g") == 0.0
+    assert policy.is_starved("g")  # min > 0, usage 0
+    assert policy.is_borrower("b")  # min 0: always over-quota
+    assert not policy.is_starved("b")
+    policy.observe_tick({"b": 30, "g": 10})
+    assert policy.usage("b") == 0.75
+    assert policy.usage("g") == 0.25
+    assert policy.is_starved("g") and policy.is_borrower("b")
+    # The window SLIDES: old entries roll off, idle ticks decay usage.
+    for _ in range(4):
+        policy.observe_tick({"g": 10})
+    assert policy.usage("b") == 0.0
+    assert policy.usage("g") == 1.0
+    assert not policy.is_starved("g")
+    assert policy.borrowed_ticks >= 1  # g ran past its 0.5 min at the end
+
+
+def test_policy_ceiling_and_admission_blocking():
+    policy = QuotaPolicy({"c": TenantShare(0.0, 0.3)}, window_ticks=8)
+    policy.observe_tick({"c": 10})
+    assert policy.usage("c") == 1.0
+    assert policy.over_ceiling("c")
+    assert policy.admission_blocked("c", starved_waiting=False)
+    # max_share >= 1.0 never ceiling-blocks (a sole tenant's share IS 1).
+    assert not policy.over_ceiling("unknown")
+    # Borrowers are blocked only while a starved guarantee is waiting.
+    assert policy.admission_blocked("unknown", starved_waiting=True)
+    assert not policy.admission_blocked("unknown", starved_waiting=False)
+    # Default-tenant mapping: None == DEFAULT_TENANT.
+    policy.observe_tick({DEFAULT_TENANT: 5})
+    assert policy.usage(None) == policy.usage(DEFAULT_TENANT) > 0
+
+
+def test_policy_victim_selection_is_lowest_priority_first():
+    policy = QuotaPolicy(
+        {"g": TenantShare(0.5, 1.0), "b1": TenantShare(0.0, 1.0),
+         "b2": TenantShare(0.0, 1.0)},
+        window_ticks=8,
+    )
+    policy.observe_tick({"b1": 60, "b2": 30, "g": 10})
+    candidates = [(0, "b1", 1), (1, "b1", 4), (2, "b2", 2), (3, "g", 3)]
+    # Most-over-quota tenant first (b1), youngest serial within it.
+    assert policy.select_victim(candidates, "g") == 1
+    # The protected tenant's own slots are never victims.
+    assert policy.select_victim([(3, "g", 3)], "g") is None
+    # A starved tenant's slots are protected even from other tenants.
+    policy2 = QuotaPolicy({"g": TenantShare(0.5, 1.0)}, window_ticks=8)
+    policy2.observe_tick({"g": 1, "x": 99})
+    assert policy2.select_victim([(0, "g", 1)], "x") is None
+
+
+# -- spill/revive exactness (tentpole a) ---------------------------------------
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_spilled_prefix_hit_is_bit_identical_to_cold(params, temperature):
+    """THE spill exactness oracle: same tiny pool, same traffic, spill
+    tier on vs off. The third request's prefix was evicted under
+    pressure — tier ON revives it from host (copy-in), tier OFF
+    recomputes it cold — and the outputs must be bit-identical, greedy
+    and sampled (the revive changes WHERE bytes come from, never what
+    any dispatched program computes)."""
+    donor = [((i * 5) % 91) + 1 for i in range(24)]
+    big = [((i * 7) % 91) + 2 for i in range(40)]
+
+    def run(spill_blocks):
+        server = DecodeServer(
+            params, CFG, n_slots=2, max_len=64, prompt_buckets=(8, 16),
+            block_size=8, total_blocks=1 + 6, spill_blocks=spill_blocks,
+            temperature=temperature, seed=11,
+        ).start()
+        try:
+            outs = [
+                server.generate(donor, max_new=4, timeout=300),
+                server.generate(big, max_new=4, timeout=300),
+                server.generate(donor, max_new=4, timeout=300),
+            ]
+        finally:
+            server.stop()
+        return outs, server
+
+    cold, _ = run(spill_blocks=0)
+    tiered, server = run(spill_blocks=None)  # default: one pool's worth
+    assert tiered == cold
+    assert server.spills >= 2  # the donor's keyed blocks moved to host
+    assert server.revives >= 1  # ...and came back by copy-in
+    assert server._block_mgr.conserved()
+    check_invariants(server._block_mgr)
+
+
+@cpu_only
+def test_revive_counters_flow_through_report_and_metrics(params):
+    from nos_tpu.observability import Metrics
+    from nos_tpu.telemetry import collect_serving
+
+    donor = [((i * 5) % 91) + 1 for i in range(24)]
+    big = [((i * 7) % 91) + 2 for i in range(40)]
+    registry = Metrics()
+    server = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8, 16),
+        block_size=8, total_blocks=1 + 6, metrics=registry,
+    ).start()
+    try:
+        server.generate(donor, max_new=4, timeout=300)
+        server.generate(big, max_new=4, timeout=300)
+        server.generate(donor, max_new=4, timeout=300)
+    finally:
+        server.stop()
+    assert server.spills > 0 and server.revives > 0
+    report = collect_serving(server)
+    assert report.spills == server.spills
+    assert report.revives == server.revives
+    assert report.spill_host_bytes == server.spill_host_bytes
+    assert report.kv_blocks_spilled == server._block_mgr.counts()["spilled"]
+    assert registry.get("nos_tpu_decode_spills") == float(server.spills)
+    assert registry.get("nos_tpu_decode_revives") == float(server.revives)
+    assert (
+        registry.get("nos_tpu_decode_spill_host_bytes")
+        == float(server.spill_host_bytes)
+    )
+
+
+@cpu_only
+def test_revive_transient_fault_retries_bit_identical(params):
+    """The new `revive` injection site composes with the transient
+    retry path: the copy-in raises BEFORE the payload is taken, the
+    tick retries, and the output stays bit-identical."""
+    donor = [((i * 5) % 91) + 1 for i in range(24)]
+    big = [((i * 7) % 91) + 2 for i in range(40)]
+
+    def run(injector):
+        server = DecodeServer(
+            params, CFG, n_slots=2, max_len=64, prompt_buckets=(8, 16),
+            block_size=8, total_blocks=1 + 6, fault_injector=injector,
+            transient_backoff_s=0.001,
+        ).start()
+        try:
+            outs = [
+                server.generate(donor, max_new=4, timeout=300),
+                server.generate(big, max_new=4, timeout=300),
+                server.generate(donor, max_new=4, timeout=300),
+            ]
+        finally:
+            server.stop()
+        return outs, server
+
+    base, _ = run(None)
+    got, server = run(FaultInjector([FaultSpec("revive", 1, FAULT_TRANSIENT)]))
+    assert got == base
+    assert server.transient_retries >= 1
+    assert server.revives >= 1
+    assert server._block_mgr.conserved()
+
+
+# -- preemption exactness (tentpole b) -----------------------------------------
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_preempted_stream_is_bit_identical_to_uninterrupted(params, temperature):
+    """THE preemption exactness oracle: checkpoint -> KV spill ->
+    re-admission replays the stream bit-identically, greedy and
+    temperature (serial preserved, PRNG step offset by the replay)."""
+    prompt = [4, 9, 2, 33]
+
+    ref = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8,), block_size=8,
+        temperature=temperature, seed=11,
+    ).start()
+    try:
+        want = ref.generate(prompt, max_new=12, timeout=300)
+    finally:
+        ref.stop()
+
+    server = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8,), block_size=8,
+        temperature=temperature, seed=11,
+    )
+    fut = server.submit(prompt, max_new=12)
+    assert drive(
+        server,
+        lambda: server._slots[0].active
+        and server._slots[0].phase == "decoding"
+        and 2 <= len(server._slots[0].refs) < 12,
+        n=64,
+    )
+    server._preempt_slot(0)
+    assert server.preemptions == 1
+    assert len(server._waiting) == 1
+    assert server._waiting[0].serial is not None
+    assert drive(server, fut.done)
+    assert fut.result(timeout=5) == want
+    assert server._block_mgr.conserved()
+    check_invariants(server._block_mgr)
+    server.stop()
+
+
+@cpu_only
+def test_device_lost_interleaves_with_waiting_preempted_slot_by_serial(params):
+    """ISSUE 7 satellite: the _admit queue-ordering contract. A
+    device-lost fault lands while a quota-preempted slot (serial 2) is
+    waiting; the fault's restores (serials 1 and 3) must MERGE around
+    it — head of line strictly serial-ordered — instead of jumping it,
+    and all three streams finish bit-identical."""
+    prompts = [[5, 11, 3, 42], [1, 2, 3, 4, 5, 6, 7], [9, 8, 7]]
+
+    ref = DecodeServer(
+        params, CFG, n_slots=3, max_len=64, prompt_buckets=(8,), block_size=8
+    ).start()
+    try:
+        want = [ref.generate(p, max_new=10, timeout=300) for p in prompts]
+    finally:
+        ref.stop()
+
+    server = DecodeServer(
+        params, CFG, n_slots=3, max_len=64, prompt_buckets=(8,), block_size=8
+    )
+    futs = [server.submit(p, max_new=10) for p in prompts]
+    assert drive(
+        server,
+        lambda: all(
+            s.active and s.phase == "decoding" and 0 < len(s.refs) < 10
+            for s in server._slots
+        ),
+        n=64,
+    )
+    server._preempt_slot(1)  # serial 2 waits in the restore region
+    assert [r.serial for r in server._waiting] == [2]
+    server._recover(DeviceLostError("mid-flight"))
+    # The contract: serial-sorted restore region, no jumping.
+    assert [r.serial for r in server._waiting] == [1, 2, 3]
+    assert drive(server, lambda: all(f.done() for f in futs))
+    assert [f.result(timeout=5) for f in futs] == want
+    assert server._block_mgr.conserved()
+    server.stop()
+
+
+# -- elastic quotas end-to-end (tentpole c) ------------------------------------
+@cpu_only
+def test_guaranteed_tenant_preempts_borrower_and_both_finish_exact(params):
+    """The quota loop end to end, deterministically (manual ticks): a
+    borrower floods a pool too small for two working sets; a guaranteed
+    tenant's request then cannot be hosted, quota enforcement preempts
+    the borrower (checkpoint + spill), the guarantee admits and
+    finishes, the borrower replays — and BOTH streams are bit-identical
+    to their solo runs."""
+    policy = QuotaPolicy(
+        {"g": TenantShare(0.6, 1.0), "b": TenantShare(0.0, 1.0)},
+        window_ticks=32,
+    )
+    bp = [5, 11, 3, 42, 7, 9, 2, 1]
+    gp = [40, 41, 42]
+    server = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8,), block_size=8,
+        total_blocks=1 + 7, quota=policy,
+    )
+    fb = server.submit(bp, max_new=40, tenant="b")  # needs 6 of 7 blocks
+    assert drive(
+        server,
+        lambda: any(
+            s.active and s.phase == "decoding" and len(s.refs) >= 4
+            for s in server._slots
+        ),
+        n=64,
+    )
+    fg = server.submit(gp, max_new=10, tenant="g")  # needs 2: cannot fit
+    assert drive(server, lambda: fg.done() and fb.done())
+    assert server.preemptions >= 1
+    assert server.borrowed_ticks > 0  # the borrower used idle capacity
+    rg, rb = fg.result(5), fb.result(5)
+
+    solo = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8,), block_size=8
+    ).start()
+    try:
+        wb = solo.generate(bp, max_new=40, timeout=300)  # serial 1, like fb
+        wg = solo.generate(gp, max_new=10, timeout=300)  # serial 2, like fg
+    finally:
+        solo.stop()
+    assert rg == wg and rb == wb
+    assert server._block_mgr.conserved()
+    check_invariants(server._block_mgr)
+    server.stop()
+
+
+@cpu_only
+def test_ceiling_blocked_tenant_is_skipped_in_place(params):
+    """Admission skips a tenant at its max_share ceiling IN PLACE: a
+    later best-effort request admits first, the capped tenant's request
+    keeps its queue position and admits once its share decays."""
+    policy = QuotaPolicy({"c": TenantShare(0.0, 0.3)}, window_ticks=4)
+    for _ in range(2):
+        policy.observe_tick({"c": 50})  # pre-load: c is at its ceiling
+    server = DecodeServer(
+        params, CFG, n_slots=1, max_len=64, prompt_buckets=(8,), block_size=8,
+        quota=policy,
+    )
+    fc = server.submit([1, 2, 3], max_new=4, tenant="c")
+    fd = server.submit([4, 5, 6], max_new=4)
+    server._tick()
+    # The single slot went to the LATER, unblocked request.
+    assert server._slots[0].active and server._slots[0].tenant is None
+    assert len(server._waiting) == 1
+    assert drive(server, lambda: fc.done() and fd.done())
+    assert fc.result(5) and fd.result(5)
+    server.stop()
+
+
+@cpu_only
+def test_preemption_restores_preserve_tenant_accounting(params):
+    """A preempted request re-admits under its ORIGINAL tenant (the
+    checkpoint carries it), so its replayed work keeps billing the right
+    account."""
+    policy = QuotaPolicy(
+        {"g": TenantShare(0.6, 1.0), "b": TenantShare(0.0, 1.0)},
+        window_ticks=32,
+    )
+    server = DecodeServer(
+        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8,), block_size=8,
+        total_blocks=1 + 7, quota=policy,
+    )
+    fb = server.submit([5, 11, 3, 42, 7, 9, 2, 1], max_new=40, tenant="b")
+    assert drive(
+        server,
+        lambda: any(s.active and len(s.refs) >= 4 for s in server._slots),
+        n=64,
+    )
+    fg = server.submit([40, 41, 42], max_new=10, tenant="g")
+    assert drive(server, lambda: server.preemptions >= 1, n=64)
+    assert any(r.tenant == "b" for r in server._waiting)
+    assert drive(server, lambda: fg.done() and fb.done())
+    server.stop()
+
+
+# -- checkpoint versioning satellite -------------------------------------------
+def test_checkpoint_dict_carries_version_and_tenant():
+    ck = SlotCheckpoint(
+        prompt=[1, 2, 3], generated=[4, 5], max_new=6, serial=9,
+        t_submit=12.5, prefill_cursor=3, spec={"rate": 0.5, "denied_for": 2},
+        tenant="tenant-a",
+    )
+    d = ck.to_dict()
+    assert d["version"] == CHECKPOINT_VERSION
+    assert d["tenant"] == "tenant-a"
+    back = SlotCheckpoint.from_dict(d)
+    assert back == ck
+    assert back.tenant == "tenant-a"
+
+
+@pytest.mark.parametrize("version", [None, 0, 1, 99, "2"])
+def test_checkpoint_rejects_unknown_versions_at_the_boundary(version):
+    """The satellite's point: a stale/foreign dict fails HERE with a
+    clear message, not deep inside restore as a KeyError."""
+    d = SlotCheckpoint(
+        prompt=[1], generated=[], max_new=2, serial=1
+    ).to_dict()
+    if version is None:
+        del d["version"]
+    else:
+        d["version"] = version
+    with pytest.raises(ValueError, match="SlotCheckpoint version"):
+        SlotCheckpoint.from_dict(d)
+
+
+# -- overload smoke (the bench scenario's structural half) ---------------------
+@cpu_only
+@pytest.mark.slow
+def test_overload_quota_smoke_guaranteed_tenant_is_protected(params):
+    """Scaled-down bench.py `overload_quota` (marked slow — wall-clock
+    bound, off the tier-1 budget): a borrower floods the engine; with
+    the quota armed, the guaranteed tenant's requests are served via
+    preemption and finish bit-identical to solo runs; without it they
+    wait out the borrower's whole stream."""
+    policy = QuotaPolicy(
+        {"g": TenantShare(0.5, 1.0), "b": TenantShare(0.0, 1.0)},
+        window_ticks=64,
+    )
+    borrower = [[((i * 7 + s) % 91) + 1 for i in range(16)] for s in range(3)]
+    gp = [40, 41, 42, 43]
+
+    def run(quota):
+        server = DecodeServer(
+            params, CFG, n_slots=2, max_len=64, prompt_buckets=(8, 16),
+            block_size=8, total_blocks=1 + 10, quota=quota,
+        ).start()
+        try:
+            server.generate(gp, max_new=4, timeout=300)  # warm compiles
+            t0 = time.monotonic()
+            # 16 + 24 - 1 -> 5 blocks each: two borrowers fill BOTH
+            # slots and the whole pool, so the guarantee needs a
+            # preemption to land.
+            fbs = [server.submit(p, max_new=24, tenant="b") for p in borrower]
+            time.sleep(0.05)  # the borrower occupies the engine
+            fg = server.submit(gp, max_new=8, tenant="g")
+            rg = fg.result(timeout=300)
+            g_wall = time.monotonic() - t0
+            for f in fbs:
+                f.result(timeout=300)
+        finally:
+            server.stop()
+        return rg, g_wall, server
+
+    rg_on, _, server_on = run(policy)
+    rg_off, _, _ = run(None)
+    assert rg_on == rg_off  # quota changes WHEN work runs, never results
+    assert server_on.preemptions >= 1
+    assert server_on.borrowed_ticks > 0
+    assert server_on._block_mgr.conserved()

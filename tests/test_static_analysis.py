@@ -22,6 +22,7 @@ from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
 from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
 from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
 from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
 
@@ -332,6 +333,54 @@ def test_fault_discipline_real_engine_is_clean():
     for fname in ("decode_server.py", "slice_server.py"):
         findings = run_checkers(
             os.path.join(TREE, "runtime", fname), [FaultDisciplineChecker()]
+        )
+        assert findings == [], fname
+
+
+# -- NOS013 spill-tier state outside the SpillTier -----------------------------
+def test_spill_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "spill_pos.py"), [SpillDisciplineChecker()]
+    )
+    assert codes_of(findings) == ["NOS013"]
+    # Constructor assign, subscript assign, reach-through augassign,
+    # .pop, del, and the module-level .clear() — and NOT the len()/
+    # membership reads (no constructor exemption: tier state existing
+    # outside the SpillTier IS the finding).
+    assert len(findings) == 6
+    msgs = " | ".join(f.message for f in findings)
+    assert "_spill_store" in msgs
+    assert "_spill_bytes" in msgs
+    assert all("SpillTier" in f.message for f in findings)
+
+
+def test_spill_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "spill_neg.py"), [SpillDisciplineChecker()]
+    )
+    assert findings == []
+
+
+def test_spill_discipline_scope_needs_runtime_dir(tmp_path):
+    # The same mutation OUTSIDE a runtime/ directory is out of scope —
+    # the rule guards the serving engine's host tier, not every dict
+    # named _spill_store in the tree.
+    f = tmp_path / "tier_like.py"
+    f.write_text(
+        "class Engine:\n"
+        "    def spill(self, k, p):\n"
+        "        self._spill_store[k] = p\n"
+    )
+    assert run_checkers(str(f), [SpillDisciplineChecker()]) == []
+
+
+def test_spill_discipline_real_engine_is_clean():
+    # The tentpole's enforcement, checked directly: neither the engine
+    # nor the BlockManager mutates tier state — both route through
+    # SpillTier methods (put/take/discard/reset).
+    for fname in ("decode_server.py", "block_manager.py", "spill.py"):
+        findings = run_checkers(
+            os.path.join(TREE, "runtime", fname), [SpillDisciplineChecker()]
         )
         assert findings == [], fname
 
