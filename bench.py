@@ -122,6 +122,119 @@ def _run_trial(jax, jnp, cfg, server) -> float:
     return sum(all_lat) / len(all_lat)
 
 
+def _multi_replica(np, cfg, params, policy: str) -> dict:
+    """One arm of the PR-8 cluster scenario: 3 CPU-backed DecodeServer
+    replicas behind a PrefixRouter, serving a SKEWED multi-tenant trace
+    (6 tenants, zipf-ish request counts, each tenant a 256-token shared
+    system prompt + distinct 32-token suffixes). `policy` is the A/B:
+    "prefix" = cache-aware scoring + tenant stickiness, "round_robin" =
+    pure rotation. Measured: aggregate (fleet-merged) prefix hit rate
+    over the burst's hittable blocks, pooled TTFT tails of the timed
+    phase, wall tok/s — and the outputs themselves, which must be
+    BIT-IDENTICAL across policies (routing moves WHERE a stream runs,
+    never its bytes). Module-level so the smoke numbers in
+    docs/benchmark.md are reproducible without running the whole phase."""
+    import time as _time
+
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.serving import PrefixRouter, ReplicaSet
+    from nos_tpu.telemetry import percentile
+
+    srng = np.random.default_rng([2026, 8, 3])
+    tenants = [f"t{k}" for k in range(6)]
+    sys_prompts = {
+        t: srng.integers(1, cfg.vocab, 256).tolist() for t in tenants
+    }
+    counts = [6, 4, 3, 2, 2, 1]  # skewed: 18 requests over 6 tenants
+    warm_trace = [
+        (t, sys_prompts[t] + srng.integers(1, cfg.vocab, 32).tolist())
+        for t in tenants
+    ]
+    burst_by_tenant = [
+        [
+            (t, sys_prompts[t] + srng.integers(1, cfg.vocab, 32).tolist())
+            for _ in range(c - 1)
+        ]
+        for t, c in zip(tenants, counts)
+    ]
+    # Interleave tenants round-robin so every replica sees mixed arrival
+    # order — the shape that actually separates the policies.
+    burst = []
+    for j in range(max(counts)):
+        for rows in burst_by_tenant:
+            if j < len(rows):
+                burst.append(rows[j])
+    # Out-of-trace warm prompt: compiles every program shape on every
+    # replica (twice: the second pass takes the prefix-HIT path, whose
+    # final chunk is a differently-bucketed program) without seeding any
+    # tenant's prefix into any cache — that would rig the A/B.
+    warm_prompt = srng.integers(1, cfg.vocab, 288).tolist()
+
+    engines = [
+        DecodeServer(
+            params,
+            cfg,
+            n_slots=4,
+            max_len=1024,
+            prompt_buckets=(16, 32, 64, 128, 256),
+            steps_per_dispatch=16,
+            block_size=32,
+        )
+        for _ in range(3)
+    ]
+    replicas = ReplicaSet(engines, start=True)
+    router = PrefixRouter(replicas, policy=policy)
+    try:
+        for h in replicas.handles:
+            for _ in range(2):
+                h.engine.generate(warm_prompt, max_new=32, timeout=600)
+        warm_ttft = {
+            h.replica_id: len(h.engine.ttft_s) for h in replicas.handles
+        }
+        hits0 = sum(h.engine.prefix_hit_blocks for h in replicas.handles)
+        charged0 = sum(h.engine.prefill_tokens for h in replicas.handles)
+        t0 = _time.perf_counter()
+        # Phase 1: one populator per tenant (the deployed system prompt
+        # warms wherever the router puts the tenant).
+        warm_futs = [
+            router.submit(p, max_new=32, tenant=t) for t, p in warm_trace
+        ]
+        outs = [list(f.result(timeout=600)) for f in warm_futs]
+        # Phase 2: the skewed burst.
+        futs = [router.submit(p, max_new=32, tenant=t) for t, p in burst]
+        outs.extend(list(f.result(timeout=600)) for f in futs)
+        wall = _time.perf_counter() - t0
+        report = replicas.fleet_report()
+        ttft_timed = [
+            s
+            for h in replicas.handles
+            for s in h.engine.ttft_s[warm_ttft[h.replica_id] :]
+        ]
+        # Hittable blocks: every full block below each burst prompt's
+        # last-token block (the populators are charged cold by design).
+        hittable = sum(
+            (len(p) - 1) // replicas.block_size for _, p in burst
+        )
+        return {
+            "policy": policy,
+            "tok_s_aggregate": round(len(outs) * 32 / wall, 1),
+            "ttft_p50_s": round(percentile(ttft_timed, 50), 4),
+            "ttft_p95_s": round(percentile(ttft_timed, 95), 4),
+            "prefix_hit_rate_burst": round(
+                (report.prefix_hit_blocks - hits0) / hittable, 3
+            ),
+            "prefill_tokens_charged": report.prefill_tokens - charged0,
+            "router": {
+                k: v
+                for k, v in router.snapshot().items()
+                if k != "replicas"
+            },
+            "outputs": outs,
+        }
+    finally:
+        replicas.stop()
+
+
 def _decode_phase(jax, jnp) -> dict:
     """Driver-captured serving throughput (VERDICT r4 #3: the README's
     tok/s claims lived only in docs — now the artifact carries them).
@@ -147,7 +260,11 @@ def _decode_phase(jax, jnp) -> dict:
     baseline — goodput retention and restore-latency tails. PR 7 adds
     the OVERLOAD_QUOTA scenario: two tenants over a pool sized below
     their combined working set, elastic quota + preemption on vs off,
-    guaranteed-tenant tok/s and TTFT tails vs its solo run."""
+    guaranteed-tenant tok/s and TTFT tails vs its solo run. PR 8 adds
+    the MULTI_REPLICA scenario (cluster serving plane): 3 replicas
+    behind the prefix-aware router vs round-robin over a skewed
+    multi-tenant trace — aggregate hit rate, pooled TTFT tails, and the
+    bit-identical-across-policies witness."""
     import numpy as np
 
     from nos_tpu.models.gpt import GPTConfig, init_gpt
@@ -687,6 +804,28 @@ def _decode_phase(jax, jnp) -> dict:
             )
             for p in (False, True)
         ],
+    }
+
+    # Cluster serving plane (PR 8, docs/serving-cluster.md): 3 replicas
+    # behind the PrefixRouter, skewed multi-tenant trace with shared
+    # system prompts — cache-aware routing vs round-robin on aggregate
+    # prefix hit rate and pooled TTFT tails, with every stream's output
+    # bit-identical across the two policies (the placement-independence
+    # oracle, asserted here so the artifact carries it).
+    runs = [
+        _retry(
+            f"decode:multi_replica_{policy}",
+            lambda policy=policy: _multi_replica(np, cfg, params, policy),
+        )
+        for policy in ("round_robin", "prefix")
+    ]
+    outputs_identical = runs[0].pop("outputs") == runs[1].pop("outputs")
+    out["multi_replica"] = {
+        "replicas": 3,
+        "tenants": 6,
+        "requests": 18,
+        "outputs_identical_across_policies": outputs_identical,
+        "runs": runs,
     }
     return out
 

@@ -21,6 +21,9 @@ Package map (reference layer in parentheses — SURVEY.md §1):
   - ``nos_tpu.scheduler``    plugin framework + CapacityScheduling      (pkg/scheduler/plugins)
   - ``nos_tpu.controllers``  reconcilers: partitioner, agents, quotas   (internal/controllers)
   - ``nos_tpu.tpulib``       native C++ slice shim + ctypes bindings    (pkg/gpu/nvml analog)
+  - ``nos_tpu.serving``      cluster serving plane: prefix-aware router,  (TPU-native, no ref analog)
+                             replica registry, drain/migrate over N
+                             DecodeServer replicas
   - ``nos_tpu.parallel``     JAX mesh/sharding/collectives for workloads (TPU-native, no ref analog)
   - ``nos_tpu.ops``          Pallas TPU kernels for workload hot ops
   - ``nos_tpu.models``       flagship JAX workloads (bench + graft entry)

@@ -174,6 +174,41 @@ DEVICE_PLUGIN_POD_LABEL = "name"
 DEVICE_PLUGIN_POD_LABEL_VALUE = "nvidia-device-plugin-ds"
 DEFAULT_DEVICE_PLUGIN_RESTART_TIMEOUT_S = 60.0
 
+# ---------------------------------------------------------------------------
+# Cluster serving plane (nos_tpu/serving/) wire format. The router, the
+# replica registry, and the engines' load probes exchange plain dicts; the
+# key strings and state names below ARE that protocol — a replica id or
+# drain state spelled inline in the router and differently in telemetry
+# would drift exactly like a mistyped annotation.
+# ---------------------------------------------------------------------------
+# Replica identity: "<prefix><ordinal>", assigned by the ReplicaSet.
+REPLICA_ID_PREFIX = "replica-"
+# Replica lifecycle states (the serving port of the planner's move
+# protocol: a DRAINING replica stops admitting, its in-flight work is
+# re-homed, then it RETIRES — create -> drain -> delete).
+REPLICA_STATE_ACTIVE = "active"
+REPLICA_STATE_DRAINING = "draining"
+REPLICA_STATE_RETIRED = "retired"
+REPLICA_STATES = (
+    REPLICA_STATE_ACTIVE,
+    REPLICA_STATE_DRAINING,
+    REPLICA_STATE_RETIRED,
+)
+# Replica snapshot keys (ReplicaHandle.snapshot() / fleet telemetry rows).
+REPLICA_KEY_ID = "replica_id"
+REPLICA_KEY_STATE = "state"
+REPLICA_KEY_SHADOW_KEYS = "shadow_keys"
+REPLICA_KEY_ROUTED_REQUESTS = "routed_requests"
+# Engine load-probe keys (DecodeServer.probe() -> router scoring).
+PROBE_KEY_ACTIVE_SLOTS = "active_slots"
+PROBE_KEY_QUEUED_REQUESTS = "queued_requests"
+PROBE_KEY_PREFILL_BACKLOG = "prefill_backlog_tokens"
+PROBE_KEY_DRAINING = "draining"
+# Router placement policies (PrefixRouter).
+ROUTER_POLICY_PREFIX = "prefix"
+ROUTER_POLICY_ROUND_ROBIN = "round_robin"
+ROUTER_POLICIES = (ROUTER_POLICY_PREFIX, ROUTER_POLICY_ROUND_ROBIN)
+
 # Scheduler name used by pods that want quota-aware scheduling.
 SCHEDULER_NAME = "nos-tpu-scheduler"
 

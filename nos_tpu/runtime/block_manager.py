@@ -74,6 +74,19 @@ def chain_key(parent: str, tokens: Sequence[int]) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
+def prompt_chain_keys(prompt: Sequence[int], block_size: int) -> List[str]:
+    """Chain keys for every block FULLY covered by `prompt`, in prefix
+    order. Module-level so the cluster router (nos_tpu/serving/router.py)
+    computes the SAME keys engines index under — router keys and engine
+    keys agree by construction, never by convention."""
+    keys: List[str] = []
+    parent = ""
+    for b in range(len(prompt) // block_size):
+        parent = chain_key(parent, prompt[b * block_size : (b + 1) * block_size])
+        keys.append(parent)
+    return keys
+
+
 class BlockManager:
     """Host-side accounting for the paged KV pool: free/cached/owned
     block sets, per-block refcounts, per-slot block lists, and the
@@ -196,13 +209,56 @@ class BlockManager:
 
     def prompt_keys(self, prompt: Sequence[int]) -> List[str]:
         """Chain keys for every block FULLY covered by the prompt."""
-        bs = self.block_size
-        keys: List[str] = []
-        parent = ""
-        for b in range(len(prompt) // bs):
-            parent = chain_key(parent, prompt[b * bs : (b + 1) * bs])
-            keys.append(parent)
-        return keys
+        return prompt_chain_keys(prompt, self.block_size)
+
+    def peek_prefix(self, prompt: Sequence[int]) -> Tuple[int, int]:
+        """READ-ONLY prefix probe: how many leading full blocks of
+        `prompt` would be served without recompute, as (device_blocks,
+        spilled_blocks) — the device run first, then its contiguous
+        continuation on the host tier, under the same below-the-last-
+        token cap `admit()` applies (so a router prediction built on
+        this probe matches what admission will actually take).
+
+        Deliberately side-effect free, for router shadow reconciliation
+        (nos_tpu/serving/): no refcount bump, no cached-free LRU touch
+        or revival, no counter increments, no revive staging — probing a
+        replica's cache must not change which block the next allocation
+        evicts, or the probe itself would perturb the very recency order
+        it reports on (pinned by the LRU-no-touch property test)."""
+        cap = max(0, (len(prompt) - 1) // self.block_size)
+        keys = prompt_chain_keys(prompt, self.block_size)[:cap]
+        dev = 0
+        for key in keys:
+            if key not in self._prefix_index:
+                break
+            dev += 1
+        host = 0
+        if self._spill is not None:
+            for key in keys[dev:]:
+                # SpillTier.__contains__ is a plain membership test —
+                # it never reorders the tier's LRU.
+                if key not in self._spill:
+                    break
+                host += 1
+        return dev, host
+
+    def index_keys(self) -> frozenset:
+        """Snapshot of every chain key currently resident — device index
+        plus host tier. Host-side dict reads only (no device traffic);
+        used by the router to reconcile its per-replica shadow index.
+        The engine thread may be mutating the index concurrently: a
+        mid-iteration resize raises, so retry a couple of times and fall
+        back to an empty snapshot — the shadow is advisory (a stale or
+        empty shadow only costs routing quality, never correctness)."""
+        for _ in range(3):
+            try:
+                keys = set(self._prefix_index)
+                if self._spill is not None:
+                    keys.update(self._spill.keys())
+                return frozenset(keys)
+            except RuntimeError:
+                continue  # dict changed size mid-iteration: retry
+        return frozenset()
 
     # -- admission -----------------------------------------------------------
     def admit(

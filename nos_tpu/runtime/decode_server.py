@@ -94,6 +94,7 @@ import numpy as np
 
 import logging
 
+from nos_tpu import constants
 from nos_tpu.models.decode import (
     init_paged_cache,
     paged_decode_step,
@@ -497,6 +498,21 @@ class DecodeServer:
         self._first_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
         self._inflight: Deque[_TokRef] = deque()
         self._stop = threading.Event()
+        # Set the moment the engine stops ACCEPTING work (stop(), drain,
+        # or drain_extract): a submit() after this raises instead of
+        # enqueueing a request no tick will ever serve — a stranded
+        # Future is strictly worse than a clear error.
+        self._closed = threading.Event()
+        # Every accepted request's Future, appended BEFORE it enters the
+        # queue (under _accept_lock — client threads race each other
+        # here). This is the drain loop's ground truth for "work still
+        # owed": queue/waiting/slot snapshots have a blind window while
+        # the engine thread holds a popped request in a local mid-
+        # admission, but a Future is visibly unresolved from acceptance
+        # to completion. Pruned opportunistically so it never grows past
+        # the outstanding set.
+        self._accept_lock = threading.Lock()
+        self._accepted: List[Future] = []
         self._thread: Optional[threading.Thread] = None
         self.steps_run = 0
         self.spec_rounds = 0
@@ -746,15 +762,80 @@ class DecodeServer:
     ) -> Future:
         """`tenant` names the quota account this request's decode tokens
         bill against (runtime/quota.py); ignored unless the engine was
-        built with a QuotaPolicy."""
-        fut: Future = Future()
+        built with a QuotaPolicy. Raises RuntimeError once the engine has
+        stopped (or begun draining): a request enqueued after the loop
+        exits would strand its Future forever."""
+        return self.transfer_in_request(prompt, max_new, tenant=tenant)
+
+    def transfer_in_request(
+        self,
+        prompt: Sequence[int],
+        max_new: int = 16,
+        tenant: Optional[str] = None,
+        future: Optional[Future] = None,
+        t_submit: Optional[float] = None,
+    ) -> Future:
+        """The general request-ingress hook: `submit()` plus the
+        cross-replica form the drain/migrate controller
+        (nos_tpu/serving/drain.py) uses — a migrated request keeps its
+        ORIGINAL client Future and submit timestamp, so the client
+        blocked in Future.result() never notices its work moved
+        engines. Thread-safe (the queue is the cross-thread boundary)."""
+        if self._closed.is_set():
+            raise RuntimeError(
+                "DecodeServer is stopped (or draining): submit() after "
+                "stop() would strand the request; route it elsewhere"
+            )
+        fut: Future = future if future is not None else Future()
         if max_new <= 0:
             fut.set_result([])
             return fut
+        self._note_accepted(fut)
         self._queue.put(
-            _Request(list(prompt), max_new, fut, time.monotonic(), tenant=tenant)
+            _Request(
+                list(prompt),
+                max_new,
+                fut,
+                t_submit if t_submit is not None else time.monotonic(),
+                tenant=tenant,
+            )
         )
         return fut
+
+    def transfer_in_checkpoint(
+        self, ck: SlotCheckpoint, t_restore: Optional[float] = None
+    ) -> None:
+        """Accept a SlotCheckpoint captured on ANOTHER replica
+        (drain/migrate): enqueued as a restore-shaped request — replay =
+        the tokens already generated at the source, sampling serial
+        preserved and the PRNG step offset by the replay, so a
+        temperature stream continues bit-identically on this engine
+        provided it shares the source's params, config, and sampling
+        seed (the ReplicaSet construction contract,
+        docs/serving-cluster.md). The checkpoint's Future rides along:
+        the client resolves against THIS engine's completion."""
+        if self._closed.is_set():
+            raise RuntimeError(
+                "DecodeServer is stopped (or draining): cannot accept a "
+                "migrated checkpoint; route it elsewhere"
+            )
+        if ck.future is not None and ck.future.done():
+            return  # resolved at capture (eos/budget) — nothing to replay
+        if ck.future is not None:
+            self._note_accepted(ck.future)
+        self._queue.put(
+            _Request(
+                prompt=list(ck.prompt),
+                max_new=ck.max_new,
+                future=ck.future if ck.future is not None else Future(),
+                t_submit=ck.t_submit,
+                replay=list(ck.generated),
+                serial=ck.serial,
+                t_restore=t_restore if t_restore is not None else time.monotonic(),
+                spec=dict(ck.spec) if ck.spec is not None else None,
+                tenant=ck.tenant,
+            )
+        )
 
     def generate(self, prompt: Sequence[int], max_new: int = 16, timeout=None):
         return self.submit(prompt, max_new).result(timeout=timeout)
@@ -765,13 +846,141 @@ class DecodeServer:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False, drain_timeout_s: Optional[float] = None) -> None:
+        """Stop the engine. `drain=False` (the default, the original
+        semantics): the loop exits and every outstanding future FAILS.
+        `drain=True` (graceful): admission closes first (submit() starts
+        raising), then every queued and in-flight request runs to
+        completion before the loop exits — nothing is failed unless
+        `drain_timeout_s` elapses with work still outstanding, in which
+        case the remainder falls through to the hard stop. An engine
+        never start()ed drains by ticking inline (the deterministic
+        manual-tick path the tests use)."""
+        if drain:
+            self._closed.set()
+            deadline = (
+                time.monotonic() + drain_timeout_s
+                if drain_timeout_s is not None
+                else None
+            )
+            while self._has_outstanding():
+                if deadline is not None and time.monotonic() > deadline:
+                    logger.warning(
+                        "drain timed out with work outstanding; hard-stopping"
+                    )
+                    break
+                if self._thread is None:
+                    self._tick()
+                else:
+                    self._stop.wait(0.005)
+        self._closed.set()
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
         # Never strand a client in Future.result(): fail everything still in
         # flight or queued.
         self._fail_outstanding(RuntimeError("DecodeServer stopped"))
+
+    def _note_accepted(self, fut: Future) -> None:
+        with self._accept_lock:
+            if len(self._accepted) > 64:
+                self._accepted = [f for f in self._accepted if not f.done()]
+            self._accepted.append(fut)
+
+    def _has_outstanding(self) -> bool:
+        """Any accepted request whose Future is still unresolved. Exact
+        by construction (no queue/waiting/slot snapshot races): a Future
+        joins `_accepted` before its request enters the queue and only
+        leaves once resolved."""
+        with self._accept_lock:
+            self._accepted = [f for f in self._accepted if not f.done()]
+            return bool(self._accepted)
+
+    # -- cluster serving plane hooks (nos_tpu/serving/) -----------------------
+    def probe(self) -> Dict[str, object]:
+        """Router-side load probe: active slots, queued requests, and the
+        prompt tokens reserved slots still owe the prefill budget. Plain
+        host-side reads (no device traffic, no locks): the snapshot may
+        race the engine thread, but a slightly stale load number only
+        shades a routing score — the router's misroutes cost performance,
+        never correctness."""
+        active = 0
+        backlog = 0
+        for slot in self._slots:
+            if not slot.active:
+                continue
+            active += 1
+            pending = slot.pending_prompt
+            if pending is not None:
+                backlog += max(0, len(pending) - slot.prefill_cursor)
+        return {
+            constants.PROBE_KEY_ACTIVE_SLOTS: active,
+            constants.PROBE_KEY_QUEUED_REQUESTS: (
+                self._queue.qsize() + len(self._waiting)
+            ),
+            constants.PROBE_KEY_PREFILL_BACKLOG: backlog,
+            constants.PROBE_KEY_DRAINING: self._closed.is_set(),
+        }
+
+    def prefix_keys(self) -> frozenset:
+        """Chain keys resident in this engine's prefix cache (device
+        index + host spill tier) — the truth the router reconciles its
+        per-replica shadow index against. Host-side dict reads only."""
+        return self._block_mgr.index_keys()
+
+    def drain_extract(self) -> Tuple[List[SlotCheckpoint], List[_Request]]:
+        """The drain half of the serving move protocol
+        (nos_tpu/serving/drain.py): close admission, stop the loop, and
+        hand back everything this replica still owes — checkpoints for
+        every admitted slot (the SAME capture fault recovery and
+        preemption use, so re-homing is reversible by construction:
+        serial + PRNG step preserved, replay re-derives the KV on the
+        destination) in serial order, plus the not-yet-admitted waiting
+        requests FIFO with their client Futures intact. Restore-shaped
+        entries already waiting (an earlier preemption/device-lost
+        restore the drain lands on top of) are folded into the
+        checkpoint list by serial. The pool is released and conservation
+        asserted; the engine is left stopped and empty."""
+        self._closed.set()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._drain_queue()
+        checkpoints: List[SlotCheckpoint] = []
+        for idx, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            ck = self._checkpoint_slot(idx)
+            self._release_slot(idx)
+            if ck is not None:
+                checkpoints.append(ck)
+        pending: List[_Request] = []
+        while self._waiting:
+            req = self._waiting.popleft()
+            if req.serial is not None:
+                # Already restore-shaped: re-wrap as a checkpoint so the
+                # destination treats it exactly like the drained slots.
+                checkpoints.append(
+                    SlotCheckpoint(
+                        prompt=list(req.prompt),
+                        generated=list(req.replay),
+                        max_new=req.max_new,
+                        serial=req.serial,
+                        t_submit=req.t_submit,
+                        spec=req.spec,
+                        tenant=req.tenant,
+                        future=req.future,
+                    )
+                )
+            else:
+                pending.append(req)
+        self._inflight.clear()
+        self._pending_verifies.clear()
+        checkpoints.sort(key=lambda ck: ck.serial)
+        if not self._block_mgr.conserved():
+            raise RuntimeError("pool conservation violated during drain")
+        return checkpoints, pending
 
     def _fail_outstanding(self, exc: Exception) -> None:
         for idx, slot in enumerate(self._slots):

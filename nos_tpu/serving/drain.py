@@ -1,0 +1,129 @@
+"""Replica drain/migrate: the planner's move protocol, ported to serving.
+
+PR 1 taught the partitioning planner to move a sub-slice with an ordered
+create -> drain -> delete protocol (create the destination first, drain
+the source's work onto it, only then delete the source). This module is
+the same protocol one layer up, where the moved unit is a serving
+replica's in-flight decode streams instead of a carved slice:
+
+  CREATE   the destination capacity already exists — the fleet's other
+           replicas (or a fresh one registered via `ReplicaSet.add`,
+           the `migrate_replica` path, before anything drains);
+  DRAIN    the source stops admitting (state -> `draining`, so the
+           router masks it), then `DecodeServer.drain_extract()`
+           checkpoints every admitted slot with the SAME capture fault
+           recovery and quota preemption use (PR 6/7 substrate:
+           prompt + generated tokens + sampling serial + spec state)
+           and hands back not-yet-admitted requests with their client
+           Futures intact; each checkpoint is re-homed through the
+           router (prefix-aware, so a re-homed stream usually lands
+           where its prefix is already cached) and replayed through the
+           destination's budgeted prefill — serial and PRNG step
+           preserved, so greedy AND temperature streams finish
+           bit-identically to an undrained run;
+  DELETE   the source engine stops and the replica retires.
+
+This closes the planner <-> serving loop: a replanning pass that wants a
+sub-slice back can drain its replica against live load and re-carve,
+paying a replay instead of failed requests.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from nos_tpu import constants
+from nos_tpu.serving.replica import ReplicaHandle, ReplicaSet
+from nos_tpu.serving.router import PrefixRouter
+
+
+@dataclass
+class DrainReport:
+    """What one drain moved: counts plus the per-stream placements
+    ((serial, destination replica id) for checkpointed slots)."""
+
+    replica_id: str
+    slots_migrated: int = 0
+    requests_migrated: int = 0
+    placements: List[Tuple[int, str]] = field(default_factory=list)
+    destinations: Dict[str, int] = field(default_factory=dict)
+
+
+def drain_replica(
+    replica_set: ReplicaSet, router: PrefixRouter, replica_id: str
+) -> DrainReport:
+    """Drain `replica_id` and retire it, re-homing every stream through
+    `router`. Checkpoints move in serial order (oldest admission first —
+    the same head-of-line ordering the intra-engine restore queue
+    keeps); pending requests follow FIFO. Raises if the fleet has no
+    other admitting replica — a drain that would strand work refuses up
+    front instead of failing futures."""
+    handle = replica_set.get(replica_id)
+    if handle.state != constants.REPLICA_STATE_ACTIVE:
+        raise RuntimeError(
+            f"{replica_id} is {handle.state}: only an active replica drains"
+        )
+    # Refuse before touching the source: re-homing needs a destination.
+    router._candidates(exclude=handle)  # raises when none admit
+    handle.state = constants.REPLICA_STATE_DRAINING
+    report = DrainReport(replica_id=replica_id)
+    try:
+        checkpoints, pending = handle.engine.drain_extract()
+        # Destinations place against engine truth, not optimistic
+        # residue: reconcile the survivors' shadows first (host-side
+        # reads only).
+        router.reconcile()
+        t_restore = time.monotonic()
+        for ck in checkpoints:
+            dst = router.select(
+                ck.replay_prompt(), tenant=ck.tenant, exclude=handle
+            )
+            dst.engine.transfer_in_checkpoint(ck, t_restore=t_restore)
+            report.slots_migrated += 1
+            report.placements.append((ck.serial, dst.replica_id))
+            report.destinations[dst.replica_id] = (
+                report.destinations.get(dst.replica_id, 0) + 1
+            )
+        for req in pending:
+            dst = router.select(req.prompt, tenant=req.tenant, exclude=handle)
+            dst.engine.transfer_in_request(
+                req.prompt,
+                req.max_new,
+                tenant=req.tenant,
+                future=req.future,
+                t_submit=req.t_submit,
+            )
+            report.requests_migrated += 1
+            report.destinations[dst.replica_id] = (
+                report.destinations.get(dst.replica_id, 0) + 1
+            )
+    except Exception:
+        # A failed drain must not leave a half-drained replica looking
+        # routable: retire it — drain_extract already stopped admission,
+        # and whatever work was not re-homed fails loudly with the
+        # raised error rather than silently queueing forever.
+        handle.state = constants.REPLICA_STATE_RETIRED
+        raise
+    # DELETE: the source is empty — stop it and retire.
+    handle.engine.stop()
+    handle.state = constants.REPLICA_STATE_RETIRED
+    return report
+
+
+def migrate_replica(
+    replica_set: ReplicaSet,
+    router: PrefixRouter,
+    replica_id: str,
+    new_engine,
+    start: bool = True,
+) -> Tuple[ReplicaHandle, DrainReport]:
+    """The full move: CREATE `new_engine` as a fresh replica, then drain
+    `replica_id` (its streams re-home prefix-aware across the whole
+    fleet, the fresh replica included — typically absorbing most of
+    them, since it is the least loaded), then retire the source. Returns
+    (new handle, drain report)."""
+    new_handle = replica_set.add(new_engine, start=start)
+    report = drain_replica(replica_set, router, replica_id)
+    return new_handle, report

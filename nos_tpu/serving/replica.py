@@ -1,0 +1,195 @@
+"""ReplicaSet/ReplicaHandle: the registry of the cluster serving plane.
+
+This is the layer ROADMAP item 2 names: the repo's nos half (the
+partitioning planner that carves ICI-contiguous sub-slices) and its
+serving half (DecodeServer + BlockManager + QuotaPolicy) finally touch.
+A `ReplicaSet` owns N serving replicas — in the intended deployment one
+`DecodeServer` per planner-carved sub-slice, in tests and the CPU bench
+N CPU-backed engines — and tracks, per replica:
+
+  - **identity and lifecycle**: a stable id
+    (`constants.REPLICA_ID_PREFIX + ordinal`) and a drain state
+    (`active` -> `draining` -> `retired`, the serving port of the
+    planner's create -> drain -> delete move protocol —
+    nos_tpu/serving/drain.py);
+  - **load**: the engine's `probe()` snapshot (active slots, queued
+    requests, prefill backlog) — plain host reads, no device traffic;
+  - a router-side **shadow of the replica's prefix index**: the chain
+    keys (runtime/block_manager.py `chain_key` sha256 chain) the router
+    believes are resident on that replica. The shadow is updated
+    OPTIMISTICALLY at routing time (the routed prompt's full blocks will
+    index as its prefill dispatches) and reconciled against engine truth
+    (`DecodeServer.prefix_keys()`, again host-side dict reads) on
+    demand. Staleness is safe by construction: a wrong shadow can only
+    misroute, and a misrouted request simply prefills cold — outputs are
+    bit-identical regardless of placement (docs/serving-cluster.md).
+
+Replica construction contract: every engine in one set must share
+`block_size` (router keys and engine keys must agree — enforced here)
+and, for temperature traffic to survive drain/migrate bit-identically,
+the same params/config/sampling seed (a migrated checkpoint keeps its
+serial and PRNG step, which only reproduces the stream on an engine
+sampling from the same base key — documented, not enforced: greedy
+traffic has no such requirement).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.telemetry import ServingReport, collect_serving
+
+
+class ReplicaHandle:
+    """One serving replica: the engine, its router-visible identity and
+    drain state, and the router's shadow of its prefix index. Mutable
+    state (state, shadow, counters) is owned by the router/set layer —
+    the handle itself takes no locks; PrefixRouter serializes mutation
+    under its own lock."""
+
+    def __init__(self, replica_id: str, engine):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.state = constants.REPLICA_STATE_ACTIVE
+        #: Router-side shadow of the replica's content-addressed prefix
+        #: index: chain keys believed resident (device or host tier).
+        self.shadow: set = set()
+        #: Requests the router has placed on this replica (lifetime).
+        self.routed_requests = 0
+
+    @property
+    def admitting(self) -> bool:
+        """Whether the router may place new work here."""
+        return self.state == constants.REPLICA_STATE_ACTIVE
+
+    def probe(self) -> Dict[str, object]:
+        """The engine's load snapshot (constants.PROBE_KEY_*)."""
+        return self.engine.probe()
+
+    def load(self) -> float:
+        """Scalar load estimate for routing penalties, in slot-ish
+        units: active slots + queued requests + prefill backlog scaled
+        by the engine's block size (a 4k-token backlog weighs more than
+        an idle slot's worth of queue depth)."""
+        p = self.probe()
+        backlog = p[constants.PROBE_KEY_PREFILL_BACKLOG]
+        return (
+            p[constants.PROBE_KEY_ACTIVE_SLOTS]
+            + p[constants.PROBE_KEY_QUEUED_REQUESTS]
+            + backlog / max(1, self.engine.block_size)
+        )
+
+    def shadow_hit_blocks(self, keys: List[str]) -> int:
+        """Longest leading run of `keys` present in the shadow — the
+        router's prediction of the prefix blocks this replica would
+        serve from cache."""
+        hit = 0
+        for key in keys:
+            if key not in self.shadow:
+                break
+            hit += 1
+        return hit
+
+    def note_routed(self, keys: Iterable[str]) -> None:
+        """Optimistic shadow update at routing time: the routed prompt's
+        full blocks will be indexed as its prefill dispatches."""
+        self.shadow.update(keys)
+        self.routed_requests += 1
+
+    def reconcile_shadow(self) -> None:
+        """Replace the shadow with engine truth (device index + host
+        tier). Host-side reads only — the 'no new device traffic'
+        contract of the shadow design."""
+        self.shadow = set(self.engine.prefix_keys())
+
+    def snapshot(self) -> Dict[str, object]:
+        """Wire-format view of the replica for fleet telemetry."""
+        return {
+            constants.REPLICA_KEY_ID: self.replica_id,
+            constants.REPLICA_KEY_STATE: self.state,
+            constants.REPLICA_KEY_SHADOW_KEYS: len(self.shadow),
+            constants.REPLICA_KEY_ROUTED_REQUESTS: self.routed_requests,
+            **self.probe(),
+        }
+
+
+class ReplicaSet:
+    """Owns N serving replicas. Construction validates the cross-replica
+    contract (equal block sizes — the router computes ONE key chain per
+    prompt); `start=True` spins each engine's loop thread, `start=False`
+    leaves them for deterministic manual ticking (tests)."""
+
+    def __init__(self, engines: Iterable, start: bool = False):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("ReplicaSet needs at least one engine")
+        sizes = {e.block_size for e in engines}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"replicas must share one block_size (router keys and "
+                f"engine keys agree by construction), got {sorted(sizes)}"
+            )
+        self.block_size = engines[0].block_size
+        self._next_ordinal = 0
+        self.handles: List[ReplicaHandle] = []
+        for engine in engines:
+            self._add_handle(engine)
+        if start:
+            for h in self.handles:
+                h.engine.start()
+
+    def _add_handle(self, engine) -> ReplicaHandle:
+        handle = ReplicaHandle(
+            f"{constants.REPLICA_ID_PREFIX}{self._next_ordinal}", engine
+        )
+        self._next_ordinal += 1
+        self.handles.append(handle)
+        return handle
+
+    # -- registry -------------------------------------------------------------
+    def get(self, replica_id: str) -> ReplicaHandle:
+        for h in self.handles:
+            if h.replica_id == replica_id:
+                return h
+        raise KeyError(f"no such replica: {replica_id}")
+
+    def active_handles(self) -> List[ReplicaHandle]:
+        return [h for h in self.handles if h.admitting]
+
+    def add(self, engine, start: bool = False) -> ReplicaHandle:
+        """Register a new replica (the CREATE step of the move protocol:
+        grow the fleet first, then drain the source into it)."""
+        if engine.block_size != self.block_size:
+            raise ValueError(
+                f"new replica block_size {engine.block_size} != fleet "
+                f"block_size {self.block_size}"
+            )
+        handle = self._add_handle(engine)
+        if start:
+            engine.start()
+        return handle
+
+    # -- fleet telemetry ------------------------------------------------------
+    def fleet_report(self) -> ServingReport:
+        """One merged ServingReport over every non-retired replica:
+        counters summed, latency percentiles re-derived from pooled raw
+        samples (telemetry.ServingReport.merge)."""
+        return ServingReport.merge(
+            collect_serving(h.engine)
+            for h in self.handles
+            if h.state != constants.REPLICA_STATE_RETIRED
+        )
+
+    def snapshot(self) -> List[Dict[str, object]]:
+        """Per-replica wire-format rows (id, state, load, shadow size)."""
+        return [h.snapshot() for h in self.handles]
+
+    # -- lifecycle ------------------------------------------------------------
+    def stop(self, drain: bool = False, drain_timeout_s: Optional[float] = None):
+        """Stop every non-retired replica (drain=True: gracefully)."""
+        for h in self.handles:
+            if h.state == constants.REPLICA_STATE_RETIRED:
+                continue
+            h.engine.stop(drain=drain, drain_timeout_s=drain_timeout_s)
+            h.state = constants.REPLICA_STATE_RETIRED

@@ -1,0 +1,205 @@
+"""PrefixRouter: cache-aware load balancing over a ReplicaSet.
+
+SGLang-style cache-aware routing on top of PR 5's content-addressed
+prefix index: `submit(prompt, tenant=...)` hashes the prompt's full-block
+chain with the SAME sha256 chain-key scheme the engines index under
+(runtime/block_manager.py `prompt_chain_keys` — one function, imported by
+both sides, so router keys and engine keys agree by construction) and
+scores every admitting replica by
+
+    score = shadow_hit_blocks x block_size - load_penalty_tokens x load
+
+i.e. the prefix tokens the replica is predicted to serve from cache,
+minus a load penalty in the same token currency (`load` is the replica's
+probe snapshot: active slots + queued requests + backlog blocks). The
+argmax wins; exact ties rotate round-robin, which also makes the
+no-cache-signal case (cold fleet, disjoint traffic) degrade to plain
+round-robin load balancing. `policy="round_robin"` disables the scoring
+entirely — the bench A/B baseline.
+
+Per-tenant STICKINESS (default on): the first request of a named tenant
+is placed by score and the tenant is pinned to that replica while it
+keeps admitting. Two reasons: (a) a tenant's traffic is exactly the
+traffic that shares its system prompt, so stickiness IS prefix locality
+after the first request; (b) QuotaPolicy accounting is per-engine —
+splitting one tenant's stream across replicas would let it borrow N
+ceilings' worth of capacity and make every replica's usage window a
+partial, incoherent view. A drained/retired replica's pins dissolve:
+the next request re-scores and re-pins.
+
+Correctness is placement-independent by construction: every replica runs
+the same bit-exact engine, a shadow miss or misroute only means a cold
+prefill (performance, never output bytes). The router therefore treats
+its shadow as advisory and never blocks on engine state
+(docs/serving-cluster.md's staleness argument).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from nos_tpu import constants
+from nos_tpu.runtime.block_manager import prompt_chain_keys
+from nos_tpu.serving.replica import ReplicaHandle, ReplicaSet
+
+
+class PrefixRouter:
+    """The cluster front end: clients submit here; replicas serve.
+
+    Thread-safe: placement state (round-robin cursor, tenant pins,
+    shadows, counters) mutates only under `self._lock`; the chosen
+    engine's own queue is the cross-thread boundary for the request
+    itself."""
+
+    def __init__(
+        self,
+        replica_set: ReplicaSet,
+        policy: str = constants.ROUTER_POLICY_PREFIX,
+        load_penalty_tokens: Optional[float] = None,
+        sticky_tenants: bool = True,
+    ):
+        """`load_penalty_tokens` prices one unit of replica load (an
+        active slot / queued request) in prefix-hit tokens; default =
+        one block. Higher values favor balance over cache locality."""
+        if policy not in constants.ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown router policy {policy!r}; "
+                f"expected one of {constants.ROUTER_POLICIES}"
+            )
+        self.replica_set = replica_set
+        self.policy = policy
+        self.block_size = replica_set.block_size
+        self.load_penalty_tokens = float(
+            load_penalty_tokens
+            if load_penalty_tokens is not None
+            else self.block_size
+        )
+        self.sticky_tenants = bool(sticky_tenants)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._sticky: Dict[str, str] = {}  # tenant -> replica_id
+        # Router counters (fleet telemetry; counts only).
+        self.routed_requests = 0
+        self.prefix_routed = 0  # placements won by a shadow-hit score
+        self.sticky_routed = 0  # placements decided by a tenant pin
+        self.rr_routed = 0  # pure rotation (round_robin policy or no signal)
+        self.predicted_hit_tokens = 0
+
+    # -- client side ----------------------------------------------------------
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new: int = 16,
+        tenant: Optional[str] = None,
+    ) -> Future:
+        """Route one request and submit it to the chosen replica's
+        engine. Returns that engine's Future — the client never sees
+        which replica served it."""
+        handle = self.select(prompt, tenant=tenant)
+        return handle.engine.submit(prompt, max_new, tenant=tenant)
+
+    def select(
+        self,
+        prompt: Sequence[int],
+        tenant: Optional[str] = None,
+        exclude: Optional[ReplicaHandle] = None,
+    ) -> ReplicaHandle:
+        """Pick (and account) the destination replica for `prompt`
+        without submitting — the placement half of `submit`, also used
+        by the drain controller to re-home extracted work (`exclude`
+        masks the draining source even before its state flips)."""
+        with self._lock:
+            handle, keys, hit = self._select_locked(prompt, tenant, exclude)
+            handle.note_routed(keys)
+            self.routed_requests += 1
+            self.predicted_hit_tokens += hit * self.block_size
+            if self.sticky_tenants and tenant is not None:
+                self._sticky[tenant] = handle.replica_id
+            return handle
+
+    # -- placement ------------------------------------------------------------
+    def _candidates(self, exclude: Optional[ReplicaHandle]) -> List[ReplicaHandle]:
+        active = [
+            h
+            for h in self.replica_set.handles
+            if h.admitting and h is not exclude
+        ]
+        if not active:
+            raise RuntimeError(
+                "no admitting replica (all draining/retired): cannot route"
+            )
+        return active
+
+    def _select_locked(
+        self,
+        prompt: Sequence[int],
+        tenant: Optional[str],
+        exclude: Optional[ReplicaHandle],
+    ) -> Tuple[ReplicaHandle, List[str], int]:
+        """Returns (handle, the prompt's cacheable chain keys, predicted
+        hit blocks). Caller holds the lock."""
+        active = self._candidates(exclude)
+        # Same below-the-last-token cap admission applies: the final
+        # block is always recomputed privately, so it can never hit.
+        cap = max(0, (len(prompt) - 1) // self.block_size)
+        keys = prompt_chain_keys(prompt, self.block_size)[:cap]
+        if self.policy == constants.ROUTER_POLICY_ROUND_ROBIN:
+            handle = active[self._rr % len(active)]
+            self._rr += 1
+            self.rr_routed += 1
+            return handle, keys, handle.shadow_hit_blocks(keys)
+        if self.sticky_tenants and tenant is not None:
+            pinned = self._sticky.get(tenant)
+            if pinned is not None:
+                for h in active:
+                    if h.replica_id == pinned:
+                        self.sticky_routed += 1
+                        return h, keys, h.shadow_hit_blocks(keys)
+                # Pin points at a draining/retired replica: dissolve it
+                # and fall through to a fresh scored placement.
+                del self._sticky[tenant]
+        scored = [
+            (
+                h.shadow_hit_blocks(keys) * self.block_size
+                - self.load_penalty_tokens * h.load(),
+                h,
+            )
+            for h in active
+        ]
+        best = max(score for score, _ in scored)
+        ties = [h for score, h in scored if score == best]
+        handle = ties[self._rr % len(ties)]
+        self._rr += 1
+        hit = handle.shadow_hit_blocks(keys)
+        if hit > 0:
+            self.prefix_routed += 1
+        else:
+            self.rr_routed += 1
+        return handle, keys, hit
+
+    # -- shadow maintenance ---------------------------------------------------
+    def reconcile(self) -> None:
+        """Replace every admitting replica's shadow with engine truth
+        (device index + host tier — host-side reads, no device
+        traffic). Optimistic routing entries for work that was evicted,
+        spilled away, or never finished prefilling are corrected here;
+        between reconciles, staleness costs routing quality only."""
+        with self._lock:
+            for h in self.replica_set.active_handles():
+                h.reconcile_shadow()
+
+    # -- telemetry ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """Router counters + per-replica rows, wire-format."""
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "routed_requests": self.routed_requests,
+                "prefix_routed": self.prefix_routed,
+                "sticky_routed": self.sticky_routed,
+                "rr_routed": self.rr_routed,
+                "predicted_hit_tokens": self.predicted_hit_tokens,
+                "replicas": self.replica_set.snapshot(),
+            }

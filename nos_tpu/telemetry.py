@@ -19,8 +19,8 @@ from __future__ import annotations
 
 import json
 import logging
-from dataclasses import asdict, dataclass, field
-from typing import Callable, Dict, Optional
+from dataclasses import asdict, dataclass, field, fields
+from typing import Callable, Dict, Iterable, List, Optional
 
 import nos_tpu
 from nos_tpu import constants
@@ -157,6 +157,49 @@ class ServingReport:
     inflight_dispatches: int = 0
     pending_verifies: int = 0
     waiting_requests: int = 0
+    # Fleet aggregation (nos_tpu/serving/): how many engine snapshots
+    # this report summarizes (1 for a single engine), and the RAW
+    # latency samples backing the percentiles (seconds — counts only,
+    # never request content). Carried so `merge` can POOL samples across
+    # replicas and re-derive fleet percentiles: averaging per-replica
+    # p95s weights a one-request replica like a thousand-request one
+    # and has no statistical meaning for tails (pinned by the
+    # pooled-vs-averaged divergence test).
+    replicas: int = 1
+    ttft_samples: List[float] = field(default_factory=list)
+    queue_wait_samples: List[float] = field(default_factory=list)
+    restore_latency_samples: List[float] = field(default_factory=list)
+
+    @staticmethod
+    def merge(reports: Iterable["ServingReport"]) -> "ServingReport":
+        """Fleet-level aggregation of per-replica reports: integer
+        counters/gauges SUM (pool-state gauges sum to the fleet's pool),
+        per-slot maps re-key as "<replica index>:<slot>", raw latency
+        samples concatenate, and every percentile field is RE-DERIVED
+        from the pooled samples — never averaged across replicas. A
+        report built without samples (hand-constructed, or a foreign
+        snapshot) contributes its counters but no tail information; the
+        pooled percentiles are 0.0 when no samples exist at all."""
+        merged = ServingReport(replicas=0)
+        for i, rep in enumerate(reports):
+            for f in fields(ServingReport):
+                cur = getattr(merged, f.name)
+                val = getattr(rep, f.name)
+                if f.name.endswith("_samples"):
+                    cur.extend(float(v) for v in val)
+                elif f.name in ("macro_tokens_by_slot", "spec_rounds_by_slot"):
+                    for slot, n in val.items():
+                        cur[f"{i}:{slot}"] = int(n)
+                elif isinstance(cur, int):
+                    setattr(merged, f.name, cur + int(val))
+        for prefix, samples in (
+            ("ttft", merged.ttft_samples),
+            ("queue_wait", merged.queue_wait_samples),
+            ("restore_latency", merged.restore_latency_samples),
+        ):
+            setattr(merged, f"{prefix}_p50_s", percentile(samples, 50))
+            setattr(merged, f"{prefix}_p95_s", percentile(samples, 95))
+        return merged
 
 
 def percentile(samples, q: float) -> float:
@@ -210,6 +253,9 @@ def collect_serving(server) -> ServingReport:
         ttft_p95_s=percentile(ttft, 95),
         queue_wait_p50_s=percentile(queue_wait, 50),
         queue_wait_p95_s=percentile(queue_wait, 95),
+        ttft_samples=[float(v) for v in ttft],
+        queue_wait_samples=[float(v) for v in queue_wait],
+        restore_latency_samples=[float(v) for v in restore],
         inflight_dispatches=len(getattr(server, "_inflight", ())),
         pending_verifies=len(getattr(server, "_pending_verifies", ())),
         waiting_requests=len(getattr(server, "_waiting", ())),

@@ -41,6 +41,55 @@ if not os.environ.get("NOS_TPU_TEST_ON_TPU"):
         pass  # older jax without the persistent-cache knobs
 
 
+# -- shared tiny serving-engine model -----------------------------------------
+# One model config + one params init for every serving-engine test module
+# (test_quota_serving, test_serving_faults, test_serving_cluster): the
+# per-file copies used to re-run init_gpt per module and invite config
+# drift between files whose exactness oracles assume THE SAME model.
+# float32 deliberately: the oracles cross program shapes (macro step vs
+# prefill chunk vs verify window), where the tiny random bf16 models'
+# one-ulp rounding splits would test luck, not the machinery.
+
+
+def serving_test_config():
+    """The shared tiny serving-engine GPTConfig (importable constant-in-
+    function: conftest must not import jax/models at collection time)."""
+    from nos_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(
+        vocab=97, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=128,
+        dtype="float32",
+    )
+
+
+def _serving_params():
+    import jax
+
+    from nos_tpu.models.gpt import init_gpt
+
+    return init_gpt(jax.random.PRNGKey(0), serving_test_config())
+
+
+_SERVING_PARAMS_CACHE = []
+
+
+def serving_test_params():
+    """Session-cached params for `serving_test_config()` — one init_gpt
+    for the whole run, shared by the `serving_params` fixture and any
+    helper that needs the weights outside a fixture context."""
+    if not _SERVING_PARAMS_CACHE:
+        _SERVING_PARAMS_CACHE.append(_serving_params())
+    return _SERVING_PARAMS_CACHE[0]
+
+
+import pytest  # noqa: E402  (after the platform setup above, by design)
+
+
+@pytest.fixture(scope="session")
+def serving_params():
+    return serving_test_params()
+
+
 # -- multi-device gating ------------------------------------------------------
 # Modules whose tests construct multi-device meshes (dp/tp/sp/pp/ep, the
 # virtual 8-device CPU fabric) declare `pytestmark = pytest.mark.multidevice`

@@ -328,6 +328,89 @@ def test_reset_keeps_host_tier_for_replays():
     check_invariants(mgr)
 
 
+# -- peek_prefix: the router's read-only probe (ISSUE 8 satellite) ------------
+def test_peek_prefix_walks_device_then_host_with_the_admission_cap():
+    """The probe reports what admission WOULD take: leading device-index
+    blocks, then the contiguous host-tier continuation, both capped
+    below the prompt's last-token block."""
+    mgr, tier = mk_spilling(total=1 + 8)
+    donor = list(range(12))  # 3 full blocks
+    mgr.admit(0, donor, 3)
+    mgr.note_progress(0, 12)
+    mgr.release(0)
+    keys = mgr.prompt_keys(donor)
+    # All three resident on device; cap excludes the last-token block of
+    # an exact-multiple prompt.
+    assert mgr.peek_prefix(donor) == (2, 0)
+    assert mgr.peek_prefix(donor + [99]) == (3, 0)  # tail token lifts the cap
+    assert mgr.peek_prefix([99] + donor) == (0, 0)  # different chain: miss
+    assert mgr.peek_prefix(donor[:3]) == (0, 0)  # no full block at all
+    # Spill block 3 (LRU says blocks 1,2 first — so spill ALL, then
+    # restore 1,2 to device by re-admitting): simpler — move everything
+    # to host via a spill-release and check the host walk.
+    mgr2, tier2 = mk_spilling(total=1 + 8)
+    mgr2.admit(0, donor, 3)
+    mgr2.note_progress(0, 12)
+    mgr2.release(0, spill=True)
+    assert mgr2.peek_prefix(donor + [99]) == (0, 3)
+    # Mixed: re-admit (revive targets are fresh blocks, device index
+    # repopulates as note_progress advances).
+    blocks, _ = mgr2.admit(1, donor, 3)
+    mgr2.note_progress(1, 4)  # first block re-indexed on device
+    dev, host = mgr2.peek_prefix(donor + [99])
+    assert dev == 1  # device run first...
+    assert host >= 1  # ...then its host continuation
+
+
+def test_peek_prefix_never_revives_or_reorders_the_lru():
+    """THE probe property: peeking must not change refcounts, the
+    cached-free LRU's membership OR order, the host tier's recency, or
+    any counter — a router probing a replica's cache must not perturb
+    which block the next allocation evicts."""
+    mgr, tier = mk_spilling(total=1 + 8)
+    pa, pb = [1] * 8, [2] * 8
+    mgr.admit(0, pa, 2)
+    mgr.note_progress(0, 8)
+    mgr.release(0)
+    mgr.admit(0, pb, 2)
+    mgr.note_progress(0, 8)
+    mgr.release(0)  # LRU: A1, A2, B1, B2 — A's are the next casualties
+    before_lru = list(mgr._cached_free.items())
+    before_rc = list(mgr._refcount)
+    before_counts = mgr.counts()
+    before_counters = (mgr.lookups, mgr.hit_blocks, mgr.hit_tokens,
+                       mgr.evictions, mgr.spill_hit_blocks)
+    before_tier = (tier.spills, tier.revives, tier.drops, list(tier.keys()))
+    for prompt in (pa, pb, pa + [9], [7] * 12):
+        mgr.peek_prefix(prompt)
+    assert list(mgr._cached_free.items()) == before_lru
+    assert list(mgr._refcount) == before_rc
+    assert mgr.counts() == before_counts
+    assert (mgr.lookups, mgr.hit_blocks, mgr.hit_tokens,
+            mgr.evictions, mgr.spill_hit_blocks) == before_counters
+    assert (tier.spills, tier.revives, tier.drops, list(tier.keys())) == before_tier
+    check_invariants(mgr)
+    # And the next eviction takes the block the PRE-probe LRU order
+    # named: A's first block, untouched by the probes above.
+    a_keys = mgr.prompt_keys(pa)
+    mgr.admit(1, [3] * 29, 8)  # drains free (4) + evicts 4, oldest first
+    assert not any(k in mgr._prefix_index for k in a_keys)
+    check_invariants(mgr)
+
+
+def test_index_keys_snapshots_device_and_host():
+    mgr, tier = mk_spilling(total=1 + 6)
+    donor = list(range(8))
+    mgr.admit(0, donor, 2)
+    mgr.note_progress(0, 8)
+    keys = set(mgr.prompt_keys(donor))
+    assert mgr.index_keys() == frozenset(keys)
+    mgr.release(0, spill=True)  # both keyed blocks move to host
+    assert mgr.index_keys() == frozenset(keys)  # host keys still resident
+    mgr.reset()
+    assert mgr.index_keys() == frozenset(keys)  # tier survives device reset
+
+
 # -- the randomized invariant satellite ---------------------------------------
 def test_randomized_interleaving_preserves_invariants():
     """ISSUE 5 satellite, extended by ISSUE 6 and ISSUE 7: after ANY

@@ -1,0 +1,443 @@
+"""Cluster serving plane (ISSUE 8 tentpole): ReplicaSet/ReplicaHandle,
+the prefix-aware PrefixRouter, replica drain/migrate, and fleet
+telemetry aggregation.
+
+The correctness bar is placement-independence: every replica runs the
+same bit-exact engine, so outputs must be IDENTICAL whichever routing
+policy placed them (misroutes cost performance, never bytes), and a
+mid-decode drain must re-home streams that finish bit-identically to an
+undrained run — greedy AND temperature (the migrated checkpoint keeps
+its sampling serial and PRNG step offset; the fleet shares one seed).
+Manual ticking throughout for determinism (the same `drive` idiom as
+test_quota_serving); threaded engines only where the satellite under
+test is the thread lifecycle itself (stop(drain=True))."""
+
+import jax
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.serving import (
+    PrefixRouter,
+    ReplicaSet,
+    drain_replica,
+    migrate_replica,
+)
+from nos_tpu.telemetry import ServingReport, collect_serving, percentile
+from tests.conftest import serving_test_config
+from tests.test_block_manager import check_invariants
+
+CFG = serving_test_config()
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="placement/replay bit-exactness crosses program shapes: needs "
+    "the deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+def make_engine(params, **kw):
+    defaults = dict(
+        n_slots=2, max_len=64, prompt_buckets=(8, 16), block_size=8, seed=11
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, **defaults)
+
+
+def make_fleet(params, n=2, **kw):
+    return ReplicaSet([make_engine(params, **kw) for _ in range(n)])
+
+
+def drive_fleet(rs, pred, n=600):
+    """Deterministic manual ticking across every active, non-started
+    replica (round-robin, one tick each per wave)."""
+    for _ in range(n):
+        for h in rs.handles:
+            if (
+                h.state == constants.REPLICA_STATE_ACTIVE
+                and h.engine._thread is None
+            ):
+                h.engine._tick()
+        if pred():
+            return True
+    return False
+
+
+PROMPTS = {
+    "a": [4, 9, 2, 33, 7, 1, 8, 5],
+    "b": [40, 41, 42, 43, 44, 45, 46, 47],
+    "c": [9, 8, 7, 6, 5, 4, 3, 2],
+}
+
+
+# -- registry / construction ---------------------------------------------------
+def test_replica_set_validates_block_sizes(params):
+    with pytest.raises(ValueError, match="block_size"):
+        ReplicaSet(
+            [make_engine(params, block_size=8), make_engine(params, block_size=16)]
+        )
+    with pytest.raises(ValueError, match="at least one"):
+        ReplicaSet([])
+    rs = make_fleet(params, n=2)
+    with pytest.raises(ValueError, match="block_size"):
+        rs.add(make_engine(params, block_size=16))
+
+
+def test_router_rejects_unknown_policy(params):
+    rs = make_fleet(params, n=2)
+    with pytest.raises(ValueError, match="policy"):
+        PrefixRouter(rs, policy="coin-flip")
+
+
+def test_replica_ids_and_states_use_the_wire_constants(params):
+    rs = make_fleet(params, n=2)
+    assert [h.replica_id for h in rs.handles] == [
+        f"{constants.REPLICA_ID_PREFIX}0",
+        f"{constants.REPLICA_ID_PREFIX}1",
+    ]
+    rows = rs.snapshot()
+    assert all(
+        r[constants.REPLICA_KEY_STATE] == constants.REPLICA_STATE_ACTIVE
+        for r in rows
+    )
+    assert constants.PROBE_KEY_ACTIVE_SLOTS in rows[0]
+
+
+# -- routing -------------------------------------------------------------------
+def test_round_robin_policy_rotates(params):
+    rs = make_fleet(params, n=3)
+    router = PrefixRouter(rs, policy=constants.ROUTER_POLICY_ROUND_ROBIN)
+    picks = [
+        router.select(PROMPTS["a"], tenant=None).replica_id for _ in range(6)
+    ]
+    assert picks == [
+        "replica-0", "replica-1", "replica-2",
+        "replica-0", "replica-1", "replica-2",
+    ]
+    assert router.rr_routed == 6 and router.prefix_routed == 0
+
+
+@cpu_only
+def test_prefix_routing_follows_the_shadow(params):
+    """Same-prefix traffic lands where the prefix lives: the first
+    request seeds replica-0's shadow optimistically at routing time, so
+    the second scores a hit there even while the fleet is otherwise
+    balanced."""
+    rs = make_fleet(params, n=2)
+    router = PrefixRouter(rs)
+    donor = [((i * 5) % 91) + 1 for i in range(24)]  # 3 full blocks
+    f1 = router.submit(donor, max_new=4)
+    assert drive_fleet(rs, f1.done)
+    f2 = router.submit(donor, max_new=4)
+    assert drive_fleet(rs, f2.done)
+    assert f1.result(1) == f2.result(1)
+    assert rs.handles[0].routed_requests == 2  # both on the shadow holder
+    assert router.prefix_routed >= 1
+    assert router.predicted_hit_tokens > 0
+    # The prediction came true on the engine: the second admission hit.
+    assert rs.handles[0].engine.prefix_hit_blocks >= 2
+
+
+def test_load_penalty_spills_cold_traffic_over(params):
+    """With no cache signal, scoring degrades to load balancing: a
+    loaded replica loses to an idle one."""
+    rs = make_fleet(params, n=2)
+    router = PrefixRouter(rs)
+    first = router.select(PROMPTS["a"])
+    second = router.select(PROMPTS["b"])  # different chain, no hit
+    assert first.replica_id != second.replica_id
+
+
+def test_sticky_tenant_pins_and_repins_after_drain(params):
+    rs = make_fleet(params, n=2)
+    router = PrefixRouter(rs)
+    h1 = router.select(PROMPTS["a"], tenant="t")
+    h2 = router.select(PROMPTS["b"], tenant="t")  # no shared prefix...
+    assert h2 is h1  # ...but the pin holds (quota coherence)
+    assert router.sticky_routed == 1
+    # The pin dissolves when its replica stops admitting.
+    h1.state = constants.REPLICA_STATE_DRAINING
+    h3 = router.select(PROMPTS["c"], tenant="t")
+    assert h3 is not h1 and h3.admitting
+
+
+def test_router_raises_when_no_replica_admits(params):
+    rs = make_fleet(params, n=1)
+    rs.handles[0].state = constants.REPLICA_STATE_RETIRED
+    router = PrefixRouter(rs)
+    with pytest.raises(RuntimeError, match="no admitting replica"):
+        router.select(PROMPTS["a"])
+
+
+def test_reconcile_replaces_optimistic_shadow_with_engine_truth(params):
+    rs = make_fleet(params, n=2)
+    router = PrefixRouter(rs)
+    donor = [((i * 5) % 91) + 1 for i in range(24)]
+    f = router.submit(donor, max_new=4)
+    assert drive_fleet(rs, f.done)
+    holder = rs.handles[0]
+    holder.shadow.add("bogus-key-that-was-never-indexed")
+    router.reconcile()
+    assert holder.shadow == set(holder.engine.prefix_keys())
+    assert "bogus-key-that-was-never-indexed" not in holder.shadow
+
+
+# -- the placement-independence oracle -----------------------------------------
+@cpu_only
+def test_outputs_bit_identical_across_routing_policies(params):
+    """THE acceptance oracle in tiny form: a skewed multi-tenant trace
+    with shared per-tenant system prompts, served twice — prefix-aware
+    vs round-robin. Outputs must be bit-identical (placement changes
+    WHERE work runs, never what it computes); the prefix policy must win
+    on aggregate cache hits."""
+    sys_a = [((i * 5) % 91) + 1 for i in range(16)]
+    sys_b = [((i * 7) % 91) + 2 for i in range(16)]
+    # Two phases, the bench scenario's shape: one populator request per
+    # tenant runs to completion (the deployed-system-prompt-is-warm
+    # case), then the tenants' remaining traffic arrives together.
+    warm = [("a", sys_a + [60]), ("b", sys_b + [70])]
+    burst = [
+        ("a", sys_a + [61]), ("a", sys_a + [62]),
+        ("b", sys_b + [71]), ("b", sys_b + [72]),
+    ]
+
+    def run(policy):
+        rs = make_fleet(params, n=2, total_blocks=1 + 16)
+        router = PrefixRouter(rs, policy=policy)
+        outs = []
+        for t, p in warm:
+            f = router.submit(p, max_new=4, tenant=t)
+            assert drive_fleet(rs, f.done)
+            outs.append(f.result(1))
+        futs = [router.submit(p, max_new=4, tenant=t) for t, p in burst]
+        assert drive_fleet(rs, lambda: all(f.done() for f in futs))
+        outs.extend(f.result(1) for f in futs)
+        report = rs.fleet_report()
+        for h in rs.handles:
+            assert h.engine._block_mgr.conserved()
+            check_invariants(h.engine._block_mgr)
+        return outs, report
+
+    outs_prefix, rep_prefix = run(constants.ROUTER_POLICY_PREFIX)
+    outs_rr, rep_rr = run(constants.ROUTER_POLICY_ROUND_ROBIN)
+    assert outs_prefix == outs_rr  # bit-identical across policies
+    # Aggregate fleet hit rate: prefix-aware routing reuses each
+    # tenant's system prompt; round-robin recomputes it across replicas.
+    assert rep_prefix.prefix_hit_blocks > rep_rr.prefix_hit_blocks
+    assert rep_prefix.prefill_tokens < rep_rr.prefill_tokens
+    assert rep_prefix.replicas == rep_rr.replicas == 2
+
+
+# -- drain / migrate -----------------------------------------------------------
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_drain_rehomes_mid_decode_streams_bit_identical(params, temperature):
+    """THE drain oracle: a replica drained mid-decode re-homes its
+    streams through the router and every stream finishes bit-identically
+    to the undrained fleet — greedy and temperature (checkpoint keeps
+    serial + PRNG step; the fleet shares params/config/seed). Pool
+    conservation holds on source and destination."""
+    prompts = [PROMPTS["a"], PROMPTS["b"], PROMPTS["c"]]
+
+    def submit_all(rs, router):
+        return [router.submit(p, max_new=10) for p in prompts]
+
+    # Undrained reference: same fleet shape, same deterministic routing.
+    rs_ref = make_fleet(params, n=2, temperature=temperature)
+    futs = submit_all(rs_ref, PrefixRouter(rs_ref))
+    assert drive_fleet(rs_ref, lambda: all(f.done() for f in futs))
+    want = [f.result(1) for f in futs]
+    rs_ref.stop()
+
+    rs = make_fleet(params, n=2, temperature=temperature)
+    router = PrefixRouter(rs)
+    futs = submit_all(rs, router)
+    src = rs.handles[0].engine
+    assert drive_fleet(
+        rs,
+        lambda: any(
+            s.active and s.phase == "decoding" and 2 <= len(s.refs) < 10
+            for s in src._slots
+        ),
+        n=64,
+    )
+    report = drain_replica(rs, router, "replica-0")
+    assert report.slots_migrated >= 1
+    assert rs.handles[0].state == constants.REPLICA_STATE_RETIRED
+    assert src._block_mgr.conserved()  # source released everything
+    check_invariants(src._block_mgr)
+    assert drive_fleet(rs, lambda: all(f.done() for f in futs))
+    got = [f.result(1) for f in futs]
+    assert got == want  # bit-identical, greedy AND temperature
+    dst = rs.handles[1].engine
+    assert dst._block_mgr.conserved()
+    check_invariants(dst._block_mgr)
+    # The re-homed streams billed replay work on the destination.
+    assert dst.replay_tokens > 0 or report.slots_migrated == 0
+    rs.stop()
+
+
+@cpu_only
+def test_drain_preserves_queued_request_futures(params):
+    """Requests still WAITING (never admitted) migrate with their client
+    Futures intact — the client blocked in result() never notices."""
+    rs = make_fleet(params, n=2, n_slots=1)
+    router = PrefixRouter(rs)
+    # Sticky tenant: all three land on one replica; one admits, two wait.
+    futs = [
+        router.submit(PROMPTS[k], max_new=6, tenant="t") for k in ("a", "b", "c")
+    ]
+    pinned = router.select(PROMPTS["a"], tenant="t")  # resolve the pin
+    assert drive_fleet(
+        rs, lambda: any(s.active for s in pinned.engine._slots), n=64
+    )
+    report = drain_replica(rs, router, pinned.replica_id)
+    assert report.slots_migrated + report.requests_migrated == 3
+    assert report.requests_migrated >= 1
+    assert drive_fleet(rs, lambda: all(f.done() for f in futs))
+    assert all(len(f.result(1)) == 6 for f in futs)
+    rs.stop()
+
+
+def test_drain_refuses_without_a_destination(params):
+    rs = make_fleet(params, n=1)
+    router = PrefixRouter(rs)
+    fut = router.submit(PROMPTS["a"], max_new=4)
+    with pytest.raises(RuntimeError, match="no admitting replica"):
+        drain_replica(rs, router, "replica-0")
+    # The refusal left the replica routable and the request servable.
+    assert rs.handles[0].state == constants.REPLICA_STATE_ACTIVE
+    assert drive_fleet(rs, fut.done)
+    assert fut.result(1)
+    rs.stop()
+
+
+@cpu_only
+def test_migrate_replica_is_create_then_drain_then_delete(params):
+    """The full move protocol: the fresh replica registers FIRST, then
+    the source drains into the fleet (the new, idle replica absorbs the
+    streams), then the source retires."""
+    rs = make_fleet(params, n=1)
+    router = PrefixRouter(rs)
+    futs = [router.submit(PROMPTS[k], max_new=8) for k in ("a", "b")]
+    src = rs.handles[0].engine
+    assert drive_fleet(rs, lambda: any(s.active for s in src._slots), n=64)
+    new_handle, report = migrate_replica(
+        rs, router, "replica-0", make_engine(params), start=False
+    )
+    assert new_handle.replica_id == "replica-1"
+    assert rs.handles[0].state == constants.REPLICA_STATE_RETIRED
+    assert new_handle.state == constants.REPLICA_STATE_ACTIVE
+    assert set(report.destinations) == {"replica-1"}
+    assert drive_fleet(rs, lambda: all(f.done() for f in futs))
+    assert all(f.result(1) for f in futs)
+    rs.stop()
+
+
+# -- DecodeServer.stop(drain=True) satellite -----------------------------------
+@cpu_only
+def test_stop_drain_finishes_queued_and_inflight(params):
+    """Graceful engine drain: queued + in-flight requests all complete
+    before the loop exits — nothing is failed."""
+    server = make_engine(params, n_slots=1).start()
+    futs = [server.submit(PROMPTS[k], max_new=6) for k in ("a", "b", "c")]
+    server.stop(drain=True, drain_timeout_s=120)
+    assert all(f.done() and not f.exception() for f in futs)
+    assert all(len(f.result(0)) == 6 for f in futs)
+
+
+@cpu_only
+def test_stop_drain_ticks_inline_on_a_manual_engine(params):
+    server = make_engine(params, n_slots=1)  # never start()ed
+    futs = [server.submit(PROMPTS[k], max_new=4) for k in ("a", "b")]
+    server.stop(drain=True, drain_timeout_s=120)
+    assert all(len(f.result(0)) == 4 for f in futs)
+
+
+def test_submit_after_stop_raises_instead_of_stranding(params):
+    server = make_engine(params).start()
+    server.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        server.submit(PROMPTS["a"], max_new=4)
+    with pytest.raises(RuntimeError, match="stopped"):
+        server.transfer_in_request(PROMPTS["a"], max_new=4)
+    # Drained engines refuse identically.
+    drained = make_engine(params)
+    drained.stop(drain=True, drain_timeout_s=10)
+    with pytest.raises(RuntimeError, match="stopped"):
+        drained.submit(PROMPTS["a"], max_new=4)
+
+
+# -- fleet telemetry: ServingReport.merge satellite ----------------------------
+def test_merge_sums_counters_and_rekeys_slot_maps():
+    r0 = ServingReport(
+        steps_run=10, prefill_tokens=100, prefix_hit_blocks=4,
+        kv_blocks_free=7, macro_tokens_by_slot={"0": 5, "1": 3},
+    )
+    r1 = ServingReport(
+        steps_run=32, prefill_tokens=50, prefix_hit_blocks=1,
+        kv_blocks_free=2, macro_tokens_by_slot={"0": 9},
+    )
+    m = ServingReport.merge([r0, r1])
+    assert m.steps_run == 42
+    assert m.prefill_tokens == 150
+    assert m.prefix_hit_blocks == 5
+    assert m.kv_blocks_free == 9  # fleet pool gauge
+    assert m.replicas == 2
+    assert m.macro_tokens_by_slot == {"0:0": 5, "0:1": 3, "1:0": 9}
+
+
+def test_merge_pools_percentiles_instead_of_averaging():
+    """THE satellite's point, pinned on a skewed fleet: replica A served
+    19 fast requests, replica B one catastrophic straggler. Averaging
+    the per-replica p95s invents a 5s fleet tail that no pooling of the
+    actual samples supports; pooling ranks the straggler where it
+    belongs — above p95 of the fleet's 20 requests."""
+    fast = [0.01] * 19
+    slow = [10.0]
+    ra = ServingReport(
+        ttft_p95_s=percentile(fast, 95), ttft_samples=list(fast),
+        queue_wait_samples=[0.001] * 19,
+    )
+    rb = ServingReport(
+        ttft_p95_s=percentile(slow, 95), ttft_samples=list(slow),
+        queue_wait_samples=[2.0] * 5,
+    )
+    averaged_p95 = (ra.ttft_p95_s + rb.ttft_p95_s) / 2  # 5.005 — fiction
+    m = ServingReport.merge([ra, rb])
+    assert m.ttft_samples == fast + slow
+    # Nearest-rank p95 of the 20 pooled samples ranks the single
+    # straggler (5% of fleet traffic) ABOVE p95, where it belongs.
+    assert m.ttft_p95_s == pytest.approx(0.01)
+    assert m.ttft_p95_s != pytest.approx(averaged_p95)
+    assert averaged_p95 > 5.0  # the averaged number overstates 500x
+    # The flip side: a 5/24 slow mass IS the fleet tail, and pooling
+    # surfaces it (per-replica averaging would halve it to ~1s).
+    assert m.queue_wait_p95_s == pytest.approx(2.0)
+    assert m.queue_wait_p50_s == pytest.approx(0.001)
+
+
+def test_merge_of_empty_and_sampleless_reports():
+    assert ServingReport.merge([]).replicas == 0
+    m = ServingReport.merge([ServingReport(steps_run=3), ServingReport()])
+    assert m.steps_run == 3 and m.ttft_p95_s == 0.0
+
+
+@cpu_only
+def test_fleet_report_pools_engine_samples(params):
+    rs = make_fleet(params, n=2)
+    router = PrefixRouter(rs, policy=constants.ROUTER_POLICY_ROUND_ROBIN)
+    futs = [router.submit(PROMPTS[k], max_new=4) for k in ("a", "b", "c")]
+    assert drive_fleet(rs, lambda: all(f.done() for f in futs))
+    per_replica = [collect_serving(h.engine) for h in rs.handles]
+    fleet = rs.fleet_report()
+    assert fleet.replicas == 2
+    assert len(fleet.ttft_samples) == 3  # pooled across both engines
+    assert fleet.steps_run == sum(r.steps_run for r in per_replica)
+    assert fleet.ttft_p95_s == percentile(fleet.ttft_samples, 95)
+    rs.stop()

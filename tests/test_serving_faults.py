@@ -16,7 +16,6 @@ test_decode_server SPEC_CFG reasoning)."""
 import jax
 import pytest
 
-from nos_tpu.models.gpt import GPTConfig, init_gpt
 from nos_tpu.runtime.checkpoint import SlotCheckpoint
 from nos_tpu.runtime.decode_server import DecodeServer
 from nos_tpu.runtime.faults import (
@@ -31,12 +30,12 @@ from nos_tpu.runtime.faults import (
     classify_fault,
     poison_slot_of,
 )
+from tests.conftest import serving_test_config
 from tests.test_block_manager import check_invariants
 
-CFG = GPTConfig(
-    vocab=97, hidden=32, layers=2, heads=4, kv_heads=2, max_seq=128,
-    dtype="float32",
-)
+# The shared tiny-model config/params live in tests/conftest.py (the
+# engine-builder fixture every serving test module collapses onto).
+CFG = serving_test_config()
 
 cpu_only = pytest.mark.skipif(
     jax.default_backend() == "tpu",
@@ -46,8 +45,8 @@ cpu_only = pytest.mark.skipif(
 
 
 @pytest.fixture(scope="module")
-def params():
-    return init_gpt(jax.random.PRNGKey(0), CFG)
+def params(serving_params):
+    return serving_params
 
 
 CHAOS_PROMPTS = [

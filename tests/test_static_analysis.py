@@ -59,6 +59,20 @@ def test_tree_has_zero_non_baselined_findings():
         assert entry.rationale, f"baseline entry without rationale: {entry.render()}"
 
 
+def test_tree_walk_covers_the_serving_subsystem():
+    """ISSUE 8 satellite: the lint walk over `nos_tpu/` must discover the
+    cluster serving plane (nos_tpu/serving/) — NOS001/NOS002/NOS005 cover
+    the new wire-format constants and the router's lock discipline. A
+    future refactor that moves serving out of the walked tree would
+    silently un-lint it; this pins the coverage."""
+    from nos_tpu.analysis.core import Engine
+
+    discovered = Engine.discover([TREE])
+    serving = [p for p in discovered if "/serving/" in p.replace("\\", "/")]
+    names = {p.rsplit("/", 1)[-1] for p in serving}
+    assert {"__init__.py", "replica.py", "router.py", "drain.py"} <= names
+
+
 def test_tree_gate_actually_detects_an_injected_literal(tmp_path):
     # End-to-end sanity that the gate has teeth: a file with a drifted
     # protocol literal makes the suite non-clean.
