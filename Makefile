@@ -2,8 +2,9 @@
 
 PY ?= python
 
-.PHONY: all test test-tpu native bench dryrun demo simulate example clean \
-	render cluster kind-cluster docker-build e2e-kind lint slow-audit
+.PHONY: all test test-tpu native bench bench-smoke dryrun demo simulate \
+	example clean render cluster kind-cluster docker-build e2e-kind lint \
+	slow-audit
 
 all: native test
 
@@ -50,6 +51,14 @@ native:
 # Headline benchmark on the real chip (prints one JSON line).
 bench:
 	$(PY) bench.py
+
+# CPU smoke of the tracing artifact (docs/tracing.md): runs bench.py's
+# trace_timeline scenario on the tiny model and asserts the artifact
+# parses, outputs are bit-identical tracing-on vs off, phase attribution
+# covers >= 95% of tick wall, and the overhead gate holds (default 3%,
+# override via NOS_TPU_TRACE_OVERHEAD_PCT).
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) hack/bench_smoke.py
 
 # Multi-chip sharding dry-run on 8 virtual CPU devices.
 dryrun:

@@ -235,6 +235,116 @@ def _multi_replica(np, cfg, params, policy: str) -> dict:
         replicas.stop()
 
 
+def _trace_timeline(
+    np,
+    cfg,
+    params,
+    n_streams: int = 8,
+    prompt_len: int = 128,
+    max_new: int = 64,
+    max_len: int = 512,
+    prompt_buckets=(16, 32, 64, 128, 256),
+    steps_per_dispatch: int = 16,
+    block_size: int = 32,
+    trials: int = 2,
+) -> dict:
+    """Tracing-overhead gate + tick-phase timeline (PR 9, docs/tracing.md).
+
+    Runs the n-stream scenario on IDENTICAL traffic twice per trial:
+    tracing off (no tracer, no flight recorder, no profiler) vs the full
+    EngineTracing bundle. The artifact carries the three acceptance
+    facts: (a) outputs are bit-identical — tracing observes the
+    schedule, never changes it; (b) tok/s overhead, best-of-`trials` per
+    arm so the gate measures the tracing layer's cost rather than the
+    host's scheduling noise; (c) the per-phase tick attribution
+    (constants.TICK_PHASES, ms totals) with its coverage of measured
+    tick wall, plus the host-overhead vs dispatch split and the
+    dispatch-floor estimate (host-overhead ms per engine dispatch) —
+    the first per-cause attribution of BENCH_r04/r05's
+    `dispatch_overhead_ms`. Module-level so `make bench-smoke`
+    (hack/bench_smoke.py) runs the same code on a CPU-sized model."""
+    import time as _time
+
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.telemetry import collect_serving
+    from nos_tpu.tracing import EngineTracing
+
+    srng = np.random.default_rng([2026, 9, n_streams, prompt_len])
+    prompts = [
+        srng.integers(1, cfg.vocab, prompt_len).tolist() for _ in range(n_streams)
+    ]
+
+    def run(tracing_on):
+        tracing = EngineTracing() if tracing_on else None
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=n_streams,
+            max_len=max_len,
+            prompt_buckets=prompt_buckets,
+            steps_per_dispatch=steps_per_dispatch,
+            block_size=block_size,
+            tracing=tracing,
+        ).start()
+        try:
+            # Warm every program shape so the timed window holds no
+            # compiles (the overhead gate compares tick-loop costs).
+            server.generate(prompts[0], max_new=4, timeout=600)
+            t0 = _time.perf_counter()
+            futs = [server.submit(p, max_new=max_new) for p in prompts]
+            outs = [list(f.result(timeout=600)) for f in futs]
+            wall = _time.perf_counter() - t0
+            return outs, wall, collect_serving(server), tracing
+        finally:
+            server.stop()
+
+    walls_off, walls_on = [], []
+    identical = True
+    report = tracing = None
+    for _ in range(max(1, trials)):
+        outs_off, w_off, _, _ = run(False)
+        outs_on, w_on, report, tracing = run(True)
+        identical = identical and outs_on == outs_off
+        walls_off.append(w_off)
+        walls_on.append(w_on)
+    tokens = n_streams * max_new
+    tok_s_off = tokens / min(walls_off)
+    tok_s_on = tokens / min(walls_on)
+    coverage = (
+        sum(report.tick_phase_s.values()) / report.tick_wall_s
+        if report.tick_wall_s
+        else 1.0
+    )
+    # Engine dispatches = macro+verify programs (steps_run) + prefill
+    # chunk/window programs; the floor estimate charges every one its
+    # share of the pure-host tick time.
+    dispatches = report.steps_run + report.prefill_dispatches
+    return {
+        "streams": n_streams,
+        "max_new": max_new,
+        "trials": max(1, trials),
+        "outputs_identical": identical,
+        "tok_s_tracing_off": round(tok_s_off, 1),
+        "tok_s_tracing_on": round(tok_s_on, 1),
+        "tracing_overhead_pct": round(100.0 * (1.0 - tok_s_on / tok_s_off), 2),
+        "ticks_profiled": report.ticks_profiled,
+        "phase_ms": {
+            k: round(v * 1e3, 3) for k, v in sorted(report.tick_phase_s.items())
+        },
+        "phase_attribution_coverage": round(coverage, 4),
+        "tick_wall_ms": round(report.tick_wall_s * 1e3, 3),
+        "dispatch_ms": round(report.tick_dispatch_s * 1e3, 3),
+        "host_overhead_ms": round(report.tick_host_overhead_s * 1e3, 3),
+        "host_overhead_p95_ms": round(report.host_overhead_p95_s * 1e3, 4),
+        "dispatch_p95_ms": round(report.dispatch_p95_s * 1e3, 4),
+        "engine_dispatches": dispatches,
+        "dispatch_floor_ms_per_dispatch": round(
+            1e3 * report.tick_host_overhead_s / max(1, dispatches), 4
+        ),
+        "flight_recorder_events": tracing.recorder.events_recorded,
+    }
+
+
 def _decode_phase(jax, jnp) -> dict:
     """Driver-captured serving throughput (VERDICT r4 #3: the README's
     tok/s claims lived only in docs — now the artifact carries them).
@@ -827,6 +937,16 @@ def _decode_phase(jax, jnp) -> dict:
         "outputs_identical_across_policies": outputs_identical,
         "runs": runs,
     }
+
+    # Tracing-overhead gate + tick-phase timeline (PR 9,
+    # docs/tracing.md): 8 streams with the full tracing bundle on vs
+    # off, bit-identical outputs, per-phase ms attribution, and the
+    # host-overhead-per-dispatch floor estimate — the per-cause
+    # breakdown of the dispatch_overhead_ms the MFU artifacts have
+    # carried unexplained since BENCH_r04.
+    out["trace_timeline"] = _retry(
+        "decode:trace_timeline", lambda: _trace_timeline(np, cfg, params)
+    )
     return out
 
 

@@ -16,6 +16,7 @@ def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
     from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
+    from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
     from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
     from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
 
@@ -29,4 +30,5 @@ def all_checkers() -> List[Checker]:
         BlockDisciplineChecker(),
         FaultDisciplineChecker(),
         SpillDisciplineChecker(),
+        TraceDisciplineChecker(),
     ]

@@ -209,6 +209,116 @@ ROUTER_POLICY_PREFIX = "prefix"
 ROUTER_POLICY_ROUND_ROBIN = "round_robin"
 ROUTER_POLICIES = (ROUTER_POLICY_PREFIX, ROUTER_POLICY_ROUND_ROBIN)
 
+# ---------------------------------------------------------------------------
+# Serving-plane tracing wire format (nos_tpu/tracing.py, docs/tracing.md).
+# The span/event NAMES below are the vocabulary of the request-lifecycle
+# tracer and the engine flight recorder: /debug/* consumers, the bench
+# trace_timeline artifact, and postmortem tooling all key off these
+# strings, so a name spelled inline in engine code would drift exactly
+# like a mistyped annotation — the NOS014 checker
+# (analysis/checkers/trace_discipline.py) flags any of these values used
+# as a literal outside this file.
+# ---------------------------------------------------------------------------
+# Trace identity: "<prefix><counter>", assigned by tracing.Tracer.
+TRACE_ID_PREFIX = "tr-"
+
+# Request-lifecycle span/event names (one trace per request; the id rides
+# _Request/_Slot, SlotCheckpoint, and transfer_in_checkpoint so a
+# restored or re-homed stream keeps ONE coherent trace).
+TRACE_EV_ROUTER_SELECT = "router.select"
+TRACE_EV_SUBMIT = "req.submit"
+TRACE_EV_RESERVED = "req.reserved"
+TRACE_EV_PREFILL_CHUNK = "req.prefill_chunk"
+TRACE_EV_FIRST_TOKEN = "req.first_token"
+TRACE_EV_DECODE = "req.decode"
+TRACE_EV_FINISH = "req.finish"
+# Exceptional edges.
+TRACE_EV_PREEMPT = "req.preempt"
+TRACE_EV_SPILL = "req.spill"
+TRACE_EV_REVIVE = "req.revive"
+TRACE_EV_RESTORE = "req.restore"
+TRACE_EV_DRAIN_MIGRATE = "req.drain_migrate"
+TRACE_EVENTS = (
+    TRACE_EV_ROUTER_SELECT,
+    TRACE_EV_SUBMIT,
+    TRACE_EV_RESERVED,
+    TRACE_EV_PREFILL_CHUNK,
+    TRACE_EV_FIRST_TOKEN,
+    TRACE_EV_DECODE,
+    TRACE_EV_FINISH,
+    TRACE_EV_PREEMPT,
+    TRACE_EV_SPILL,
+    TRACE_EV_REVIVE,
+    TRACE_EV_RESTORE,
+    TRACE_EV_DRAIN_MIGRATE,
+)
+
+# Engine flight-recorder event names (bounded per-engine ring buffer;
+# payloads are counts/ids ONLY — the same no-request-content contract as
+# telemetry.ServingReport).
+FLIGHT_EV_ADMIT = "engine.admit"
+FLIGHT_EV_PREFILL_WAVE = "engine.prefill_wave"
+FLIGHT_EV_MACRO = "engine.dispatch_macro"
+FLIGHT_EV_VERIFY = "engine.dispatch_verify"
+FLIGHT_EV_RESOLVE = "engine.resolve"
+FLIGHT_EV_FINISH = "engine.finish"
+FLIGHT_EV_RECOVERY = "engine.recovery"
+FLIGHT_EV_TRANSIENT_RETRY = "engine.transient_retry"
+FLIGHT_EV_FAIL_ALL = "engine.fail_all"
+FLIGHT_EV_PREEMPT = "engine.preempt"
+FLIGHT_EV_SPILL = "engine.spill"
+FLIGHT_EV_EVICT = "engine.evict"
+FLIGHT_EV_REVIVE = "engine.revive"
+FLIGHT_EVENTS = (
+    FLIGHT_EV_ADMIT,
+    FLIGHT_EV_PREFILL_WAVE,
+    FLIGHT_EV_MACRO,
+    FLIGHT_EV_VERIFY,
+    FLIGHT_EV_RESOLVE,
+    FLIGHT_EV_FINISH,
+    FLIGHT_EV_RECOVERY,
+    FLIGHT_EV_TRANSIENT_RETRY,
+    FLIGHT_EV_FAIL_ALL,
+    FLIGHT_EV_PREEMPT,
+    FLIGHT_EV_SPILL,
+    FLIGHT_EV_EVICT,
+    FLIGHT_EV_REVIVE,
+)
+
+# Tick-phase profiler phase names (tracing.TickProfiler): label values of
+# the nos_tpu_decode_tick_phase_seconds histogram and the keys of
+# ServingReport.tick_phase_s / the bench trace_timeline artifact.
+TICK_PHASE_QUOTA_ENFORCE = "quota_enforce"
+TICK_PHASE_ADMIT = "admit"
+TICK_PHASE_RESOLVE = "resolve"
+TICK_PHASE_EOS_SCAN = "eos_scan"
+TICK_PHASE_PUMP_REVIVES = "pump_revives"
+TICK_PHASE_PUMP_PREFILL = "pump_prefill"
+TICK_PHASE_DISPATCH_VERIFY = "dispatch_verify"
+TICK_PHASE_DISPATCH_MACRO = "dispatch_macro"
+TICK_PHASE_SAMPLE_SCATTER = "sample_scatter"
+TICK_PHASE_PUBLISH = "publish"
+TICK_PHASE_IDLE = "idle"
+TICK_PHASES = (
+    TICK_PHASE_QUOTA_ENFORCE,
+    TICK_PHASE_ADMIT,
+    TICK_PHASE_RESOLVE,
+    TICK_PHASE_EOS_SCAN,
+    TICK_PHASE_PUMP_REVIVES,
+    TICK_PHASE_PUMP_PREFILL,
+    TICK_PHASE_DISPATCH_VERIFY,
+    TICK_PHASE_DISPATCH_MACRO,
+    TICK_PHASE_SAMPLE_SCATTER,
+    TICK_PHASE_PUBLISH,
+    TICK_PHASE_IDLE,
+)
+
+# Debug/observability HTTP surface (observability.ObservabilityServer).
+DEBUG_PATH_EVENTS = "/debug/events"
+DEBUG_PATH_TRACE_PREFIX = "/debug/trace/"
+# Prometheus text exposition format version (what scrapers negotiate on).
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
 # Scheduler name used by pods that want quota-aware scheduling.
 SCHEDULER_NAME = "nos-tpu-scheduler"
 

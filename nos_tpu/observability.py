@@ -2,9 +2,14 @@
 
 The analog of the reference's controller-runtime metrics endpoint +
 healthz/readyz probes (SURVEY.md §5): a small Prometheus-text metrics
-registry, a health manager every component registers checks with, and leveled
-logging setup (zap analog). An optional HTTP server exposes /metrics,
-/healthz and /readyz for deployments.
+registry (counters, gauges, and bucketed duration histograms with exact
+count/sum and a capped raw-sample reservoir), a health manager every
+component registers checks with, and leveled logging setup (zap analog).
+An optional HTTP server exposes /metrics (text exposition format 0.0.4,
+`# TYPE` metadata), /healthz and /readyz for deployments — plus the
+serving-plane debug surface (/debug/events, /debug/trace/<id> — see
+nos_tpu/tracing.py and docs/tracing.md) when a flight recorder / tracer
+is attached.
 
 The serving engine publishes onto a registry handed to it as
 `DecodeServer(..., metrics=registry)`: `nos_tpu_decode_*` counters
@@ -17,25 +22,53 @@ cached,shared}`) — see docs/telemetry.md for the full series list.
 
 from __future__ import annotations
 
+import bisect
 import http.server
+import json
 import logging
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Callable, Dict, Optional, Tuple
+
+from nos_tpu import constants
+
+#: Histogram bucket upper bounds (seconds) for `observe`d durations —
+#: sub-millisecond through 10s, the range an engine tick phase or a plan
+#: pass actually spans. Cumulative `_bucket{le=...}` series (plus +Inf)
+#: render in Prometheus text format alongside the exact _count/_sum.
+DURATION_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+#: Raw samples retained per duration series. Histogram buckets carry the
+#: distribution and _count/_sum stay exact, so the raw samples are only a
+#: recent window for debugging — the fixed cap is what fixes the old
+#: unbounded `observe()` append (every observation kept forever).
+DURATION_RESERVOIR = 512
 
 
 # ---------------------------------------------------------------------------
 # Metrics
 # ---------------------------------------------------------------------------
 class Metrics:
-    """Counters, gauges and duration histograms with label support."""
+    """Counters, gauges and bucketed duration histograms with label
+    support. Durations keep exact `_count`/`_sum`, per-bucket counts
+    (Prometheus `_bucket{le=...}` series), and a bounded reservoir of
+    recent raw samples — memory is constant regardless of how many
+    observations a long-lived process makes."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
         self._gauges: Dict[Tuple[str, Tuple], float] = {}
-        self._durations: Dict[Tuple[str, Tuple], list] = defaultdict(list)
+        self._durations: Dict[Tuple[str, Tuple], deque] = {}
+        self._dur_count: Dict[Tuple[str, Tuple], int] = defaultdict(int)
+        self._dur_sum: Dict[Tuple[str, Tuple], float] = defaultdict(float)
+        # Non-cumulative per-bucket counts; index len(DURATION_BUCKETS)
+        # is the +Inf overflow bucket.
+        self._dur_buckets: Dict[Tuple[str, Tuple], list] = {}
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]) -> Tuple[str, Tuple]:
@@ -57,8 +90,15 @@ class Metrics:
             self._gauges.pop(self._key(name, labels), None)
 
     def observe(self, name: str, seconds: float, **labels) -> None:
+        key = self._key(name, labels)
         with self._lock:
-            self._durations[self._key(name, labels)].append(seconds)
+            if key not in self._durations:
+                self._durations[key] = deque(maxlen=DURATION_RESERVOIR)
+                self._dur_buckets[key] = [0] * (len(DURATION_BUCKETS) + 1)
+            self._durations[key].append(seconds)
+            self._dur_count[key] += 1
+            self._dur_sum[key] += seconds
+            self._dur_buckets[key][bisect.bisect_left(DURATION_BUCKETS, seconds)] += 1
 
     def time(self, name: str, **labels):
         """Context manager recording a duration."""
@@ -83,7 +123,10 @@ class Metrics:
             return self._gauges.get(key, 0.0)
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format (version 0.0.4): `# TYPE`
+        metadata per metric family, cumulative `_bucket{le=...}` series
+        (with the mandatory `+Inf` bucket) for every observed duration,
+        and exact `_count`/`_sum` regardless of the raw-sample cap."""
         def fmt(name, labels, value):
             if labels:
                 inner = ",".join(f'{k}="{v}"' for k, v in labels)
@@ -92,13 +135,45 @@ class Metrics:
 
         lines = []
         with self._lock:
+            prev = None
             for (name, labels), value in sorted(self._counters.items()):
+                if name != prev:
+                    lines.append(f"# TYPE {name}_total counter")
+                    prev = name
                 lines.append(fmt(name + "_total", labels, value))
+            prev = None
             for (name, labels), value in sorted(self._gauges.items()):
+                if name != prev:
+                    lines.append(f"# TYPE {name} gauge")
+                    prev = name
                 lines.append(fmt(name, labels, value))
-            for (name, labels), values in sorted(self._durations.items()):
-                lines.append(fmt(name + "_seconds_count", labels, len(values)))
-                lines.append(fmt(name + "_seconds_sum", labels, sum(values)))
+            prev = None
+            for (name, labels) in sorted(self._dur_count):
+                key = (name, labels)
+                if name != prev:
+                    lines.append(f"# TYPE {name}_seconds histogram")
+                    prev = name
+                cumulative = 0
+                for le, count in zip(
+                    DURATION_BUCKETS, self._dur_buckets[key]
+                ):
+                    cumulative += count
+                    lines.append(
+                        fmt(
+                            name + "_seconds_bucket",
+                            labels + (("le", format(le, "g")),),
+                            cumulative,
+                        )
+                    )
+                lines.append(
+                    fmt(
+                        name + "_seconds_bucket",
+                        labels + (("le", "+Inf"),),
+                        self._dur_count[key],
+                    )
+                )
+                lines.append(fmt(name + "_seconds_count", labels, self._dur_count[key]))
+                lines.append(fmt(name + "_seconds_sum", labels, self._dur_sum[key]))
         return "\n".join(lines) + "\n"
 
 
@@ -151,7 +226,11 @@ class HealthManager:
 # HTTP endpoint
 # ---------------------------------------------------------------------------
 class ObservabilityServer:
-    """Serves /metrics, /healthz, /readyz (kube-rbac-proxy-less analog)."""
+    """Serves /metrics, /healthz, /readyz (kube-rbac-proxy-less analog),
+    plus the serving-plane debug surface (/debug/events — the engine
+    flight recorder's ring + postmortem dumps; /debug/trace/<id> — one
+    request's lifecycle span events) when a tracing.FlightRecorder /
+    tracing.Tracer is attached."""
 
     def __init__(
         self,
@@ -160,42 +239,67 @@ class ObservabilityServer:
         port: int = 0,
         host: str = "127.0.0.1",
         metrics_token: Optional[str] = None,
+        tracer=None,
+        recorder=None,
     ):
         """In-cluster deployments bind host='0.0.0.0' on the configured
         health_probe_port so kubelet httpGet probes can reach the pod IP;
         tests/demos keep loopback + ephemeral.
 
-        `metrics_token` guards /metrics with bearer-token auth (the
-        kube-rbac-proxy-guarded pattern without the sidecar: Prometheus
-        authenticates via the ServiceMonitor's bearerTokenSecret, everyone
-        else gets 401). /healthz and /readyz stay open — kubelet httpGet
-        probes cannot attach credentials."""
+        `metrics_token` guards /metrics AND /debug/* with bearer-token
+        auth (the kube-rbac-proxy-guarded pattern without the sidecar:
+        Prometheus authenticates via the ServiceMonitor's
+        bearerTokenSecret, everyone else gets 401 — and the debug
+        surface, which exposes per-request timing, is at least as
+        sensitive as the metrics). /healthz and /readyz stay open —
+        kubelet httpGet probes cannot attach credentials.
+
+        `tracer`/`recorder` (optional, duck-typed to nos_tpu.tracing's
+        Tracer/FlightRecorder) arm the /debug endpoints; without them
+        the paths answer 404. Payloads are JSON and carry counts/ids
+        only — the recorder/tracer never stored request content to
+        begin with (docs/tracing.md privacy contract)."""
         self.metrics = metrics_registry
         self.health = health
         self.metrics_token = metrics_token
+        self.tracer = tracer
+        self.recorder = recorder
         obs = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *args):  # quiet
                 pass
 
-            def do_GET(self):
-                if self.path == "/metrics":
-                    if obs.metrics_token is not None:
-                        import hmac
+            def _authorized(self) -> bool:
+                if obs.metrics_token is None:
+                    return True
+                import hmac
 
-                        presented = self.headers.get("Authorization", "")
-                        if not hmac.compare_digest(
-                            presented, f"Bearer {obs.metrics_token}"
-                        ):
-                            body = b"unauthorized"
-                            self.send_response(401)
-                            self.send_header("WWW-Authenticate", "Bearer")
-                            self.send_header("Content-Length", str(len(body)))
-                            self.end_headers()
-                            self.wfile.write(body)
-                            return
+                presented = self.headers.get("Authorization", "")
+                return hmac.compare_digest(
+                    presented, f"Bearer {obs.metrics_token}"
+                )
+
+            def _reply_401(self):
+                body = b"unauthorized"
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", "Bearer")
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                # Prometheus scrapers key the exposition-format parser
+                # off the Content-Type version; plain probes and the
+                # JSON debug surface declare theirs too.
+                ctype = "text/plain"
+                if self.path == "/metrics":
+                    if not self._authorized():
+                        self._reply_401()
+                        return
                     body = obs.metrics.render().encode()
+                    ctype = constants.METRICS_CONTENT_TYPE
                     self.send_response(200)
                 elif self.path == "/healthz":
                     ok, failures = obs.health.healthz()
@@ -205,9 +309,44 @@ class ObservabilityServer:
                     ok, failures = obs.health.readyz()
                     body = (b"ok" if ok else repr(failures).encode())
                     self.send_response(200 if ok else 500)
+                elif self.path == constants.DEBUG_PATH_EVENTS:
+                    if not self._authorized():
+                        self._reply_401()
+                        return
+                    if obs.recorder is None:
+                        body = b"flight recorder not attached"
+                        self.send_response(404)
+                    else:
+                        payload = {
+                            "events": obs.recorder.snapshot(),
+                            "postmortems": obs.recorder.postmortem_dumps(),
+                        }
+                        if obs.tracer is not None:
+                            payload["traces"] = obs.tracer.trace_ids()
+                        body = json.dumps(payload).encode()
+                        ctype = "application/json"
+                        self.send_response(200)
+                elif self.path.startswith(constants.DEBUG_PATH_TRACE_PREFIX):
+                    if not self._authorized():
+                        self._reply_401()
+                        return
+                    tid = self.path[len(constants.DEBUG_PATH_TRACE_PREFIX):]
+                    events = (
+                        obs.tracer.trace(tid) if obs.tracer is not None else None
+                    )
+                    if events is None:
+                        body = b"no such trace"
+                        self.send_response(404)
+                    else:
+                        body = json.dumps(
+                            {"trace_id": tid, "events": events}
+                        ).encode()
+                        ctype = "application/json"
+                        self.send_response(200)
                 else:
                     body = b"not found"
                     self.send_response(404)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -60,6 +60,7 @@ import hashlib
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from nos_tpu import constants
 from nos_tpu.runtime.spill import SpillTier
 
 
@@ -139,6 +140,10 @@ class BlockManager:
         self.hit_tokens = 0
         self.evictions = 0
         self.spill_hit_blocks = 0
+        # Optional flight recorder (nos_tpu/tracing.py): pool-pressure
+        # events (spill/evict) recorded through its API — block ids and
+        # counts only, never chain keys or content.
+        self._recorder = None
 
     def attach_spill(
         self,
@@ -153,6 +158,12 @@ class BlockManager:
         self._spill = tier
         self._spill_reader = reader
 
+    def attach_recorder(self, recorder) -> None:
+        """Arm the engine's flight recorder (tracing.FlightRecorder) for
+        pool-pressure events. Recording goes through the recorder's own
+        API (NOS014); the manager never touches its ring."""
+        self._recorder = recorder
+
     def _spill_out(self, block: int, key: str) -> None:
         """Move one indexed refcount-0 block's contents to the host tier
         and drop its device index entry. The caller owns the block's
@@ -161,6 +172,10 @@ class BlockManager:
         self._spill.put(key, payload, nbytes)
         del self._prefix_index[key]
         del self._block_key[block]
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_SPILL, block=block, nbytes=nbytes
+            )
 
     # -- queries -------------------------------------------------------------
     def available(self) -> int:
@@ -399,6 +414,8 @@ class BlockManager:
             del self._prefix_index[key]
             del self._block_key[block]
         self.evictions += 1
+        if self._recorder is not None:
+            self._recorder.record(constants.FLIGHT_EV_EVICT, block=block)
         return block
 
     # -- prefill progress ----------------------------------------------------

@@ -62,6 +62,13 @@ class SlotCheckpoint:
     prefill_cursor: int = 0
     spec: Optional[Dict[str, float]] = None
     tenant: Optional[str] = None
+    # Request-lifecycle trace id (nos_tpu/tracing.py): rides the
+    # checkpoint so a restored / preempted / drain-migrated stream keeps
+    # ONE coherent trace across recoveries and replicas. Optional
+    # observability metadata — absent (None) in pre-tracing dicts, which
+    # is why it does NOT bump CHECKPOINT_VERSION: readers tolerate the
+    # missing key and no existing field changed meaning.
+    trace_id: Optional[str] = None
     future: Optional[Future] = field(default=None, repr=False, compare=False)
 
     @property
@@ -87,6 +94,7 @@ class SlotCheckpoint:
             "prefill_cursor": self.prefill_cursor,
             "spec": dict(self.spec) if self.spec is not None else None,
             "tenant": self.tenant,
+            "trace_id": self.trace_id,
         }
 
     @classmethod
@@ -110,4 +118,5 @@ class SlotCheckpoint:
             prefill_cursor=int(d.get("prefill_cursor", 0)),
             spec=dict(d["spec"]) if d.get("spec") is not None else None,
             tenant=d.get("tenant"),
+            trace_id=d.get("trace_id"),
         )

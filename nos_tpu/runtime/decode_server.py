@@ -115,6 +115,7 @@ from nos_tpu.runtime.faults import (
 )
 from nos_tpu.runtime.quota import QuotaPolicy
 from nos_tpu.runtime.spill import SpillTier
+from nos_tpu.tracing import EngineTracing, TickProfiler
 
 logger = logging.getLogger(__name__)
 
@@ -178,6 +179,10 @@ class _Request:
     # best-effort tenant. Preserved across checkpoint restores and
     # preemption re-admissions.
     tenant: Optional[str] = None
+    # Request-lifecycle trace id (nos_tpu/tracing.py): minted at ingress
+    # (or by the router), preserved across restores/preemptions/migrations
+    # so one request is one trace regardless of how many engines served it.
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -234,6 +239,11 @@ class _Slot:
     # in prefix order, consumed front-first as the cursor advances.
     tenant: Optional[str] = None
     pending_revives: List[Tuple[int, int, str]] = field(default_factory=list)
+    # Tracing state (nos_tpu/tracing.py): the request's trace id, and
+    # whether the slot's `req.decode` span event has been recorded (once,
+    # on its first post-prefill dispatch).
+    trace_id: Optional[str] = None
+    trace_decoding: bool = False
 
 
 @dataclass
@@ -268,6 +278,7 @@ class DecodeServer:
         spill_blocks: Optional[int] = None,
         quota: Optional[QuotaPolicy] = None,
         metrics=None,
+        tracing: Optional[EngineTracing] = None,
         fault_injector=None,
         surgical_recovery: bool = True,
         max_transient_retries: int = 4,
@@ -413,6 +424,19 @@ class DecodeServer:
         `nos_tpu_decode_*` (see telemetry.py ServingReport for the
         one-shot snapshot analog).
 
+        `tracing` (optional, nos_tpu/tracing.py EngineTracing) arms the
+        observability tentpole (docs/tracing.md): request-lifecycle
+        spans on the bundle's Tracer (share ONE Tracer across a replica
+        fleet so migrated streams keep one coherent trace), a bounded
+        flight-recorder ring of engine events snapshotted into a
+        postmortem dump on every recovery, and the tick-phase profiler
+        (per-phase wall attribution + the host-overhead vs dispatch
+        split). All hooks are host-side perf_counter stamps — never a
+        device sync — and payloads are counts/ids only; outputs are
+        bit-identical tracing-on vs tracing-off (the counter-gated
+        oracle in tests/test_tracing.py). None (the default) pays a
+        disabled-flag check per tick phase and nothing else.
+
         `surgical_recovery` (default True) selects the engine's failure
         model. True: tick-path exceptions are classified through the
         fault taxonomy (runtime/faults.py) — poison faults fail ONLY the
@@ -460,9 +484,20 @@ class DecodeServer:
         # NOS011 flags pool-state mutation anywhere else.
         self.prefix_cache = bool(prefix_cache)
         self._fault_injector = fault_injector
+        # Tracing bundle (nos_tpu/tracing.py): tracer/recorder hooks are
+        # None-guarded; the profiler is a per-engine disabled instance
+        # when tracing is off, so the tick path stays branch-light.
+        self.tracing = tracing
+        self._tracer = tracing.tracer if tracing is not None else None
+        self._recorder = tracing.recorder if tracing is not None else None
+        self._prof = (
+            tracing.profiler if tracing is not None else TickProfiler(enabled=False)
+        )
         self._block_mgr = BlockManager(
             self.total_blocks, self.block_size, n_slots, fault_injector=fault_injector
         )
+        if self._recorder is not None:
+            self._block_mgr.attach_recorder(self._recorder)
         # Host-RAM spill tier (PR 7): sized in blocks, attached to the
         # BlockManager with this engine's device-copy reader. The engine
         # owns the device arrays; the manager owns WHEN content moves.
@@ -759,13 +794,18 @@ class DecodeServer:
         prompt: Sequence[int],
         max_new: int = 16,
         tenant: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """`tenant` names the quota account this request's decode tokens
         bill against (runtime/quota.py); ignored unless the engine was
-        built with a QuotaPolicy. Raises RuntimeError once the engine has
-        stopped (or begun draining): a request enqueued after the loop
-        exits would strand its Future forever."""
-        return self.transfer_in_request(prompt, max_new, tenant=tenant)
+        built with a QuotaPolicy. `trace_id` continues a trace the router
+        already opened (nos_tpu/tracing.py); with a tracer armed and no
+        id given, the engine mints one. Raises RuntimeError once the
+        engine has stopped (or begun draining): a request enqueued after
+        the loop exits would strand its Future forever."""
+        return self.transfer_in_request(
+            prompt, max_new, tenant=tenant, trace_id=trace_id
+        )
 
     def transfer_in_request(
         self,
@@ -774,6 +814,7 @@ class DecodeServer:
         tenant: Optional[str] = None,
         future: Optional[Future] = None,
         t_submit: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """The general request-ingress hook: `submit()` plus the
         cross-replica form the drain/migrate controller
@@ -790,6 +831,15 @@ class DecodeServer:
         if max_new <= 0:
             fut.set_result([])
             return fut
+        if self._tracer is not None:
+            if trace_id is None:
+                trace_id = self._tracer.new_trace()
+            self._tracer.event(
+                trace_id,
+                constants.TRACE_EV_SUBMIT,
+                prompt_tokens=len(prompt),
+                max_new=max_new,
+            )
         self._note_accepted(fut)
         self._queue.put(
             _Request(
@@ -798,6 +848,7 @@ class DecodeServer:
                 fut,
                 t_submit if t_submit is not None else time.monotonic(),
                 tenant=tenant,
+                trace_id=trace_id,
             )
         )
         return fut
@@ -834,6 +885,7 @@ class DecodeServer:
                 t_restore=t_restore if t_restore is not None else time.monotonic(),
                 spec=dict(ck.spec) if ck.spec is not None else None,
                 tenant=ck.tenant,
+                trace_id=ck.trace_id,
             )
         )
 
@@ -970,6 +1022,7 @@ class DecodeServer:
                         t_submit=req.t_submit,
                         spec=req.spec,
                         tenant=req.tenant,
+                        trace_id=req.trace_id,
                         future=req.future,
                     )
                 )
@@ -1193,6 +1246,8 @@ class DecodeServer:
                 slot.step_base = len(req.replay)
                 slot.t_restore = req.t_restore
                 slot.tenant = req.tenant
+                slot.trace_id = req.trace_id
+                slot.trace_decoding = False
                 slot.pending_prompt = full_prompt
                 # Prefix hits are already in the page table: the prefill
                 # cursor starts at the first MISS boundary, so the budget
@@ -1234,6 +1289,25 @@ class DecodeServer:
                         )
                 else:
                     self.queue_wait_s.append(time.monotonic() - req.t_submit)
+                if self._tracer is not None:
+                    self._tracer.event(
+                        slot.trace_id,
+                        constants.TRACE_EV_RESTORE
+                        if req.t_restore
+                        else constants.TRACE_EV_RESERVED,
+                        slot=idx,
+                        serial=serial,
+                        hit_blocks=n_hit,
+                        replay_tokens=len(req.replay),
+                    )
+                if self._recorder is not None:
+                    self._recorder.record(
+                        constants.FLIGHT_EV_ADMIT,
+                        slot=idx,
+                        serial=serial,
+                        hit_blocks=n_hit,
+                        restore=int(bool(req.t_restore)),
+                    )
                 self._check_fault("admit", idx)
             except Exception:
                 # A fault between block assignment and slot binding must
@@ -1289,7 +1363,8 @@ class DecodeServer:
                 if slot.phase not in ("reserved", "prefilling"):
                     continue  # finished in an earlier wave of this tick
                 if slot.pending_revives:
-                    n_copies, used = self._pump_revives(idx, budget, spent)
+                    with self._prof.phase(constants.TICK_PHASE_PUMP_REVIVES):
+                        n_copies, used = self._pump_revives(idx, budget, spent)
                     revived += n_copies
                     dispatches += n_copies
                     spent += used
@@ -1342,9 +1417,22 @@ class DecodeServer:
                 slot.pending_revives = []
                 break
             kx, vx = payload
-            self.cache = self._revive_fn(
-                self.cache, jnp.asarray(kx), jnp.asarray(vx), block
-            )
+            with self._prof.dispatch():
+                self.cache = self._revive_fn(
+                    self.cache, jnp.asarray(kx), jnp.asarray(vx), block
+                )
+            if self._tracer is not None:
+                self._tracer.event(
+                    slot.trace_id,
+                    constants.TRACE_EV_REVIVE,
+                    slot=idx,
+                    block=block,
+                    offset=start,
+                )
+            if self._recorder is not None:
+                self._recorder.record(
+                    constants.FLIGHT_EV_REVIVE, slot=idx, block=block
+                )
             slot.pending_revives.pop(0)
             slot.prefill_cursor = start + cost
             slot.pos = slot.prefill_cursor
@@ -1380,14 +1468,15 @@ class DecodeServer:
                 idx, start, piece = entries[0]
                 padded = np.zeros((1, bucket), dtype=np.int32)
                 padded[0, : len(piece)] = piece
-                self.cache = self._prefill_chunk(
-                    self.params,
-                    jnp.asarray(padded),
-                    self.cache,
-                    self._table[idx],
-                    start,
-                    len(piece),
-                )
+                with self._prof.dispatch():
+                    self.cache = self._prefill_chunk(
+                        self.params,
+                        jnp.asarray(padded),
+                        self.cache,
+                        self._table[idx],
+                        start,
+                        len(piece),
+                    )
             else:
                 tokens = np.zeros((self.n_slots, bucket), dtype=np.int32)
                 pos = np.zeros((self.n_slots,), dtype=np.int32)
@@ -1398,33 +1487,35 @@ class DecodeServer:
                     pos[idx] = start
                     lengths[idx] = len(piece)
                     active[idx] = True
-                self.cache = self._prefill_window(
-                    self.params,
-                    jnp.asarray(tokens),
-                    self.cache,
-                    self._table,
-                    jnp.asarray(pos),
-                    jnp.asarray(lengths),
-                    jnp.asarray(active),
-                )
+                with self._prof.dispatch():
+                    self.cache = self._prefill_window(
+                        self.params,
+                        jnp.asarray(tokens),
+                        self.cache,
+                        self._table,
+                        jnp.asarray(pos),
+                        jnp.asarray(lengths),
+                        jnp.asarray(active),
+                    )
             dispatches += 1
         for idx, start, piece in finals:
             bucket = self._bucket(len(piece))
             padded = np.zeros((1, bucket), dtype=np.int32)
             padded[0, : len(piece)] = piece
-            self.cache, self._last_dev, self._first_dev = self._prefill_last(
-                self.params,
-                jnp.asarray(padded),
-                self.cache,
-                self._table[idx],
-                start,
-                len(piece),
-                self._last_dev,
-                self._first_dev,
-                idx,
-                int(self._slot_serial[idx]),
-                self._slots[idx].step_base,
-            )
+            with self._prof.dispatch():
+                self.cache, self._last_dev, self._first_dev = self._prefill_last(
+                    self.params,
+                    jnp.asarray(padded),
+                    self.cache,
+                    self._table[idx],
+                    start,
+                    len(piece),
+                    self._last_dev,
+                    self._first_dev,
+                    idx,
+                    int(self._slot_serial[idx]),
+                    self._slots[idx].step_base,
+                )
             dispatches += 1
         for idx, start, piece in wave:
             slot = self._slots[idx]
@@ -1433,6 +1524,14 @@ class DecodeServer:
             if slot.phase == "reserved":
                 slot.phase = "prefilling"
             self.prefill_tokens += len(piece)
+            if self._tracer is not None:
+                self._tracer.event(
+                    slot.trace_id,
+                    constants.TRACE_EV_PREFILL_CHUNK,
+                    slot=idx,
+                    start=start,
+                    tokens=len(piece),
+                )
             # Full prompt blocks behind the (dispatched) cursor become
             # shareable: index them now, so even a concurrent same-prefix
             # arrival can hit them — its chunks dispatch after this wave
@@ -1462,8 +1561,22 @@ class DecodeServer:
                     self.ttft_s_by_tenant.setdefault(
                         slot.tenant or "", []
                     ).append(now - slot.t_submit)
+                if self._tracer is not None:
+                    self._tracer.event(
+                        slot.trace_id,
+                        constants.TRACE_EV_FIRST_TOKEN,
+                        slot=idx,
+                        pos=slot.pos,
+                    )
                 self._finish_if_done(idx)
         self.prefill_dispatches += dispatches
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_PREFILL_WAVE,
+                dispatches=dispatches,
+                tokens=sum(len(piece) for _, _, piece in wave),
+                finals=len(finals),
+            )
         if self.metrics is not None:
             self.metrics.inc("nos_tpu_decode_prefill_dispatches", dispatches)
             self.metrics.inc(
@@ -1494,6 +1607,18 @@ class DecodeServer:
             tokens = tokens[: tokens.index(self.eos_id) + 1]
         return list(slot.replay) + tokens
 
+    def _trace_finish(self, idx: int, slot: _Slot, n_tokens: int) -> None:
+        """The lifecycle terminus: one span event + one recorder event
+        per completed request (counts/ids only)."""
+        if self._tracer is not None:
+            self._tracer.event(
+                slot.trace_id, constants.TRACE_EV_FINISH, slot=idx, tokens=n_tokens
+            )
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_FINISH, slot=idx, tokens=n_tokens
+            )
+
     def _finish_if_done(self, idx: int) -> None:
         """Deterministic completion: the countdown and the cache bound are
         known at dispatch time (slot.pos is the NEXT write index; a step at
@@ -1505,7 +1630,9 @@ class DecodeServer:
             # first-token dispatch.
             return
         if slot.remaining <= 0 or slot.pos >= self.max_len:
-            slot.future.set_result(self._finalize(slot))
+            out = self._finalize(slot)
+            slot.future.set_result(out)
+            self._trace_finish(idx, slot, len(out))
             self._release_slot(idx)
 
     def _scan_eos(self) -> None:
@@ -1528,7 +1655,9 @@ class DecodeServer:
                 slot.eos_scanned += 1
                 if token == self.eos_id:
                     slot.refs = slot.refs[: slot.eos_scanned]
-                    slot.future.set_result(self._finalize(slot))
+                    out = self._finalize(slot)
+                    slot.future.set_result(out)
+                    self._trace_finish(idx, slot, len(out))
                     self._release_slot(idx)
                     break
 
@@ -1615,18 +1744,28 @@ class DecodeServer:
             active[idx] = True
             slot.verifying = True
             self.spec_rounds_by_slot[idx] += 1
+            if self._tracer is not None and not slot.trace_decoding:
+                slot.trace_decoding = True
+                self._tracer.event(
+                    slot.trace_id, constants.TRACE_EV_DECODE, slot=idx
+                )
         pos = np.array([s.pos for s in self._slots], dtype=np.int32)
-        preds_dev, self.cache = self._verify_fn(
-            self.params,
-            jnp.asarray(tokens),
-            self.cache,
-            self._table,
-            jnp.asarray(pos),
-            jnp.asarray(lengths),
-            jnp.asarray(active),
-        )
+        with self._prof.dispatch():
+            preds_dev, self.cache = self._verify_fn(
+                self.params,
+                jnp.asarray(tokens),
+                self.cache,
+                self._table,
+                jnp.asarray(pos),
+                jnp.asarray(lengths),
+                jnp.asarray(active),
+            )
         self.steps_run += 1
         self.spec_rounds += 1
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_VERIFY, slots=len(drafts), window=W
+            )
         if self.metrics is not None:
             self.metrics.inc("nos_tpu_decode_steps")
             self.metrics.inc("nos_tpu_decode_spec_rounds")
@@ -1696,14 +1835,22 @@ class DecodeServer:
                 # Deterministic completion now: _finalize truncates at EOS.
                 slot.remaining = 0
             self._finish_if_done(idx)
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_RESOLVE,
+                slots=len(entry.windows),
+                scattered=len(scatter_rows),
+            )
         if scatter_rows:
             # Keep the device-side token vector coherent for these slots'
             # next macro dispatch WITHOUT reading it back to the host (the
             # old batch-wide round paid a hidden second synchronous read
             # here).
-            self._last_dev = self._last_dev.at[
-                jnp.asarray(scatter_rows, dtype=jnp.int32)
-            ].set(jnp.asarray(scatter_vals, dtype=jnp.int32))
+            with self._prof.phase(constants.TICK_PHASE_SAMPLE_SCATTER), \
+                    self._prof.dispatch():
+                self._last_dev = self._last_dev.at[
+                    jnp.asarray(scatter_rows, dtype=jnp.int32)
+                ].set(jnp.asarray(scatter_vals, dtype=jnp.int32))
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -1721,6 +1868,9 @@ class DecodeServer:
                     # benchmark's baseline): every in-flight request
                     # fails, the pool reallocates.
                     self.fail_all_recoveries += 1
+                    if self._recorder is not None:
+                        self._recorder.record(constants.FLIGHT_EV_FAIL_ALL)
+                        self._recorder.dump(constants.FLIGHT_EV_FAIL_ALL)
                     self._fail_outstanding(exc)
                     self._reset_device_state()
                     continue
@@ -1732,6 +1882,9 @@ class DecodeServer:
                     # backstop — no classification can be trusted here.
                     logger.exception("surgical recovery failed; failing all")
                     self.fail_all_recoveries += 1
+                    if self._recorder is not None:
+                        self._recorder.record(constants.FLIGHT_EV_FAIL_ALL)
+                        self._recorder.dump(constants.FLIGHT_EV_FAIL_ALL)
                     self._fail_outstanding(rexc)
                     self._reset_device_state()
 
@@ -1768,6 +1921,15 @@ class DecodeServer:
             self._transient_streak += 1
             if self._transient_streak <= self.max_transient_retries:
                 self.transient_retries += 1
+                if self._recorder is not None:
+                    # Every recovery — a backoff retry included — leaves
+                    # a postmortem: the events LEADING UP to the flake
+                    # are exactly what a streak diagnosis needs.
+                    self._recorder.record(
+                        constants.FLIGHT_EV_TRANSIENT_RETRY,
+                        streak=self._transient_streak,
+                    )
+                    self._recorder.dump(FAULT_TRANSIENT)
                 if self.metrics is not None:
                     self.metrics.inc("nos_tpu_decode_transient_retries")
                 delay = min(
@@ -1793,6 +1955,16 @@ class DecodeServer:
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_exception(exc)
                 self.requests_poisoned += 1
+                if self._tracer is not None:
+                    # The poisoned request's trace terminates here — a
+                    # finish marked failed, not a silent dead end.
+                    self._tracer.event(
+                        slot.trace_id,
+                        constants.TRACE_EV_FINISH,
+                        slot=idx,
+                        tokens=0,
+                        poisoned=1,
+                    )
                 if self.metrics is not None:
                     self.metrics.inc("nos_tpu_decode_requests_poisoned")
                 self._release_slot(idx)
@@ -1822,11 +1994,23 @@ class DecodeServer:
                     t_restore=t_fault,
                     spec=ck.spec,
                     tenant=ck.tenant,
+                    trace_id=ck.trace_id,
                 )
                 for ck in checkpoints
             ]
         )
         self.slots_restored += len(checkpoints)
+        if self._recorder is not None:
+            # The postmortem IS the point of the flight recorder: the
+            # ring's events leading up to this fault, frozen per
+            # recovery, keyed by the classified kind.
+            self._recorder.record(
+                constants.FLIGHT_EV_RECOVERY,
+                kind=kind,
+                checkpoints=len(checkpoints),
+                poison_slot=-1 if poison_slot is None else poison_slot,
+            )
+            self._recorder.dump(kind)
         if self.metrics is not None:
             self.metrics.inc("nos_tpu_decode_recoveries", kind=kind)
             if checkpoints:
@@ -1858,10 +2042,12 @@ class DecodeServer:
             tokens = tokens[: tokens.index(self.eos_id) + 1]
             if slot.future is not None and not slot.future.done():
                 slot.future.set_result(tokens)
+                self._trace_finish(idx, slot, len(tokens))
             return None
         if len(tokens) >= slot.max_new:
             if slot.future is not None and not slot.future.done():
                 slot.future.set_result(tokens[: slot.max_new])
+                self._trace_finish(idx, slot, slot.max_new)
             return None
         spec = slot.adapt.snapshot(len(slot.refs)) if slot.adapt is not None else None
         return SlotCheckpoint(
@@ -1873,6 +2059,7 @@ class DecodeServer:
             prefill_cursor=slot.prefill_cursor,
             spec=spec,
             tenant=slot.tenant,
+            trace_id=slot.trace_id,
             future=slot.future,
         )
 
@@ -1904,6 +2091,21 @@ class DecodeServer:
             return
         self._check_fault("preempt", idx)
         t0 = time.monotonic()
+        if self._tracer is not None:
+            self._tracer.event(
+                slot.trace_id,
+                constants.TRACE_EV_PREEMPT,
+                slot=idx,
+                serial=int(self._slot_serial[idx]),
+            )
+            self._tracer.event(
+                slot.trace_id,
+                constants.TRACE_EV_SPILL,
+                slot=idx,
+                blocks=len(self._block_mgr.slot_blocks(idx)),
+            )
+        if self._recorder is not None:
+            self._recorder.record(constants.FLIGHT_EV_PREEMPT, slot=idx)
         ck = self._checkpoint_slot(idx)
         self._release_slot(idx, spill=True)
         self.preemptions += 1
@@ -1923,6 +2125,7 @@ class DecodeServer:
                     t_restore=t0,
                     spec=ck.spec,
                     tenant=ck.tenant,
+                    trace_id=ck.trace_id,
                 )
             ]
         )
@@ -1984,37 +2187,61 @@ class DecodeServer:
         engine's sole possible progress. With a QuotaPolicy armed, step
         (0) runs first: quota enforcement may preempt borrower slots
         (checkpoint + KV spill + restore-ordered re-admission) to make
-        room for a starved guaranteed tenant's waiting request."""
-        self._enforce_quota()
-        self._admit()
+        room for a starved guaranteed tenant's waiting request.
+
+        With a tracing bundle armed, every phase below runs inside the
+        TickProfiler (nos_tpu/tracing.py): per-phase wall attribution
+        (constants.TICK_PHASES, nested exclusive times) plus the
+        host-overhead vs dispatch split, observed into the metric
+        histograms at tick end. Pure perf_counter bookkeeping — the
+        profiler never syncs the device and never changes which
+        dispatches happen (the tracing-on == tracing-off oracle)."""
+        prof = self._prof
+        prof.begin_tick()
+        try:
+            self._tick_phases(prof)
+        finally:
+            prof.end_tick(self.metrics)
+
+    def _tick_phases(self, prof) -> None:
+        with prof.phase(constants.TICK_PHASE_QUOTA_ENFORCE):
+            self._enforce_quota()
+        with prof.phase(constants.TICK_PHASE_ADMIT):
+            self._admit()
         if self._pending_verifies:
-            self._resolve_verifies(block=False)
-        self._scan_eos()
+            with prof.phase(constants.TICK_PHASE_RESOLVE):
+                self._resolve_verifies(block=False)
+        with prof.phase(constants.TICK_PHASE_EOS_SCAN):
+            self._scan_eos()
         if not any(s.active for s in self._slots):
             self._note_quota_tick()
-            self._stop.wait(0.005)
+            with prof.phase(constants.TICK_PHASE_IDLE):
+                self._stop.wait(0.005)
             return
-        n_prefill = self._pump_prefill()
+        with prof.phase(constants.TICK_PHASE_PUMP_PREFILL):
+            n_prefill = self._pump_prefill()
         n_drafting = 0
         if self.spec_k > 0:
-            drafts = self._spec_drafts()
-            if drafts:
-                # A late EOS may have materialized during a blocking
-                # (spec_sync) history pass — never verify a dead slot.
-                self._scan_eos()
-                drafts = {
-                    i: d for i, d in drafts.items() if self._slots[i].active
-                }
-            if drafts:
-                self._dispatch_verify(drafts)
-                n_drafting = len(drafts)
+            with prof.phase(constants.TICK_PHASE_DISPATCH_VERIFY):
+                drafts = self._spec_drafts()
+                if drafts:
+                    # A late EOS may have materialized during a blocking
+                    # (spec_sync) history pass — never verify a dead slot.
+                    self._scan_eos()
+                    drafts = {
+                        i: d for i, d in drafts.items() if self._slots[i].active
+                    }
+                if drafts:
+                    self._dispatch_verify(drafts)
+                    n_drafting = len(drafts)
         macro = [
             i
             for i, s in enumerate(self._slots)
             if s.active and s.phase == "decoding" and not s.verifying
         ]
         if macro:
-            self._dispatch_macro(macro)
+            with prof.phase(constants.TICK_PHASE_DISPATCH_MACRO):
+                self._dispatch_macro(macro)
         if n_drafting and macro:
             self.both_dispatch_ticks += 1
         if n_prefill and macro:
@@ -2026,10 +2253,12 @@ class DecodeServer:
         if not n_drafting and not macro and not n_prefill:
             # Every active slot is awaiting its verify outcome: the
             # drafting slots themselves need it — the one blocking read.
-            self._resolve_verifies(block=True)
+            with prof.phase(constants.TICK_PHASE_RESOLVE):
+                self._resolve_verifies(block=True)
         self._note_quota_tick()
         if self.metrics is not None:
-            self._publish_gauges(n_drafting, len(macro))
+            with prof.phase(constants.TICK_PHASE_PUBLISH):
+                self._publish_gauges(n_drafting, len(macro))
 
     def _note_quota_tick(self) -> None:
         """Fold this tick's per-tenant decode-token production into the
@@ -2059,27 +2288,37 @@ class DecodeServer:
             [s.remaining if mask[i] else 0 for i, s in enumerate(self._slots)],
             dtype=np.int32,
         )
-        last, toks, self.cache = self._step_fn(
-            self.params,
-            self._last_dev,
-            self.cache,
-            self._table,
-            jnp.asarray(pos),
-            jnp.asarray(mask),
-            jnp.asarray(self._slot_serial),
-            jnp.asarray(step),
-            jnp.asarray(steps_left),
-        )
+        with self._prof.dispatch():
+            last, toks, self.cache = self._step_fn(
+                self.params,
+                self._last_dev,
+                self.cache,
+                self._table,
+                jnp.asarray(pos),
+                jnp.asarray(mask),
+                jnp.asarray(self._slot_serial),
+                jnp.asarray(step),
+                jnp.asarray(steps_left),
+            )
         self._last_dev = last
         ref = _TokRef(toks)
         self._inflight.append(ref)
         self.steps_run += 1
         self.macro_dispatches += 1
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_MACRO, slots=len(idxs), k=K
+            )
         if self.metrics is not None:
             self.metrics.inc("nos_tpu_decode_steps")
             self.metrics.inc("nos_tpu_decode_macro_dispatches")
         for idx in idxs:
             slot = self._slots[idx]
+            if self._tracer is not None and not slot.trace_decoding:
+                slot.trace_decoding = True
+                self._tracer.event(
+                    slot.trace_id, constants.TRACE_EV_DECODE, slot=idx
+                )
             executed = min(K, slot.remaining, self.max_len - slot.pos)
             for k in range(executed):
                 slot.refs.append((ref, idx, k))
@@ -2146,6 +2385,43 @@ class DecodeServer:
         """Ticks where a tenant ran above its guaranteed share — the
         'idle capacity is borrowable' witness."""
         return self._quota.borrowed_ticks if self._quota is not None else 0
+
+    # -- tick-phase profiler counters (read-through to the TickProfiler;
+    # telemetry's collect_serving duck-types these as plain attributes,
+    # all zeros/empty when tracing is off) -----------------------------------
+    @property
+    def ticks_profiled(self) -> int:
+        return self._prof.ticks
+
+    @property
+    def tick_wall_s(self) -> float:
+        """Total measured wall time across profiled ticks."""
+        return self._prof.tick_wall_s
+
+    @property
+    def tick_dispatch_s(self) -> float:
+        """Wall time spent INSIDE jitted-call invocations — the device
+        half of the per-tick split."""
+        return self._prof.dispatch_s
+
+    @property
+    def tick_host_overhead_s(self) -> float:
+        """Tick wall minus dispatch time: pure host scheduling overhead,
+        the quantity behind ROADMAP item 3's dispatch floor."""
+        return self._prof.host_overhead_s
+
+    @property
+    def tick_phase_s(self) -> Dict[str, float]:
+        """Per-phase exclusive wall totals (constants.TICK_PHASES)."""
+        return dict(self._prof.phase_s)
+
+    @property
+    def host_overhead_samples(self) -> List[float]:
+        return list(self._prof.host_overhead_samples)
+
+    @property
+    def dispatch_samples(self) -> List[float]:
+        return list(self._prof.dispatch_samples)
 
     def _publish_gauges(self, n_drafting: int, n_macro: int) -> None:
         """Per-tick split, queue-depth, and pool-state gauges, plus the

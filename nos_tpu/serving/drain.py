@@ -80,6 +80,17 @@ def drain_replica(
             dst = router.select(
                 ck.replay_prompt(), tenant=ck.tenant, exclude=handle
             )
+            if router.tracer is not None:
+                # The re-homed stream keeps ONE trace: the migration is
+                # an edge on the request's existing span chain, not a
+                # new trace on the destination.
+                router.tracer.event(
+                    ck.trace_id,
+                    constants.TRACE_EV_DRAIN_MIGRATE,
+                    src=replica_id,
+                    dst=dst.replica_id,
+                    generated=len(ck.generated),
+                )
             dst.engine.transfer_in_checkpoint(ck, t_restore=t_restore)
             report.slots_migrated += 1
             report.placements.append((ck.serial, dst.replica_id))
@@ -88,12 +99,21 @@ def drain_replica(
             )
         for req in pending:
             dst = router.select(req.prompt, tenant=req.tenant, exclude=handle)
+            if router.tracer is not None:
+                router.tracer.event(
+                    req.trace_id,
+                    constants.TRACE_EV_DRAIN_MIGRATE,
+                    src=replica_id,
+                    dst=dst.replica_id,
+                    generated=0,
+                )
             dst.engine.transfer_in_request(
                 req.prompt,
                 req.max_new,
                 tenant=req.tenant,
                 future=req.future,
                 t_submit=req.t_submit,
+                trace_id=req.trace_id,
             )
             report.requests_migrated += 1
             report.destinations[dst.replica_id] = (

@@ -30,7 +30,14 @@ and, for temperature traffic to survive drain/migrate bit-identically,
 the same params/config/sampling seed (a migrated checkpoint keeps its
 serial and PRNG step, which only reproduces the stream on an engine
 sampling from the same base key — documented, not enforced: greedy
-traffic has no such requirement).
+traffic has no such requirement). For request-lifecycle tracing
+(nos_tpu/tracing.py, docs/tracing.md) the same shape of contract
+applies: give every replica's EngineTracing bundle — and the
+PrefixRouter — ONE shared Tracer, so a drain-migrated stream's trace id
+(riding its SlotCheckpoint) keeps appending to the trace the router
+opened; flight recorders and tick profilers stay per-engine, and
+`fleet_report()` pools their host-overhead/dispatch samples like every
+other tail.
 """
 
 from __future__ import annotations

@@ -37,6 +37,7 @@ its shadow as advisory and never blocks on engine state
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -59,10 +60,19 @@ class PrefixRouter:
         policy: str = constants.ROUTER_POLICY_PREFIX,
         load_penalty_tokens: Optional[float] = None,
         sticky_tenants: bool = True,
+        tracer=None,
     ):
         """`load_penalty_tokens` prices one unit of replica load (an
         active slot / queued request) in prefix-hit tokens; default =
-        one block. Higher values favor balance over cache locality."""
+        one block. Higher values favor balance over cache locality.
+
+        `tracer` (optional, nos_tpu/tracing.py Tracer — share the SAME
+        instance the replicas' EngineTracing bundles use) opens each
+        submitted request's lifecycle trace at the router: the trace
+        starts with a `router.select` span (scoring duration + chosen
+        replica) and its id is threaded into the engine, so one request
+        is one trace from placement to finish — across restores,
+        preemptions, and drain migrations."""
         if policy not in constants.ROUTER_POLICIES:
             raise ValueError(
                 f"unknown router policy {policy!r}; "
@@ -77,6 +87,7 @@ class PrefixRouter:
             else self.block_size
         )
         self.sticky_tenants = bool(sticky_tenants)
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._rr = 0
         self._sticky: Dict[str, str] = {}  # tenant -> replica_id
@@ -97,8 +108,20 @@ class PrefixRouter:
         """Route one request and submit it to the chosen replica's
         engine. Returns that engine's Future — the client never sees
         which replica served it."""
+        trace_id = None
+        t0 = time.perf_counter()
         handle = self.select(prompt, tenant=tenant)
-        return handle.engine.submit(prompt, max_new, tenant=tenant)
+        if self.tracer is not None:
+            trace_id = self.tracer.new_trace()
+            self.tracer.event(
+                trace_id,
+                constants.TRACE_EV_ROUTER_SELECT,
+                dur_s=time.perf_counter() - t0,
+                replica=handle.replica_id,
+            )
+        return handle.engine.submit(
+            prompt, max_new, tenant=tenant, trace_id=trace_id
+        )
 
     def select(
         self,

@@ -169,6 +169,27 @@ class ServingReport:
     ttft_samples: List[float] = field(default_factory=list)
     queue_wait_samples: List[float] = field(default_factory=list)
     restore_latency_samples: List[float] = field(default_factory=list)
+    # Tick-phase profiler (PR 9, nos_tpu/tracing.py, docs/tracing.md):
+    # profiled engine ticks, total measured wall, the per-tick
+    # host-overhead vs dispatch split (dispatch = wall inside jitted-call
+    # invocations; host overhead = everything else — the dispatch-floor
+    # quantity), per-phase exclusive wall totals keyed by
+    # constants.TICK_PHASES, and the per-tick raw samples backing the
+    # split percentiles. All zeros/empty when the engine ran untraced.
+    # `merge` sums the totals, sums the phase dict per key, POOLS the
+    # samples, and re-derives the percentiles — same contract as the
+    # latency tails above.
+    ticks_profiled: int = 0
+    tick_wall_s: float = 0.0
+    tick_dispatch_s: float = 0.0
+    tick_host_overhead_s: float = 0.0
+    tick_phase_s: Dict[str, float] = field(default_factory=dict)
+    host_overhead_p50_s: float = 0.0
+    host_overhead_p95_s: float = 0.0
+    dispatch_p50_s: float = 0.0
+    dispatch_p95_s: float = 0.0
+    host_overhead_samples: List[float] = field(default_factory=list)
+    dispatch_samples: List[float] = field(default_factory=list)
 
     @staticmethod
     def merge(reports: Iterable["ServingReport"]) -> "ServingReport":
@@ -190,12 +211,23 @@ class ServingReport:
                 elif f.name in ("macro_tokens_by_slot", "spec_rounds_by_slot"):
                     for slot, n in val.items():
                         cur[f"{i}:{slot}"] = int(n)
+                elif f.name == "tick_phase_s":
+                    for phase, s in val.items():
+                        cur[phase] = cur.get(phase, 0.0) + float(s)
+                elif f.name in (
+                    "tick_wall_s",
+                    "tick_dispatch_s",
+                    "tick_host_overhead_s",
+                ):
+                    setattr(merged, f.name, cur + float(val))
                 elif isinstance(cur, int):
                     setattr(merged, f.name, cur + int(val))
         for prefix, samples in (
             ("ttft", merged.ttft_samples),
             ("queue_wait", merged.queue_wait_samples),
             ("restore_latency", merged.restore_latency_samples),
+            ("host_overhead", merged.host_overhead_samples),
+            ("dispatch", merged.dispatch_samples),
         ):
             setattr(merged, f"{prefix}_p50_s", percentile(samples, 50))
             setattr(merged, f"{prefix}_p95_s", percentile(samples, 95))
@@ -219,6 +251,8 @@ def collect_serving(server) -> ServingReport:
     ttft = list(getattr(server, "ttft_s", ()))
     queue_wait = list(getattr(server, "queue_wait_s", ()))
     restore = list(getattr(server, "restore_latency_s", ()))
+    host_over = [float(v) for v in getattr(server, "host_overhead_samples", ())]
+    dispatch = [float(v) for v in getattr(server, "dispatch_samples", ())]
     report = ServingReport(
         steps_run=int(getattr(server, "steps_run", 0)),
         macro_dispatches=int(getattr(server, "macro_dispatches", 0)),
@@ -256,6 +290,20 @@ def collect_serving(server) -> ServingReport:
         ttft_samples=[float(v) for v in ttft],
         queue_wait_samples=[float(v) for v in queue_wait],
         restore_latency_samples=[float(v) for v in restore],
+        ticks_profiled=int(getattr(server, "ticks_profiled", 0)),
+        tick_wall_s=float(getattr(server, "tick_wall_s", 0.0)),
+        tick_dispatch_s=float(getattr(server, "tick_dispatch_s", 0.0)),
+        tick_host_overhead_s=float(getattr(server, "tick_host_overhead_s", 0.0)),
+        tick_phase_s={
+            str(k): float(v)
+            for k, v in dict(getattr(server, "tick_phase_s", {}) or {}).items()
+        },
+        host_overhead_p50_s=percentile(host_over, 50),
+        host_overhead_p95_s=percentile(host_over, 95),
+        dispatch_p50_s=percentile(dispatch, 50),
+        dispatch_p95_s=percentile(dispatch, 95),
+        host_overhead_samples=host_over,
+        dispatch_samples=dispatch,
         inflight_dispatches=len(getattr(server, "_inflight", ())),
         pending_verifies=len(getattr(server, "_pending_verifies", ())),
         waiting_requests=len(getattr(server, "_waiting", ())),

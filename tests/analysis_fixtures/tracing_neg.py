@@ -1,0 +1,47 @@
+"""NOS014 negatives: the Tracer and FlightRecorder own their state —
+mutations inside those class bodies are the sanctioned sites; engines
+that derive event names from nos_tpu.constants and route recording
+through the event()/record()/dump() API stay clean. Similarly-named
+attributes that are not tracing state (`_ring_buffer`, `_trace_ids`)
+are out of scope, as are reads.
+"""
+
+from collections import OrderedDict, deque
+
+from nos_tpu import constants
+
+
+class Tracer:
+    def __init__(self):
+        self._traces = OrderedDict()
+
+    def event(self, tid, name, **attrs):
+        self._traces.setdefault(tid, []).append((name, attrs))
+
+
+class FlightRecorder:
+    def __init__(self, capacity=8):
+        self._ring = deque(maxlen=capacity)
+        self._postmortems = deque(maxlen=2)
+
+    def record(self, name, **payload):
+        self._ring.append({"name": name, **payload})
+
+    def dump(self, reason):
+        self._postmortems.append({"reason": reason, "events": list(self._ring)})
+
+
+class Engine:
+    def __init__(self):
+        self._tracer = Tracer()
+        self._recorder = FlightRecorder()
+        self._ring_buffer = []  # not tracing state
+        self._trace_ids = set()  # not tracing state
+
+    def _tick(self, tid):
+        # The sanctioned routes: names from constants, writes via the API.
+        self._tracer.event(tid, constants.TRACE_EV_FINISH, tokens=3)
+        self._recorder.record(constants.FLIGHT_EV_MACRO, slots=2)
+        self._recorder.dump(constants.FLIGHT_EV_RECOVERY)
+        self._ring_buffer.append(tid)
+        return len(self._recorder._ring)  # read: legal

@@ -1,0 +1,33 @@
+"""NOS014 positives: tracing drift, both classes. A span event name
+quoted in prose like this — "req.submit" — stays exempt (docstring).
+
+Expected findings (6): an event-name literal inline in an event() call,
+an event-name literal bound to a module constant, a `.append()` on the
+recorder's ring outside FlightRecorder, a trace-store subscript
+assignment outside Tracer, a `del` on a postmortem entry — and the
+constructor's ring assignment in a non-owner class: like NOS011/NOS013
+there is no constructor exemption, because recorder state EXISTING
+outside the owning class is the drift the rule guards against. Reads
+(`len(...)`, membership, iteration) stay legal.
+"""
+
+from collections import deque
+
+RECOVERY_EVENT = "engine.recovery"
+
+
+class Engine:
+    def __init__(self, tracer, recorder):
+        self._tracer = tracer
+        self._recorder = recorder
+        self._ring = deque(maxlen=8)
+
+    def _tick(self, tid):
+        self._tracer.event(tid, "req.finish", tokens=3)
+        self._recorder._ring.append({"name": RECOVERY_EVENT})
+        self._tracer._traces[tid] = []
+        del self._recorder._postmortems[0]
+        return len(self._recorder._ring)  # read: legal
+
+    def resident(self, tid):
+        return tid in self._tracer._traces  # read: legal

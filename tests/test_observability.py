@@ -119,6 +119,85 @@ class TestEndpoints:
         assert not errors, errors
 
 
+class TestExpositionFormat:
+    """ISSUE 9 satellites: Prometheus scrapers negotiate on the
+    Content-Type version header, `# TYPE` metadata, and real
+    `_bucket{le=...}` histogram series; and `observe()` must hold
+    constant memory (the old bare-list append kept every sample
+    forever)."""
+
+    def test_metrics_content_type_is_prometheus_text_0_0_4(self, server):
+        from nos_tpu import constants
+
+        srv, metrics, _ = server
+        metrics.inc("nos_tpu_scrape_check")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ) as r:
+            assert r.headers.get("Content-Type") == constants.METRICS_CONTENT_TYPE
+            assert r.headers.get("Content-Type") == "text/plain; version=0.0.4"
+        # Probes declare plain text too.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=10
+        ) as r:
+            assert r.headers.get("Content-Type") == "text/plain"
+
+    def test_render_emits_type_lines_per_family(self):
+        from nos_tpu.observability import Metrics
+
+        m = Metrics()
+        m.inc("nos_tpu_cycles", kind="a")
+        m.inc("nos_tpu_cycles", kind="b")
+        m.set_gauge("nos_tpu_depth", 3)
+        m.observe("nos_tpu_plan", 0.2)
+        body = m.render()
+        lines = body.splitlines()
+        # One TYPE line per family (not per labeled series), ahead of it.
+        assert lines.count("# TYPE nos_tpu_cycles_total counter") == 1
+        assert "# TYPE nos_tpu_depth gauge" in lines
+        assert "# TYPE nos_tpu_plan_seconds histogram" in lines
+        assert lines.index("# TYPE nos_tpu_cycles_total counter") < lines.index(
+            'nos_tpu_cycles_total{kind="a"} 1'
+        )
+
+    def test_histogram_buckets_are_cumulative_with_inf(self):
+        from nos_tpu.observability import DURATION_BUCKETS, Metrics
+
+        m = Metrics()
+        for v in (0.0003, 0.0003, 0.004, 0.08, 7.0, 42.0):
+            m.observe("nos_tpu_tick", v, phase="admit")
+        body = m.render()
+        assert 'nos_tpu_tick_seconds_bucket{phase="admit",le="0.0005"} 2' in body
+        assert 'nos_tpu_tick_seconds_bucket{phase="admit",le="0.005"} 3' in body
+        assert 'nos_tpu_tick_seconds_bucket{phase="admit",le="0.1"} 4' in body
+        assert 'nos_tpu_tick_seconds_bucket{phase="admit",le="10"} 5' in body
+        # +Inf catches the overflow sample and equals _count.
+        assert 'nos_tpu_tick_seconds_bucket{phase="admit",le="+Inf"} 6' in body
+        assert 'nos_tpu_tick_seconds_count{phase="admit"} 6' in body
+        # A bucket boundary hit exactly counts into its own le (<=).
+        m2 = Metrics()
+        m2.observe("nos_tpu_edge", DURATION_BUCKETS[3])
+        assert (
+            f'nos_tpu_edge_seconds_bucket{{le="{DURATION_BUCKETS[3]:g}"}} 1'
+            in m2.render()
+        )
+
+    def test_observe_memory_is_bounded_but_count_sum_exact(self):
+        from nos_tpu.observability import DURATION_RESERVOIR, Metrics
+
+        m = Metrics()
+        n = 5 * DURATION_RESERVOIR
+        for i in range(n):
+            m.observe("nos_tpu_leak_check", 0.001)
+        key = m._key("nos_tpu_leak_check", {})
+        # The raw-sample window is capped...
+        assert len(m._durations[key]) == DURATION_RESERVOIR
+        # ...while the rendered count/sum stay exact.
+        body = m.render()
+        assert f"nos_tpu_leak_check_seconds_count {n}" in body
+        assert f"nos_tpu_leak_check_seconds_sum {n * 0.001:g}" in body
+
+
 class TestDecodeServerCounters:
     """The serving plane's counters flow out two ways: live `nos_tpu_decode_*`
     series through an injected Metrics registry (scraped here over real

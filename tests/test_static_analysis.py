@@ -23,6 +23,7 @@ from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
 from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
+from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
 from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
 from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
 
@@ -397,6 +398,58 @@ def test_spill_discipline_real_engine_is_clean():
             os.path.join(TREE, "runtime", fname), [SpillDisciplineChecker()]
         )
         assert findings == [], fname
+
+
+# -- NOS014 tracing event names / recorder state outside their APIs ------------
+def test_trace_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "tracing_pos.py"), [TraceDisciplineChecker()]
+    )
+    assert codes_of(findings) == ["NOS014"]
+    # Inline event literal, event literal bound to a module constant,
+    # ring .append, trace-store subscript assign, postmortem del, and
+    # the non-owner constructor's ring assign — NOT the len()/membership
+    # reads, and NOT the docstring's quoted span name.
+    assert len(findings) == 6
+    msgs = " | ".join(f.message for f in findings)
+    assert "req.finish" in msgs
+    assert "engine.recovery" in msgs
+    assert "_ring" in msgs
+    assert "_traces" in msgs
+    assert "_postmortems" in msgs
+
+
+def test_trace_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "tracing_neg.py"), [TraceDisciplineChecker()]
+    )
+    assert findings == []
+
+
+def test_trace_discipline_constants_py_is_the_definition_site(tmp_path):
+    # The vocabulary's own definition site stays exempt — the same
+    # single-allowed-site rule NOS001 applies.
+    pkg = tmp_path / "constants.py"
+    pkg.write_text('TRACE_EV_FINISH = "req.finish"\n')
+    assert run_checkers(str(pkg), [TraceDisciplineChecker()]) == []
+
+
+def test_trace_discipline_real_surface_is_clean():
+    # The whole tracing surface, checked directly: event names come from
+    # constants and every ring/trace-store mutation lives inside
+    # Tracer/FlightRecorder.
+    for rel in (
+        "tracing.py",
+        "observability.py",
+        os.path.join("runtime", "decode_server.py"),
+        os.path.join("runtime", "block_manager.py"),
+        os.path.join("serving", "router.py"),
+        os.path.join("serving", "drain.py"),
+    ):
+        findings = run_checkers(
+            os.path.join(TREE, rel), [TraceDisciplineChecker()]
+        )
+        assert findings == [], rel
 
 
 # -- engine: inline suppression ----------------------------------------------

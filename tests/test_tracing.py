@@ -1,0 +1,605 @@
+"""Request-lifecycle tracing, flight recorder, and tick-phase profiler
+(ISSUE 9 tentpole, docs/tracing.md).
+
+The correctness bar has two halves. (1) Tracing must be a pure observer:
+with the full EngineTracing bundle armed, greedy AND temperature outputs
+are BIT-IDENTICAL to the untraced run and the engine's dispatch counters
+match exactly (the counter-gated overhead oracle — tracing that changes
+which dispatches happen is measurement perturbing the measured). (2) The
+observations must be coherent: one request is ONE trace across
+device-lost restores and cross-replica drain migrations (the id rides
+SlotCheckpoint), flight-recorder postmortems appear for all three fault
+kinds with counts/ids-only payloads, and the tick-phase attribution sums
+to >= 95% of measured tick wall. Manual ticking wherever determinism
+matters; threaded engines only where the recovery loop itself is the
+machinery under test (fault injection runs through _run's classifier).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.observability import HealthManager, Metrics, ObservabilityServer
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.runtime.faults import (
+    FAULT_DEVICE_LOST,
+    FAULT_POISON,
+    FAULT_TRANSIENT,
+    FaultInjector,
+    FaultSpec,
+)
+from nos_tpu.serving import PrefixRouter, ReplicaSet, drain_replica
+from nos_tpu.telemetry import ServingReport, collect_serving
+from nos_tpu.tracing import EngineTracing, FlightRecorder, TickProfiler, Tracer
+from tests.conftest import serving_test_config
+
+CFG = serving_test_config()
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="bit-exactness oracles cross program shapes: needs the "
+    "deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+def make_engine(params, **kw):
+    defaults = dict(
+        n_slots=2, max_len=64, prompt_buckets=(8, 16), block_size=8,
+        steps_per_dispatch=4, seed=11,
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, **defaults)
+
+
+def drive(server, pred, n=800):
+    for _ in range(n):
+        server._tick()
+        if pred():
+            return True
+    return False
+
+
+# -- Tracer unit ---------------------------------------------------------------
+class TestTracer:
+    def test_ids_are_deterministic_and_events_ordered(self):
+        tr = Tracer()
+        a, b = tr.new_trace(), tr.new_trace()
+        assert a != b and a.startswith(constants.TRACE_ID_PREFIX)
+        tr.event(a, constants.TRACE_EV_SUBMIT, prompt_tokens=3)
+        tr.event(a, constants.TRACE_EV_FINISH, tokens=5)
+        events = tr.trace(a)
+        assert [e["name"] for e in events] == [
+            constants.TRACE_EV_SUBMIT,
+            constants.TRACE_EV_FINISH,
+        ]
+        assert events[0]["attrs"]["prompt_tokens"] == 3
+        assert events[0]["t"] <= events[1]["t"]
+        # A second Tracer mints the same id sequence: deterministic, no RNG.
+        assert Tracer().new_trace() == f"{constants.TRACE_ID_PREFIX}{1:08d}"
+
+    def test_none_trace_id_is_a_noop(self):
+        tr = Tracer()
+        tr.event(None, constants.TRACE_EV_SUBMIT)
+        assert tr.trace_ids() == []
+
+    def test_per_trace_events_are_bounded(self):
+        tr = Tracer(max_events_per_trace=4)
+        tid = tr.new_trace()
+        for i in range(10):
+            tr.event(tid, constants.TRACE_EV_PREFILL_CHUNK, start=i)
+        events = tr.trace(tid)
+        assert len(events) == 4
+        assert [e["attrs"]["start"] for e in events] == [6, 7, 8, 9]  # newest kept
+
+    def test_trace_count_is_bounded_oldest_evicted(self):
+        tr = Tracer(max_traces=3)
+        tids = [tr.new_trace() for _ in range(5)]
+        assert len(tr.trace_ids()) == 3
+        assert tr.trace(tids[0]) is None
+        assert tr.trace(tids[-1]) == []
+        assert tr.dropped_traces == 2
+
+    def test_event_on_foreign_id_recreates_the_trace(self):
+        # A checkpoint migrated in from another replica's tracer keeps
+        # collecting events here instead of vanishing.
+        tr = Tracer()
+        tr.event("tr-foreign", constants.TRACE_EV_RESTORE, slot=1)
+        assert [e["name"] for e in tr.trace("tr-foreign")] == [
+            constants.TRACE_EV_RESTORE
+        ]
+
+
+# -- FlightRecorder unit --------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_keeps_newest_and_counts_lifetime(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record(constants.FLIGHT_EV_MACRO, slots=i)
+        snap = rec.snapshot()
+        assert len(snap) == 4
+        assert [e["slots"] for e in snap] == [6, 7, 8, 9]
+        assert rec.events_recorded == 10
+        assert [e["seq"] for e in snap] == [7, 8, 9, 10]
+
+    def test_postmortems_freeze_the_ring_and_are_bounded(self):
+        rec = FlightRecorder(capacity=8, max_postmortems=2)
+        rec.record(constants.FLIGHT_EV_ADMIT, slot=0)
+        dump = rec.dump(FAULT_TRANSIENT)
+        assert dump["reason"] == FAULT_TRANSIENT
+        assert [e["name"] for e in dump["events"]] == [constants.FLIGHT_EV_ADMIT]
+        # Later ring churn must not rewrite the frozen dump.
+        rec.record(constants.FLIGHT_EV_FINISH, slot=0, tokens=3)
+        assert len(rec.postmortem_dumps()[0]["events"]) == 1
+        rec.dump(FAULT_POISON)
+        rec.dump(FAULT_DEVICE_LOST)
+        reasons = [d["reason"] for d in rec.postmortem_dumps()]
+        assert reasons == [FAULT_POISON, FAULT_DEVICE_LOST]  # bounded at 2
+
+
+# -- TickProfiler unit ---------------------------------------------------------
+class TestTickProfiler:
+    def test_nested_phases_attribute_exclusive_time(self):
+        clock = iter(range(0, 1000)).__next__  # 1s per call, deterministic
+        prof = TickProfiler(clock=clock)
+        prof.begin_tick()  # t=0
+        with prof.phase("outer"):  # enter t=1
+            with prof.phase("inner"):  # enter t=2
+                pass  # exit t=3 -> inner = 1
+            pass  # exit t=4 -> outer = 3 - inner(1) = 2... (see math below)
+        prof.end_tick()
+        # outer: enter 1, exit 4 -> dur 3; inner: enter 2, exit 3 -> dur 1;
+        # outer exclusive = 3 - 1 = 2. Tick wall: begin 0, end 5 -> 5.
+        assert prof.phase_s == {"outer": 2.0, "inner": 1.0}
+        assert prof.ticks == 1
+        assert prof.tick_wall_s == 5.0
+
+    def test_dispatch_split_is_orthogonal_to_phases(self):
+        clock = iter(range(0, 1000)).__next__
+        prof = TickProfiler(clock=clock)
+        prof.begin_tick()  # 0
+        with prof.phase("macro"):  # 1..4 -> 3
+            with prof.dispatch():  # 2..3 -> 1
+                pass
+        prof.end_tick()  # 5
+        assert prof.phase_s == {"macro": 3.0}  # dispatch did NOT subtract
+        assert prof.dispatch_s == 1.0
+        assert prof.host_overhead_s == 4.0  # wall 5 - dispatch 1
+        assert list(prof.dispatch_samples) == [1.0]
+        assert list(prof.host_overhead_samples) == [4.0]
+
+    def test_disabled_profiler_records_nothing(self):
+        prof = TickProfiler(enabled=False)
+        prof.begin_tick()
+        with prof.phase("x"):
+            with prof.dispatch():
+                pass
+        prof.end_tick()
+        assert prof.ticks == 0 and prof.phase_s == {}
+
+    def test_end_tick_observes_histograms(self):
+        clock = iter(range(0, 1000)).__next__
+        metrics = Metrics()
+        prof = TickProfiler(clock=clock)
+        prof.begin_tick()
+        with prof.phase(constants.TICK_PHASE_ADMIT):
+            pass
+        prof.end_tick(metrics)
+        body = metrics.render()
+        assert "nos_tpu_decode_tick_phase_seconds_seconds_bucket" in body
+        assert 'phase="admit"' in body
+        assert "nos_tpu_decode_tick_host_overhead_seconds_seconds_count" in body
+
+
+# -- the counter-gated overhead oracle ----------------------------------------
+@cpu_only
+class TestTracingIsAPureObserver:
+    def _run(self, params, tracing, temperature=0.0):
+        server = make_engine(
+            params, n_slots=4, tracing=tracing, temperature=temperature
+        )
+        prompts = [
+            [5, 11, 3, 42],
+            [1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+            [5, 11, 3, 42],  # shared prefix with stream 0
+            [9, 8, 7],
+        ]
+        futs = [
+            server.submit(p, max_new=n)
+            for p, n in zip(prompts, (12, 8, 10, 14))
+        ]
+        assert drive(server, lambda: all(f.done() for f in futs))
+        outs = [f.result() for f in futs]
+        counters = (
+            server.steps_run,
+            server.macro_dispatches,
+            server.prefill_dispatches,
+            server.prefill_tokens,
+            server.prefix_hit_blocks,
+        )
+        return outs, counters
+
+    def test_greedy_outputs_and_counters_identical_tracing_on_vs_off(self, params):
+        outs_off, counters_off = self._run(params, None)
+        outs_on, counters_on = self._run(params, EngineTracing())
+        assert outs_on == outs_off
+        assert counters_on == counters_off
+
+    def test_temperature_outputs_identical_tracing_on_vs_off(self, params):
+        outs_off, counters_off = self._run(params, None, temperature=0.7)
+        outs_on, counters_on = self._run(
+            params, EngineTracing(), temperature=0.7
+        )
+        assert outs_on == outs_off
+        assert counters_on == counters_off
+
+
+# -- lifecycle spans -----------------------------------------------------------
+@cpu_only
+class TestLifecycleSpans:
+    def test_request_trace_covers_the_lifecycle_in_order(self, params):
+        tracing = EngineTracing()
+        server = make_engine(params, tracing=tracing)
+        fut = server.submit(list(range(1, 21)), max_new=6)
+        assert drive(server, fut.done)
+        (tid,) = tracing.tracer.trace_ids()
+        names = [e["name"] for e in tracing.tracer.trace(tid)]
+        assert names[0] == constants.TRACE_EV_SUBMIT
+        assert names[-1] == constants.TRACE_EV_FINISH
+        # submit -> reserved -> chunk[i] -> first_token -> decode -> finish,
+        # in that order (a 20-token prompt at chunk width 16 takes 2 chunks).
+        for earlier, later in zip(
+            (
+                constants.TRACE_EV_SUBMIT,
+                constants.TRACE_EV_RESERVED,
+                constants.TRACE_EV_PREFILL_CHUNK,
+                constants.TRACE_EV_FIRST_TOKEN,
+                constants.TRACE_EV_DECODE,
+            ),
+            (
+                constants.TRACE_EV_RESERVED,
+                constants.TRACE_EV_PREFILL_CHUNK,
+                constants.TRACE_EV_FIRST_TOKEN,
+                constants.TRACE_EV_DECODE,
+                constants.TRACE_EV_FINISH,
+            ),
+        ):
+            assert names.index(earlier) < names.index(later)
+        assert names.count(constants.TRACE_EV_PREFILL_CHUNK) == 2
+
+    def test_span_attrs_are_counts_and_ids_only(self, params):
+        """The privacy contract: no token values, prompts, or generated
+        text in any event — every attr value is a scalar (and never a
+        list/dict that could smuggle content)."""
+        tracing = EngineTracing()
+        server = make_engine(params, tracing=tracing)
+        fut = server.submit([7, 3, 9, 1, 4], max_new=5)
+        assert drive(server, fut.done)
+        for tid in tracing.tracer.trace_ids():
+            for ev in tracing.tracer.trace(tid):
+                assert ev["name"] in constants.TRACE_EVENTS
+                for key, value in ev["attrs"].items():
+                    assert isinstance(value, (int, float, str, bool)), (
+                        ev["name"], key, value,
+                    )
+        for ev in tracing.recorder.snapshot():
+            assert ev["name"] in constants.FLIGHT_EVENTS
+            for key, value in ev.items():
+                assert isinstance(value, (int, float, str, bool)), (ev, key)
+
+
+# -- trace continuity across recovery and migration ----------------------------
+@cpu_only
+class TestTraceContinuity:
+    def test_one_trace_across_device_lost_restore(self, params):
+        """PR 6's chaos substrate, observed: a device-lost fault mid-
+        decode restores the slot, and the restored stream CONTINUES the
+        same trace (req.restore edge), finishing bit-identical to the
+        fault-free run."""
+        prompts = [[5, 11, 3, 42], [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]]
+
+        def run(injector, tracing):
+            server = make_engine(
+                params, tracing=tracing, fault_injector=injector,
+                transient_backoff_s=0.001,
+            )
+            futs = [server.submit(p, max_new=10) for p in prompts]
+            server.start()
+            try:
+                outs = [f.result(timeout=300) for f in futs]
+            finally:
+                server.stop()
+            return outs
+
+        base = run(None, None)
+        tracing = EngineTracing()
+        injector = FaultInjector(
+            [FaultSpec("dispatch_macro", 2, FAULT_DEVICE_LOST)]
+        )
+        outs = run(injector, tracing)
+        assert outs == base  # replay exactness, traced
+        tids = tracing.tracer.trace_ids()
+        assert len(tids) == 2  # NO new trace was minted by the recovery
+        restored = [
+            tid
+            for tid in tids
+            if any(
+                e["name"] == constants.TRACE_EV_RESTORE
+                for e in tracing.tracer.trace(tid)
+            )
+        ]
+        assert restored, "no trace carries the restore edge"
+        for tid in restored:
+            names = [e["name"] for e in tracing.tracer.trace(tid)]
+            # One coherent story: submitted, reserved, restored later,
+            # finished — all on the same id.
+            assert names.index(constants.TRACE_EV_SUBMIT) < names.index(
+                constants.TRACE_EV_RESTORE
+            ) < names.index(constants.TRACE_EV_FINISH)
+
+    def test_one_trace_across_drain_migration(self, params):
+        """The cross-replica half: the id rides SlotCheckpoint through
+        drain_extract -> router.select -> transfer_in_checkpoint, so the
+        re-homed stream appends to the trace the router opened."""
+        tracer = Tracer()
+        engines = [
+            make_engine(params, tracing=EngineTracing(tracer=tracer))
+            for _ in range(2)
+        ]
+        replicas = ReplicaSet(engines)
+        router = PrefixRouter(replicas, tracer=tracer)
+        fut = router.submit(list(range(1, 10)), max_new=12, tenant="t0")
+        src = replicas.handles[0] if engines[0]._accepted else replicas.handles[1]
+        src_engine = src.engine
+        # Tick the source mid-decode (first token out, not finished).
+        assert drive(src_engine, lambda: len(src_engine.ttft_s) > 0)
+        assert not fut.done()
+        report = drain_replica(replicas, router, src.replica_id)
+        assert report.slots_migrated == 1
+        dst = [h for h in replicas.handles if h is not src][0]
+        assert drive(dst.engine, fut.done)
+        out = fut.result()
+        assert len(out) == 12
+        (tid,) = tracer.trace_ids()
+        names = [e["name"] for e in tracer.trace(tid)]
+        assert names[0] == constants.TRACE_EV_ROUTER_SELECT
+        assert constants.TRACE_EV_DRAIN_MIGRATE in names
+        migrate = next(
+            e
+            for e in tracer.trace(tid)
+            if e["name"] == constants.TRACE_EV_DRAIN_MIGRATE
+        )
+        assert migrate["attrs"]["src"] == src.replica_id
+        assert migrate["attrs"]["dst"] == dst.replica_id
+        # The destination's replay continues the SAME trace.
+        assert names.index(constants.TRACE_EV_DRAIN_MIGRATE) < names.index(
+            constants.TRACE_EV_RESTORE
+        ) < names.index(constants.TRACE_EV_FINISH)
+        assert names[-1] == constants.TRACE_EV_FINISH
+
+    def test_checkpoint_dict_round_trips_the_trace_id(self):
+        from nos_tpu.runtime.checkpoint import SlotCheckpoint
+
+        ck = SlotCheckpoint(
+            prompt=[1, 2], generated=[3], max_new=4, serial=7,
+            trace_id="tr-00000042",
+        )
+        back = SlotCheckpoint.from_dict(ck.to_dict())
+        assert back.trace_id == "tr-00000042"
+        # Pre-tracing (v2, no trace_id key) dicts still load.
+        d = ck.to_dict()
+        del d["trace_id"]
+        assert SlotCheckpoint.from_dict(d).trace_id is None
+
+
+# -- flight-recorder postmortems ----------------------------------------------
+@cpu_only
+class TestPostmortems:
+    @pytest.mark.parametrize(
+        "spec, kind",
+        [
+            (FaultSpec("admit", 2, FAULT_POISON), FAULT_POISON),
+            (FaultSpec("dispatch_macro", 2, FAULT_TRANSIENT), FAULT_TRANSIENT),
+            (FaultSpec("dispatch_macro", 2, FAULT_DEVICE_LOST), FAULT_DEVICE_LOST),
+        ],
+    )
+    def test_recovery_dumps_a_postmortem_for_every_fault_kind(
+        self, params, spec, kind
+    ):
+        tracing = EngineTracing()
+        server = make_engine(
+            params,
+            tracing=tracing,
+            fault_injector=FaultInjector([spec]),
+            transient_backoff_s=0.001,
+        )
+        futs = [
+            server.submit(p, max_new=8)
+            for p in ([5, 11, 3, 42], [1, 2, 3, 4, 5, 6, 7, 8, 9, 10])
+        ]
+        server.start()
+        try:
+            for f in futs:
+                try:
+                    f.result(timeout=300)
+                except Exception:  # noqa: BLE001 — poisoned arm
+                    pass
+        finally:
+            server.stop()
+        dumps = tracing.recorder.postmortem_dumps()
+        assert dumps, "recovery left no postmortem"
+        assert dumps[0]["reason"] == kind
+        names = {e["name"] for e in dumps[0]["events"]}
+        # The dump holds the events LEADING UP to the fault.
+        assert constants.FLIGHT_EV_ADMIT in names
+        assert names <= set(constants.FLIGHT_EVENTS)
+        if kind != FAULT_TRANSIENT:
+            # The ring (post-recovery) carries the classified recovery
+            # event itself; a transient's dump precedes its retry marker.
+            ring = [e["name"] for e in tracing.recorder.snapshot()]
+            assert constants.FLIGHT_EV_RECOVERY in ring
+
+
+# -- tick-phase attribution gate ----------------------------------------------
+@cpu_only
+class TestTickPhaseAttribution:
+    def test_phase_attribution_covers_95_percent_of_tick_wall(self, params):
+        tracing = EngineTracing()
+        server = make_engine(params, n_slots=4, tracing=tracing)
+        futs = [
+            server.submit(list(range(1, 11)), max_new=12) for _ in range(4)
+        ]
+        assert drive(server, lambda: all(f.done() for f in futs))
+        prof = tracing.profiler
+        assert prof.ticks > 0
+        assert prof.attribution_coverage() >= 0.95
+        # The split partitions the wall: host + dispatch == wall (up to
+        # the max(0, ...) clamp).
+        assert prof.dispatch_s > 0
+        assert prof.host_overhead_s + prof.dispatch_s == pytest.approx(
+            prof.tick_wall_s, rel=1e-6
+        )
+        # The named scheduler phases all appear.
+        for phase in (
+            constants.TICK_PHASE_ADMIT,
+            constants.TICK_PHASE_PUMP_PREFILL,
+            constants.TICK_PHASE_DISPATCH_MACRO,
+        ):
+            assert phase in prof.phase_s
+
+    def test_serving_report_carries_and_merges_the_split(self, params):
+        tracing = EngineTracing()
+        server = make_engine(params, tracing=tracing)
+        fut = server.submit([1, 2, 3, 4, 5], max_new=6)
+        assert drive(server, fut.done)
+        rep = collect_serving(server)
+        assert rep.ticks_profiled == tracing.profiler.ticks
+        assert rep.tick_wall_s > 0
+        assert rep.tick_phase_s
+        assert len(rep.dispatch_samples) == rep.ticks_profiled
+        # Fleet merge: totals sum, phase dict sums per key, percentiles
+        # re-derive from POOLED samples.
+        skew = ServingReport(
+            ticks_profiled=1,
+            tick_wall_s=100.0,
+            tick_host_overhead_s=99.0,
+            tick_dispatch_s=1.0,
+            tick_phase_s={constants.TICK_PHASE_ADMIT: 99.0},
+            host_overhead_samples=[99.0],
+            dispatch_samples=[1.0],
+        )
+        merged = ServingReport.merge([rep, skew])
+        assert merged.ticks_profiled == rep.ticks_profiled + 1
+        assert merged.tick_wall_s == pytest.approx(rep.tick_wall_s + 100.0)
+        assert merged.tick_phase_s[constants.TICK_PHASE_ADMIT] == pytest.approx(
+            rep.tick_phase_s[constants.TICK_PHASE_ADMIT] + 99.0
+        )
+        assert len(merged.host_overhead_samples) == len(
+            rep.host_overhead_samples
+        ) + 1
+        # The pooled p95 sees the skewed replica's tail...
+        assert merged.host_overhead_p95_s == 99.0
+        # ...while the engine's own p50 stays representative.
+        assert merged.host_overhead_p50_s < 99.0
+
+    def test_untraced_engine_reports_zeros(self, params):
+        server = make_engine(params)
+        fut = server.submit([1, 2, 3], max_new=4)
+        assert drive(server, fut.done)
+        rep = collect_serving(server)
+        assert rep.ticks_profiled == 0
+        assert rep.tick_phase_s == {}
+        assert rep.dispatch_samples == []
+
+
+# -- /debug endpoints ----------------------------------------------------------
+class TestDebugEndpoints:
+    def _get(self, port, path, token=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            headers={"Authorization": f"Bearer {token}"} if token else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.headers.get("Content-Type"), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Content-Type"), e.read()
+
+    def test_debug_events_and_trace_serve_json(self):
+        tracer = Tracer()
+        recorder = FlightRecorder()
+        tid = tracer.new_trace()
+        tracer.event(tid, constants.TRACE_EV_SUBMIT, prompt_tokens=3)
+        recorder.record(constants.FLIGHT_EV_ADMIT, slot=0, serial=1)
+        recorder.dump(FAULT_TRANSIENT)
+        srv = ObservabilityServer(
+            Metrics(), HealthManager(), port=0, tracer=tracer, recorder=recorder
+        ).start()
+        try:
+            status, ctype, body = self._get(srv.port, constants.DEBUG_PATH_EVENTS)
+            assert status == 200 and ctype == "application/json"
+            payload = json.loads(body)
+            assert payload["events"][0]["name"] == constants.FLIGHT_EV_ADMIT
+            assert payload["postmortems"][0]["reason"] == FAULT_TRANSIENT
+            assert payload["traces"] == [tid]
+            status, ctype, body = self._get(
+                srv.port, constants.DEBUG_PATH_TRACE_PREFIX + tid
+            )
+            assert status == 200 and ctype == "application/json"
+            trace = json.loads(body)
+            assert trace["trace_id"] == tid
+            assert trace["events"][0]["name"] == constants.TRACE_EV_SUBMIT
+            # Unknown trace id -> 404; unarmed paths stay 404 too.
+            status, _, _ = self._get(
+                srv.port, constants.DEBUG_PATH_TRACE_PREFIX + "tr-nope"
+            )
+            assert status == 404
+        finally:
+            srv.stop()
+
+    def test_debug_endpoints_404_when_tracing_not_attached(self):
+        srv = ObservabilityServer(Metrics(), HealthManager(), port=0).start()
+        try:
+            assert self._get(srv.port, constants.DEBUG_PATH_EVENTS)[0] == 404
+            assert (
+                self._get(srv.port, constants.DEBUG_PATH_TRACE_PREFIX + "x")[0]
+                == 404
+            )
+        finally:
+            srv.stop()
+
+    def test_debug_endpoints_require_the_bearer_token(self):
+        tracer = Tracer()
+        recorder = FlightRecorder()
+        tid = tracer.new_trace()
+        srv = ObservabilityServer(
+            Metrics(),
+            HealthManager(),
+            port=0,
+            metrics_token="s3cret",
+            tracer=tracer,
+            recorder=recorder,
+        ).start()
+        try:
+            for path in (
+                constants.DEBUG_PATH_EVENTS,
+                constants.DEBUG_PATH_TRACE_PREFIX + tid,
+            ):
+                status, _, _ = self._get(srv.port, path)
+                assert status == 401, path
+                status, _, _ = self._get(srv.port, path, token="wrong")
+                assert status == 401, path
+                status, _, _ = self._get(srv.port, path, token="s3cret")
+                assert status == 200, path
+            # Probes stay open.
+            assert self._get(srv.port, "/healthz")[0] == 200
+        finally:
+            srv.stop()
