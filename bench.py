@@ -289,7 +289,12 @@ def _trace_timeline(
         try:
             # Warm every program shape so the timed window holds no
             # compiles (the overhead gate compares tick-loop costs).
-            server.generate(prompts[0], max_new=4, timeout=600)
+            # Full-dress: the SAME traffic as the measurement, because
+            # the fused-burst programs (PR 10) compile per window count
+            # — a token-count-truncated warmup would leave their
+            # compiles inside the timed window.
+            for f in [server.submit(p, max_new=max_new) for p in prompts]:
+                f.result(timeout=600)
             t0 = _time.perf_counter()
             futs = [server.submit(p, max_new=max_new) for p in prompts]
             outs = [list(f.result(timeout=600)) for f in futs]
@@ -342,6 +347,153 @@ def _trace_timeline(
             1e3 * report.tick_host_overhead_s / max(1, dispatches), 4
         ),
         "flight_recorder_events": tracing.recorder.events_recorded,
+    }
+
+
+def _dispatch_floor(
+    np,
+    cfg,
+    params,
+    n_streams: int = 8,
+    prompt_len: int = 24,
+    max_new: int = 96,
+    max_len: int = 128,
+    prompt_buckets=(8, 16),
+    steps_per_dispatch: int = 1,
+    burst_windows: int = 8,
+    block_size: int = 8,
+    trials: int = 2,
+) -> dict:
+    """Dispatch-floor A/B (PR 10, ROADMAP item 3): fused macro bursts
+    off vs on, IDENTICAL traffic, both arms traced. K defaults to 1 —
+    the iteration-level (Orca-style) dispatch regime where the per-
+    dispatch host floor actually binds; the bench's K=16 macro scenarios
+    elsewhere measure the already-amortized regime.
+
+    Methodology: MANUAL deterministic ticks (no engine thread), a
+    full-dress warmup pass so every program shape — each fused-burst
+    window count included — compiles outside the measurement, then a
+    STEADY-STATE window: from "every slot decoding, nothing queued" to
+    just before the first completion (so neither admissions, prefill
+    chunking, nor end-of-stream materialization pollute the split).
+    Every quoted counter is a delta over that window. The artifact
+    carries the acceptance facts: (a) outputs bit-identical burst-on vs
+    burst-off; (b) engine dispatches per generated token down ~N x;
+    (c) steady-state host overhead per generated token (trace_timeline's
+    attribution, per token) with its off/on ratio — the floor-must-drop
+    gate `make bench-smoke` enforces; (d) h2d uploads flat (the
+    device-resident tick state: ZERO metadata uploads per steady
+    dispatch). Best-of-`trials` per arm on the wall numbers."""
+    import time as _time
+
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.telemetry import collect_serving
+    from nos_tpu.tracing import EngineTracing
+
+    srng = np.random.default_rng([2026, 10, n_streams, prompt_len])
+    prompts = [
+        srng.integers(1, cfg.vocab, prompt_len).tolist() for _ in range(n_streams)
+    ]
+    # End the measured window before ANY lane can finish inside it.
+    tail = 3 * burst_windows * steps_per_dispatch
+
+    def drain(server, futs):
+        while not all(f.done() for f in futs):
+            server._tick()
+
+    def run(burst_on):
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=n_streams,
+            max_len=max_len,
+            prompt_buckets=prompt_buckets,
+            steps_per_dispatch=steps_per_dispatch,
+            burst_windows=burst_windows if burst_on else 1,
+            block_size=block_size,
+            tracing=EngineTracing(),
+        )
+        try:
+            drain(server, [server.submit(p, max_new=max_new) for p in prompts])
+            futs = [server.submit(p, max_new=max_new) for p in prompts]
+            while not (
+                all(s.active and s.phase == "decoding" for s in server._slots)
+                and not server._waiting
+                and server._queue.empty()
+            ):
+                server._tick()
+            before = collect_serving(server)
+            t0 = _time.perf_counter()
+            while min(s.remaining for s in server._slots) > tail:
+                server._tick()
+            wall = _time.perf_counter() - t0
+            after = collect_serving(server)
+            drain(server, futs)
+            outs = [list(f.result(timeout=600)) for f in futs]
+            return outs, wall, before, after
+        finally:
+            server.stop()
+
+    best = {}
+    identical = True
+    outs_ref = None
+    for _ in range(max(1, trials)):
+        for arm in (False, True):
+            outs, wall, before, after = run(arm)
+            if arm:
+                identical = identical and outs == outs_ref
+            else:
+                outs_ref = outs
+            cur = best.get(arm)
+            if cur is None or wall < cur[0]:
+                best[arm] = (wall, before, after)
+
+    def arm_stats(arm):
+        wall, before, after = best[arm]
+
+        def delta(field):
+            return getattr(after, field) - getattr(before, field)
+
+        tokens = sum(after.macro_tokens_by_slot.values()) - sum(
+            before.macro_tokens_by_slot.values()
+        )
+        dispatches = delta("steps_run") + delta("prefill_dispatches")
+        host_s = delta("tick_host_overhead_s")
+        return {
+            "window_tokens": tokens,
+            "tok_s": round(tokens / max(1e-9, wall), 1),
+            "engine_dispatches": dispatches,
+            "dispatches_per_token": round(dispatches / max(1, tokens), 4),
+            "host_overhead_ms": round(host_s * 1e3, 3),
+            "host_overhead_us_per_token": round(1e6 * host_s / max(1, tokens), 3),
+            "dispatch_floor_ms_per_dispatch": round(
+                1e3 * host_s / max(1, dispatches), 4
+            ),
+            "burst_dispatches": delta("burst_dispatches"),
+            "burst_windows_run": delta("burst_windows_run"),
+            "h2d_uploads": delta("h2d_uploads"),
+            "staging_syncs": delta("staging_syncs"),
+            "blocking_syncs": delta("blocking_syncs"),
+        }
+
+    off, on = arm_stats(False), arm_stats(True)
+    return {
+        "streams": n_streams,
+        "max_new": max_new,
+        "steps_per_dispatch": steps_per_dispatch,
+        "burst_windows": burst_windows,
+        "trials": max(1, trials),
+        "outputs_identical": identical,
+        "burst_off": off,
+        "burst_on": on,
+        "dispatches_per_token_ratio": round(
+            off["dispatches_per_token"] / max(1e-9, on["dispatches_per_token"]), 2
+        ),
+        "host_overhead_per_token_ratio": round(
+            off["host_overhead_us_per_token"]
+            / max(1e-9, on["host_overhead_us_per_token"]),
+            2,
+        ),
     }
 
 
@@ -946,6 +1098,14 @@ def _decode_phase(jax, jnp) -> dict:
     # carried unexplained since BENCH_r04.
     out["trace_timeline"] = _retry(
         "decode:trace_timeline", lambda: _trace_timeline(np, cfg, params)
+    )
+
+    # Dispatch-floor A/B (PR 10, ROADMAP item 3): fused macro bursts
+    # off vs on on identical traffic — dispatches per token down ~N x,
+    # steady-state host overhead per token down with it, outputs
+    # bit-identical.
+    out["dispatch_floor"] = _retry(
+        "decode:dispatch_floor", lambda: _dispatch_floor(np, cfg, params)
     )
     return out
 

@@ -16,7 +16,20 @@ asserts the PR-9 acceptance gates:
   - the dispatch-floor split is present (host_overhead/dispatch ms and
     the per-dispatch floor estimate).
 
-Exit 0 and print the artifact on success; exit 1 with the failed gate
+PR 10 adds the `dispatch_floor` A/B (fused macro bursts off vs on on
+identical traffic) with its own gates:
+
+  - outputs bit-identical burst-on vs burst-off;
+  - engine dispatches per token DROP burst-on (counter-based, noise-
+    free);
+  - steady-state host overhead per generated token DROPS burst-on (the
+    floor-must-drop gate);
+  - burst-on actually fused (burst_dispatches > 0) and tok/s did not
+    regress beyond the tolerance (NOS_TPU_BURST_TOKS_TOLERANCE_PCT,
+    default 10% — wall-based, so a slack band absorbs CI scheduling
+    noise; the counter gates carry the regression protection).
+
+Exit 0 and print the artifacts on success; exit 1 with the failed gate
 otherwise.
 """
 
@@ -109,6 +122,35 @@ def main() -> int:
     if not parsed.get("flight_recorder_events", 0):
         failures.append("flight recorder recorded nothing")
 
+    # -- PR 10: the dispatch-floor A/B (bursts off vs on) ------------------
+    floor = bench._dispatch_floor(np, cfg, params, trials=2)
+    floor_payload = json.dumps(floor, sort_keys=True)
+    floor_parsed = json.loads(floor_payload)
+    print(floor_payload)
+
+    if not floor_parsed["outputs_identical"]:
+        failures.append("outputs differ burst-on vs burst-off")
+    off, on = floor_parsed["burst_off"], floor_parsed["burst_on"]
+    if not on["burst_dispatches"]:
+        failures.append("burst arm never fused a macro burst")
+    if on["dispatches_per_token"] >= off["dispatches_per_token"]:
+        failures.append(
+            f"dispatches/token did not drop: off "
+            f"{off['dispatches_per_token']} vs on {on['dispatches_per_token']}"
+        )
+    if on["host_overhead_us_per_token"] >= off["host_overhead_us_per_token"]:
+        failures.append(
+            f"host overhead/token did not drop: off "
+            f"{off['host_overhead_us_per_token']} vs on "
+            f"{on['host_overhead_us_per_token']}"
+        )
+    toks_tol = float(os.environ.get("NOS_TPU_BURST_TOKS_TOLERANCE_PCT", "10.0"))
+    if on["tok_s"] < off["tok_s"] * (1.0 - toks_tol / 100.0):
+        failures.append(
+            f"burst-on tok/s regressed beyond {toks_tol}%: "
+            f"off {off['tok_s']} vs on {on['tok_s']}"
+        )
+
     if failures:
         for f in failures:
             print(f"[bench-smoke] FAIL: {f}", file=sys.stderr)
@@ -117,7 +159,14 @@ def main() -> int:
         f"[bench-smoke] ok: overhead {parsed['tracing_overhead_pct']:.2f}% "
         f"(gate {threshold}%), attribution "
         f"{parsed['phase_attribution_coverage']:.3f}, dispatch floor "
-        f"{parsed['dispatch_floor_ms_per_dispatch']} ms/dispatch",
+        f"{parsed['dispatch_floor_ms_per_dispatch']} ms/dispatch; "
+        f"burst A/B: dispatches/token {off['dispatches_per_token']} -> "
+        f"{on['dispatches_per_token']} "
+        f"({floor_parsed['dispatches_per_token_ratio']}x), host-overhead/token "
+        f"{off['host_overhead_us_per_token']} -> "
+        f"{on['host_overhead_us_per_token']} us "
+        f"({floor_parsed['host_overhead_per_token_ratio']}x), tok/s "
+        f"{off['tok_s']} -> {on['tok_s']}",
         file=sys.stderr,
     )
     return 0

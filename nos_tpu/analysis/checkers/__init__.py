@@ -16,6 +16,7 @@ def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
     from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
+    from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
     from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
     from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
     from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
@@ -30,5 +31,6 @@ def all_checkers() -> List[Checker]:
         BlockDisciplineChecker(),
         FaultDisciplineChecker(),
         SpillDisciplineChecker(),
+        StagingDisciplineChecker(),
         TraceDisciplineChecker(),
     ]

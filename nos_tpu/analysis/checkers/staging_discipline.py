@@ -1,0 +1,136 @@
+"""NOS015 — host->device staging outside the staging API on the tick path.
+
+NOS010 polices the device->host direction (blocking reads); this checker
+polices the OTHER half of the dispatch floor: host->device uploads. The
+serving engine's tick metadata is device-resident (runtime/staging.py
+TickState, advanced by the dispatched programs themselves), and every
+upload the tick path still needs — prompt chunks, verify windows, the
+packed state sync — funnels through the counted `HostStage.to_device`,
+so the host-sync budget (`h2d_uploads`) is exact. A stray `jnp.asarray`/
+`jnp.array`/`jax.device_put` in a tick-path method re-introduces an
+uncounted per-dispatch transfer — exactly the ~6-upload-per-macro-
+dispatch pattern PR 10 removed.
+
+Scope: identical to NOS010 — files under `runtime/` containing an ENGINE
+class (a class defining `_tick`); flagged regions are the engine class's
+methods reachable from `_tick`/`_run` via `self.method()` calls plus
+every method of helper classes in the same file. The staging module
+itself (runtime/staging.py) defines no engine class and is therefore out
+of scope by construction — it is the ONE sanctioned home of the raw
+transfer. Closures inside `__init__` (the jitted program bodies) are out
+of scope too: an asarray on a traced value inside jit is program math,
+not a transfer. Genuinely sanctioned engine-side sites carry
+`# nos-lint: ignore[NOS015]` with a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from nos_tpu.analysis.core import Checker, FileContext, Report
+from nos_tpu.analysis.checkers.trace_safety import _dotted
+
+_ROOTS = ("_tick", "_run")
+
+_STAGING = {
+    "jax.numpy.asarray": "jnp.asarray() (uncounted host->device staging)",
+    "jax.numpy.array": "jnp.array() (uncounted host->device staging)",
+    "jax.device_put": "jax.device_put() (uncounted host->device staging)",
+}
+
+
+class StagingDisciplineChecker(Checker):
+    name = "staging-discipline"
+    codes = ("NOS015",)
+    description = "host->device staging outside the staging API on the tick path"
+
+    def __init__(self) -> None:
+        self._active = False
+        self._aliases: Dict[str, str] = {}
+        self._scope_funcs: Set[ast.AST] = set()
+
+    # -- per-file prescan ----------------------------------------------------
+    def begin_file(self, ctx: FileContext) -> None:
+        self._active = "runtime" in ctx.segments[:-1]
+        self._aliases = {}
+        self._scope_funcs = set()
+        if not self._active:
+            return
+        engine: List[Dict[str, ast.AST]] = []
+        helpers: List[Dict[str, ast.AST]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self._aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif isinstance(node, ast.ClassDef):
+                methods = {
+                    n.name: n
+                    for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                (engine if "_tick" in methods else helpers).append(methods)
+        if not engine:
+            self._active = False
+            return
+        for methods in engine:
+            for name in self._reachable(methods):
+                self._scope_funcs.add(methods[name])
+        for methods in helpers:
+            self._scope_funcs.update(methods.values())
+
+    @staticmethod
+    def _reachable(methods: Dict[str, ast.AST]) -> Set[str]:
+        """Methods reachable from the tick roots via `self.method()` calls
+        (the same unambiguous local resolution NOS006/NOS010 use)."""
+        seen = {r for r in _ROOTS if r in methods}
+        queue = list(seen)
+        while queue:
+            body = methods[queue.pop()]
+            for node in ast.walk(body):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                    continue
+                target = node.func
+                if (
+                    isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and target.attr in methods
+                    and target.attr not in seen
+                ):
+                    seen.add(target.attr)
+                    queue.append(target.attr)
+        return seen
+
+    # -- visit ---------------------------------------------------------------
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if not self._active or not isinstance(node, ast.Call):
+            return
+        enclosing = ctx.enclosing_all(ast.FunctionDef, ast.AsyncFunctionDef)
+        if not any(f in self._scope_funcs for f in enclosing):
+            return
+        # Closures defined INSIDE a scoped method but not the method
+        # itself (jitted program bodies built in __init__ never land
+        # here; bodies built inside a tick method would — that is
+        # deliberate: building a program per tick is itself a bug).
+        reason = self._staging_reason(node)
+        if reason is not None:
+            report.add(
+                ctx.rel,
+                node.lineno,
+                "NOS015",
+                f"host->device staging outside the staging API on the engine "
+                f"tick path: {reason}; route it through HostStage.to_device "
+                "(runtime/staging.py) so the h2d budget stays exact",
+            )
+
+    def _staging_reason(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        module = self._aliases.get(head, head)
+        full = f"{module}.{rest}" if rest else module
+        return _STAGING.get(full)

@@ -257,6 +257,7 @@ TRACE_EVENTS = (
 # payloads are counts/ids ONLY — the same no-request-content contract as
 # telemetry.ServingReport).
 FLIGHT_EV_ADMIT = "engine.admit"
+FLIGHT_EV_BURST = "engine.dispatch_burst"
 FLIGHT_EV_PREFILL_WAVE = "engine.prefill_wave"
 FLIGHT_EV_MACRO = "engine.dispatch_macro"
 FLIGHT_EV_VERIFY = "engine.dispatch_verify"
@@ -271,6 +272,7 @@ FLIGHT_EV_EVICT = "engine.evict"
 FLIGHT_EV_REVIVE = "engine.revive"
 FLIGHT_EVENTS = (
     FLIGHT_EV_ADMIT,
+    FLIGHT_EV_BURST,
     FLIGHT_EV_PREFILL_WAVE,
     FLIGHT_EV_MACRO,
     FLIGHT_EV_VERIFY,
@@ -296,6 +298,7 @@ TICK_PHASE_PUMP_REVIVES = "pump_revives"
 TICK_PHASE_PUMP_PREFILL = "pump_prefill"
 TICK_PHASE_DISPATCH_VERIFY = "dispatch_verify"
 TICK_PHASE_DISPATCH_MACRO = "dispatch_macro"
+TICK_PHASE_DISPATCH_BURST = "dispatch_burst"
 TICK_PHASE_SAMPLE_SCATTER = "sample_scatter"
 TICK_PHASE_PUBLISH = "publish"
 TICK_PHASE_IDLE = "idle"
@@ -308,6 +311,7 @@ TICK_PHASES = (
     TICK_PHASE_PUMP_PREFILL,
     TICK_PHASE_DISPATCH_VERIFY,
     TICK_PHASE_DISPATCH_MACRO,
+    TICK_PHASE_DISPATCH_BURST,
     TICK_PHASE_SAMPLE_SCATTER,
     TICK_PHASE_PUBLISH,
     TICK_PHASE_IDLE,

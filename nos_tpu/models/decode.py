@@ -181,16 +181,6 @@ def init_paged_cache(cfg: GPTConfig, total_blocks: int, block_size: int) -> Dict
     }
 
 
-def _gather_pages(arr, table):
-    """[total_blocks, nkv, bs, hd] gathered by table [B, P] ->
-    [B, nkv, P*bs, hd]: a VIRTUALLY contiguous per-sequence cache, laid out
-    exactly like the dense cache so the same attention kernels (and
-    therefore the same numerics) apply."""
-    g = arr[table]  # [B, P, nkv, bs, hd]
-    b, p, nkv, bs, hd = g.shape
-    return g.transpose(0, 2, 1, 3, 4).reshape(b, nkv, p * bs, hd)
-
-
 def paged_decode_step(
     params, token, cfg: GPTConfig, pcache, table, pos, mask, block_size: int
 ):
@@ -248,6 +238,8 @@ def paged_prefill_chunk(
     is as many bounded dispatches, never one giant compile/step, and each
     chunk attends over the already-written prefix (exact causal masking
     within the chunk via _attend_cache)."""
+    from nos_tpu.ops.paged_attention import paged_window_attention
+
     _, c = tokens.shape
     positions = start + jnp.arange(c, dtype=jnp.int32)
     valid = jnp.arange(c) < length
@@ -255,7 +247,13 @@ def paged_prefill_chunk(
     table = table_row[None, :]  # [1, P]
     pages = jnp.where(valid, table_row[positions // block_size], 0)
     offs = positions % block_size
-    limit = positions + 1  # [C]; padding rows masked by `valid` at sample time
+    # Attention reads go through the windowed paged op (Pallas in-kernel
+    # gather on TPU; the gather reference elsewhere). Chunk-padding rows
+    # (>= length) attend only the scratch page's first position — their
+    # logits were always garbage masked by `valid` at sample time.
+    w_pos = jnp.broadcast_to(jnp.asarray(start, jnp.int32), (1,))
+    w_len = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (1,))
+    w_mask = jnp.ones((1,), dtype=bool)
     new_cache = {}
     for i in range(cfg.layers):
         p = params["layers"][str(i)]
@@ -265,10 +263,7 @@ def paged_prefill_chunk(
             ck = lc["k"].at[pages, :, offs, :].set(k_new[0].transpose(1, 0, 2))
             cv = lc["v"].at[pages, :, offs, :].set(v_new[0].transpose(1, 0, 2))
             new_cache[str(i)] = {"k": ck, "v": cv}
-            return _attend_cache(
-                q, _gather_pages(ck, table), _gather_pages(cv, table),
-                cfg.heads // cfg.n_kv, limit,
-            )
+            return paged_window_attention(q, ck, cv, table, w_pos, w_len, w_mask)
 
         x = _block_core(x, p, cfg, positions[None, :], attend)
     if not with_logits:
@@ -297,6 +292,8 @@ def _paged_window_core(
     at per-row positions pos[b]..pos[b]+lengths[b]-1 into each row's own
     pages, attending causally over the confirmed prefix plus the window.
     Returns (pre-final-norm activations [B, W, h], new pool)."""
+    from nos_tpu.ops.paged_attention import paged_window_attention
+
     b, w = tokens.shape
     positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
     valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]
@@ -307,9 +304,14 @@ def _paged_window_core(
         0,
     )  # [B, W]; invalid rows hit scratch
     offs = positions % block_size
-    # Invalid rows attend the scratch page's first position only: their
-    # logits are garbage, but an all-masked score row would softmax to NaN.
-    limit = jnp.where(valid, positions + 1, 1)  # [B, W]
+    # Attention reads go through the windowed paged op
+    # (ops/paged_attention.paged_window_attention): on TPU the Pallas
+    # kernel consumes the block table directly — no `pool[table]` dense
+    # materialization per layer per dispatch — and computes the per-row
+    # causal limit (pos[b]+w+1 while valid, else the scratch-page guard
+    # that keeps an all-masked softmax row from NaN) from the prefetched
+    # scalars; elsewhere the gather reference keeps the numerics the
+    # dense formulation always had.
     new_cache = {}
     for i in range(cfg.layers):
         p = params["layers"][str(i)]
@@ -319,10 +321,7 @@ def _paged_window_core(
             ck = lc["k"].at[pages, :, offs, :].set(k_new.transpose(0, 2, 1, 3))
             cv = lc["v"].at[pages, :, offs, :].set(v_new.transpose(0, 2, 1, 3))
             new_cache[str(i)] = {"k": ck, "v": cv}
-            return _attend_cache(
-                q, _gather_pages(ck, table), _gather_pages(cv, table),
-                cfg.heads // cfg.n_kv, limit,
-            )
+            return paged_window_attention(q, ck, cv, table, pos, lengths, mask)
 
         x = _block_core(x, p, cfg, positions, attend)
     return x, new_cache

@@ -137,6 +137,165 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# -- windowed-query variant (PR 10) ------------------------------------------
+def _window_reference(q, pool_k, pool_v, table, pos, lengths, mask):
+    """The gather formulation of the windowed read: q [B,nh,W,hd]; pool
+    [T,nkv,bs,hd]; table [B,P]; pos/lengths [B]; mask [B] bool ->
+    [B,nh,W,hd]. Deliberately the EXACT ops `_paged_window_core` used
+    before the kernel existed (gather + models.decode._attend_cache), so
+    the reference backend's numerics are bit-identical to the pre-kernel
+    engine — every greedy exactness oracle carries over unchanged."""
+    from nos_tpu.models.decode import _attend_cache
+
+    b, nh, w, hd = q.shape
+    nkv = pool_k.shape[1]
+
+    def gather(pool):
+        g = pool[table]  # [B, P, nkv, bs, hd]
+        bb, p, kk, bs, dd = g.shape
+        return g.transpose(0, 2, 1, 3, 4).reshape(bb, kk, p * bs, dd)
+
+    positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]
+    # Invalid rows attend the scratch page's first position only (an
+    # all-masked score row would softmax to NaN) — same guard as the
+    # window core always applied.
+    limit = jnp.where(valid, positions + 1, 1)  # [B, W]
+    return _attend_cache(q, gather(pool_k), gather(pool_v), nh // nkv, limit)
+
+
+def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
+                   interpret: bool = False):
+    """In-kernel paged gather for W query tokens per sequence: the page
+    table, window base positions, and lengths ride as SCALAR-PREFETCH
+    operands; the K/V BlockSpec index maps read `(table[b, p], g, 0, 0)`
+    pages straight from the pool with an online-softmax accumulator
+    across pages — the windowed-query analog of the single-token kernel
+    above, with the per-row causal limit computed IN the kernel from the
+    prefetched scalars (`limit[b, w] = pos[b] + w + 1` while `w <
+    lengths[b]` and the lane is active, else 1): the window's own K/V
+    was written into the pool by the same program before the attention
+    reads it, so table-mapped pages + the in-window causal part are one
+    read path. No materialized `pool[table]` gather, which is what
+    `_paged_window_core` paid per layer per dispatch before this."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, nh, w, hd = q.shape
+    t, nkv, bs, _ = pool_k.shape
+    n_pages = table.shape[1]
+    rep = nh // nkv
+    rows = rep * w
+    rows_p = max(8, -(-rows // 8) * 8)  # sublane-pad the row block
+    # Group-major row layout (matches _attend_cache's reshape): row =
+    # r * W + w_idx within each kv group.
+    qg = q.reshape(b, nkv, rep, w, hd).reshape(b, nkv, rows, hd)
+    if rows_p != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_p - rows), (0, 0)))
+    scale = hd ** -0.5
+
+    def kernel(table_ref, pos_ref, len_ref, mask_ref, q_ref, k_ref, v_ref,
+               o_ref, m_ref, l_ref, acc_ref):
+        i = pl.program_id(0)
+        p = pl.program_id(2)
+
+        @pl.when(p == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        qf = q_ref[0, 0].astype(jnp.float32)          # [rows_p, hd]
+        kf = k_ref[0, 0].astype(jnp.float32)          # [bs, hd]
+        s = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                      # [rows_p, bs]
+        # Per-row causal limit from the prefetched scalars: row -> its
+        # window offset (row % W in the group-major layout), padding
+        # rows (row >= rep*W) and rows past lengths[i] clamp to 1.
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        w_idx = jax.lax.rem(row, w)
+        in_window = (w_idx < len_ref[i]) & (row < rows) & (mask_ref[i] > 0)
+        lim = jnp.where(in_window, pos_ref[i] + w_idx + 1, 1)  # [rows_p, bs]
+        idx = p * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = idx < lim
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)   # [rows_p, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        e = jnp.where(valid, jnp.exp(s - m_new), 0.0)           # [rows_p, bs]
+        alpha = jnp.exp(m_prev - m_new)                         # [rows_p, 1]
+        l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            e, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(p == n_pages - 1)
+        def _finalize():
+            l_fin = jnp.max(l_ref[...], axis=-1, keepdims=True)
+            o_ref[0, 0] = (
+                acc_ref[...] / jnp.maximum(l_fin, 1e-30)
+            ).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # (table, pos, lengths, mask) ride in SMEM
+        grid=(b, nkv, n_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, rows_p, hd), lambda i, g, p, tr, pr, lr, mr: (i, g, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, hd), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], g, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, hd), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], g, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rows_p, hd), lambda i, g, p, tr, pr, lr, mr: (i, g, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows_p, 128), jnp.float32),  # running max
+            pltpu.VMEM((rows_p, 128), jnp.float32),  # running normalizer
+            pltpu.VMEM((rows_p, hd), jnp.float32),   # unnormalized output
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, rows_p, hd), q.dtype),
+        interpret=interpret,
+    )(
+        table.astype(jnp.int32),
+        pos.astype(jnp.int32),
+        lengths.astype(jnp.int32),
+        mask.astype(jnp.int32),
+        qg,
+        pool_k,
+        pool_v,
+    )
+    return out[:, :, :rows, :].reshape(b, nkv, rep, w, hd).reshape(b, nh, w, hd)
+
+
+def paged_window_attention(q, pool_k, pool_v, table, pos, lengths, mask):
+    """Windowed-query attention over a block-paged KV pool: q [B,nh,W,hd]
+    (W window tokens per sequence, already written into the pool by the
+    caller), table [B,P] page ids, pos [B] window base positions,
+    lengths [B] valid window lengths, mask [B] active lanes. Query
+    (b, w) attends its pages up to pos[b]+w+1 while w < lengths[b] and
+    mask[b]; other rows attend only the scratch page's first position
+    (garbage the caller ignores — never NaN). Pallas scalar-prefetch
+    kernel on TPU (no materialized gather); the XLA gather reference
+    elsewhere, bit-identical to the pre-kernel `_paged_window_core`
+    read path."""
+    if _use_pallas():
+        return _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask)
+    return _window_reference(q, pool_k, pool_v, table, pos, lengths, mask)
+
+
 def paged_decode_attention(q, pool_k, pool_v, table, limit):
     """Single-token attention over a block-paged KV pool: q [B,nh,hd],
     pool [total_blocks,nkv,block,hd], table [B,P] (page ids per sequence,

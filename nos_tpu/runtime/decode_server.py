@@ -115,6 +115,7 @@ from nos_tpu.runtime.faults import (
 )
 from nos_tpu.runtime.quota import QuotaPolicy
 from nos_tpu.runtime.spill import SpillTier
+from nos_tpu.runtime.staging import HostStage, SyncLedger, TickState
 from nos_tpu.tracing import EngineTracing, TickProfiler
 
 logger = logging.getLogger(__name__)
@@ -122,19 +123,26 @@ logger = logging.getLogger(__name__)
 
 class _TokRef:
     """One dispatched step's token vector (or a prefill's scalar first
-    token); materializes to numpy once, on first host need."""
+    token); materializes to numpy once, on first host need. `ledger`
+    (runtime/staging.py SyncLedger) counts device-backed
+    materializations into the engine's `blocking_syncs` budget —
+    host-list-backed refs (verify acceptance columns) are free and
+    stay uncounted."""
 
-    __slots__ = ("_arr", "_np")
+    __slots__ = ("_arr", "_np", "_ledger")
 
-    def __init__(self, arr):
+    def __init__(self, arr, ledger: Optional[SyncLedger] = None):
         self._arr = arr
         self._np = None
+        self._ledger = ledger if hasattr(arr, "is_ready") else None
 
     def np(self):
         # THE sanctioned materialization point: every tick-path host read
         # funnels through here, deliberately deferred until the value is
         # needed (or ready — see _resolve_verifies' pipelined reads).
         if self._np is None:
+            if self._ledger is not None:
+                self._ledger.note()
             self._np = np.asarray(self._arr)  # nos-lint: ignore[NOS010]
             self._arr = None
         return self._np
@@ -268,6 +276,7 @@ class DecodeServer:
         seed: int = 0,
         pipeline_depth: int = 16,
         steps_per_dispatch: int = 1,
+        burst_windows: int = 4,
         block_size: int = 32,
         total_blocks: Optional[int] = None,
         spec_k: int = 0,
@@ -302,6 +311,31 @@ class DecodeServer:
         link RTT, not the step execution, bounds throughput. Admission and
         EOS reaction granularity become K steps; greedy outputs are
         bit-identical for any K (same math, same order).
+
+        `burst_windows` (N, default 4; <= 1 disables) arms FUSED MACRO
+        BURSTS (PR 10): when a tick finds the engine in a steady decode
+        state — every active slot decoding, nothing prefilling/drafting/
+        reviving, no unresolved verify, no queued or waiting request, no
+        pending injected fault, not draining — it dispatches ONE burst
+        program running up to N macro windows on-device (`lax.fori`-style
+        scan over the existing K-step macro body: device-side sampling,
+        `steps_left`/eos masking so lanes that finish mid-burst coast on
+        the scratch page), crossing the host boundary once per K*N tokens
+        instead of once per K. The burst consumes and advances the
+        device-resident tick metadata (runtime/staging.py TickState), so
+        a steady-state crossing uploads NOTHING; quota `observe_tick` and
+        the token counters fold after the burst from per-window token
+        counts the program returns as one array. Outputs are
+        bit-identical burst-on vs burst-off (greedy AND temperature: the
+        burst runs the same per-step math at the same PRNG step indices —
+        `fold_in(serial, step)` is per-step, not sequential), and bursts
+        DEGRADE to per-tick dispatch whenever any non-steady condition
+        holds — admissions, restores, preemption pressure, drain, or a
+        fault injector with scheduled chaos — so the PR 6-8
+        recovery/migration semantics see the per-tick engine they were
+        built against (checkpoints reconstruct at burst boundaries from
+        the same refs as ever). Speculative engines (spec_k > 0) keep
+        per-tick scheduling: the draft probe is host-side by nature.
 
         `block_size`/`total_blocks` size the paged KV pool. The default pool
         (n_slots x ceil(max_len/block_size) + scratch) matches the dense
@@ -478,7 +512,17 @@ class DecodeServer:
         if self.total_blocks < 2:
             raise ValueError("total_blocks must be >= 2 (scratch + 1)")
         self.cache = init_paged_cache(cfg, self.total_blocks, self.block_size)
-        self._table = jnp.zeros((n_slots, self.max_pages), dtype=jnp.int32)
+        # Host->device staging discipline (runtime/staging.py, NOS015):
+        # every tick-path upload funnels through the counted HostStage;
+        # the per-slot tick metadata (block table, pos/mask/serial/step/
+        # steps_left) lives DEVICE-RESIDENT in the TickState, advanced by
+        # the dispatched programs themselves and re-synced with a single
+        # packed upload only on ticks where a host event changed it. The
+        # numpy table mirror is the host truth the sync packs from.
+        self._stage = HostStage()
+        self._syncs = SyncLedger()
+        self._tick_state = TickState(self._stage, n_slots, self.max_pages)
+        self._table_np = np.zeros((n_slots, self.max_pages), dtype=np.int32)
         # ALL pool bookkeeping (free/cached lists, refcounts, per-slot
         # block lists, the prefix index) lives in the BlockManager —
         # NOS011 flags pool-state mutation anywhere else.
@@ -558,6 +602,17 @@ class DecodeServer:
         # the direct witness that a speculating slot did not stall its
         # neighbors (the decoupling the r5 neighbor penalty lacked).
         self.both_dispatch_ticks = 0
+        # Fused macro bursts (PR 10): burst programs dispatched, macro
+        # windows they fused, plus the idle-tick fast-path counter and
+        # the flag that keeps a burst's per-window quota fold from
+        # double-counting with the end-of-tick observe.
+        self.burst_windows = max(1, int(burst_windows))
+        self.burst_dispatches = 0
+        self.burst_windows_run = 0
+        self.idle_ticks = 0
+        self._engine_idle = False
+        self._quota_burst_folded = False
+        self._burst_fns: Dict[int, object] = {}
         # Per-slot dispatch accounting, the counter-based substrate for the
         # neighbor-throughput gate (wall-time-free, CI-stable).
         self.macro_tokens_by_slot = np.zeros((n_slots,), dtype=np.int64)
@@ -654,6 +709,7 @@ class DecodeServer:
             )(keys, logits).astype(jnp.int32)
 
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        self._sample = _sample  # the burst builder (_make_burst) reuses it
         K = self.steps_per_dispatch
         bs = self.block_size
 
@@ -662,7 +718,11 @@ class DecodeServer:
             lane participates iff it is active, still owes tokens
             (k < steps_left), and stays inside the cache window; lanes that
             finish mid-window coast (their writes go to the scratch page,
-            token held)."""
+            token held). The program ADVANCES the device-resident tick
+            metadata itself (returns post-window pos/step/steps_left —
+            the same min(K, steps_left, max_len - pos) arithmetic the
+            host bookkeeping mirrors), so steady-state dispatches upload
+            nothing (runtime/staging.py TickState)."""
 
             def body(carry, k):
                 token, cache = carry
@@ -678,11 +738,21 @@ class DecodeServer:
             (final_token, cache), toks = jax.lax.scan(
                 body, (token, cache), jnp.arange(K)
             )
-            return final_token, toks, cache  # toks: [K, n_slots]
+            execd = jnp.where(
+                active, jnp.clip(jnp.minimum(steps_left, max_len - pos0), 0, K), 0
+            ).astype(pos0.dtype)
+            # toks: [K, n_slots]
+            return (
+                final_token, toks, cache,
+                pos0 + execd, step0 + execd, steps_left - execd,
+            )
 
         # Donate the cache: with pipeline_depth dispatches in flight,
-        # donation keeps one pool allocation alive instead of depth of them.
-        self._step_fn = jax.jit(_macro, donate_argnums=(2,))
+        # donation keeps one pool allocation alive instead of depth of
+        # them. The tick-metadata arrays (pos/step/steps_left) are donated
+        # too — the program replaces them, and the TickState is their only
+        # holder.
+        self._step_fn = jax.jit(_macro, donate_argnums=(2, 4, 7, 8))
 
         # Chunked prefill: one bounded dispatch per prompt chunk, writing
         # into the slot's pages. `finish` statically selects the last-chunk
@@ -784,6 +854,7 @@ class DecodeServer:
         only under allocation pressure or preemption (slow paths by
         definition), and the bytes moved are the point."""
         k, v = self._extract_fn(self.cache, block)
+        self._syncs.note()  # one counted blocking copy-out per block
         k = np.asarray(k)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
         v = np.asarray(v)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
         return (k, v), k.nbytes + v.nbytes
@@ -1063,12 +1134,14 @@ class DecodeServer:
         freeing HBM immediately."""
         self._block_mgr.release(idx, spill=spill)
         self._slots[idx] = _Slot()
+        self._tick_state.mark_table_dirty()
 
     def _reset_device_state(self) -> None:
         """After an engine error the donated cache chain is untrustworthy;
         start from a fresh allocation."""
         self.cache = init_paged_cache(self.cfg, self.total_blocks, self.block_size)
-        self._table = jnp.zeros((self.n_slots, self.max_pages), dtype=jnp.int32)
+        self._table_np[:] = 0
+        self._tick_state.mark_table_dirty()
         # The prefix index dies with the pool: cached blocks' K/V was in
         # the reallocated buffers, so serving a hit would serve zeros.
         self._block_mgr.reset()
@@ -1231,9 +1304,12 @@ class DecodeServer:
                     evicted = self._block_mgr.evictions - evict0
                     if evicted:
                         self.metrics.inc("nos_tpu_decode_prefix_evictions", evicted)
-                row = np.zeros((self.max_pages,), dtype=np.int32)
-                row[: len(blocks)] = blocks
-                self._table = self._table.at[idx].set(jnp.asarray(row))
+                # Host-mirror write only: the device table re-syncs with
+                # the next packed staging upload (an admission is a host
+                # event by definition).
+                self._table_np[idx, :] = 0
+                self._table_np[idx, : len(blocks)] = blocks
+                self._tick_state.mark_table_dirty()
                 serial = req.serial if req.serial is not None else self._next_serial
                 if req.serial is None:
                     self._next_serial += 1
@@ -1419,8 +1495,12 @@ class DecodeServer:
             kx, vx = payload
             with self._prof.dispatch():
                 self.cache = self._revive_fn(
-                    self.cache, jnp.asarray(kx), jnp.asarray(vx), block
+                    self.cache,
+                    self._stage.to_device(kx),
+                    self._stage.to_device(vx),
+                    block,
                 )
+            self._tick_state.mark_dirty()
             if self._tracer is not None:
                 self._tracer.event(
                     slot.trace_id,
@@ -1454,6 +1534,12 @@ class DecodeServer:
         and its device-side scatter are unchanged per slot — only when
         chunks dispatch moves, never what they compute."""
         self._check_fault("dispatch_prefill_wave", wave[0][0])
+        # The chunk programs read only the block TABLE from the device
+        # tick state — re-synced here iff an admission/release actually
+        # changed it (cursor churn from earlier waves this tick does not
+        # force per-wave uploads).
+        self._sync_tick_state(for_table_only=True)
+        table = self._tick_state.table
         mids: Dict[int, List[Tuple[int, int, list]]] = {}
         finals: List[Tuple[int, int, list]] = []
         for entry in wave:
@@ -1471,9 +1557,9 @@ class DecodeServer:
                 with self._prof.dispatch():
                     self.cache = self._prefill_chunk(
                         self.params,
-                        jnp.asarray(padded),
+                        self._stage.to_device(padded),
                         self.cache,
-                        self._table[idx],
+                        table[idx],
                         start,
                         len(piece),
                     )
@@ -1490,12 +1576,12 @@ class DecodeServer:
                 with self._prof.dispatch():
                     self.cache = self._prefill_window(
                         self.params,
-                        jnp.asarray(tokens),
+                        self._stage.to_device(tokens),
                         self.cache,
-                        self._table,
-                        jnp.asarray(pos),
-                        jnp.asarray(lengths),
-                        jnp.asarray(active),
+                        table,
+                        self._stage.to_device(pos),
+                        self._stage.to_device(lengths),
+                        self._stage.to_device(active),
                     )
             dispatches += 1
         for idx, start, piece in finals:
@@ -1505,9 +1591,9 @@ class DecodeServer:
             with self._prof.dispatch():
                 self.cache, self._last_dev, self._first_dev = self._prefill_last(
                     self.params,
-                    jnp.asarray(padded),
+                    self._stage.to_device(padded),
                     self.cache,
-                    self._table[idx],
+                    table[idx],
                     start,
                     len(piece),
                     self._last_dev,
@@ -1517,6 +1603,10 @@ class DecodeServer:
                     self._slots[idx].step_base,
                 )
             dispatches += 1
+        # Cursor/phase advances are host events for the scheduling
+        # metadata (not the table): the next macro/verify dispatch
+        # re-syncs once.
+        self._tick_state.mark_dirty()
         for idx, start, piece in wave:
             slot = self._slots[idx]
             slot.prefill_cursor = start + len(piece)
@@ -1544,7 +1634,7 @@ class DecodeServer:
             # previous), so the wave costs a single device->host transfer
             # instead of one RTT per slot.
             now = time.monotonic()
-            ref = _TokRef(self._first_dev)
+            ref = _TokRef(self._first_dev, self._syncs)
             for idx, _, _ in finals:
                 slot = self._slots[idx]
                 slot.phase = "decoding"
@@ -1749,16 +1839,21 @@ class DecodeServer:
                 self._tracer.event(
                     slot.trace_id, constants.TRACE_EV_DECODE, slot=idx
                 )
-        pos = np.array([s.pos for s in self._slots], dtype=np.int32)
+        # The drafting flags just changed the macro mask: mark + sync so
+        # the verify read of `pos` and the same-tick macro dispatch both
+        # consume one freshly packed state.
+        self._tick_state.mark_dirty()
+        self._sync_tick_state()
+        st = self._tick_state
         with self._prof.dispatch():
             preds_dev, self.cache = self._verify_fn(
                 self.params,
-                jnp.asarray(tokens),
+                self._stage.to_device(tokens),
                 self.cache,
-                self._table,
-                jnp.asarray(pos),
-                jnp.asarray(lengths),
-                jnp.asarray(active),
+                st.table,
+                st.pos,
+                self._stage.to_device(lengths),
+                self._stage.to_device(active),
             )
         self.steps_run += 1
         self.spec_rounds += 1
@@ -1769,7 +1864,9 @@ class DecodeServer:
         if self.metrics is not None:
             self.metrics.inc("nos_tpu_decode_steps")
             self.metrics.inc("nos_tpu_decode_spec_rounds")
-        self._pending_verifies.append(_PendingVerify(_TokRef(preds_dev), windows))
+        self._pending_verifies.append(
+            _PendingVerify(_TokRef(preds_dev, self._syncs), windows)
+        )
 
     def _resolve_verifies(self, block: bool) -> None:
         """Fold completed verify rounds back into slot state, oldest
@@ -1796,6 +1893,9 @@ class DecodeServer:
         update, and a device-side scatter of each slot's new last token
         (no host read-back of the token vector)."""
         preds = entry.preds.np()
+        # Acceptance advances pos/remaining and clears drafting flags —
+        # a host event for the device tick state.
+        self._tick_state.mark_dirty()
         scatter_rows: List[int] = []
         scatter_vals: List[int] = []
         for idx, window in entry.windows.items():
@@ -1849,8 +1949,8 @@ class DecodeServer:
             with self._prof.phase(constants.TICK_PHASE_SAMPLE_SCATTER), \
                     self._prof.dispatch():
                 self._last_dev = self._last_dev.at[
-                    jnp.asarray(scatter_rows, dtype=jnp.int32)
-                ].set(jnp.asarray(scatter_vals, dtype=jnp.int32))
+                    self._stage.to_device(scatter_rows, dtype=jnp.int32)
+                ].set(self._stage.to_device(scatter_vals, dtype=jnp.int32))
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -2204,6 +2304,21 @@ class DecodeServer:
             prof.end_tick(self.metrics)
 
     def _tick_phases(self, prof) -> None:
+        if self._engine_idle and self._queue.empty():
+            # The idle fast path: the previous tick proved the engine
+            # empty (no active slot, no waiting request) and only a
+            # client submit can change that — checked above with one
+            # lock-guarded length read. O(1) and allocation-free: no
+            # quota dict rebuild (the policy folds a shared empty
+            # entry), no gauge array rebuilds, no slot scans. Pinned by
+            # the idle-tick counter test.
+            self.idle_ticks += 1
+            if self._quota is not None:
+                self._quota.observe_idle_tick()
+            with prof.phase(constants.TICK_PHASE_IDLE):
+                self._stop.wait(0.005)
+            return
+        self._engine_idle = False
         with prof.phase(constants.TICK_PHASE_QUOTA_ENFORCE):
             self._enforce_quota()
         with prof.phase(constants.TICK_PHASE_ADMIT):
@@ -2215,6 +2330,11 @@ class DecodeServer:
             self._scan_eos()
         if not any(s.active for s in self._slots):
             self._note_quota_tick()
+            self.idle_ticks += 1
+            # Arm the fast path only once the engine is provably empty:
+            # a waiting (pool-blocked) request still needs the admission
+            # scan every tick.
+            self._engine_idle = not self._waiting and self._queue.empty()
             with prof.phase(constants.TICK_PHASE_IDLE):
                 self._stop.wait(0.005)
             return
@@ -2240,8 +2360,17 @@ class DecodeServer:
             if s.active and s.phase == "decoding" and not s.verifying
         ]
         if macro:
-            with prof.phase(constants.TICK_PHASE_DISPATCH_MACRO):
-                self._dispatch_macro(macro)
+            # Steady state? Fuse up to N macro windows into ONE burst
+            # dispatch (host boundary crossed once per K*N tokens);
+            # any host obligation — admission, restore, drain, chaos —
+            # degrades to the per-tick macro dispatch below.
+            n_burst = self._burst_plan(macro, n_prefill, n_drafting)
+            if n_burst:
+                with prof.phase(constants.TICK_PHASE_DISPATCH_BURST):
+                    self._dispatch_burst(macro, n_burst)
+            else:
+                with prof.phase(constants.TICK_PHASE_DISPATCH_MACRO):
+                    self._dispatch_macro(macro)
         if n_drafting and macro:
             self.both_dispatch_ticks += 1
         if n_prefill and macro:
@@ -2267,41 +2396,264 @@ class DecodeServer:
         window only moves when ticks are appended)."""
         if self._quota is None:
             return
+        if self._quota_burst_folded:
+            # A burst already folded its windows one observe_tick each
+            # (from the program's per-window counts); folding the tick
+            # again would double-advance the window clock.
+            self._quota_burst_folded = False
+            self._tick_tokens = {}
+            return
         self._quota.observe_tick(self._tick_tokens)
         self._tick_tokens = {}
+
+    def _sync_tick_state(self, for_table_only: bool = False) -> None:
+        """Re-sync the device-resident tick metadata from the host
+        mirrors — ONE packed staging upload (runtime/staging.py), and
+        only when a host event dirtied it since the last sync. The
+        packed layout is [n_slots, max_pages + 5] int32: the block-table
+        row, then pos / macro-mask / serial / PRNG-step / steps_left.
+        `for_table_only` consumers (the prefill programs) skip the sync
+        while only scheduling metadata churned — the table itself
+        changes only on admit/release/reset."""
+        st = self._tick_state
+        if for_table_only:
+            if not st.table_dirty:
+                return
+        elif not st.dirty and not st.table_dirty:
+            return
+        P = self.max_pages
+        packed = np.zeros((self.n_slots, P + 5), dtype=np.int32)
+        packed[:, :P] = self._table_np
+        for i, s in enumerate(self._slots):
+            packed[i, P] = s.pos
+            packed[i, P + 1] = int(
+                s.active and s.phase == "decoding" and not s.verifying
+            )
+            packed[i, P + 2] = self._slot_serial[i]
+            packed[i, P + 3] = s.step_base + len(s.refs)
+            packed[i, P + 4] = s.remaining if s.active else 0
+        st.sync(packed)
+
+    def _burst_plan(self, macro: List[int], n_prefill: int, n_drafting: int) -> int:
+        """How many macro windows to fuse into one burst dispatch this
+        tick: 0 = stay per-tick. Bursts engage ONLY in a steady decode
+        state — every active slot decoding (none prefilling, reviving,
+        drafting, or awaiting a verify), no queued or waiting request,
+        no scheduled injected fault, not stopping/draining — so every
+        host event (admission, restore, preemption, drain, chaos) sees
+        the per-tick engine the PR 6-8 recovery semantics were built
+        against. The window count is capped at the work actually left
+        (ceil(max remaining / K)), so lanes never coast through whole
+        trailing windows."""
+        if self.burst_windows <= 1 or self.spec_k > 0:
+            return 0
+        if n_prefill or n_drafting or self._pending_verifies:
+            return 0
+        if self._closed.is_set() or self._stop.is_set():
+            return 0
+        if self._fault_injector is not None and self._fault_injector.has_pending():
+            return 0
+        if self._waiting or not self._queue.empty():
+            return 0
+        active = [s for s in self._slots if s.active]
+        if not active or len(macro) != len(active):
+            return 0
+        K = self.steps_per_dispatch
+        max_rem = max(min(s.remaining, self.max_len - s.pos) for s in active)
+        if max_rem <= 0:
+            return 0
+        n = min(self.burst_windows, -(-max_rem // K))
+        return n if n >= 2 else 0
+
+    def _make_burst(self, n_windows: int):
+        """Compile the N-window burst program: an outer scan over N
+        windows of the SAME K-step macro body (`_dispatch_macro`'s math
+        at the same PRNG step indices — `fold_in(serial, step)` is
+        per-step, so the fused chain is bit-identical to N per-tick
+        dispatches), with device-side eos masking so a lane that samples
+        its eos mid-burst coasts on the scratch page for the remaining
+        windows, and per-window executed-token counts returned as one
+        [N, n_slots] array for the post-burst quota/counter fold."""
+        cfg = self.cfg
+        K = self.steps_per_dispatch
+        bs = self.block_size
+        max_len = self.max_len
+        eos_id = self.eos_id
+        n_slots = self.n_slots
+        sample = self._sample
+
+        def _burst(params, token, cache, table, pos, active, serial, step, steps_left):
+            def window(carry, _):
+                token, cache, pos, step, steps_left, finished = carry
+
+                def body(c, k):
+                    token, cache, finished = c
+                    pos_k = pos + k
+                    adv = active & (k < steps_left) & (pos_k < max_len)
+                    m = adv & ~finished
+                    logits, cache = paged_decode_step(
+                        params, token, cfg, cache, table, pos_k, m, bs
+                    )
+                    nxt = sample(logits, serial, step + k)
+                    out_token = jnp.where(m, nxt, token)
+                    if eos_id is not None:
+                        finished = finished | (m & (nxt == eos_id))
+                    return (out_token, cache, finished), (jnp.where(m, nxt, 0), m)
+
+                (token, cache, finished), (toks, ms) = jax.lax.scan(
+                    body, (token, cache, finished), jnp.arange(K)
+                )
+                counts = jnp.sum(ms.astype(jnp.int32), axis=0)  # [n_slots]
+                execd = jnp.where(
+                    active,
+                    jnp.clip(jnp.minimum(steps_left, max_len - pos), 0, K),
+                    0,
+                ).astype(pos.dtype)
+                return (
+                    token, cache, pos + execd, step + execd,
+                    steps_left - execd, finished,
+                ), (toks, counts)
+
+            finished0 = jnp.zeros((n_slots,), dtype=bool)
+            (token, cache, pos, step, steps_left, _), (toks, counts) = jax.lax.scan(
+                window,
+                (token, cache, pos, step, steps_left, finished0),
+                None,
+                length=n_windows,
+            )
+            # toks: [N, K, n_slots] -> [N*K, n_slots], rows addressable by
+            # the usual (ref, lane, row) scheme with row = window*K + k.
+            return (
+                token,
+                toks.reshape(n_windows * K, n_slots),
+                counts,  # [N, n_slots]
+                cache,
+                pos,
+                step,
+                steps_left,
+            )
+
+        return jax.jit(_burst, donate_argnums=(2, 4, 7, 8))
+
+    def _dispatch_burst(self, idxs: List[int], n_windows: int) -> None:
+        """One fused burst dispatch: N macro windows, one host-boundary
+        crossing. Host bookkeeping mirrors the device advance window by
+        window (the same min(K, remaining, max_len - pos) arithmetic the
+        program applies), so checkpoints remain reconstructible at burst
+        boundaries from the refs exactly as in per-tick mode. With a
+        QuotaPolicy armed, the per-window token counts the program
+        returned fold through `observe_tick` once per fused window —
+        the window clock advances as if the windows had been ticks."""
+        self._check_fault("dispatch_burst", idxs[0])
+        self._sync_tick_state()
+        st = self._tick_state
+        fn = self._burst_fns.get(n_windows)
+        if fn is None:
+            fn = self._make_burst(n_windows)
+            self._burst_fns[n_windows] = fn
+        with self._prof.dispatch():
+            (
+                last, toks, counts, self.cache, pos, step, steps_left,
+            ) = fn(
+                self.params,
+                self._last_dev,
+                self.cache,
+                st.table,
+                st.pos,
+                st.mask,
+                st.serial,
+                st.step,
+                st.steps_left,
+            )
+        self._last_dev = last
+        st.advance(pos, step, steps_left)
+        ref = _TokRef(toks, self._syncs)
+        self._inflight.append(ref)
+        self.steps_run += 1
+        self.burst_dispatches += 1
+        self.burst_windows_run += n_windows
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_BURST,
+                slots=len(idxs),
+                windows=n_windows,
+                k=self.steps_per_dispatch,
+            )
+        if self.metrics is not None:
+            self.metrics.inc("nos_tpu_decode_steps")
+            self.metrics.inc("nos_tpu_decode_burst_dispatches")
+            self.metrics.inc("nos_tpu_decode_burst_windows", n_windows)
+        K = self.steps_per_dispatch
+        for idx in idxs:
+            slot = self._slots[idx]
+            if self._tracer is not None and not slot.trace_decoding:
+                slot.trace_decoding = True
+                self._tracer.event(
+                    slot.trace_id, constants.TRACE_EV_DECODE, slot=idx
+                )
+            # A lane executes contiguously from the burst's first row
+            # until it runs out (steps_left/max_len), then coasts: its
+            # executed rows are EXACTLY range(total) of the [N*K,
+            # n_slots] token matrix — the window-by-window accounting
+            # collapses to one flat extend (the same arithmetic the
+            # program applied on device, window by window).
+            total = min(n_windows * K, slot.remaining, self.max_len - slot.pos)
+            slot.refs.extend((ref, idx, r) for r in range(total))
+            slot.pos += total
+            slot.remaining -= total
+            self.macro_tokens_by_slot[idx] += total
+            if total:
+                # Windows in which this lane made progress.
+                self.macro_dispatches_by_slot[idx] += -(-total // K)
+        if self._quota is not None:
+            # The one deliberate host read of the burst: the per-window
+            # counts array ([N, n_slots] ints — the quota fold is
+            # inherently host-side, and this read is the crossing the
+            # fused windows amortize). Counted in the blocking_syncs
+            # budget via the ledger.
+            counts_np = _TokRef(counts, self._syncs).np()
+            for w in range(n_windows):
+                tick_tokens: Dict[str, int] = {}
+                for idx in idxs:
+                    n = int(counts_np[w, idx])
+                    if n:
+                        tenant = self._slots[idx].tenant or ""
+                        tick_tokens[tenant] = tick_tokens.get(tenant, 0) + n
+                self._quota.observe_tick(tick_tokens)
+            self._quota_burst_folded = True
+        for idx in idxs:
+            self._finish_if_done(idx)
+        while len(self._inflight) > self.pipeline_depth:
+            self._inflight.popleft().np()
 
     def _dispatch_macro(self, idxs: List[int]) -> None:
         """One K-step macro dispatch for the non-drafting active slots.
         The active mask excludes slots with a verify in flight: their
         lanes coast (scratch-page writes, token held), and their _last_dev
         entry stays untouched until acceptance resolution scatters the
-        true last token over it — mixed advances stay coherent."""
+        true last token over it — mixed advances stay coherent. Inputs
+        come from the device-resident TickState (synced here only if a
+        host event dirtied it); the program advances pos/step/steps_left
+        on device, so steady-state dispatches upload nothing."""
         self._check_fault("dispatch_macro", idxs[0])
+        self._sync_tick_state()
+        st = self._tick_state
         K = self.steps_per_dispatch
-        mask = np.zeros((self.n_slots,), dtype=bool)
-        mask[idxs] = True
-        pos = np.array([s.pos for s in self._slots], dtype=np.int32)
-        step = np.array(
-            [s.step_base + len(s.refs) for s in self._slots], dtype=np.int64
-        )  # tokens generated so far (incl. replayed) = the PRNG step index
-        steps_left = np.array(
-            [s.remaining if mask[i] else 0 for i, s in enumerate(self._slots)],
-            dtype=np.int32,
-        )
         with self._prof.dispatch():
-            last, toks, self.cache = self._step_fn(
+            last, toks, self.cache, pos, step, steps_left = self._step_fn(
                 self.params,
                 self._last_dev,
                 self.cache,
-                self._table,
-                jnp.asarray(pos),
-                jnp.asarray(mask),
-                jnp.asarray(self._slot_serial),
-                jnp.asarray(step),
-                jnp.asarray(steps_left),
+                st.table,
+                st.pos,
+                st.mask,
+                st.serial,
+                st.step,
+                st.steps_left,
             )
         self._last_dev = last
-        ref = _TokRef(toks)
+        st.advance(pos, step, steps_left)
+        ref = _TokRef(toks, self._syncs)
         self._inflight.append(ref)
         self.steps_run += 1
         self.macro_dispatches += 1
@@ -2386,6 +2738,30 @@ class DecodeServer:
         'idle capacity is borrowable' witness."""
         return self._quota.borrowed_ticks if self._quota is not None else 0
 
+    # -- host-sync budget counters (runtime/staging.py; the NOS010/NOS015
+    # disciplines turned into runtime numbers — ROADMAP item 3's "extend
+    # from lint to a runtime assertion") --------------------------------------
+    @property
+    def h2d_uploads(self) -> int:
+        """Host->device transfers performed on the tick path, all
+        funneled through the counted HostStage. Steady-state decode
+        contributes ZERO per dispatch (the device-resident TickState
+        advances itself); the budget test gates on the delta."""
+        return self._stage.uploads
+
+    @property
+    def blocking_syncs(self) -> int:
+        """Blocking device->host materializations (device-backed
+        _TokRef reads + spill copy-outs + the per-burst quota-count
+        read), via the shared SyncLedger."""
+        return self._syncs.syncs
+
+    @property
+    def staging_syncs(self) -> int:
+        """Packed TickState uploads — at most one per host-event tick
+        (and <= 1 per burst, the steady-state budget gate)."""
+        return self._tick_state.syncs
+
     # -- tick-phase profiler counters (read-through to the TickProfiler;
     # telemetry's collect_serving duck-types these as plain attributes,
     # all zeros/empty when tracing is off) -----------------------------------
@@ -2448,6 +2824,10 @@ class DecodeServer:
             ("nos_tpu_decode_revives", self.revives),
             ("nos_tpu_decode_spill_drops", self.spill_drops),
             ("nos_tpu_decode_borrowed_ticks", self.borrowed_ticks),
+            ("nos_tpu_decode_h2d_uploads", self.h2d_uploads),
+            ("nos_tpu_decode_blocking_syncs", self.blocking_syncs),
+            ("nos_tpu_decode_staging_syncs", self.staging_syncs),
+            ("nos_tpu_decode_idle_ticks", self.idle_ticks),
         ):
             prev = self._metric_shadow.get(name, 0)
             if cur > prev:

@@ -235,6 +235,15 @@ class FaultInjector:
             raise PoisonRequestError(msg, site=site, slot=slot)
         raise exc_type(msg, site=site)
 
+    def has_pending(self) -> bool:
+        """Whether any scheduled fault is still waiting to fire. The
+        burst scheduler (PR 10) reads this: while chaos is pending the
+        engine DEGRADES to per-tick dispatch so every named site keeps
+        its per-tick visit cadence and the scheduled occurrences land
+        exactly where the chaos tests aimed them — bursts resume once
+        the schedule is exhausted."""
+        return bool(self._pending)
+
     def visits(self, site: str) -> int:
         return self._visits.get(site, 0)
 

@@ -44,6 +44,11 @@ from typing import Deque, Dict, List, Optional, Tuple
 #: Tenant name requests without an explicit tenant are accounted under.
 DEFAULT_TENANT = ""
 
+#: The shared immutable idle-tick window entry (`observe_idle_tick`):
+#: appended by reference so an idle tick allocates nothing. Never
+#: mutated — `observe_tick` always builds a fresh dict for real entries.
+_EMPTY_TICK: Dict[str, int] = {}
+
 
 @dataclass(frozen=True)
 class TenantShare:
@@ -118,6 +123,27 @@ class QuotaPolicy:
             for t, n in entry.items()
         ):
             self.borrowed_ticks += 1
+
+    def observe_idle_tick(self) -> None:
+        """O(1), allocation-free fold of a tick that produced no tokens
+        (the idle-tick fast path, PR 10): appends the shared immutable
+        empty entry so the window still advances — a ceiling-blocked
+        tenant's share keeps decaying across idle ticks — without
+        rebuilding a dict, scanning tenants, or running the borrow
+        check per tick. Equivalent to ``observe_tick({})`` by
+        construction (an empty entry has no totals to add and can never
+        witness borrowing); the idle-tick counter test pins the shared-
+        entry identity."""
+        self.ticks += 1
+        if len(self._window) == self._window.maxlen:
+            old = self._window[0]
+            if old:
+                for t, n in old.items():
+                    self._totals[t] -= n
+                    if self._totals[t] <= 0:
+                        del self._totals[t]
+                    self._window_total -= n
+        self._window.append(_EMPTY_TICK)
 
     def usage(self, tenant: Optional[str]) -> float:
         """The tenant's fraction of all decode tokens in the window

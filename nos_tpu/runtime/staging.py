@@ -1,0 +1,171 @@
+"""Host->device staging discipline for the serving engine's tick path.
+
+PR 9's `trace_timeline` artifact attributed a pure-host floor per engine
+dispatch (0.54 ms on the CPU smoke; 60-100 ms/dispatch link+dispatch on
+chip — BENCH_r04/r05 `dispatch_overhead_ms`). A measurable slice of that
+floor was self-inflicted: every macro dispatch re-built `pos`/`mask`/
+`serial`/`step`/`steps_left` host-side and re-uploaded them (~6 fresh
+`jnp.asarray` transfers per dispatch) even when NOTHING had changed
+since the previous tick. This module is the fix and the discipline:
+
+  - ``HostStage`` — the ONE sanctioned host->device transfer funnel on
+    the tick path. Every upload the engine performs mid-tick goes
+    through :meth:`HostStage.to_device`, which counts it
+    (``h2d_uploads``) so the host-sync budget is a COUNTER, not a
+    timing assertion. The NOS015 checker flags raw ``jnp.asarray`` /
+    ``jnp.array`` / ``jax.device_put`` calls on tick-path engine
+    methods; this module (no engine class) is the sanctioned home.
+
+  - ``TickState`` — the device-resident per-slot tick metadata: the
+    block table plus ``pos``/``mask``/``serial``/``step``/
+    ``steps_left``, living as device arrays that the dispatched macro
+    and burst programs ADVANCE THEMSELVES (the program returns the
+    post-window ``pos``/``step``/``steps_left``; :meth:`advance` swaps
+    them in without any transfer). Host events — admit, release,
+    preempt, restore, prefill progress, verify resolution, drain —
+    mark the state dirty; the next dispatch re-syncs with a SINGLE
+    packed upload ([n_slots, max_pages + 5] int32, one transfer for
+    all six arrays) plus one jitted device-side unpack. Steady-state
+    decode therefore crosses the host->device boundary zero times per
+    dispatch for metadata.
+
+  - ``SyncLedger`` — the blocking device->host counterpart: `_TokRef`
+    materializations and spill copy-outs tick it, giving the engine a
+    ``blocking_syncs`` counter with the same budget-not-timing
+    property.
+
+Packing is int32 throughout: positions, remaining counts, PRNG step
+indices, and serials are all small non-negative ints (serials count
+admitted requests; steps are bounded by max_new), and JAX's default
+x64-disabled mode would down-cast an int64 upload to int32 anyway — the
+packed layout just makes the invariant explicit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SyncLedger:
+    """Counts blocking device->host materializations on the tick path
+    (the `blocking_syncs` budget). A plain mutable counter object so
+    `_TokRef` instances can share the engine's ledger without a
+    backreference to the engine."""
+
+    __slots__ = ("syncs",)
+
+    def __init__(self) -> None:
+        self.syncs = 0
+
+    def note(self) -> None:
+        self.syncs += 1
+
+
+class HostStage:
+    """The sanctioned host->device staging funnel (NOS015).
+
+    Tick-path engine code never calls `jnp.asarray` directly; it calls
+    :meth:`to_device`, which performs the transfer AND counts it, so
+    "how many uploads did that tick cost" is an exact counter the
+    regression tests gate on (`h2d_uploads`)."""
+
+    __slots__ = ("uploads",)
+
+    def __init__(self) -> None:
+        self.uploads = 0
+
+    def to_device(self, value, dtype=None):
+        """One counted host->device transfer."""
+        self.uploads += 1
+        return jnp.asarray(value, dtype=dtype)
+
+
+class TickState:
+    """Device-resident per-slot tick metadata behind the staging API.
+
+    Layout of the packed staging buffer ([n_slots, max_pages + 5]
+    int32): columns [0, max_pages) are the block table row, then one
+    column each of pos, mask (0/1), serial, step, steps_left. `sync`
+    performs the single counted upload + one jitted unpack; `advance`
+    swaps in the program-advanced pos/step/steps_left without touching
+    the host boundary. Consumers read the `.table`/`.pos`/`.mask`/
+    `.serial`/`.step`/`.steps_left` device arrays directly."""
+
+    def __init__(self, stage: HostStage, n_slots: int, max_pages: int):
+        self._stage = stage
+        self.n_slots = int(n_slots)
+        self.max_pages = int(max_pages)
+        self.dirty = True
+        #: Separate table-staleness flag: the block table changes only
+        #: on admit/release/reset, while pos/step cursors churn every
+        #: prefill wave — consumers that read ONLY the table (the
+        #: prefill programs) sync against this flag, so a multi-wave
+        #: prefill tick costs one packed upload, not one per wave.
+        self.table_dirty = True
+        #: Packed-sync count (<= one per host-event tick; the budget
+        #: test's "<= 1 staging upload per burst" witness).
+        self.syncs = 0
+        self.table = None
+        self.pos = None
+        self.mask = None
+        self.serial = None
+        self.step = None
+        self.steps_left = None
+        P = self.max_pages
+
+        def _unpack(packed):
+            return (
+                packed[:, :P],
+                packed[:, P],
+                packed[:, P + 1] > 0,
+                packed[:, P + 2],
+                packed[:, P + 3],
+                packed[:, P + 4],
+            )
+
+        self._unpack = jax.jit(_unpack)
+
+    def mark_dirty(self) -> None:
+        """A host event (prefill progress, verify resolution, drafting
+        flags) changed slot scheduling metadata: the next metadata
+        consumer (macro/burst/verify dispatch) must re-sync from the
+        host mirrors."""
+        self.dirty = True
+
+    def mark_table_dirty(self) -> None:
+        """A host event changed the block table itself (admit, release,
+        preempt, restore, pool reset): every consumer — the prefill
+        programs included — must re-sync."""
+        self.dirty = True
+        self.table_dirty = True
+
+    def sync(self, packed: np.ndarray) -> None:
+        """One packed staging upload + one device-side unpack. No-op
+        unless dirty."""
+        if not self.dirty and not self.table_dirty:
+            return
+        dev = self._stage.to_device(packed, dtype=jnp.int32)
+        (
+            self.table,
+            self.pos,
+            self.mask,
+            self.serial,
+            self.step,
+            self.steps_left,
+        ) = self._unpack(dev)
+        self.syncs += 1
+        self.dirty = False
+        self.table_dirty = False
+
+    def advance(self, pos, step, steps_left) -> None:
+        """Swap in the post-dispatch metadata the program itself
+        computed — zero host->device traffic. Leaves dirtiness alone:
+        if a host event already re-dirtied the state this tick, the
+        next sync still wins."""
+        self.pos = pos
+        self.step = step
+        self.steps_left = steps_left

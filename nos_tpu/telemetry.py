@@ -153,6 +153,19 @@ class ServingReport:
     both_dispatch_ticks: int = 0
     macro_tokens_by_slot: Dict[str, int] = field(default_factory=dict)
     spec_rounds_by_slot: Dict[str, int] = field(default_factory=dict)
+    # Fused macro bursts + the host-sync budget (PR 10,
+    # runtime/staging.py): burst programs dispatched and the macro
+    # windows they fused (steps_run counts a burst as ONE dispatch —
+    # dispatches-per-token is the point), host->device uploads through
+    # the counted staging funnel, packed TickState syncs (<= 1 per
+    # host-event tick), blocking device->host materializations, and
+    # ticks served by the O(1) idle fast path.
+    burst_dispatches: int = 0
+    burst_windows_run: int = 0
+    h2d_uploads: int = 0
+    staging_syncs: int = 0
+    blocking_syncs: int = 0
+    idle_ticks: int = 0
     # Queue depths at snapshot time.
     inflight_dispatches: int = 0
     pending_verifies: int = 0
@@ -260,6 +273,12 @@ def collect_serving(server) -> ServingReport:
         spec_tokens_accepted=int(getattr(server, "spec_tokens_accepted", 0)),
         spec_demotions=int(getattr(server, "spec_demotions", 0)),
         both_dispatch_ticks=int(getattr(server, "both_dispatch_ticks", 0)),
+        burst_dispatches=int(getattr(server, "burst_dispatches", 0)),
+        burst_windows_run=int(getattr(server, "burst_windows_run", 0)),
+        h2d_uploads=int(getattr(server, "h2d_uploads", 0)),
+        staging_syncs=int(getattr(server, "staging_syncs", 0)),
+        blocking_syncs=int(getattr(server, "blocking_syncs", 0)),
+        idle_ticks=int(getattr(server, "idle_ticks", 0)),
         prefill_dispatches=int(getattr(server, "prefill_dispatches", 0)),
         prefill_tokens=int(getattr(server, "prefill_tokens", 0)),
         ticks_with_prefill_and_macro=int(

@@ -1,0 +1,19 @@
+"""NOS015 negatives: a runtime file WITHOUT an engine class (no `_tick`)
+is out of scope — `runtime/staging.py`'s HostStage is the real-tree
+example: it is the ONE sanctioned home of the raw transfer. Tick-path
+code that routes uploads through the stage is clean (the call carries no
+flagged name), as are device-side constructors like `jnp.zeros`.
+"""
+
+import jax.numpy as jnp
+
+
+class Stage:
+    def to_device(self, value, dtype=None):
+        return jnp.asarray(value, dtype=dtype)
+
+
+class BatchRunner:
+    def step(self, x):
+        staged = Stage().to_device(x)
+        return staged, jnp.zeros((4,), jnp.int32)

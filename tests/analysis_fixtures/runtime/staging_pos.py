@@ -1,0 +1,30 @@
+"""NOS015 positives: raw host->device staging on an engine class's tick
+path. Expected findings: `jnp.asarray` in `_tick`, `jnp.array` in the
+reachable `_upload`, and the helper class's `jax.device_put` (helpers in
+an engine file are tick-path by construction). `submit` is client-side
+(unreachable from `_tick`/`_run`) and stays legal.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class _Staging:
+    def push(self, x):
+        return jax.device_put(x)
+
+
+class Engine:
+    def __init__(self):
+        self.queue = []
+
+    def _tick(self):
+        arr = jnp.asarray(self.queue)
+        self._upload()
+        return arr
+
+    def _upload(self):
+        return jnp.array([1, 2, 3])
+
+    def submit(self, x):
+        return jnp.asarray(x)  # off the tick path: legal

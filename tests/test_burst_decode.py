@@ -1,0 +1,327 @@
+"""Fused macro bursts + device-resident tick state (PR 10).
+
+The dispatch-overhead tentpole's exactness and budget gates:
+
+  - burst-on vs burst-off outputs BIT-IDENTICAL (greedy, temperature,
+    eos, staggered lane lengths — the burst runs the same per-step math
+    at the same PRNG step indices);
+  - the steady-state host-sync budget is COUNTER-gated, never timed:
+    <= 1 packed staging upload per burst, zero per already-clean burst,
+    zero blocking reads without a quota fold;
+  - bursts DEGRADE to per-tick dispatch under fault injection, quota
+    preemption pressure, and drain/migrate — the PR 6-8 recovery
+    semantics see the per-tick engine they were built against, and
+    `BlockManager.conserved()` holds at every recovery;
+  - quota `observe_tick` folds once per FUSED window from the counts
+    array the burst program returns (the window clock advances as if
+    the windows had been ticks), in exact agreement with the host's
+    nominal bookkeeping;
+  - idle ticks take the O(1) fast path: no gauge publishing, no quota
+    dict rebuild (the shared empty entry, pinned by identity).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.runtime.faults import (
+    FAULT_TRANSIENT,
+    FAULT_DEVICE_LOST,
+    FaultInjector,
+    FaultSpec,
+)
+from nos_tpu.runtime.quota import QuotaPolicy, TenantShare
+from tests.conftest import serving_test_config
+
+CFG = serving_test_config()
+
+PROMPTS = [
+    [3, 11, 42, 7, 19, 5, 23, 2, 61, 13],
+    [8, 8, 31, 4, 90, 17, 6, 44, 9, 28],
+    [55, 1, 2, 3, 70, 70, 12, 39, 80, 10],
+]
+
+
+@pytest.fixture
+def params(serving_params):
+    return serving_params
+
+
+def _engine(params, burst_windows, **kw):
+    defaults = dict(
+        n_slots=3, max_len=96, prompt_buckets=(8, 16), block_size=8,
+        steps_per_dispatch=4,
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, burst_windows=burst_windows, **defaults)
+
+
+def _drive(server, reqs):
+    """Manual deterministic driving: submit everything, tick to
+    completion (routing tick faults through the classification sweep
+    exactly as `_run` does), return outputs in submit order."""
+    futs = [server.submit(p, max_new=n, tenant=t) for p, n, t in reqs]
+    for _ in range(4000):
+        if all(f.done() for f in futs):
+            break
+        try:
+            server._tick()
+        except Exception as exc:  # noqa: BLE001 — the _run contract
+            server._recover(exc)
+    return [f.result(timeout=5) for f in futs]
+
+
+# -- exactness ---------------------------------------------------------------
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_burst_outputs_bit_identical_greedy_and_temperature(params, temperature):
+    """Staggered max_new so lanes finish mid-burst and coast: the fused
+    chain must still equal per-tick dispatch token for token."""
+    reqs = [(p, 20 + 7 * i, None) for i, p in enumerate(PROMPTS)]
+    off = _engine(params, 1, temperature=temperature)
+    outs_off = _drive(off, reqs)
+    on = _engine(params, 6, temperature=temperature)
+    outs_on = _drive(on, reqs)
+    assert outs_on == outs_off
+    assert on.burst_dispatches > 0, "steady state never fused"
+    assert on.burst_windows_run >= 2 * on.burst_dispatches
+    # Dispatch amortization: a burst counts as ONE engine dispatch.
+    assert on.steps_run < off.steps_run
+
+
+def test_burst_outputs_bit_identical_with_eos(params):
+    """Device-side eos masking: a lane that samples its eos mid-burst
+    coasts on the scratch page; the materialized output still truncates
+    at the first eos exactly like per-tick detection."""
+    eos = 5  # appears in the tiny model's greedy streams
+    reqs = [(p, 30, None) for p in PROMPTS]
+    outs_off = _drive(_engine(params, 1, eos_id=eos), reqs)
+    on = _engine(params, 6, eos_id=eos)
+    outs_on = _drive(on, reqs)
+    assert outs_on == outs_off
+    assert on.burst_dispatches > 0
+
+
+# -- the steady-state host-sync budget (counter-gated) ------------------------
+def test_steady_state_budget_one_staging_upload_per_burst(params):
+    server = _engine(params, 4, steps_per_dispatch=2)
+    futs = [server.submit(p, max_new=40) for p in PROMPTS]
+    # Reach steady state: everything admitted, prefilled, decoding.
+    for _ in range(50):
+        server._tick()
+        if all(
+            s.active and s.phase == "decoding" for s in server._slots
+        ) and not server._waiting and server._queue.empty():
+            break
+    b0, s0, u0 = server.burst_dispatches, server.staging_syncs, server.h2d_uploads
+    server._tick()
+    assert server.burst_dispatches == b0 + 1, "steady tick did not burst"
+    # <= 1 packed sync per burst, and the sync is the ONLY upload.
+    assert server.staging_syncs - s0 <= 1
+    assert server.h2d_uploads - u0 == server.staging_syncs - s0
+    # A second steady tick re-dispatches from the device-advanced state:
+    # ZERO host->device traffic.
+    b1, u1, bl1 = server.burst_dispatches, server.h2d_uploads, server.blocking_syncs
+    server._tick()
+    assert server.burst_dispatches == b1 + 1
+    assert server.h2d_uploads == u1
+    assert server.blocking_syncs == bl1  # no quota: nothing is read back
+    for f in futs:
+        f.cancel()
+    server.stop()
+
+
+def test_per_tick_macro_uploads_nothing_when_state_clean(params):
+    """The device-resident tick state pays off in per-tick mode too:
+    consecutive macro dispatches with no host event upload nothing."""
+    server = _engine(params, 1, steps_per_dispatch=2)
+    futs = [server.submit(p, max_new=40) for p in PROMPTS]
+    for _ in range(50):
+        server._tick()
+        if all(s.active and s.phase == "decoding" for s in server._slots):
+            break
+    server._tick()  # absorb any pending host events into one sync
+    u0, m0 = server.h2d_uploads, server.macro_dispatches
+    for _ in range(3):
+        server._tick()
+    assert server.macro_dispatches == m0 + 3
+    assert server.h2d_uploads == u0
+    for f in futs:
+        f.cancel()
+    server.stop()
+
+
+# -- degradation contracts ----------------------------------------------------
+def test_bursts_degrade_under_fault_injection_then_resume(params):
+    """While the injector holds scheduled chaos the engine stays
+    per-tick (named-site visit cadence preserved); the recovery replays
+    bit-identically, conservation holds, and bursts resume once the
+    schedule is exhausted."""
+    reqs = [(p, 24, None) for p in PROMPTS]
+    baseline = _drive(_engine(params, 6), reqs)
+
+    injector = FaultInjector([FaultSpec("dispatch_macro", 3, FAULT_DEVICE_LOST)])
+    server = _engine(params, 6, fault_injector=injector)
+    outs = _drive(server, reqs)
+    assert outs == baseline
+    assert injector.fired, "scheduled fault never fired"
+    assert server.recoveries == 1
+    assert server._block_mgr.conserved()
+    # Degraded while pending, fused after exhaustion.
+    assert server.burst_dispatches > 0
+
+
+@pytest.mark.parametrize("seed", range(7))
+def test_burst_chaos_gate_seven_seeds(params, seed):
+    """The PR 6 chaos gate shape, burst-on: seeded transient/device-lost
+    schedules against burst engines produce bit-identical outputs to the
+    fault-free burst run, with pool conservation at every recovery."""
+    reqs = [(p, 18, None) for p in PROMPTS]
+    baseline = _drive(_engine(params, 4), reqs)
+    injector = FaultInjector.seeded(
+        seed,
+        n_faults=2,
+        kinds=(FAULT_TRANSIENT, FAULT_DEVICE_LOST),
+        sites=("dispatch_macro", "dispatch_prefill_wave"),
+    )
+    server = _engine(params, 4, fault_injector=injector)
+    outs = _drive(server, reqs)
+    assert outs == baseline
+    assert server._block_mgr.conserved()
+
+
+def test_mid_burst_preemption_is_bit_identical(params):
+    """A preemption landing while burst refs are still in flight: the
+    checkpoint materializes through the same refs as per-tick mode, and
+    the preempted borrower's replayed stream equals the uninterrupted
+    one."""
+    borrower = (PROMPTS[0], 36, "free")
+
+    def run(interfere):
+        # Pool sized so borrower + guaranteed cannot coexist: the
+        # guaranteed arrival forces a preemption.
+        server = _engine(
+            params, 6, n_slots=2, total_blocks=8, max_len=48,
+            quota=QuotaPolicy(
+                {"gold": TenantShare(0.6, 1.0), "free": TenantShare(0.0, 1.0)},
+                window_ticks=32,
+            ),
+        )
+        fut = server.submit(*borrower[:2], tenant=borrower[2])
+        gold = None
+        for i in range(3000):
+            server._tick()
+            if i == 1 and interfere:
+                # The tick above dispatched the first burst; its refs
+                # are still in flight when the guaranteed tenant
+                # arrives and cannot be hosted — the preemption
+                # checkpoint materializes THROUGH the burst.
+                assert server.burst_dispatches > 0
+                gold = server.submit(PROMPTS[1], max_new=8, tenant="gold")
+            if fut.done() and (gold is None or gold.done()):
+                break
+        out = fut.result(timeout=5)
+        assert server._block_mgr.conserved()
+        return out, server
+
+    solo, s_solo = run(False)
+    preempted, s_pre = run(True)
+    assert preempted == solo
+    assert s_pre.preemptions >= 1, "interference never preempted"
+    assert s_solo.burst_dispatches > 0
+
+
+def test_drain_migrate_after_bursts_is_bit_identical(params):
+    """Drain an engine mid-stream after bursts ran; re-home the
+    checkpoints; the migrated streams finish bit-identically and both
+    pools conserve."""
+    reqs = [(p, 32, None) for p in PROMPTS]
+    baseline = _drive(_engine(params, 6), reqs)
+
+    src = _engine(params, 6)
+    futs = [src.submit(p, max_new=n) for p, n, _ in reqs]
+    for _ in range(10):
+        src._tick()
+    assert src.burst_dispatches > 0, "no burst before the drain"
+    checkpoints, pending = src.drain_extract()
+    assert src._block_mgr.conserved()
+    dst = _engine(params, 6)
+    for ck in checkpoints:
+        dst.transfer_in_checkpoint(ck)
+    for req in pending:
+        dst.transfer_in_request(
+            req.prompt, req.max_new, future=req.future, t_submit=req.t_submit
+        )
+    for _ in range(3000):
+        if all(f.done() for f in futs):
+            break
+        dst._tick()
+    assert [f.result(timeout=5) for f in futs] == baseline
+    assert dst._block_mgr.conserved()
+
+
+# -- quota fold from the returned per-window counts ---------------------------
+def test_burst_folds_quota_window_per_fused_window(params):
+    quota = QuotaPolicy({"t": TenantShare(0.2, 1.0)}, window_ticks=64)
+    server = _engine(params, 4, steps_per_dispatch=2, quota=quota)
+    fut = server.submit(PROMPTS[0], max_new=32, tenant="t")
+    for _ in range(50):
+        server._tick()
+        if server.burst_dispatches:
+            break
+    assert server.burst_dispatches == 1
+    n = server.burst_windows_run
+    assert n >= 2
+    # The window clock advanced once per FUSED window (not once per
+    # tick), and the folded tokens equal the host's nominal bookkeeping.
+    folded = [dict(e) for e in list(quota._window)[-n:]]
+    assert sum(e.get("t", 0) for e in folded) == n * server.steps_per_dispatch
+    # The counts read is the burst's one deliberate blocking sync.
+    assert server.blocking_syncs >= 1
+    fut.cancel()
+    server.stop()
+
+
+# -- idle ticks ---------------------------------------------------------------
+class _CountingMetrics:
+    def __init__(self):
+        self.calls = 0
+
+    def inc(self, name, value=1, **kw):
+        self.calls += 1
+
+    def set_gauge(self, name, value, **kw):
+        self.calls += 1
+
+    def observe(self, name, value, **kw):
+        self.calls += 1
+
+
+def test_idle_ticks_are_o1_and_allocation_free(params):
+    quota = QuotaPolicy({"t": TenantShare(0.5, 1.0)}, window_ticks=8)
+    metrics = _CountingMetrics()
+    server = _engine(params, 4, quota=quota, metrics=metrics)
+    out = _drive(server, [(PROMPTS[0], 6, "t")])
+    assert len(out[0]) == 6
+    # Two transition ticks park the engine, then the fast path holds.
+    server._tick()
+    server._tick()
+    assert server._engine_idle
+    calls0, idle0, ticks0 = metrics.calls, server.idle_ticks, quota.ticks
+    for _ in range(20):
+        server._tick()
+    assert server.idle_ticks == idle0 + 20
+    assert quota.ticks == ticks0 + 20  # window clock still advances
+    assert metrics.calls == calls0  # no gauge/counter publishing while idle
+    # Allocation-free quota fold: every idle window entry IS the shared
+    # empty singleton (identity, not equality).
+    entries = list(quota._window)
+    assert len({id(e) for e in entries}) == 1 and not entries[0]
+    # A new submit leaves the fast path immediately.
+    fut = server.submit(PROMPTS[1], max_new=4, tenant="t")
+    for _ in range(200):
+        if fut.done():
+            break
+        server._tick()
+    assert len(fut.result(timeout=5)) == 4
+    server.stop()

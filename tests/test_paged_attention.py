@@ -7,7 +7,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from nos_tpu.ops.paged_attention import _pallas, _reference, paged_decode_attention
+from nos_tpu.ops.paged_attention import (
+    _pallas,
+    _reference,
+    _window_pallas,
+    _window_reference,
+    paged_decode_attention,
+    paged_window_attention,
+)
 
 
 def make_case(seed, b, nh, nkv, hd, bs, n_pages, total_blocks, dtype=jnp.float32):
@@ -86,6 +93,106 @@ def test_public_entry_uses_reference_off_tpu():
     q, pk, pv, table, limit = make_case(4, 2, 8, 8, 64, 32, 2, 8)
     out = paged_decode_attention(q, pk, pv, table, limit)
     ref = _reference(q, pk, pv, table, limit)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+# -- windowed-query kernel (PR 10): interpret-mode parity vs the gather
+# reference across table layouts --------------------------------------------
+def make_window_case(
+    seed, b, nh, nkv, hd, bs, n_pages, total_blocks, w, dtype=jnp.float32
+):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, nh, w, hd), dtype)
+    pool_k = jnp.asarray(rng.randn(total_blocks, nkv, bs, hd), dtype)
+    pool_v = jnp.asarray(rng.randn(total_blocks, nkv, bs, hd), dtype)
+    perm = rng.permutation(np.arange(1, total_blocks))
+    table = np.zeros((b, n_pages), dtype=np.int32)
+    k = 0
+    owned = rng.randint(1, n_pages + 1, size=b)
+    for row in range(b):
+        for p in range(owned[row]):
+            table[row, p] = perm[k % len(perm)]
+            k += 1
+    # Window base positions such that pos + w stays inside the owned run.
+    pos = np.zeros((b,), dtype=np.int32)
+    for row in range(b):
+        hi = max(1, owned[row] * bs - w)
+        pos[row] = rng.randint(0, hi)
+    lengths = jnp.asarray(rng.randint(1, w + 1, size=b), jnp.int32)
+    mask = jnp.asarray(np.ones((b,), dtype=bool))
+    return (
+        q, pool_k, pool_v, jnp.asarray(table), jnp.asarray(pos), lengths, mask
+    )
+
+
+def _window_close(args, rtol=2e-5, atol=2e-5):
+    ref = _window_reference(*args)
+    out = _window_pallas(*args, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize(
+    "b,nh,nkv,hd,bs,n_pages,total,w",
+    [
+        (4, 8, 8, 64, 32, 4, 24, 5),   # MHA, mid window
+        (8, 8, 2, 64, 32, 4, 40, 8),   # GQA rep=4, row block = rep*W
+        (2, 16, 16, 128, 16, 8, 20, 3),
+        (1, 4, 4, 64, 64, 2, 4, 1),    # single row, single query token
+    ],
+)
+def test_window_kernel_matches_gather_reference(b, nh, nkv, hd, bs, n_pages, total, w):
+    _window_close(make_window_case(0, b, nh, nkv, hd, bs, n_pages, total, w))
+
+
+@pytest.mark.parametrize("w", [7, 8, 9])
+def test_window_kernel_bucket_boundary_shapes(w):
+    """bucket-1 / bucket / bucket+1 window widths: the row block pads to
+    the sublane multiple; parity must hold on both sides of the
+    boundary."""
+    _window_close(make_window_case(1, 3, 8, 4, 32, 8, 6, 16, w))
+
+
+def test_window_kernel_shared_prefix_rows():
+    """Two table rows mapping the SAME prefix pages (refcounted sharing,
+    PR 5) with different private tails: reads through the shared pages
+    must agree with the gather reference per row."""
+    q, pk, pv, table, pos, lengths, mask = make_window_case(
+        2, 2, 8, 4, 64, 64, 4, 16, 4
+    )
+    t = np.asarray(table).copy()
+    t[1, :2] = t[0, :2]  # shared prefix run, private tail beyond
+    pos = jnp.asarray([2 * 64 + 3, 2 * 64 + 17], jnp.int32)  # both past the run
+    args = (q, pk, pv, jnp.asarray(t), pos, lengths, mask)
+    _window_close(args)
+
+
+def test_window_kernel_scratch_masked_lanes():
+    """mask[b]=False lanes (the composition contract's inactive rows)
+    attend only the scratch page's first position — garbage, but
+    finite, and identical to the reference's guard."""
+    q, pk, pv, table, pos, lengths, mask = make_window_case(
+        3, 4, 8, 8, 64, 32, 4, 24, 5
+    )
+    mask = jnp.asarray([True, False, True, False])
+    args = (q, pk, pv, table, pos, lengths, mask)
+    ref = _window_reference(*args)
+    out = _window_pallas(*args, interpret=True)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_window_kernel_ragged_lengths():
+    """Per-row lengths 1..W (ragged verify windows): rows beyond
+    lengths[b] take the scratch guard; valid rows match exactly."""
+    q, pk, pv, table, pos, _, mask = make_window_case(4, 4, 8, 4, 32, 16, 6, 20, w=4)
+    lengths = jnp.asarray([1, 2, 3, 4], jnp.int32)
+    _window_close((q, pk, pv, table, pos, lengths, mask))
+
+
+def test_window_public_entry_uses_reference_off_tpu():
+    args = make_window_case(5, 2, 8, 8, 64, 32, 2, 8, 3)
+    out = paged_window_attention(*args)
+    ref = _window_reference(*args)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
 
 

@@ -504,8 +504,11 @@ def test_checkpoint_slot_captures_state_and_resolves_completed(params):
 def test_restored_request_survives_engine_stop_cleanly(params):
     """Checkpoints waiting in the re-admission line are failed (never
     stranded) when the engine stops before restoring them."""
+    # burst_windows=1: the test's manual tick count assumes per-tick
+    # dispatch (a burst would finish the request before the fault).
     server = DecodeServer(
-        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8,), block_size=8
+        params, CFG, n_slots=2, max_len=64, prompt_buckets=(8,), block_size=8,
+        burst_windows=1,
     )
     fut = server.submit([5, 11, 3, 42], max_new=12)
     for _ in range(8):

@@ -23,6 +23,7 @@ from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
 from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
+from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
 from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
 from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
 from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
@@ -531,6 +532,58 @@ def test_cli_lint_select_filters_checkers():
                  "--select", "NOS001"]) == 0
     assert main(["lint", fixture, "--no-baseline", "--root", REPO,
                  "--select", "NOS003"]) == 1
+
+
+# -- NOS015 host->device staging outside the staging API ----------------------
+def test_staging_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "staging_pos.py"),
+        [StagingDisciplineChecker()],
+    )
+    assert codes_of(findings) == ["NOS015"]
+    # jnp.asarray in _tick, jnp.array in the reachable _upload, the
+    # helper class's jax.device_put — and NOT submit()'s jnp.asarray.
+    assert len(findings) == 3
+    msgs = " | ".join(f.message for f in findings)
+    assert "jnp.asarray" in msgs
+    assert "jnp.array" in msgs
+    assert "device_put" in msgs
+
+
+def test_staging_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "staging_neg.py"),
+        [StagingDisciplineChecker()],
+    )
+    assert findings == []
+
+
+def test_staging_discipline_scope_needs_runtime_dir(tmp_path):
+    # The same engine class OUTSIDE a runtime/ directory is out of scope.
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "import jax.numpy as jnp\n"
+        "class Engine:\n"
+        "    def _tick(self):\n"
+        "        return jnp.asarray(self.queue)\n"
+    )
+    assert run_checkers(str(f), [StagingDisciplineChecker()]) == []
+
+
+def test_staging_discipline_sanctioned_site_suppressed_inline(tmp_path):
+    runtime = tmp_path / "runtime"
+    runtime.mkdir()
+    f = runtime / "engine.py"
+    f.write_text(
+        "import jax.numpy as jnp\n"
+        "class Engine:\n"
+        "    def _tick(self):\n"
+        "        a = jnp.asarray([1, 2])  # nos-lint: ignore[NOS015]\n"
+        "        b = jnp.asarray(self.queue)\n"
+        "        return a, b\n"
+    )
+    findings = run_checkers(str(runtime), [StagingDisciplineChecker()])
+    assert [x.line for x in findings] == [5]
 
 
 # -- engine robustness --------------------------------------------------------
