@@ -29,6 +29,16 @@ identical traffic) with its own gates:
     default 10% — wall-based, so a slack band absorbs CI scheduling
     noise; the counter gates carry the regression protection).
 
+PR 11 adds the `sharded_decode` A/B (tensor-parallel tp=1 vs tp=2 on
+identical traffic, docs/sharded-decode.md) with its own gates:
+
+  - outputs bit-identical across tp widths (the exactness oracle as an
+    artifact witness);
+  - the steady-state host-sync budget did NOT grow with the mesh
+    (h2d uploads / packed TickState syncs / blocking reads per window,
+    each <= the tp=1 arm's — counter-based, noise-free);
+  - the sharded arm actually fused bursts (steady state reached).
+
 Exit 0 and print the artifacts on success; exit 1 with the failed gate
 otherwise.
 """
@@ -41,6 +51,13 @@ import sys
 import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The sharded_decode A/B needs >= 2 devices: force the virtual CPU
+# fabric (same seam as tests/conftest.py) before jax initializes.
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 # Runnable as `python hack/bench_smoke.py` from the repo root: bench.py
 # lives at the root, not on hack/'s implicit path entry.
@@ -151,6 +168,26 @@ def main() -> int:
             f"off {off['tok_s']} vs on {on['tok_s']}"
         )
 
+    # -- PR 11: the sharded-decode A/B (tp=1 vs tp=2) ----------------------
+    shard = bench._sharded_decode(np, cfg, params, trials=2)
+    shard_payload = json.dumps(shard, sort_keys=True)
+    shard_parsed = json.loads(shard_payload)
+    print(shard_payload)
+
+    if shard_parsed.get("skipped"):
+        failures.append(f"sharded_decode skipped: {shard_parsed['skipped']}")
+    else:
+        if not shard_parsed["outputs_identical_across_tp"]:
+            failures.append("outputs differ tp=2 vs tp=1")
+        if shard_parsed["budget_grew_with_mesh"]:
+            tp1, tpn = shard_parsed["tp1"], shard_parsed["tp2"]
+            failures.append(
+                "host-sync budget grew with the mesh: "
+                f"tp1 {tp1} vs tp2 {tpn}"
+            )
+        if not shard_parsed["tp2"]["burst_dispatches"]:
+            failures.append("sharded arm never fused a macro burst")
+
     if failures:
         for f in failures:
             print(f"[bench-smoke] FAIL: {f}", file=sys.stderr)
@@ -166,7 +203,14 @@ def main() -> int:
         f"{off['host_overhead_us_per_token']} -> "
         f"{on['host_overhead_us_per_token']} us "
         f"({floor_parsed['host_overhead_per_token_ratio']}x), tok/s "
-        f"{off['tok_s']} -> {on['tok_s']}",
+        f"{off['tok_s']} -> {on['tok_s']}; sharded A/B: outputs identical "
+        f"across tp={shard_parsed.get('tp')}, budget flat "
+        f"(tp1 {shard_parsed['tp1']['h2d_uploads']}/"
+        f"{shard_parsed['tp1']['staging_syncs']}/"
+        f"{shard_parsed['tp1']['blocking_syncs']} vs tp2 "
+        f"{shard_parsed['tp2']['h2d_uploads']}/"
+        f"{shard_parsed['tp2']['staging_syncs']}/"
+        f"{shard_parsed['tp2']['blocking_syncs']} uploads/syncs/reads)",
         file=sys.stderr,
     )
     return 0

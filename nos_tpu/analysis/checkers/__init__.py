@@ -10,6 +10,7 @@ from nos_tpu.analysis.core import Checker
 
 def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
+    from nos_tpu.analysis.checkers.device_placement import DevicePlacementChecker
     from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
     from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
     from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
@@ -32,5 +33,6 @@ def all_checkers() -> List[Checker]:
         FaultDisciplineChecker(),
         SpillDisciplineChecker(),
         StagingDisciplineChecker(),
+        DevicePlacementChecker(),
         TraceDisciplineChecker(),
     ]

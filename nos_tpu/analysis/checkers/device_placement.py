@@ -1,0 +1,151 @@
+"""NOS016 — per-device placement on the serving engine's tick path.
+
+Tensor-parallel decode (docs/sharded-decode.md) made device placement a
+FIRST-CLASS property of the engine: params and the paged KV pool are
+placed ONCE at construction via mesh shardings (`NamedSharding` +
+`parallel/sharding.py decode_param_rules`), and every tick-path upload
+goes through the counted `HostStage` funnel, leaving placement to the
+shard_map'd programs. Code that reaches for a SPECIFIC device —
+`jax.devices()[i]` / `jax.local_devices()[i]` indexing, or
+`jax.device_put(x, <device>)` with an explicit target — hard-wires a
+single-device topology into the engine: under a tp mesh it silently
+pins data to one shard's device (wrong results or a cross-device copy
+storm), and it bypasses both the sharding rules and the h2d budget.
+
+Scope: identical to NOS010/NOS015 — files under `runtime/` containing
+an ENGINE class (a class defining `_tick`); flagged regions are the
+engine class's methods reachable from `_tick`/`_run` via
+`self.method()` calls plus every method of helper classes in the same
+file. `jax.device_put(x)` WITHOUT a target is NOS015's uncounted-
+staging finding, not ours; `jax.devices()` / `len(jax.devices())`
+without indexing is topology INSPECTION and stays legal. Genuinely
+sanctioned sites carry `# nos-lint: ignore[NOS016]` with a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from nos_tpu.analysis.core import Checker, FileContext, Report
+from nos_tpu.analysis.checkers.trace_safety import _dotted
+
+_ROOTS = ("_tick", "_run")
+
+_DEVICE_LISTS = {"jax.devices", "jax.local_devices"}
+
+
+class DevicePlacementChecker(Checker):
+    name = "device-placement"
+    codes = ("NOS016",)
+    description = "per-device placement on the engine tick path"
+
+    def __init__(self) -> None:
+        self._active = False
+        self._aliases: Dict[str, str] = {}
+        self._scope_funcs: Set[ast.AST] = set()
+
+    # -- per-file prescan ----------------------------------------------------
+    def begin_file(self, ctx: FileContext) -> None:
+        self._active = "runtime" in ctx.segments[:-1]
+        self._aliases = {}
+        self._scope_funcs = set()
+        if not self._active:
+            return
+        engine: List[Dict[str, ast.AST]] = []
+        helpers: List[Dict[str, ast.AST]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self._aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif isinstance(node, ast.ClassDef):
+                methods = {
+                    n.name: n
+                    for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                (engine if "_tick" in methods else helpers).append(methods)
+        if not engine:
+            self._active = False
+            return
+        for methods in engine:
+            for name in self._reachable(methods):
+                self._scope_funcs.add(methods[name])
+        for methods in helpers:
+            self._scope_funcs.update(methods.values())
+
+    @staticmethod
+    def _reachable(methods: Dict[str, ast.AST]) -> Set[str]:
+        """Methods reachable from the tick roots via `self.method()` calls
+        (the same unambiguous local resolution NOS006/NOS010/NOS015 use)."""
+        seen = {r for r in _ROOTS if r in methods}
+        queue = list(seen)
+        while queue:
+            body = methods[queue.pop()]
+            for node in ast.walk(body):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                    continue
+                target = node.func
+                if (
+                    isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and target.attr in methods
+                    and target.attr not in seen
+                ):
+                    seen.add(target.attr)
+                    queue.append(target.attr)
+        return seen
+
+    # -- visit ---------------------------------------------------------------
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if not self._active:
+            return
+        reason: Optional[str] = None
+        if isinstance(node, ast.Subscript) and self._is_device_list(node.value):
+            reason = (
+                "indexing jax.devices()/jax.local_devices() pins one "
+                "physical device"
+            )
+        elif isinstance(node, ast.Call):
+            reason = self._placed_put(node)
+        if reason is None:
+            return
+        enclosing = ctx.enclosing_all(ast.FunctionDef, ast.AsyncFunctionDef)
+        if not any(f in self._scope_funcs for f in enclosing):
+            return
+        report.add(
+            ctx.rel,
+            node.lineno,
+            "NOS016",
+            f"per-device placement on the engine tick path: {reason}; "
+            "place via mesh shardings (parallel/sharding.py) at "
+            "construction or route uploads through HostStage.to_device "
+            "(runtime/staging.py)",
+        )
+
+    def _resolve(self, func) -> Optional[str]:
+        dotted = _dotted(func)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        module = self._aliases.get(head, head)
+        return f"{module}.{rest}" if rest else module
+
+    def _is_device_list(self, value) -> bool:
+        return (
+            isinstance(value, ast.Call)
+            and self._resolve(value.func) in _DEVICE_LISTS
+        )
+
+    def _placed_put(self, node: ast.Call) -> Optional[str]:
+        if self._resolve(node.func) != "jax.device_put":
+            return None
+        has_target = len(node.args) >= 2 or any(
+            kw.arg == "device" for kw in node.keywords
+        )
+        if not has_target:
+            return None  # the bare upload is NOS015's finding, not ours
+        return "jax.device_put(..., <device>) targets one physical device"

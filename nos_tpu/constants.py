@@ -204,6 +204,10 @@ PROBE_KEY_ACTIVE_SLOTS = "active_slots"
 PROBE_KEY_QUEUED_REQUESTS = "queued_requests"
 PROBE_KEY_PREFILL_BACKLOG = "prefill_backlog_tokens"
 PROBE_KEY_DRAINING = "draining"
+# Devices the replica's tensor-parallel mesh spans (1 = single-device;
+# docs/sharded-decode.md). Router load scoring stays tp-agnostic, but
+# fleet snapshots and capacity accounting want the per-replica width.
+PROBE_KEY_TP_DEVICES = "tp_devices"
 # Router placement policies (PrefixRouter).
 ROUTER_POLICY_PREFIX = "prefix"
 ROUTER_POLICY_ROUND_ROBIN = "round_robin"

@@ -15,7 +15,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from nos_tpu.models.gpt import GPTConfig, _rmsnorm, project_qkv
+from nos_tpu.models.gpt import GPTConfig, _rmsnorm, project_qkv, tp_local_config
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict:
@@ -51,20 +51,107 @@ def _attend_cache(q, cache_k, cache_v, n_rep: int, limit):
     return out.reshape(b, nh, t, hd).astype(cache_v.dtype)
 
 
-def _block_core(x, p, cfg: GPTConfig, positions, attend):
+class TPLocal:
+    """Per-device tensor-parallel context for the paged decode programs
+    (docs/sharded-decode.md). An instance lives INSIDE the engine's
+    shard_map: the model code below calls its hooks with LOCAL shards
+    (column-sharded weights, head-sharded KV pool) and every collective
+    it performs is EXACT by construction — `gather` is an all-gather
+    (pure shard concatenation in device order) and the embedding psum
+    sums one real row against zeros. No partial-sum reduction of split
+    contractions ever runs, which is the whole exactness argument:
+    sharded programs produce bit-identical per-element results to the
+    single-device ones, modulo XLA fusion-context rounding the serving
+    oracle gates at the token level. `tp=None` call sites (every
+    single-device path) never construct one of these."""
+
+    def __init__(self, axis: str, tp: int, cfg: GPTConfig,
+                 emb_sharded: bool, head_sharded: bool):
+        self.axis = axis
+        self.tp = int(tp)
+        self.cfg = cfg
+        #: The per-device config view (heads/tp, n_kv/tp — gpt.py).
+        self.lcfg = tp_local_config(cfg, tp)
+        #: Whether tok_emb rows / lm_head columns are actually sharded
+        #: (vocab % tp != 0 falls back to replicated under the
+        #: decode_param_rules divisibility guard).
+        self.emb_sharded = bool(emb_sharded)
+        self.head_sharded = bool(head_sharded)
+
+    def gather(self, x, dim=-1):
+        """All-gather shards along `dim` (device order == shard order):
+        the one collective of the column-parallel layout."""
+        return jax.lax.all_gather(
+            x, self.axis, axis=dim % x.ndim, tiled=True
+        )
+
+    def embed(self, params, tokens):
+        """Token embedding over the vocab-ROW-sharded table: each device
+        contributes its resident rows (zeros elsewhere), combined with a
+        psum — order-insensitive (one real row + zeros), hence exact."""
+        emb = params["tok_emb"]
+        if not self.emb_sharded:
+            return emb[tokens]
+        idx = jax.lax.axis_index(self.axis)
+        vshard = emb.shape[0]
+        local = tokens - idx * vshard
+        ok = (local >= 0) & (local < vshard)
+        rows = emb[jnp.clip(local, 0, vshard - 1)]
+        return jax.lax.psum(
+            jnp.where(ok[..., None], rows, jnp.zeros_like(rows)), self.axis
+        )
+
+    def head(self, x, lm_head):
+        """Vocab-column-sharded lm_head: local logits columns, gathered
+        to the full vocab (exact concat) for device-side sampling."""
+        logits = (x @ lm_head).astype(jnp.float32)
+        if self.head_sharded:
+            logits = self.gather(logits)
+        return logits
+
+
+def _embed(params, tokens, tp):
+    return params["tok_emb"][tokens] if tp is None else tp.embed(params, tokens)
+
+
+def _lm_logits(x, params, tp):
+    if tp is None:
+        return (x @ params["lm_head"]).astype(jnp.float32)
+    return tp.head(x, params["lm_head"])
+
+
+def _block_core(x, p, cfg: GPTConfig, positions, attend, tp=None):
     """The ONE copy of the cached transformer block math (norms, QKV
     projection, residuals, gated MLP). Every cache layout — dense
     contiguous, block-paged — supplies only its `attend(q, k_new, v_new)
     -> o [B, nh, T, hd]` strategy (cache write + cached attention), so the
-    engines cannot drift numerically in anything but the cache plumbing."""
+    engines cannot drift numerically in anything but the cache plumbing.
+
+    With a `tp` context (TPLocal — tensor-parallel decode,
+    docs/sharded-decode.md) this body runs PER DEVICE inside the
+    engine's shard_map, in the exactness-preserving column-parallel
+    layout: every weight shard holds OUTPUT columns (heads for QKV, the
+    gated-MLP hidden axis for w_gate/w_up, model features for
+    wo/w_down), so no floating-point contraction is ever split across
+    devices, and the only collectives are `tp.gather` all-gathers —
+    exact shard concatenation, placed so every matmul consumes its FULL
+    contraction operand. The classic Megatron row-parallel layout
+    (partial sums + all-reduce) is refused on purpose: its summation
+    order depends on the device count, which would break the serving
+    engine's sharded == single-device oracle. `tp=None` is the
+    unchanged single-device path; `cfg` is then the caller's config,
+    else the per-device `tp_local_config` view."""
     b, t, h = x.shape
+    g_ = (lambda v: v) if tp is None else tp.gather
     y = _rmsnorm(x, p["ln1"])
     q, k_new, v_new = project_qkv(y, p, cfg, positions, repeat_kv=False)
     o = attend(q, k_new, v_new)
-    o = o.transpose(0, 2, 1, 3).reshape(b, t, h)
-    x = x + o @ p["wo"]
+    # Local heads concatenate back to the full attention output BEFORE
+    # the wo matmul, so the contraction over h runs unsplit per device.
+    o = g_(o.transpose(0, 2, 1, 3).reshape(b, t, -1))
+    x = x + g_(o @ p["wo"])
     z = _rmsnorm(x, p["ln2"])
-    z = (jax.nn.silu(z @ p["w_gate"]) * (z @ p["w_up"])) @ p["w_down"]
+    z = g_(g_(jax.nn.silu(z @ p["w_gate"]) * (z @ p["w_up"])) @ p["w_down"])
     return x + z
 
 
@@ -152,7 +239,13 @@ def decode_step(params, token, cfg: GPTConfig, cache, pos):
 
 
 # -- block-paged KV cache (vLLM/Orca-style, TPU-shaped) -----------------------
-def init_paged_cache(cfg: GPTConfig, total_blocks: int, block_size: int) -> Dict:
+def init_paged_cache(
+    cfg: GPTConfig,
+    total_blocks: int,
+    block_size: int,
+    mesh=None,
+    tp_axis: str = "tp",
+) -> Dict:
     """A shared pool of fixed-size KV blocks [total_blocks, n_kv, block,
     head_dim] per layer. Sequences own disjoint block lists via a page
     table; block 0 is the SCRATCH page — writes by inactive batch lanes are
@@ -172,17 +265,32 @@ def init_paged_cache(cfg: GPTConfig, total_blocks: int, block_size: int) -> Dict
     every program of every tick; all writes (tail prefill chunks, decode
     steps, verify windows) land in pages exactly one table row maps."""
     shape = (total_blocks, cfg.n_kv, block_size, cfg.head_dim)
+    sharding = None
+    if mesh is not None and tp_axis in mesh.shape and mesh.shape[tp_axis] > 1:
+        # Tensor-parallel pool partition (docs/sharded-decode.md): each
+        # device holds the n_kv/tp head-slices of EVERY block, so block
+        # ids, page tables, and the host-side BlockManager bookkeeping
+        # stay device-count-agnostic — one logical block is one table
+        # entry at any tp; only its bytes-per-device shrink.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(
+            mesh, PartitionSpec(None, tp_axis, None, None)
+        )
+
+    def _zeros():
+        z = jnp.zeros(shape, cfg.jdtype)
+        return z if sharding is None else jax.device_put(z, sharding)
+
     return {
-        str(i): {
-            "k": jnp.zeros(shape, cfg.jdtype),
-            "v": jnp.zeros(shape, cfg.jdtype),
-        }
+        str(i): {"k": _zeros(), "v": _zeros()}
         for i in range(cfg.layers)
     }
 
 
 def paged_decode_step(
-    params, token, cfg: GPTConfig, pcache, table, pos, mask, block_size: int
+    params, token, cfg: GPTConfig, pcache, table, pos, mask, block_size: int,
+    tp=None,
 ):
     """One token [B] with per-row positions [B] against the paged pool.
     Lanes with mask[b]=False write to the scratch page (their cache is
@@ -192,10 +300,17 @@ def paged_decode_step(
     from the pool (no materialized gather — the copy that cost the paged
     engine 17-34% vs the dense engine at 8 short streams); elsewhere the
     gather reference keeps the same numerics, so the two engines cannot
-    drift."""
+    drift.
+
+    `tp` (TPLocal) runs this body per device inside the engine's
+    shard_map: the pool shard holds n_kv/tp head-slices of every block,
+    the scatter/attention stay entirely local to the device's heads,
+    and only the block-boundary gathers (`_block_core`) and the
+    embedding/head hooks touch the tp axis — all exact collectives."""
     from nos_tpu.ops.paged_attention import paged_decode_attention
 
-    x = params["tok_emb"][token[:, None]]
+    mcfg = cfg if tp is None else tp.lcfg
+    x = _embed(params, token[:, None], tp)
     positions = pos[:, None].astype(jnp.int32)
     page_idx = pos // block_size
     off = pos % block_size
@@ -214,9 +329,9 @@ def paged_decode_step(
                 q[:, :, 0, :], ck, cv, table, (pos + 1).astype(jnp.int32)
             )[:, :, None, :]
 
-        x = _block_core(x, p, cfg, positions, attend)
+        x = _block_core(x, p, mcfg, positions, attend, tp=tp)
     x = _rmsnorm(x, params["ln_f"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _lm_logits(x, params, tp)
     return logits[:, 0, :], new_cache
 
 
@@ -230,6 +345,7 @@ def paged_prefill_chunk(
     length,
     block_size: int,
     with_logits: bool = True,
+    tp=None,
 ):
     """One prompt CHUNK [1, C] for a single sequence, written into its pages
     at positions start..start+C-1 (positions >= start+length — chunk
@@ -237,13 +353,14 @@ def paged_prefill_chunk(
     chunk, new pool). Chunking bounds admission cost: a 100k-token prompt
     is as many bounded dispatches, never one giant compile/step, and each
     chunk attends over the already-written prefix (exact causal masking
-    within the chunk via _attend_cache)."""
+    within the chunk via _attend_cache). `tp`: see `paged_decode_step`."""
     from nos_tpu.ops.paged_attention import paged_window_attention
 
+    mcfg = cfg if tp is None else tp.lcfg
     _, c = tokens.shape
     positions = start + jnp.arange(c, dtype=jnp.int32)
     valid = jnp.arange(c) < length
-    x = params["tok_emb"][tokens]
+    x = _embed(params, tokens, tp)
     table = table_row[None, :]  # [1, P]
     pages = jnp.where(valid, table_row[positions // block_size], 0)
     offs = positions % block_size
@@ -265,14 +382,14 @@ def paged_prefill_chunk(
             new_cache[str(i)] = {"k": ck, "v": cv}
             return paged_window_attention(q, ck, cv, table, w_pos, w_len, w_mask)
 
-        x = _block_core(x, p, cfg, positions[None, :], attend)
+        x = _block_core(x, p, mcfg, positions[None, :], attend, tp=tp)
     if not with_logits:
         # Non-final chunks only feed the cache: skip the [C, vocab] head
         # projection entirely (XLA cannot DCE a returned output, and at
         # production vocab sizes it dominates the chunk's FLOPs).
         return None, new_cache
     x = _rmsnorm(x, params["ln_f"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _lm_logits(x, params, tp)
     return logits[0], new_cache
 
 
@@ -286,6 +403,7 @@ def _paged_window_core(
     lengths,
     mask,
     block_size: int,
+    tp=None,
 ):
     """Shared body of the batched per-slot window programs
     (`paged_verify_window`, `paged_prefill_window`): tokens [B, W] written
@@ -294,10 +412,11 @@ def _paged_window_core(
     Returns (pre-final-norm activations [B, W, h], new pool)."""
     from nos_tpu.ops.paged_attention import paged_window_attention
 
+    mcfg = cfg if tp is None else tp.lcfg
     b, w = tokens.shape
     positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
     valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]
-    x = params["tok_emb"][tokens]
+    x = _embed(params, tokens, tp)
     pages = jnp.where(
         valid,
         jnp.take_along_axis(table, positions // block_size, axis=1),
@@ -323,7 +442,7 @@ def _paged_window_core(
             new_cache[str(i)] = {"k": ck, "v": cv}
             return paged_window_attention(q, ck, cv, table, pos, lengths, mask)
 
-        x = _block_core(x, p, cfg, positions, attend)
+        x = _block_core(x, p, mcfg, positions, attend, tp=tp)
     return x, new_cache
 
 
@@ -337,6 +456,7 @@ def paged_verify_window(
     lengths,
     mask,
     block_size: int,
+    tp=None,
 ):
     """Batched speculative-verify window over the shared paged pool: tokens
     [B, W] are per-slot draft windows (window[0] = the slot's last accepted
@@ -376,10 +496,11 @@ def paged_verify_window(
     dispatched program of any tick may write a page mapped by more than
     one row."""
     x, new_cache = _paged_window_core(
-        params, tokens, cfg, pcache, table, pos, lengths, mask, block_size
+        params, tokens, cfg, pcache, table, pos, lengths, mask, block_size,
+        tp=tp,
     )
     x = _rmsnorm(x, params["ln_f"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _lm_logits(x, params, tp)
     return logits, new_cache
 
 
@@ -393,6 +514,7 @@ def paged_prefill_window(
     lengths,
     mask,
     block_size: int,
+    tp=None,
 ):
     """Multi-slot batched prefill chunk: `paged_prefill_chunk` batched
     across slots, via the same windowed core as `paged_verify_window`.
@@ -408,7 +530,8 @@ def paged_prefill_window(
     go through the per-slot `_prefill_last` variant instead, which samples
     the first token. Returns the new pool."""
     _, new_cache = _paged_window_core(
-        params, tokens, cfg, pcache, table, pos, lengths, mask, block_size
+        params, tokens, cfg, pcache, table, pos, lengths, mask, block_size,
+        tp=tp,
     )
     return new_cache
 

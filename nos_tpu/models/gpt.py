@@ -11,6 +11,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
@@ -112,10 +114,43 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def tp_local_config(cfg: GPTConfig, tp: int) -> GPTConfig:
+    """The PER-DEVICE view of a tensor-parallel decode config
+    (docs/sharded-decode.md): inside the engine's shard_map'd programs
+    every projection weight is column-sharded (wq/wk/wv on heads,
+    w_gate/w_up on the gated-MLP hidden axis — parallel/sharding.py
+    `decode_param_rules`), so the model code sees heads/tp query heads,
+    n_kv/tp KV heads, and hidden/tp per-head feature columns while
+    `head_dim` is unchanged (hidden/tp ÷ heads/tp). `project_qkv` and
+    the attention reshapes consume THIS config per shard; activations
+    stay full-width (replicated), so nothing else scales. tp=1 returns
+    `cfg` itself — the single-device path is untouched by construction."""
+    if tp <= 1:
+        return cfg
+    if cfg.heads % tp or cfg.n_kv % tp or cfg.hidden % tp:
+        raise ValueError(
+            f"tp={tp} must divide heads={cfg.heads}, kv_heads={cfg.n_kv}, "
+            f"hidden={cfg.hidden}"
+        )
+    return dataclasses.replace(
+        cfg,
+        hidden=cfg.hidden // tp,
+        heads=cfg.heads // tp,
+        kv_heads=cfg.n_kv // tp,
+    )
+
+
 def project_qkv(x, p, cfg: GPTConfig, positions, repeat_kv: bool = True):
     """QKV projections with RoPE. With `repeat_kv`, grouped KV heads are
     repeated up to the query head count (GQA) so every attention backend sees
-    full heads; cached decode passes False and attends grouped instead."""
+    full heads; cached decode passes False and attends grouped instead.
+
+    Under tensor-parallel decode this function is the projection-spec
+    hook: it runs INSIDE the engine's shard_map with column-sharded
+    weight shards and the `tp_local_config` view of the config, so the
+    reshape/rope math lands each device exactly its own heads — the
+    contraction over `hidden` is never split, which is what keeps
+    per-head outputs bit-identical to the single-device program."""
     b, t, _ = x.shape
     nh, nkv, hd = cfg.heads, cfg.n_kv, cfg.head_dim
 

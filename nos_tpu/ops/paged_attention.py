@@ -137,6 +137,70 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# -- tensor-parallel sharding (docs/sharded-decode.md) ------------------------
+def _tp_width(mesh, tp_axis) -> int:
+    if mesh is None or tp_axis is None or tp_axis not in mesh.shape:
+        return 1
+    return int(mesh.shape[tp_axis])
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    from nos_tpu.parallel.sharding import shard_map_compat
+
+    return shard_map_compat(fn, mesh, in_specs, out_specs)
+
+
+def _pallas_sharded(q, pool_k, pool_v, table, limit, mesh, tp_axis,
+                    interpret: bool = False):
+    """The single-token kernel on a tensor-parallel mesh: the pool is
+    head-sharded ([T, nkv@tp, bs, hd]) and q head-sharded to match, so
+    each device runs the UNCHANGED kernel over its own n_kv/tp groups
+    against its own head-slices of every block — the page table and
+    limits ride in replicated. Per-(sequence, group) math is independent
+    (the online softmax never crosses heads), so the shard_map'd kernel
+    is bit-identical to the unsharded one per head: no collective runs
+    inside or after the kernel."""
+    from jax.sharding import PartitionSpec as P
+
+    return _shard_map(
+        functools.partial(_pallas, interpret=interpret),
+        mesh,
+        in_specs=(
+            P(None, tp_axis, None),
+            P(None, tp_axis, None, None),
+            P(None, tp_axis, None, None),
+            P(None, None),
+            P(None),
+        ),
+        out_specs=P(None, tp_axis, None),
+    )(q, pool_k, pool_v, table, limit)
+
+
+def _window_pallas_sharded(q, pool_k, pool_v, table, pos, lengths, mask,
+                           mesh, tp_axis, interpret: bool = False):
+    """`_window_pallas` on a tensor-parallel mesh — same argument as
+    `_pallas_sharded`: q [B, nh@tp, W, hd] and the pools [T, nkv@tp, bs,
+    hd] shard on heads, the scalar-prefetch operands replicate, and each
+    device's kernel instance computes its heads' windows exactly as the
+    single-device kernel would."""
+    from jax.sharding import PartitionSpec as P
+
+    return _shard_map(
+        functools.partial(_window_pallas, interpret=interpret),
+        mesh,
+        in_specs=(
+            P(None, tp_axis, None, None),
+            P(None, tp_axis, None, None),
+            P(None, tp_axis, None, None),
+            P(None, None),
+            P(None),
+            P(None),
+            P(None),
+        ),
+        out_specs=P(None, tp_axis, None, None),
+    )(q, pool_k, pool_v, table, pos, lengths, mask)
+
+
 # -- windowed-query variant (PR 10) ------------------------------------------
 def _window_reference(q, pool_k, pool_v, table, pos, lengths, mask):
     """The gather formulation of the windowed read: q [B,nh,W,hd]; pool
@@ -280,7 +344,8 @@ def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
     return out[:, :, :rows, :].reshape(b, nkv, rep, w, hd).reshape(b, nh, w, hd)
 
 
-def paged_window_attention(q, pool_k, pool_v, table, pos, lengths, mask):
+def paged_window_attention(q, pool_k, pool_v, table, pos, lengths, mask,
+                           mesh=None, tp_axis: str = "tp"):
     """Windowed-query attention over a block-paged KV pool: q [B,nh,W,hd]
     (W window tokens per sequence, already written into the pool by the
     caller), table [B,P] page ids, pos [B] window base positions,
@@ -290,18 +355,34 @@ def paged_window_attention(q, pool_k, pool_v, table, pos, lengths, mask):
     (garbage the caller ignores — never NaN). Pallas scalar-prefetch
     kernel on TPU (no materialized gather); the XLA gather reference
     elsewhere, bit-identical to the pre-kernel `_paged_window_core`
-    read path."""
+    read path.
+
+    `mesh`/`tp_axis` (tensor-parallel decode): on TPU the kernel is
+    shard_map'd over the head axis — each device's kernel instance
+    consumes its n_kv/tp slice of every pool block with the table
+    replicated in SMEM, per-head bit-identical to the unsharded kernel.
+    The gather reference needs no wrapping: its einsums batch over the
+    sharded head dim and GSPMD keeps them local."""
     if _use_pallas():
+        if _tp_width(mesh, tp_axis) > 1:
+            return _window_pallas_sharded(
+                q, pool_k, pool_v, table, pos, lengths, mask, mesh, tp_axis
+            )
         return _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask)
     return _window_reference(q, pool_k, pool_v, table, pos, lengths, mask)
 
 
-def paged_decode_attention(q, pool_k, pool_v, table, limit):
+def paged_decode_attention(q, pool_k, pool_v, table, limit,
+                           mesh=None, tp_axis: str = "tp"):
     """Single-token attention over a block-paged KV pool: q [B,nh,hd],
     pool [total_blocks,nkv,block,hd], table [B,P] (page ids per sequence,
     rows beyond a sequence's allocation point at the scratch page), limit
     [B] attention bounds. Pallas scalar-prefetch kernel on TPU (no
-    materialized gather); XLA gather reference elsewhere."""
+    materialized gather); XLA gather reference elsewhere. `mesh`/
+    `tp_axis`: see `paged_window_attention` — the kernel shard_maps over
+    heads, the reference shards through GSPMD propagation."""
     if _use_pallas():
+        if _tp_width(mesh, tp_axis) > 1:
+            return _pallas_sharded(q, pool_k, pool_v, table, limit, mesh, tp_axis)
         return _pallas(q, pool_k, pool_v, table, limit)
     return _reference(q, pool_k, pool_v, table, limit)

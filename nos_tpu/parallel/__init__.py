@@ -16,6 +16,7 @@ from nos_tpu.parallel.mesh import (  # noqa: F401
 )
 from nos_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
+    decode_param_rules,
     replicated,
     shard_params,
     transformer_param_rules,

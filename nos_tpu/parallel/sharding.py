@@ -34,6 +34,39 @@ def transformer_param_rules(
     )
 
 
+def decode_param_rules(
+    tp_axis: str = "tp",
+) -> Sequence[Tuple[str, P]]:
+    """Tensor-parallel layout for the SERVING decode path
+    (docs/sharded-decode.md) — ALL-COLUMN-PARALLEL, chosen for the
+    serving engine's bit-exactness oracle rather than minimum collective
+    bytes: every projection shards its OUTPUT features (wq/wk/wv on
+    heads, wo on model features, w_gate/w_up on the gated-MLP hidden
+    axis, w_down on model features, embeddings/lm_head on their feature/
+    vocab columns), so no matmul contraction is ever split across
+    devices and the only collectives the programs need are all-gathers
+    (exact shard concatenation — `models/gpt.py tp_replicate`). The
+    classic Megatron row-parallel wo/w_down (partial sums + all-reduce)
+    would change floating-point summation order with the device count
+    and break `sharded == single-device` bit-for-bit; this layout still
+    shards every tensor-sized parameter and the entire attention +
+    KV-pool read path, which dominate decode HBM and FLOPs. Norm scales
+    stay replicated (they are vectors). First match wins."""
+    return (
+        (r".*(wq|wk|wv|w_gate|w_up|wo|w_down)$", P(None, tp_axis)),
+        # tok_emb shards VOCAB ROWS, not features: a feature-sharded
+        # embedding feeds the first rmsnorm straight from a sharded
+        # producer, and GSPMD then computes the norm's feature-dim mean
+        # as partial sums + all-reduce even through a replication
+        # constraint on the norm (measured ~5e-7 fp32 drift). A
+        # row-sharded lookup combines one real row with zeros —
+        # order-insensitive, exact.
+        (r".*tok_emb$", P(tp_axis, None)),
+        (r".*lm_head$", P(None, tp_axis)),
+        (r".*", P()),
+    )
+
+
 def spec_for_path(path: str, rules: Sequence[Tuple[str, P]]) -> P:
     for pattern, spec in rules:
         if re.fullmatch(pattern, path):
@@ -49,27 +82,26 @@ def _tree_paths(tree, prefix=""):
         yield prefix, tree
 
 
-def shard_params(params, mesh: Mesh, rules=None):
-    """Apply rules to a pytree of arrays, placing each on the mesh. Arrays
-    whose shape is incompatible with their matched spec fall back to
-    replication (rank/divisibility guard)."""
-    rules = rules or transformer_param_rules()
-    flat = dict(_tree_paths(params))
+def guarded_spec(arr, path: str, mesh: Mesh, rules) -> P:
+    """The rule-matched PartitionSpec for one array, with the
+    rank/divisibility guard applied: a spec whose rank exceeds the
+    array's, or whose sharded dims do not divide evenly by the mesh
+    axis, falls back to full replication. The ONE copy of the guard —
+    `shard_params`, `param_shardings`, and `param_partition_specs` all
+    agree by construction."""
+    spec = spec_for_path(path, rules)
+    if len(spec) > getattr(arr, "ndim", 0):
+        return P()
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        if axis not in mesh.shape or arr.shape[dim] % mesh.shape[axis] != 0:
+            return P()
+    return spec
 
-    def place(path, arr):
-        spec = spec_for_path(path, rules)
-        # Guard: spec rank must not exceed array rank, and sharded dims must
-        # divide evenly.
-        if len(spec) > getattr(arr, "ndim", 0):
-            spec = P()
-        else:
-            for dim, axis in enumerate(spec):
-                if axis is None:
-                    continue
-                if axis not in mesh.shape or arr.shape[dim] % mesh.shape[axis] != 0:
-                    spec = P()
-                    break
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+def _map_params(params, mesh: Mesh, rules, leaf):
+    rules = rules or transformer_param_rules()
 
     def rebuild(tree, prefix=""):
         if isinstance(tree, dict):
@@ -77,35 +109,48 @@ def shard_params(params, mesh: Mesh, rules=None):
                 k: rebuild(v, f"{prefix}/{k}" if prefix else str(k))
                 for k, v in tree.items()
             }
-        return place(prefix, tree)
+        return leaf(guarded_spec(tree, prefix, mesh, rules), tree)
 
     return rebuild(params)
+
+
+def shard_params(params, mesh: Mesh, rules=None):
+    """Apply rules to a pytree of arrays, placing each on the mesh. Arrays
+    whose shape is incompatible with their matched spec fall back to
+    replication (rank/divisibility guard)."""
+    return _map_params(
+        params, mesh, rules,
+        lambda spec, arr: jax.device_put(arr, NamedSharding(mesh, spec)),
+    )
 
 
 def param_shardings(params, mesh: Mesh, rules=None):
     """NamedShardings (not placed arrays) matching shard_params — for jit
     in_shardings/out_shardings."""
-    rules = rules or transformer_param_rules()
+    return _map_params(
+        params, mesh, rules, lambda spec, arr: NamedSharding(mesh, spec)
+    )
 
-    def build(tree, prefix=""):
-        if isinstance(tree, dict):
-            return {
-                k: build(v, f"{prefix}/{k}" if prefix else str(k))
-                for k, v in tree.items()
-            }
-        spec = spec_for_path(prefix, rules)
-        if len(spec) > getattr(tree, "ndim", 0):
-            spec = P()
-        else:
-            for dim, axis in enumerate(spec):
-                if axis is None:
-                    continue
-                if axis not in mesh.shape or tree.shape[dim] % mesh.shape[axis] != 0:
-                    spec = P()
-                    break
-        return NamedSharding(mesh, spec)
 
-    return build(params)
+def param_partition_specs(params, mesh: Mesh, rules=None):
+    """Plain PartitionSpecs (not NamedShardings) matching shard_params —
+    the in_specs pytree a shard_map'd program consumes the placed
+    params under (docs/sharded-decode.md)."""
+    return _map_params(params, mesh, rules, lambda spec, arr: spec)
+
+
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+    """`shard_map` across jax versions (experimental on the 0.4.x line,
+    promoted to `jax.shard_map` later). `check_rep=False`: the decode
+    programs mix manual collectives with replicated scalar plumbing the
+    replication checker cannot always infer."""
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # newest jax: promoted out of experimental
+        from jax import shard_map
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
 
 
 def batch_sharding(mesh: Mesh, dp_axis: str = "dp", sp_axis: str = None) -> NamedSharding:

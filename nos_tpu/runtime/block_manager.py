@@ -46,6 +46,15 @@ refcount-0 blocks straight to host, freeing HBM immediately. Host
 payloads are device-independent: `reset()` rebuilds the device pool but
 leaves the tier intact, so post-recovery replays still hit.
 
+DEVICE-COUNT-AGNOSTIC by contract (PR 11, docs/sharded-decode.md):
+everything here is bookkeeping over LOGICAL block ids. Under
+tensor-parallel serving the pool's device arrays are partitioned on the
+KV-head axis — each device holds n_kv/tp head-slices of every block —
+but a block id means the same thing at any width, so refcounts, chain
+keys, the prefix index, spill staging, and `conserved()` never mention
+a device. Keep it that way: anything per-device belongs in the engine's
+mesh plumbing, not here (NOS016 polices the engine side).
+
 Every mutation of the pool state (`_free_blocks`, `_slot_blocks`,
 `_refcount`, `_cached_free`, `_prefix_index`, `_block_key`, `_spilled`)
 lives inside this class — enforced by the NOS011 checker

@@ -24,6 +24,13 @@ checkpoint needs only:
   - the prefill cursor at capture time (observability: how much prefill
     work the fault destroyed).
 
+Checkpoints are also TENSOR-PARALLEL-AGNOSTIC (PR 11,
+docs/sharded-decode.md): they hold tokens, never device state, and the
+replay path re-derives KV through whatever mesh the restoring engine
+runs — so a stream checkpointed on a tp=2 replica restores
+bit-identically on a tp=1 replica and vice versa (the cross-tp
+drain/migrate test pins the round trip).
+
 Everything here is plain host data — `to_dict`/`from_dict` round-trip all
 of it except the Future (process-local by nature), so checkpoints could
 be shipped to another engine/replica; within one engine the Future rides

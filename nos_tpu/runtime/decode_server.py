@@ -96,6 +96,7 @@ import logging
 
 from nos_tpu import constants
 from nos_tpu.models.decode import (
+    TPLocal,
     init_paged_cache,
     paged_decode_step,
     paged_prefill_chunk,
@@ -103,6 +104,12 @@ from nos_tpu.models.decode import (
     paged_verify_window,
 )
 from nos_tpu.models.gpt import GPTConfig
+from nos_tpu.parallel.sharding import (
+    decode_param_rules,
+    param_partition_specs,
+    shard_map_compat,
+    shard_params,
+)
 from nos_tpu.models.speculative import AdaptiveSpec, _LookupIndex, accept_prefix
 from nos_tpu.runtime.block_manager import BlockManager
 from nos_tpu.runtime.checkpoint import SlotCheckpoint
@@ -286,6 +293,8 @@ class DecodeServer:
         prefix_cache: bool = True,
         spill_blocks: Optional[int] = None,
         quota: Optional[QuotaPolicy] = None,
+        mesh=None,
+        tp_axis: str = "tp",
         metrics=None,
         tracing: Optional[EngineTracing] = None,
         fault_injector=None,
@@ -452,6 +461,33 @@ class DecodeServer:
         (greedy and temperature), by the same replay-exactness argument
         as fault recovery. None = no quota behavior at all.
 
+        `mesh`/`tp_axis` (docs/sharded-decode.md) arm TENSOR-PARALLEL
+        decode: one engine replica computes over every device of the
+        mesh's `tp_axis` — a planner-carved ICI-contiguous sub-slice in
+        the intended deployment, virtual CPU devices in tests. Params
+        place via `parallel/sharding.py decode_param_rules`
+        (NamedSharding, all weights column-sharded: QKV on heads,
+        gated-MLP on its hidden axis, wo/w_down on model features,
+        embeddings/lm_head on vocab when divisible), the paged pool
+        partitions on the KV-HEAD axis (each device holds n_kv/tp
+        head-slices of EVERY block, so block ids and all BlockManager
+        bookkeeping stay device-count-agnostic), and every jitted
+        program runs shard_map'd per device with only exact collectives
+        (all-gather concats; never a split-contraction partial sum).
+        Outputs are bit-identical to tp=1 — greedy AND temperature —
+        and the host-sync budget counters do NOT grow with the mesh:
+        the packed TickState sync, the staged uploads, and the burst's
+        one blocking read are all per-ENGINE, not per-device. A `mesh`
+        whose `tp_axis` has size 1 (or mesh=None, the default) takes
+        the existing single-device path bit-for-bit — no shard_map, no
+        placement, no behavior change. Requires heads, kv_heads, and
+        hidden divisible by the axis size; `fuse_projections` is
+        rejected (concatenating column shards would reshard mid-block).
+        Spill payloads and checkpoints remain tp-agnostic: copy-outs
+        gather the head shards into one full-width host payload, so
+        spill/revive, checkpoint/restore, and drain/migrate compose
+        across replicas of DIFFERENT tp widths.
+
         `metrics` (optional) is an observability.Metrics-style registry
         (duck-typed: inc/set_gauge); when provided the engine publishes
         its counters and per-tick drafting/macro split under
@@ -488,6 +524,52 @@ class DecodeServer:
         threads deterministic chaos through the engine's named dispatch
         sites — test/benchmark machinery, never enabled in production
         serving."""
+        # Tensor-parallel serving (docs/sharded-decode.md): a mesh whose
+        # tp axis is wider than 1 arms sharded decode — params placed by
+        # the decode rules, pool head-partitioned, every program
+        # shard_map'd. tp=1 (or no mesh) is the existing single-device
+        # path BIT-FOR-BIT: no placement, no wrapping, nothing changes.
+        tp_width = 1
+        if mesh is not None:
+            if tp_axis not in mesh.shape:
+                raise ValueError(
+                    f"mesh has no '{tp_axis}' axis: {dict(mesh.shape)}"
+                )
+            tp_width = int(mesh.shape[tp_axis])
+        if tp_width > 1:
+            if cfg.fuse_projections:
+                raise ValueError(
+                    "fuse_projections is incompatible with tensor-parallel "
+                    "decode: concatenating column-sharded weights would "
+                    "reshard mid-block"
+                )
+            if cfg.heads % tp_width or cfg.n_kv % tp_width or cfg.hidden % tp_width:
+                raise ValueError(
+                    f"tp={tp_width} must divide heads={cfg.heads}, "
+                    f"kv_heads={cfg.n_kv}, and hidden={cfg.hidden}"
+                )
+            self._mesh = mesh
+        else:
+            self._mesh = None
+        self._tp_axis = tp_axis
+        #: Devices this replica computes over (1 = single-device).
+        self.tp = tp_width if self._mesh is not None else 1
+        if self._mesh is not None:
+            rules = decode_param_rules(tp_axis)
+            params = shard_params(params, mesh, rules)
+            self._param_specs = param_partition_specs(params, mesh, rules)
+            from jax.sharding import PartitionSpec as _P
+
+            self._tp = TPLocal(
+                tp_axis,
+                self.tp,
+                cfg,
+                emb_sharded=self._param_specs["tok_emb"] != _P(),
+                head_sharded=self._param_specs["lm_head"] != _P(),
+            )
+        else:
+            self._param_specs = None
+            self._tp = None
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -511,7 +593,10 @@ class DecodeServer:
         )
         if self.total_blocks < 2:
             raise ValueError("total_blocks must be >= 2 (scratch + 1)")
-        self.cache = init_paged_cache(cfg, self.total_blocks, self.block_size)
+        self.cache = init_paged_cache(
+            cfg, self.total_blocks, self.block_size,
+            mesh=self._mesh, tp_axis=tp_axis,
+        )
         # Host->device staging discipline (runtime/staging.py, NOS015):
         # every tick-path upload funnels through the counted HostStage;
         # the per-slot tick metadata (block table, pos/mask/serial/step/
@@ -521,7 +606,9 @@ class DecodeServer:
         # numpy table mirror is the host truth the sync packs from.
         self._stage = HostStage()
         self._syncs = SyncLedger()
-        self._tick_state = TickState(self._stage, n_slots, self.max_pages)
+        self._tick_state = TickState(
+            self._stage, n_slots, self.max_pages, mesh=self._mesh
+        )
         self._table_np = np.zeros((n_slots, self.max_pages), dtype=np.int32)
         # ALL pool bookkeeping (free/cached lists, refcounts, per-slot
         # block lists, the prefix index) lives in the BlockManager —
@@ -713,6 +800,30 @@ class DecodeServer:
         K = self.steps_per_dispatch
         bs = self.block_size
 
+        # shard_map plumbing for tensor-parallel programs: the params
+        # spec tree (decode rules + divisibility guard), the pool spec
+        # (KV-head axis), and replicated for everything else. When the
+        # mesh is off, `_tp_shard` is the identity and every program
+        # compiles exactly as before.
+        tp_ctx = self._tp
+        if self._mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+
+            _R = _P()
+            _KV = _P(None, tp_axis, None, None)
+            _CS = {str(i): {"k": _KV, "v": _KV} for i in range(cfg.layers)}
+            _PS = self._param_specs
+        else:
+            _R = _KV = _CS = _PS = None
+        self._prog_specs = (_R, _KV, _CS, _PS)
+
+        def _tp_shard(fn, in_specs, out_specs):
+            if self._mesh is None:
+                return fn
+            return shard_map_compat(fn, self._mesh, in_specs, out_specs)
+
+        self._tp_shard = _tp_shard  # _make_burst wraps per window count
+
         def _macro(params, token, cache, table, pos0, active, serial, step0, steps_left):
             """K ragged decode iterations in one program. Per iteration k a
             lane participates iff it is active, still owes tokens
@@ -729,7 +840,8 @@ class DecodeServer:
                 pos_k = pos0 + k
                 mask = active & (k < steps_left) & (pos_k < max_len)
                 logits, cache = paged_decode_step(
-                    params, token, cfg, cache, table, pos_k, mask, bs
+                    params, token, cfg, cache, table, pos_k, mask, bs,
+                    tp=tp_ctx,
                 )
                 nxt = _sample(logits, serial, step0 + k)
                 out_token = jnp.where(mask, nxt, token)
@@ -752,7 +864,14 @@ class DecodeServer:
         # them. The tick-metadata arrays (pos/step/steps_left) are donated
         # too — the program replaces them, and the TickState is their only
         # holder.
-        self._step_fn = jax.jit(_macro, donate_argnums=(2, 4, 7, 8))
+        self._step_fn = jax.jit(
+            _tp_shard(
+                _macro,
+                (_PS, _R, _CS, _R, _R, _R, _R, _R, _R),
+                (_R, _R, _CS, _R, _R, _R),
+            ),
+            donate_argnums=(2, 4, 7, 8),
+        )
 
         # Chunked prefill: one bounded dispatch per prompt chunk, writing
         # into the slot's pages. `finish` statically selects the last-chunk
@@ -761,7 +880,7 @@ class DecodeServer:
         def _prefill_chunk(params, tokens, cache, table_row, start, length):
             _, cache = paged_prefill_chunk(
                 params, tokens, cfg, cache, table_row, start, length, bs,
-                with_logits=False,
+                with_logits=False, tp=tp_ctx,
             )
             return cache
 
@@ -770,7 +889,8 @@ class DecodeServer:
             slot, serial, step0,
         ):
             logits, cache = paged_prefill_chunk(
-                params, tokens, cfg, cache, table_row, start, length, bs
+                params, tokens, cfg, cache, table_row, start, length, bs,
+                tp=tp_ctx,
             )
             # step0 is 0 for a fresh request; a checkpoint RESTORE passes
             # the replayed-token count so a temperature stream's PRNG
@@ -794,7 +914,8 @@ class DecodeServer:
 
             def _verify(params, tokens, cache, table, pos, lengths, active):
                 logits, cache = paged_verify_window(
-                    params, tokens, cfg, cache, table, pos, lengths, active, bs
+                    params, tokens, cfg, cache, table, pos, lengths, active, bs,
+                    tp=tp_ctx,
                 )
                 # Greedy acceptance is argmax-only: ship [B, W] int32 to the
                 # host, never [B, W, vocab] logits. Same tie-break as the
@@ -802,7 +923,14 @@ class DecodeServer:
                 # chain spec-off would.
                 return _greedy(logits), cache
 
-            self._verify_fn = jax.jit(_verify, donate_argnums=(2,))
+            self._verify_fn = jax.jit(
+                _tp_shard(
+                    _verify,
+                    (_PS, _R, _CS, _R, _R, _R, _R),
+                    (_R, _CS),
+                ),
+                donate_argnums=(2,),
+            )
 
         # Batched multi-slot mid-prompt chunks: one program per bucket,
         # always [n_slots, bucket]-shaped (inactive rows write scratch), so
@@ -812,16 +940,32 @@ class DecodeServer:
         # so a solo prompt's numerics are bit-identical to the inline path.
         def _prefill_window(params, tokens, cache, table, pos, lengths, active):
             return paged_prefill_window(
-                params, tokens, cfg, cache, table, pos, lengths, active, bs
+                params, tokens, cfg, cache, table, pos, lengths, active, bs,
+                tp=tp_ctx,
             )
 
-        self._prefill_window = jax.jit(_prefill_window, donate_argnums=(2,))
-        self._prefill_chunk = jax.jit(_prefill_chunk, donate_argnums=(2,))
+        self._prefill_window = jax.jit(
+            _tp_shard(
+                _prefill_window, (_PS, _R, _CS, _R, _R, _R, _R), _CS
+            ),
+            donate_argnums=(2,),
+        )
+        self._prefill_chunk = jax.jit(
+            _tp_shard(_prefill_chunk, (_PS, _R, _CS, _R, _R, _R), _CS),
+            donate_argnums=(2,),
+        )
         # first_vec is deliberately NOT donated: earlier admission waves'
         # _TokRefs still hold previous versions of the vector — donating it
         # would delete a buffer a pending request reads at completion. It is
         # [n_slots] int32; the copy is nothing.
-        self._prefill_last = jax.jit(_prefill_last, donate_argnums=(2, 6))
+        self._prefill_last = jax.jit(
+            _tp_shard(
+                _prefill_last,
+                (_PS, _R, _CS, _R, _R, _R, _R, _R, _R, _R, _R),
+                (_CS, _R, _R),
+            ),
+            donate_argnums=(2, 6),
+        )
 
         # Spill-tier device transfers: one gather program (copy-out: the
         # cache stays live, NOT donated) and one scatter program
@@ -844,8 +988,19 @@ class DecodeServer:
                 }
             return cache
 
-        self._extract_fn = jax.jit(_extract)
-        self._revive_fn = jax.jit(_revive, donate_argnums=(0,))
+        # Spill copy-outs GATHER the head shards into one full-width
+        # payload (out spec on the KV-head axis, np.asarray assembles),
+        # and revives SLICE the full payload back per shard — so spill
+        # payloads, and everything built on them (preemption, tiered
+        # revive, cross-replica transfer), are identical bytes at any
+        # tp: replicas of different widths interoperate by construction.
+        self._extract_fn = jax.jit(
+            _tp_shard(_extract, (_CS, _R), (_KV, _KV))
+        )
+        self._revive_fn = jax.jit(
+            _tp_shard(_revive, (_CS, _KV, _KV, _R), _CS),
+            donate_argnums=(0,),
+        )
 
     def _extract_block(self, block: int):
         """Copy one block's K/V off the device for the spill tier:
@@ -1043,6 +1198,7 @@ class DecodeServer:
             ),
             constants.PROBE_KEY_PREFILL_BACKLOG: backlog,
             constants.PROBE_KEY_DRAINING: self._closed.is_set(),
+            constants.PROBE_KEY_TP_DEVICES: self.tp,
         }
 
     def prefix_keys(self) -> frozenset:
@@ -1139,7 +1295,10 @@ class DecodeServer:
     def _reset_device_state(self) -> None:
         """After an engine error the donated cache chain is untrustworthy;
         start from a fresh allocation."""
-        self.cache = init_paged_cache(self.cfg, self.total_blocks, self.block_size)
+        self.cache = init_paged_cache(
+            self.cfg, self.total_blocks, self.block_size,
+            mesh=self._mesh, tp_axis=self._tp_axis,
+        )
         self._table_np[:] = 0
         self._tick_state.mark_table_dirty()
         # The prefix index dies with the pool: cached blocks' K/V was in
@@ -2481,6 +2640,7 @@ class DecodeServer:
         eos_id = self.eos_id
         n_slots = self.n_slots
         sample = self._sample
+        tp_ctx = self._tp
 
         def _burst(params, token, cache, table, pos, active, serial, step, steps_left):
             def window(carry, _):
@@ -2492,7 +2652,8 @@ class DecodeServer:
                     adv = active & (k < steps_left) & (pos_k < max_len)
                     m = adv & ~finished
                     logits, cache = paged_decode_step(
-                        params, token, cfg, cache, table, pos_k, m, bs
+                        params, token, cfg, cache, table, pos_k, m, bs,
+                        tp=tp_ctx,
                     )
                     nxt = sample(logits, serial, step + k)
                     out_token = jnp.where(m, nxt, token)
@@ -2533,7 +2694,15 @@ class DecodeServer:
                 steps_left,
             )
 
-        return jax.jit(_burst, donate_argnums=(2, 4, 7, 8))
+        _R, _KV, _CS, _PS = self._prog_specs
+        return jax.jit(
+            self._tp_shard(
+                _burst,
+                (_PS, _R, _CS, _R, _R, _R, _R, _R, _R),
+                (_R, _R, _R, _CS, _R, _R, _R),
+            ),
+            donate_argnums=(2, 4, 7, 8),
+        )
 
     def _dispatch_burst(self, idxs: List[int], n_windows: int) -> None:
         """One fused burst dispatch: N macro windows, one host-boundary
@@ -2813,6 +2982,7 @@ class DecodeServer:
         m.set_gauge("nos_tpu_decode_inflight_dispatches", len(self._inflight))
         m.set_gauge("nos_tpu_decode_pending_verifies", len(self._pending_verifies))
         m.set_gauge("nos_tpu_decode_waiting_requests", len(self._waiting))
+        m.set_gauge("nos_tpu_decode_tp_devices", self.tp)
         pool = self._block_mgr.counts()
         m.set_gauge("nos_tpu_decode_kv_blocks_free", pool["free"])
         m.set_gauge("nos_tpu_decode_kv_blocks_cached", pool["cached"])

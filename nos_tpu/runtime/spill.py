@@ -20,6 +20,12 @@ tenant gets HBM immediately while the borrower's prefix stays one
 copy-in away.
 
 Host payloads are plain numpy — they do NOT die with the device pool.
+They are also FULL-WIDTH by contract (PR 11, docs/sharded-decode.md):
+under tensor-parallel serving the engine's copy-out gathers the
+KV-head shards into one `[layers, n_kv, block, head_dim]` payload and
+the copy-in slices it back per shard, so a payload spilled at one tp
+width revives — or ships to another replica — at ANY width, and
+`host_bytes` gauges the same quantity everywhere.
 After a device-lost recovery the engine resets the BlockManager (device
 index, free lists) but keeps the tier: checkpoint replays can revive
 spilled prefixes into the fresh pool, which is exactly when recompute

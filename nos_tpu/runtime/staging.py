@@ -95,7 +95,16 @@ class TickState:
     the host boundary. Consumers read the `.table`/`.pos`/`.mask`/
     `.serial`/`.step`/`.steps_left` device arrays directly."""
 
-    def __init__(self, stage: HostStage, n_slots: int, max_pages: int):
+    def __init__(
+        self, stage: HostStage, n_slots: int, max_pages: int, mesh=None
+    ):
+        """`mesh` (tensor-parallel decode, docs/sharded-decode.md) pins
+        the unpacked metadata arrays REPLICATED on the engine's mesh:
+        the sharded programs consume them as committed mesh residents
+        (a device-0-committed table feeding a mesh computation is a
+        placement error), and the packed upload stays ONE staging
+        transfer regardless of the mesh size — the h2d budget must not
+        grow with tp."""
         self._stage = stage
         self.n_slots = int(n_slots)
         self.max_pages = int(max_pages)
@@ -127,7 +136,13 @@ class TickState:
                 packed[:, P + 4],
             )
 
-        self._unpack = jax.jit(_unpack)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            replicated = NamedSharding(mesh, PartitionSpec())
+            self._unpack = jax.jit(_unpack, out_shardings=(replicated,) * 6)
+        else:
+            self._unpack = jax.jit(_unpack)
 
     def mark_dirty(self) -> None:
         """A host event (prefill progress, verify resolution, drafting

@@ -23,6 +23,12 @@ replica's in-flight decode streams instead of a carved slice:
            bit-identically to an undrained run;
   DELETE   the source engine stops and the replica retires.
 
+The moved unit is width-agnostic (PR 11, docs/sharded-decode.md):
+checkpoints are host tokens and spill payloads full-width bytes, so a
+drain may re-home streams between replicas of DIFFERENT tensor-parallel
+widths — e.g. consolidate a tp=1 fleet onto one tp=4 replica before a
+re-carve, bit-identically.
+
 This closes the planner <-> serving loop: a replanning pass that wants a
 sub-slice back can drain its replica against live load and re-carve,
 paying a replay instead of failed requests.

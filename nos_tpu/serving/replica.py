@@ -25,7 +25,13 @@ N CPU-backed engines — and tracks, per replica:
     bit-identical regardless of placement (docs/serving-cluster.md).
 
 Replica construction contract: every engine in one set must share
-`block_size` (router keys and engine keys must agree — enforced here)
+`block_size` (router keys and engine keys must agree — enforced here).
+Tensor-parallel widths may MIX freely (docs/sharded-decode.md): a tp=2
+replica and a tp=1 replica serve bit-identical streams (the sharded
+engine's exactness oracle), checkpoints/spill payloads are
+width-agnostic host bytes, so drain/migrate crosses widths — the probe
+carries each replica's `tp_devices` for capacity accounting, and
+`fleet_report()` sums it
 and, for temperature traffic to survive drain/migrate bit-identically,
 the same params/config/sampling seed (a migrated checkpoint keeps its
 serial and PRNG step, which only reproduces the stream on an engine

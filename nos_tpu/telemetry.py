@@ -166,6 +166,16 @@ class ServingReport:
     staging_syncs: int = 0
     blocking_syncs: int = 0
     idle_ticks: int = 0
+    # Tensor-parallel width (docs/sharded-decode.md): devices this
+    # engine's mesh spans (1 = single-device). Merge SUMS the field —
+    # the fleet total is "devices serving", the capacity denominator
+    # for per-chip-hour accounting. Pool/spill gauges deliberately do
+    # NOT scale with it: kv_blocks_* count LOGICAL blocks (each block's
+    # head-slices live on every shard) and spill_host_bytes measures
+    # the gathered full-width payloads, so reports from replicas of
+    # different tp widths stay comparable (pinned by the mixed-tp merge
+    # test).
+    tp_devices: int = 1
     # Queue depths at snapshot time.
     inflight_dispatches: int = 0
     pending_verifies: int = 0
@@ -214,7 +224,7 @@ class ServingReport:
         report built without samples (hand-constructed, or a foreign
         snapshot) contributes its counters but no tail information; the
         pooled percentiles are 0.0 when no samples exist at all."""
-        merged = ServingReport(replicas=0)
+        merged = ServingReport(replicas=0, tp_devices=0)
         for i, rep in enumerate(reports):
             for f in fields(ServingReport):
                 cur = getattr(merged, f.name)
@@ -274,6 +284,7 @@ def collect_serving(server) -> ServingReport:
         spec_demotions=int(getattr(server, "spec_demotions", 0)),
         both_dispatch_ticks=int(getattr(server, "both_dispatch_ticks", 0)),
         burst_dispatches=int(getattr(server, "burst_dispatches", 0)),
+        tp_devices=int(getattr(server, "tp", 1)),
         burst_windows_run=int(getattr(server, "burst_windows_run", 0)),
         h2d_uploads=int(getattr(server, "h2d_uploads", 0)),
         staging_syncs=int(getattr(server, "staging_syncs", 0)),

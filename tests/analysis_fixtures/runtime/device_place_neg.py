@@ -1,0 +1,19 @@
+"""NOS016 negatives: mesh-sharding placement and topology inspection
+are legal on the tick path — `NamedSharding` construction carries no
+device index, `len(jax.devices())` inspects without pinning, and a bare
+`jax.device_put(x)` (no target) is NOS015's uncounted-staging finding,
+never ours.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class Engine:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def _tick(self):
+        spec = NamedSharding(self.mesh, PartitionSpec("tp"))
+        n = len(jax.devices())
+        return spec, n, jax.device_put([1, 2])

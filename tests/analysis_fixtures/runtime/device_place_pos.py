@@ -1,0 +1,31 @@
+"""NOS016 positives: per-device placement on an engine class's tick
+path. Expected findings: `jax.devices()[0]` indexing in `_tick`,
+`jax.device_put(..., device=...)` in the reachable `_place`, and the
+helper class's `jax.local_devices()[1]` indexing (helpers in an engine
+file are tick-path by construction). `submit` is client-side
+(unreachable from `_tick`/`_run`) and stays legal, as is the bare
+`len(jax.devices())` topology inspection.
+"""
+
+import jax
+
+
+class _Pinner:
+    def pick(self):
+        return jax.local_devices()[1]
+
+
+class Engine:
+    def __init__(self):
+        self._dev = None
+
+    def _tick(self):
+        dev = jax.devices()[0]
+        self._place(dev)
+        return len(jax.devices())
+
+    def _place(self, x):
+        return jax.device_put(x, device=self._dev)
+
+    def submit(self, x):
+        return jax.devices()[0]  # off the tick path: legal

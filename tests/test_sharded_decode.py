@@ -1,0 +1,413 @@
+"""Tensor-parallel DecodeServer on a mesh (docs/sharded-decode.md).
+
+The sharded-decode tentpole's exactness and budget gates:
+
+  - tp=2 (CPU virtual devices) outputs BIT-IDENTICAL to the tp=1
+    single-device engine — greedy AND temperature, across budgeted
+    chunked prefill, speculative decoding, fused macro bursts, eos
+    termination, and the 7-seed chaos gate (faults recover on the
+    sharded engine and replay to the single-device streams);
+  - the host-sync budget does NOT grow with the mesh: steady-state
+    counter deltas (h2d uploads, packed TickState syncs, blocking
+    reads) are IDENTICAL tp=2 vs tp=1 — the packed sync is one staged
+    transfer per host-event tick regardless of device count;
+  - cross-tp drain/migrate: streams move tp=2 -> tp=1 -> tp=2 through
+    `drain_replica`/`migrate_replica` and finish bit-identically to an
+    undrained run, with pool conservation on every engine — spill
+    payloads and checkpoints are tp-agnostic by construction (copy-outs
+    gather the head shards into full-width host bytes);
+  - telemetry stays POOL-LOGICAL under tp: kv_blocks_* gauges and
+    spill_host_bytes are identical across widths for identical traffic,
+    and `ServingReport.merge` over a mixed-tp fleet sums `tp_devices`
+    without scaling any pool gauge;
+  - the windowed/single-token Pallas kernels run per-shard under
+    shard_map (interpret-mode parity vs the gather reference on a CPU
+    mesh), and the vocab-sharded embedding/lm_head paths (exercised
+    only when vocab divides the axis) stay exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.gpt import init_gpt
+from nos_tpu.parallel.mesh import build_mesh
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.runtime.faults import (
+    FAULT_DEVICE_LOST,
+    FAULT_TRANSIENT,
+    FaultInjector,
+)
+from nos_tpu.runtime.quota import QuotaPolicy, TenantShare
+from nos_tpu.serving.drain import drain_replica, migrate_replica
+from nos_tpu.serving.replica import ReplicaSet
+from nos_tpu.serving.router import PrefixRouter
+from nos_tpu.telemetry import ServingReport, collect_serving
+from tests.conftest import serving_test_config
+
+# Builds 2-device meshes on the virtual CPU fabric; a single-chip
+# accelerator run cannot, and the bit-exactness oracles cross program
+# shapes, which needs the deterministic CPU backend.
+pytestmark = pytest.mark.multidevice
+
+CFG = serving_test_config()
+
+# Long enough that budgeted prefill runs MULTI-chunk (bucket 16 + tail)
+# and block-aligned enough that the prefix cache indexes full blocks.
+PROMPTS = [
+    [3, 11, 42, 7, 19, 5, 23, 2, 61, 13, 37, 4, 88, 29, 54, 6, 71, 9, 15, 33],
+    [8, 8, 31, 4, 90, 17, 6, 44, 9, 28, 2, 95, 41, 63, 5, 12],
+    [55, 1, 2, 3, 70, 70, 12, 39, 80, 10],
+]
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh({"tp": 2}, devices=jax.devices()[:2])
+
+
+def make(params, mesh=None, **kw):
+    defaults = dict(
+        n_slots=3, max_len=96, prompt_buckets=(8, 16), block_size=8,
+        steps_per_dispatch=4,
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, mesh=mesh, **defaults)
+
+
+def drive(server, reqs):
+    """Manual deterministic driving (the _run contract: tick, classify
+    faults through the recovery sweep)."""
+    futs = [server.submit(p, max_new=n, tenant=t) for p, n, t in reqs]
+    for _ in range(4000):
+        if all(f.done() for f in futs):
+            break
+        try:
+            server._tick()
+        except Exception as exc:  # noqa: BLE001 — the _run contract
+            server._recover(exc)
+    return [f.result(timeout=5) for f in futs]
+
+
+# -- construction contract ----------------------------------------------------
+def test_mesh_validation_and_tp1_passthrough(params, mesh):
+    # A mesh without the named axis refuses up front.
+    with pytest.raises(ValueError, match="no 'model' axis"):
+        make(params, mesh=mesh, tp_axis="model")
+    # Indivisible head counts refuse up front (heads=4 on an 8-wide axis).
+    wide = build_mesh({"tp": 8}, devices=jax.devices())
+    with pytest.raises(ValueError, match="must divide"):
+        make(params, mesh=wide)
+    # fuse_projections would reshard column shards mid-block: refused.
+    fused = dataclasses.replace(CFG, fuse_projections=True)
+    with pytest.raises(ValueError, match="fuse_projections"):
+        DecodeServer(
+            init_gpt(jax.random.PRNGKey(0), fused), fused,
+            mesh=mesh, n_slots=2, max_len=64, prompt_buckets=(8,),
+        )
+    # A 1-wide axis IS the single-device path: nothing is armed.
+    one = build_mesh({"tp": 1}, devices=jax.devices()[:1])
+    server = make(params, mesh=one)
+    assert server.tp == 1 and server._mesh is None and server._tp is None
+    sharded = make(params, mesh=mesh)
+    assert sharded.tp == 2 and sharded._mesh is mesh
+
+
+# -- exactness ---------------------------------------------------------------
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_sharded_outputs_bit_identical_greedy_and_temperature(
+    params, mesh, temperature
+):
+    """Staggered budgets + multi-chunk budgeted prefill + fused bursts:
+    the tp=2 engine must reproduce the single-device token streams
+    bit-for-bit, and the host-sync budget must not grow with the mesh
+    (identical counters for identical traffic)."""
+    reqs = [(p, 18 + 5 * i, None) for i, p in enumerate(PROMPTS)]
+    ref = make(params, temperature=temperature)
+    outs_ref = drive(ref, reqs)
+    shd = make(params, mesh=mesh, temperature=temperature)
+    outs_shd = drive(shd, reqs)
+    assert outs_shd == outs_ref
+    assert shd.burst_dispatches > 0, "sharded steady state never fused"
+    # Budget-not-growing-with-mesh: same traffic, same counters.
+    assert shd.h2d_uploads == ref.h2d_uploads
+    assert shd.staging_syncs == ref.staging_syncs
+    assert shd.blocking_syncs == ref.blocking_syncs
+
+
+def test_sharded_speculative_bit_identical(params, mesh):
+    """Drafting/verify on the mesh: the verify window program runs
+    sharded, the host-side lookup/acceptance machinery is untouched."""
+    rep = [5, 9, 5, 9, 5, 9, 5, 9, 5, 9, 5, 9]
+    reqs = [(rep, 24, None), (PROMPTS[2], 20, None)]
+    ref = make(params, n_slots=2, spec_k=3)
+    outs_ref = drive(ref, reqs)
+    shd = make(params, n_slots=2, spec_k=3, mesh=mesh)
+    outs_shd = drive(shd, reqs)
+    assert outs_shd == outs_ref
+    # Both engines really speculated. Round/acceptance COUNTS are
+    # deliberately not compared: draft scheduling keys off non-blocking
+    # ref-readiness probes (models/speculative.py "lag-tolerant by
+    # design"), so WHEN a draft fires is wall-clock-dependent even
+    # between two tp=1 runs — the output equality above is the oracle.
+    assert shd.spec_rounds > 0 and ref.spec_rounds > 0
+    assert shd.spec_tokens_accepted > 0
+
+
+def test_sharded_eos_bursts_bit_identical(params, mesh):
+    """Device-side eos masking inside a fused burst, on the mesh."""
+    reqs = [(p, 30, None) for p in PROMPTS]
+    outs_ref = drive(make(params, eos_id=5, burst_windows=6), reqs)
+    shd = make(params, eos_id=5, burst_windows=6, mesh=mesh)
+    outs_shd = drive(shd, reqs)
+    assert outs_shd == outs_ref
+    assert shd.burst_dispatches > 0
+
+
+@pytest.mark.parametrize("seed", range(7))
+def test_sharded_chaos_gate_seven_seeds(params, mesh, seed):
+    """The PR 6 chaos gate, tp=2: seeded transient/device-lost schedules
+    against the SHARDED engine recover through checkpoint/replay (pool
+    reallocated sharded) and still produce the single-device fault-free
+    streams bit-for-bit, with pool conservation."""
+    reqs = [(p, 16, None) for p in PROMPTS]
+    baseline = drive(make(params), reqs)
+    injector = FaultInjector.seeded(
+        seed,
+        n_faults=2,
+        kinds=(FAULT_TRANSIENT, FAULT_DEVICE_LOST),
+        sites=("dispatch_macro", "dispatch_prefill_wave"),
+    )
+    shd = make(params, mesh=mesh, fault_injector=injector)
+    outs = drive(shd, reqs)
+    assert outs == baseline
+    assert shd._block_mgr.conserved()
+
+
+# -- host-sync budget (the counters must not grow with the mesh) --------------
+def test_steady_state_budget_identical_to_tp1(params, mesh):
+    """The PR 10 counter-gated steady-state test, extended to tp>1:
+    <= 1 packed sync on the first burst, ZERO uploads and blocking
+    reads on subsequent clean bursts, and every delta EQUAL to the
+    tp=1 engine's on identical traffic."""
+
+    def steady_deltas(mesh_arg):
+        server = make(
+            params, mesh=mesh_arg, steps_per_dispatch=2, burst_windows=4
+        )
+        futs = [server.submit(p, max_new=40) for p in PROMPTS]
+        for _ in range(50):
+            server._tick()
+            if all(
+                s.active and s.phase == "decoding" for s in server._slots
+            ) and not server._waiting and server._queue.empty():
+                break
+        marks = []
+        for _ in range(3):
+            before = (
+                server.h2d_uploads, server.staging_syncs,
+                server.blocking_syncs, server.burst_dispatches,
+            )
+            server._tick()
+            marks.append(
+                tuple(
+                    a - b
+                    for a, b in zip(
+                        (
+                            server.h2d_uploads, server.staging_syncs,
+                            server.blocking_syncs, server.burst_dispatches,
+                        ),
+                        before,
+                    )
+                )
+            )
+        for f in futs:
+            f.cancel()
+        server.stop()
+        return marks
+
+    tp1, tp2 = steady_deltas(None), steady_deltas(mesh)
+    assert tp2 == tp1
+    # First measured burst: at most one packed sync (and its one upload);
+    # clean bursts after it: zero host->device traffic, zero blocking
+    # reads (no quota armed).
+    uploads, syncs, blocking, bursts = tp2[0]
+    assert bursts == 1 and syncs <= 1 and uploads == syncs
+    for uploads, syncs, blocking, bursts in tp2[1:]:
+        assert (uploads, syncs, blocking, bursts) == (0, 0, 0, 1)
+
+
+# -- cross-tp drain/migrate ---------------------------------------------------
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_cross_tp_drain_migrate_roundtrip(params, mesh, temperature):
+    """Migrate in-flight streams from a tp=2 replica to a tp=1 replica
+    and BACK to a fresh tp=2 replica, via the real move protocol
+    (drain_replica / migrate_replica + router re-homing). Checkpoints
+    are host-token-level and spill payloads full-width, so replicas of
+    different widths interoperate; the streams finish bit-identically
+    to an undrained single-device run."""
+    reqs = [(PROMPTS[0], 40), (PROMPTS[1], 34)]
+    baseline_engine = make(params, temperature=temperature, seed=11)
+    baseline = drive(
+        baseline_engine, [(p, n, None) for p, n in reqs]
+    )
+
+    src = make(params, mesh=mesh, temperature=temperature, seed=11)
+    mid = make(params, temperature=temperature, seed=11)
+    rs = ReplicaSet([src, mid])
+    router = PrefixRouter(rs)
+    futs = [src.submit(p, max_new=n) for p, n in reqs]
+    for _ in range(4):
+        src._tick()  # real progress (prefill + a first burst) on tp=2
+    report = drain_replica(rs, router, "replica-0")
+    assert report.slots_migrated + report.requests_migrated == len(reqs)
+    assert src._block_mgr.conserved()
+    for _ in range(4):
+        mid._tick()  # progress on the tp=1 replica before moving back
+    back = make(params, mesh=mesh, temperature=temperature, seed=11)
+    migrate_replica(rs, router, "replica-1", back, start=False)
+    assert mid._block_mgr.conserved()
+    for _ in range(3000):
+        if all(f.done() for f in futs):
+            break
+        back._tick()
+    assert [f.result(timeout=5) for f in futs] == baseline
+    assert back._block_mgr.conserved()
+    assert back.replay_tokens > 0  # the streams really were re-homed
+    rs.stop()
+
+
+# -- telemetry stays pool-logical under tp ------------------------------------
+def test_preemption_spill_bytes_pool_logical_and_bit_identical(params, mesh):
+    """Quota preemption spills KV to host on both widths: the spilled
+    payloads are FULL-width gathers, so spill counters and host bytes
+    are identical tp=2 vs tp=1 — per-shard accounting would halve them
+    — and the preempted stream replays bit-identically."""
+
+    def run(mesh_arg):
+        server = make(
+            params, mesh=mesh_arg, n_slots=2, total_blocks=8, max_len=48,
+            burst_windows=6,
+            quota=QuotaPolicy(
+                {"gold": TenantShare(0.6, 1.0), "free": TenantShare(0.0, 1.0)},
+                window_ticks=32,
+            ),
+        )
+        fut = server.submit(PROMPTS[2], max_new=36, tenant="free")
+        gold = None
+        for i in range(3000):
+            server._tick()
+            if i == 1:
+                gold = server.submit(PROMPTS[1][:8], max_new=6, tenant="gold")
+            if fut.done() and (gold is None or gold.done()):
+                break
+        out = fut.result(timeout=5)
+        assert server._block_mgr.conserved()
+        return out, server
+
+    out1, s1 = run(None)
+    out2, s2 = run(mesh)
+    assert out2 == out1
+    assert s2.preemptions >= 1 and s2.preemptions == s1.preemptions
+    assert s1.spills > 0 and s2.spills == s1.spills
+    assert s2.spill_host_bytes == s1.spill_host_bytes
+    assert s2.revives == s1.revives
+
+
+def test_fleet_report_merge_mixed_tp(params, mesh):
+    """A mixed-width fleet merges coherently: pool gauges are
+    pool-logical (identical per replica for identical traffic, summed
+    by merge) and tp_devices sums to the fleet's device count."""
+    reqs = [(PROMPTS[2], 10, None)]
+    e1 = make(params)
+    e2 = make(params, mesh=mesh)
+    assert drive(e1, reqs) == drive(e2, reqs)
+    r1, r2 = collect_serving(e1), collect_serving(e2)
+    assert r1.tp_devices == 1 and r2.tp_devices == 2
+    for field in (
+        "kv_blocks_free", "kv_blocks_cached", "kv_blocks_shared",
+        "kv_blocks_spilled", "spill_host_bytes",
+    ):
+        assert getattr(r2, field) == getattr(r1, field), field
+    merged = ServingReport.merge([r1, r2])
+    assert merged.tp_devices == 3
+    assert merged.replicas == 2
+    assert merged.kv_blocks_free == r1.kv_blocks_free + r2.kv_blocks_free
+    # The probe carries the width for fleet snapshots.
+    from nos_tpu import constants
+
+    assert e2.probe()[constants.PROBE_KEY_TP_DEVICES] == 2
+
+
+# -- sharded kernels + vocab-sharded embedding/head ---------------------------
+def test_sharded_window_kernel_interpret_parity(mesh):
+    """The windowed Pallas kernel under shard_map (per-device grid over
+    n_kv/tp groups), interpret mode on the CPU mesh, against the global
+    gather reference."""
+    from nos_tpu.ops.paged_attention import (
+        _window_pallas_sharded,
+        _window_reference,
+    )
+    from tests.test_paged_attention import make_window_case
+
+    args = make_window_case(0, 4, 8, 4, 32, 16, 4, 24, 5)
+    ref = _window_reference(*args)
+    out = _window_pallas_sharded(*args, mesh=mesh, tp_axis="tp", interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_sharded_decode_kernel_interpret_parity(mesh):
+    """The single-token Pallas kernel under shard_map, interpret mode,
+    against the gather reference."""
+    from nos_tpu.ops.paged_attention import _pallas_sharded, _reference
+
+    rng = np.random.RandomState(3)
+    b, nh, nkv, hd, bs, n_pages, total = 4, 8, 4, 32, 16, 4, 24
+    q = jnp.asarray(rng.randn(b, nh, hd), jnp.float32)
+    pk = jnp.asarray(rng.randn(total, nkv, bs, hd), jnp.float32)
+    pv = jnp.asarray(rng.randn(total, nkv, bs, hd), jnp.float32)
+    table = jnp.asarray(
+        rng.randint(1, total, size=(b, n_pages)), jnp.int32
+    )
+    limit = jnp.asarray(rng.randint(1, n_pages * bs, size=b), jnp.int32)
+    ref = _reference(q, pk, pv, table, limit)
+    out = _pallas_sharded(
+        q, pk, pv, table, limit, mesh=mesh, tp_axis="tp", interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_vocab_sharded_embedding_and_head_bit_identical(mesh):
+    """vocab=96 divides the axis, so tok_emb shards on VOCAB ROWS (the
+    one-hot psum lookup) and lm_head on vocab columns (local logits +
+    gather) — the TPLocal paths the 97-vocab serving config never
+    exercises. Full engine run, bit-identical to tp=1."""
+    cfg96 = dataclasses.replace(CFG, vocab=96)
+    params96 = init_gpt(jax.random.PRNGKey(0), cfg96)
+    reqs = [([3, 11, 42, 7, 19, 5, 23, 2], 10, None)]
+
+    def run(mesh_arg):
+        server = DecodeServer(
+            params96, cfg96, n_slots=2, max_len=64, prompt_buckets=(8,),
+            block_size=8, mesh=mesh_arg,
+        )
+        return drive(server, reqs), server
+
+    out1, _ = run(None)
+    out2, s2 = run(mesh)
+    assert out2 == out1
+    assert s2._tp is not None and s2._tp.emb_sharded and s2._tp.head_sharded

@@ -23,6 +23,7 @@ from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
 from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
+from nos_tpu.analysis.checkers.device_placement import DevicePlacementChecker
 from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
 from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
 from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
@@ -583,6 +584,58 @@ def test_staging_discipline_sanctioned_site_suppressed_inline(tmp_path):
         "        return a, b\n"
     )
     findings = run_checkers(str(runtime), [StagingDisciplineChecker()])
+    assert [x.line for x in findings] == [5]
+
+
+# -- NOS016 per-device placement on the tick path ------------------------------
+def test_device_placement_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "device_place_pos.py"),
+        [DevicePlacementChecker()],
+    )
+    assert codes_of(findings) == ["NOS016"]
+    # jax.devices()[0] in _tick, device_put(..., device=) in the
+    # reachable _place, the helper's jax.local_devices()[1] — and NOT
+    # submit()'s index nor the len(jax.devices()) inspection.
+    assert len(findings) == 3
+    msgs = " | ".join(f.message for f in findings)
+    assert "jax.devices()" in msgs
+    assert "device_put" in msgs
+
+
+def test_device_placement_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "device_place_neg.py"),
+        [DevicePlacementChecker()],
+    )
+    assert findings == []
+
+
+def test_device_placement_scope_needs_runtime_dir(tmp_path):
+    # The same engine class OUTSIDE a runtime/ directory is out of scope.
+    f = tmp_path / "engine_like.py"
+    f.write_text(
+        "import jax\n"
+        "class Engine:\n"
+        "    def _tick(self):\n"
+        "        return jax.devices()[0]\n"
+    )
+    assert run_checkers(str(f), [DevicePlacementChecker()]) == []
+
+
+def test_device_placement_sanctioned_site_suppressed_inline(tmp_path):
+    runtime = tmp_path / "runtime"
+    runtime.mkdir()
+    f = runtime / "engine.py"
+    f.write_text(
+        "import jax\n"
+        "class Engine:\n"
+        "    def _tick(self):\n"
+        "        a = jax.devices()[0]  # nos-lint: ignore[NOS016]\n"
+        "        b = jax.devices()[1]\n"
+        "        return a, b\n"
+    )
+    findings = run_checkers(str(f), [DevicePlacementChecker()])
     assert [x.line for x in findings] == [5]
 
 
