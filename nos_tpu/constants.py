@@ -208,10 +208,65 @@ PROBE_KEY_DRAINING = "draining"
 # docs/sharded-decode.md). Router load scoring stays tp-agnostic, but
 # fleet snapshots and capacity accounting want the per-replica width.
 PROBE_KEY_TP_DEVICES = "tp_devices"
+# Slot/pool capacity, for fleet headroom accounting (FleetMonitor): total
+# decode slots and total managed KV blocks alongside the in-use numbers.
+PROBE_KEY_SLOTS_TOTAL = "slots_total"
+PROBE_KEY_KV_BLOCKS_TOTAL = "kv_blocks_total"
 # Router placement policies (PrefixRouter).
 ROUTER_POLICY_PREFIX = "prefix"
 ROUTER_POLICY_ROUND_ROBIN = "round_robin"
 ROUTER_POLICIES = (ROUTER_POLICY_PREFIX, ROUTER_POLICY_ROUND_ROBIN)
+
+# ---------------------------------------------------------------------------
+# Fleet pressure plane (nos_tpu/serving/monitor.py, docs/fleet-monitor.md).
+# The verdict strings below ARE the planner-facing protocol: the future
+# ROADMAP-item-2 autoscale loop, the `/debug/pressure` JSON surface, the
+# metrics journal, and the bench `fleet_pressure` artifact all key off
+# them — a state spelled inline would drift exactly like a mistyped
+# annotation (NOS014 flags these values used as literals in the serving
+# plane outside this file).
+# ---------------------------------------------------------------------------
+# Per-replica pressure verdicts (PressureReport.replicas).
+PRESSURE_REPLICA_HOT = "hot"          # saturated AND work is waiting
+PRESSURE_REPLICA_OK = "ok"            # serving within capacity
+PRESSURE_REPLICA_IDLE = "idle"        # no slots, no queue, no tokens
+PRESSURE_REPLICA_DRAINING = "draining"  # lifecycle: not admitting
+PRESSURE_REPLICA_STATES = (
+    PRESSURE_REPLICA_HOT,
+    PRESSURE_REPLICA_OK,
+    PRESSURE_REPLICA_IDLE,
+    PRESSURE_REPLICA_DRAINING,
+)
+# Per-tenant pressure verdicts (PressureReport.tenants).
+PRESSURE_TENANT_STARVED = "starved"      # under its guarantee with work waiting
+PRESSURE_TENANT_BORROWING = "borrowing"  # running above its guarantee
+PRESSURE_TENANT_WITHIN = "within"        # inside its share (or no quota)
+PRESSURE_TENANT_STATES = (
+    PRESSURE_TENANT_STARVED,
+    PRESSURE_TENANT_BORROWING,
+    PRESSURE_TENANT_WITHIN,
+)
+# Fleet-monitor journal / SLO event names (the same NOS014-guarded
+# vocabulary contract as TRACE_EVENTS/FLIGHT_EVENTS).
+FLEET_EV_WINDOW = "fleet.window"    # one sampling window's journal line
+FLEET_EV_FREEZE = "fleet.freeze"    # journal frozen on an engine recovery
+SLO_EV_BREACH = "slo.breach"        # sustained K-of-N breach began
+SLO_EV_RECOVER = "slo.recover"      # sustained breach cleared
+FLEET_EVENTS = (
+    FLEET_EV_WINDOW,
+    FLEET_EV_FREEZE,
+    SLO_EV_BREACH,
+    SLO_EV_RECOVER,
+)
+# Engine per-tenant probe keys (DecodeServer.tenant_probe() — plain
+# host-side reads the monitor converts into windowed per-tenant rates).
+TENANT_KEY_TOKENS = "tokens"            # cumulative decode tokens produced
+TENANT_KEY_ADMISSIONS = "admissions"    # cumulative slot reservations
+TENANT_KEY_WAITING = "waiting"          # requests queued/waiting right now
+TENANT_KEY_USAGE = "usage"              # QuotaPolicy windowed share (0.0-1.0)
+TENANT_KEY_MIN_SHARE = "min_share"      # guaranteed share (0.0 = best effort)
+TENANT_KEY_QUOTA_STARVED = "quota_starved"      # QuotaPolicy.is_starved
+TENANT_KEY_QUOTA_BORROWER = "quota_borrower"    # QuotaPolicy.is_borrower
 
 # ---------------------------------------------------------------------------
 # Serving-plane tracing wire format (nos_tpu/tracing.py, docs/tracing.md).
@@ -324,6 +379,7 @@ TICK_PHASES = (
 # Debug/observability HTTP surface (observability.ObservabilityServer).
 DEBUG_PATH_EVENTS = "/debug/events"
 DEBUG_PATH_TRACE_PREFIX = "/debug/trace/"
+DEBUG_PATH_PRESSURE = "/debug/pressure"
 # Prometheus text exposition format version (what scrapers negotiate on).
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
 

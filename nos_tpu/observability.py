@@ -229,8 +229,10 @@ class ObservabilityServer:
     """Serves /metrics, /healthz, /readyz (kube-rbac-proxy-less analog),
     plus the serving-plane debug surface (/debug/events — the engine
     flight recorder's ring + postmortem dumps; /debug/trace/<id> — one
-    request's lifecycle span events) when a tracing.FlightRecorder /
-    tracing.Tracer is attached."""
+    request's lifecycle span events; /debug/pressure — the fleet
+    monitor's latest PressureReport, window rows, SLO state and journal
+    bookkeeping) when a tracing.FlightRecorder / tracing.Tracer /
+    serving.FleetMonitor is attached."""
 
     def __init__(
         self,
@@ -241,6 +243,7 @@ class ObservabilityServer:
         metrics_token: Optional[str] = None,
         tracer=None,
         recorder=None,
+        pressure=None,
     ):
         """In-cluster deployments bind host='0.0.0.0' on the configured
         health_probe_port so kubelet httpGet probes can reach the pod IP;
@@ -258,12 +261,21 @@ class ObservabilityServer:
         Tracer/FlightRecorder) arm the /debug endpoints; without them
         the paths answer 404. Payloads are JSON and carry counts/ids
         only — the recorder/tracer never stored request content to
-        begin with (docs/tracing.md privacy contract)."""
+        begin with (docs/tracing.md privacy contract).
+
+        `pressure` (optional, duck-typed to serving.FleetMonitor —
+        anything exposing `pressure_snapshot()`) arms /debug/pressure:
+        the latest PressureReport, per-replica/per-tenant window rows,
+        SLO state, and journal bookkeeping (docs/fleet-monitor.md).
+        Same auth posture as the other debug paths — fleet pressure is
+        capacity-planning intelligence, at least as sensitive as the
+        metrics."""
         self.metrics = metrics_registry
         self.health = health
         self.metrics_token = metrics_token
         self.tracer = tracer
         self.recorder = recorder
+        self.pressure = pressure
         obs = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -324,6 +336,17 @@ class ObservabilityServer:
                         if obs.tracer is not None:
                             payload["traces"] = obs.tracer.trace_ids()
                         body = json.dumps(payload).encode()
+                        ctype = "application/json"
+                        self.send_response(200)
+                elif self.path == constants.DEBUG_PATH_PRESSURE:
+                    if not self._authorized():
+                        self._reply_401()
+                        return
+                    if obs.pressure is None:
+                        body = b"fleet monitor not attached"
+                        self.send_response(404)
+                    else:
+                        body = json.dumps(obs.pressure.pressure_snapshot()).encode()
                         ctype = "application/json"
                         self.send_response(200)
                 elif self.path.startswith(constants.DEBUG_PATH_TRACE_PREFIX):

@@ -729,6 +729,16 @@ class DecodeServer:
         # what the overload bench reads to show a guaranteed tenant's
         # tails holding while a borrower floods the engine.
         self.ttft_s_by_tenant: Dict[str, List[float]] = {}
+        # Per-tenant cumulative host counters (serving/monitor.py probe
+        # surface, keyed like ttft_s_by_tenant): queue-wait samples,
+        # slot reservations, and decode tokens produced. Maintained
+        # unconditionally (quota-independent — `_tick_tokens` only
+        # exists while a QuotaPolicy is armed) from values the dispatch
+        # bookkeeping already computes on the host; the fleet monitor
+        # diffs them into windowed per-tenant rates.
+        self.queue_wait_s_by_tenant: Dict[str, List[float]] = {}
+        self.admissions_by_tenant: Dict[str, int] = {}
+        self.tokens_by_tenant: Dict[str, int] = {}
         # Failure model (docs/robustness.md): recovery counters + the
         # per-restored-request latency samples (fault detection -> the
         # restored slot's replayed final chunk dispatches — the TTFT
@@ -1199,7 +1209,57 @@ class DecodeServer:
             constants.PROBE_KEY_PREFILL_BACKLOG: backlog,
             constants.PROBE_KEY_DRAINING: self._closed.is_set(),
             constants.PROBE_KEY_TP_DEVICES: self.tp,
+            constants.PROBE_KEY_SLOTS_TOTAL: self.n_slots,
+            # total - 1: the scratch block is never allocatable.
+            constants.PROBE_KEY_KV_BLOCKS_TOTAL: self._block_mgr.total_blocks - 1,
         }
+
+    def tenant_probe(self) -> Dict[str, Dict[str, object]]:
+        """Per-tenant host-side probe (serving/monitor.py): cumulative
+        decode tokens and admissions, requests currently waiting, and —
+        when a QuotaPolicy is armed — the policy's OWN windowed share
+        accounting (usage / min / starved / borrower), so a fleet
+        monitor's starvation verdict agrees with quota enforcement by
+        construction (it reads the same accounting admission and
+        preemption act on). Same contract as `probe()`: plain host
+        reads, no locks, no device traffic; a snapshot racing the engine
+        thread shades a pressure signal, never correctness."""
+        waiting: Dict[str, int] = {}
+        for req in (*list(self._waiting), *list(self._queue.queue)):
+            tname = getattr(req, "tenant", None) or ""
+            waiting[tname] = waiting.get(tname, 0) + 1
+        tenants = (
+            set(self.tokens_by_tenant)
+            | set(self.admissions_by_tenant)
+            | set(waiting)
+        )
+        for slot in self._slots:
+            if slot.active:
+                tenants.add(slot.tenant or "")
+        if self._quota is not None:
+            tenants |= set(self._quota.tenants)
+        rows: Dict[str, Dict[str, object]] = {}
+        for tname in tenants:
+            row: Dict[str, object] = {
+                constants.TENANT_KEY_TOKENS: self.tokens_by_tenant.get(tname, 0),
+                constants.TENANT_KEY_ADMISSIONS: self.admissions_by_tenant.get(
+                    tname, 0
+                ),
+                constants.TENANT_KEY_WAITING: waiting.get(tname, 0),
+            }
+            if self._quota is not None:
+                row[constants.TENANT_KEY_USAGE] = self._quota.usage(tname)
+                row[constants.TENANT_KEY_MIN_SHARE] = self._quota.share_of(
+                    tname
+                ).min_share
+                row[constants.TENANT_KEY_QUOTA_STARVED] = self._quota.is_starved(
+                    tname
+                )
+                row[constants.TENANT_KEY_QUOTA_BORROWER] = self._quota.is_borrower(
+                    tname
+                )
+            rows[tname] = row
+        return rows
 
     def prefix_keys(self) -> frozenset:
         """Chain keys resident in this engine's prefix cache (device
@@ -1523,7 +1583,13 @@ class DecodeServer:
                             "nos_tpu_decode_replay_tokens", len(full_prompt)
                         )
                 else:
-                    self.queue_wait_s.append(time.monotonic() - req.t_submit)
+                    wait = time.monotonic() - req.t_submit
+                    tname = req.tenant or ""
+                    self.queue_wait_s.append(wait)
+                    self.queue_wait_s_by_tenant.setdefault(tname, []).append(wait)
+                    self.admissions_by_tenant[tname] = (
+                        self.admissions_by_tenant.get(tname, 0) + 1
+                    )
                 if self._tracer is not None:
                     self._tracer.event(
                         slot.trace_id,
@@ -2074,6 +2140,11 @@ class DecodeServer:
             slot.remaining -= len(accepted)
             slot.lookup.extend(accepted)
             self.spec_tokens_accepted += len(accepted)
+            if accepted:
+                tname = slot.tenant or ""
+                self.tokens_by_tenant[tname] = (
+                    self.tokens_by_tenant.get(tname, 0) + len(accepted)
+                )
             if self._quota is not None and accepted:
                 tenant = slot.tenant or ""
                 self._tick_tokens[tenant] = (
@@ -2772,6 +2843,10 @@ class DecodeServer:
             slot.remaining -= total
             self.macro_tokens_by_slot[idx] += total
             if total:
+                tname = slot.tenant or ""
+                self.tokens_by_tenant[tname] = (
+                    self.tokens_by_tenant.get(tname, 0) + total
+                )
                 # Windows in which this lane made progress.
                 self.macro_dispatches_by_slot[idx] += -(-total // K)
         if self._quota is not None:
@@ -2847,6 +2922,11 @@ class DecodeServer:
             slot.remaining -= executed
             self.macro_tokens_by_slot[idx] += executed
             self.macro_dispatches_by_slot[idx] += 1
+            if executed:
+                tname = slot.tenant or ""
+                self.tokens_by_tenant[tname] = (
+                    self.tokens_by_tenant.get(tname, 0) + executed
+                )
             if self._quota is not None and executed:
                 tenant = slot.tenant or ""
                 self._tick_tokens[tenant] = (

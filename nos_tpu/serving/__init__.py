@@ -5,16 +5,25 @@ a `ReplicaSet` owns N `DecodeServer` replicas (one per planner-carved
 sub-slice in the intended deployment; CPU-backed engines in tests and
 the bench), a `PrefixRouter` places requests cache-aware against a
 router-side shadow of each replica's content-addressed prefix index,
-and `drain_replica`/`migrate_replica` port the planner's
+`drain_replica`/`migrate_replica` port the planner's
 create -> drain -> delete move protocol to live decode streams via the
-checkpoint/spill substrate — admission, routing, and capacity
-replanning as one system.
+checkpoint/spill substrate, and a `FleetMonitor` (docs/fleet-monitor.md)
+watches the whole fleet continuously — windowed rates, per-tenant SLO
+tracking, and the planner-ready `PressureReport` the item-2 autoscale
+loop will consume — admission, routing, capacity replanning, and
+pressure observation as one system.
 """
 
 from nos_tpu.serving.drain import (  # noqa: F401
     DrainReport,
     drain_replica,
     migrate_replica,
+)
+from nos_tpu.serving.monitor import (  # noqa: F401
+    FleetMonitor,
+    PressureReport,
+    SLOTarget,
+    SLOTracker,
 )
 from nos_tpu.serving.replica import ReplicaHandle, ReplicaSet  # noqa: F401
 from nos_tpu.serving.router import PrefixRouter  # noqa: F401

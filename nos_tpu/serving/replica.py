@@ -199,6 +199,21 @@ class ReplicaSet:
         return [h.snapshot() for h in self.handles]
 
     # -- lifecycle ------------------------------------------------------------
+    def retire(self, replica_id: str) -> ReplicaHandle:
+        """Stop one replica and mark it RETIRED (the bare DELETE step —
+        callers that need its in-flight work preserved drain first via
+        serving/drain.py). Retirement is the gauge-hygiene boundary:
+        `fleet_report` stops merging the replica immediately, and a
+        `FleetMonitor` observing the set drops the replica's rate rings
+        and removes its per-replica `nos_tpu_fleet_*` gauge series on
+        its next sample — a retired replica must disappear from
+        /metrics, not freeze at its last value."""
+        handle = self.get(replica_id)
+        if handle.state != constants.REPLICA_STATE_RETIRED:
+            handle.engine.stop()
+            handle.state = constants.REPLICA_STATE_RETIRED
+        return handle
+
     def stop(self, drain: bool = False, drain_timeout_s: Optional[float] = None):
         """Stop every non-retired replica (drain=True: gracefully)."""
         for h in self.handles:

@@ -228,7 +228,13 @@ class ServingReport:
         for i, rep in enumerate(reports):
             for f in fields(ServingReport):
                 cur = getattr(merged, f.name)
-                val = getattr(rep, f.name)
+                # Tolerate reports missing optional fields entirely (an
+                # old-version snapshot rehydrated as a duck-typed object,
+                # or a foreign collector that predates a field): absent
+                # contributes nothing rather than raising mid-merge.
+                val = getattr(rep, f.name, None)
+                if val is None:
+                    continue
                 if f.name.endswith("_samples"):
                     cur.extend(float(v) for v in val)
                 elif f.name in ("macro_tokens_by_slot", "spec_rounds_by_slot"):
@@ -265,6 +271,84 @@ def percentile(samples, q: float) -> float:
         return 0.0
     rank = max(0, min(len(values) - 1, round(q / 100.0 * (len(values) - 1))))
     return values[int(rank)]
+
+
+#: ServingReport integer fields that are POINT-IN-TIME gauges, not
+#: monotonic counters: differencing two snapshots of these is meaningless
+#: (`report_delta` passes the current value through instead). Everything
+#: else integer-typed on the report accumulates monotonically over an
+#: engine's life and differences into per-window work.
+REPORT_GAUGE_FIELDS = frozenset(
+    {
+        "kv_blocks_free",
+        "kv_blocks_cached",
+        "kv_blocks_shared",
+        "kv_blocks_spilled",
+        "spill_host_bytes",
+        "inflight_dispatches",
+        "pending_verifies",
+        "waiting_requests",
+        "tp_devices",
+        "replicas",
+    }
+)
+
+
+def report_counter_fields() -> tuple:
+    """The monotonic integer counter fields of ServingReport, in schema
+    order — the delta/rate surface the fleet monitor windows over."""
+    return tuple(
+        f.name
+        for f in fields(ServingReport)
+        if f.type == "int" and f.name not in REPORT_GAUGE_FIELDS
+    )
+
+
+def report_delta(cur: ServingReport, prev: Optional[ServingReport]) -> Dict[str, int]:
+    """Per-window work between two cumulative snapshots of ONE engine:
+    every monotonic counter differenced (clamped at 0 — an engine restart
+    resets its counters, and a negative 'rate' would poison a planner),
+    gauges passed through at their current value, and the decode-token
+    production derived from the per-slot map sums as `tokens` (macro +
+    fused-burst executed tokens) plus `spec_tokens_accepted` — together
+    the window's generated-token count, the tok/s numerator. `prev=None`
+    (the first sample) yields zero deltas with current gauges."""
+    out: Dict[str, int] = {}
+    for name in report_counter_fields():
+        if prev is None:
+            # First sample: no baseline, so no work attributable to a
+            # window yet — the engine's whole life is not "this window".
+            out[name] = 0
+        else:
+            out[name] = max(
+                0, int(getattr(cur, name)) - int(getattr(prev, name, 0))
+            )
+    if prev is None:
+        out["tokens"] = 0
+    else:
+        macro_cur = sum(cur.macro_tokens_by_slot.values())
+        macro_prev = sum(prev.macro_tokens_by_slot.values())
+        out["tokens"] = max(0, macro_cur - macro_prev) + out["spec_tokens_accepted"]
+    for name in REPORT_GAUGE_FIELDS:
+        out[name] = int(getattr(cur, name))
+    return out
+
+
+def report_rates(
+    cur: ServingReport, prev: Optional[ServingReport], dt_s: float
+) -> Dict[str, float]:
+    """`report_delta` divided through by the window length: per-second
+    rates for every counter (gauges still passed through undivided).
+    Zero-length windows (first sample, clock stall) report zero rates —
+    never a division blowup."""
+    delta = report_delta(cur, prev)
+    rates: Dict[str, float] = {}
+    for name, val in delta.items():
+        if name in REPORT_GAUGE_FIELDS:
+            rates[name] = float(val)
+        else:
+            rates[name] = float(val) / dt_s if dt_s > 0.0 else 0.0
+    return rates
 
 
 def collect_serving(server) -> ServingReport:

@@ -1,0 +1,32 @@
+"""NOS014 negative fixture — the same pressure/SLO vocabulary used
+correctly in a serving-plane file: every name derived from
+nos_tpu.constants, states compared via the constants, and the taxonomy
+quoted only in prose (a verdict may be "hot" or "starved" — docstrings
+are exempt)."""
+
+from nos_tpu import constants
+
+
+def journal_window(journal, verdicts):
+    journal.append(
+        {"event": constants.FLEET_EV_WINDOW, "verdicts": verdicts}
+    )
+
+
+def breach(events, tenant):
+    events.append({"event": constants.SLO_EV_BREACH, "tenant": tenant})
+
+
+def classify(queue_depth, slots_active, slots_total):
+    if queue_depth > 0 and slots_active >= slots_total:
+        return constants.PRESSURE_REPLICA_HOT
+    return constants.PRESSURE_REPLICA_OK
+
+
+def is_starving(verdict):
+    return verdict == constants.PRESSURE_TENANT_STARVED
+
+
+def states():
+    # Reads of the vocabulary tuples are fine everywhere.
+    return tuple(constants.PRESSURE_REPLICA_STATES)

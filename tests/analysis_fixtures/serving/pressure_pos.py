@@ -1,0 +1,26 @@
+"""NOS014 positive fixture — pressure/SLO vocabulary drift in a
+serving-plane file (the `serving/` directory segment puts this file in
+the state-literal scope). Quoting "hot" or "fleet.window" here in the
+docstring is fine; the code below is not."""
+
+
+def journal_window(journal, verdicts):
+    # Inline fleet-journal event name: flagged (event vocabulary).
+    journal.append({"event": "fleet.window", "verdicts": verdicts})
+
+
+def breach(events, tenant):
+    # Inline SLO event name: flagged (event vocabulary).
+    events.append({"event": "slo.breach", "tenant": tenant})
+
+
+def classify(queue_depth, slots_active, slots_total):
+    if queue_depth > 0 and slots_active >= slots_total:
+        # Inline replica pressure state: flagged (state vocabulary).
+        return "hot"
+    return None
+
+
+def is_starving(verdict):
+    # Inline tenant pressure state: flagged (state vocabulary).
+    return verdict == "starved"

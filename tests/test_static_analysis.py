@@ -447,6 +447,67 @@ def test_trace_discipline_real_surface_is_clean():
         os.path.join("runtime", "block_manager.py"),
         os.path.join("serving", "router.py"),
         os.path.join("serving", "drain.py"),
+        os.path.join("serving", "monitor.py"),
+    ):
+        findings = run_checkers(
+            os.path.join(TREE, rel), [TraceDisciplineChecker()]
+        )
+        assert findings == [], rel
+
+
+# -- NOS014 pressure/SLO vocabulary (fleet pressure plane) ---------------------
+def test_pressure_vocabulary_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "pressure_pos.py"),
+        [TraceDisciplineChecker()],
+    )
+    assert codes_of(findings) == ["NOS014"]
+    # Inline fleet-journal event, inline SLO event, inline replica
+    # verdict, inline tenant verdict — NOT the docstring's quoted
+    # taxonomy.
+    assert len(findings) == 4
+    msgs = " | ".join(f.message for f in findings)
+    assert "fleet.window" in msgs
+    assert "slo.breach" in msgs
+    assert "hot" in msgs
+    assert "starved" in msgs
+
+
+def test_pressure_vocabulary_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "pressure_neg.py"),
+        [TraceDisciplineChecker()],
+    )
+    assert findings == []
+
+
+def test_pressure_state_literals_scoped_to_serving_plane(tmp_path):
+    # The verdict strings are ordinary English words with legitimate
+    # unrelated uses ("ok" leader-election statuses, the slot phase
+    # machine's "idle"), so the state vocabulary only binds inside the
+    # serving plane — the SAME words outside it stay legal. The EVENT
+    # names (distinctive dotted strings) bind everywhere.
+    f = tmp_path / "leaderish.py"
+    f.write_text(
+        'def renew(status):\n'
+        '    if status == "ok":\n'
+        '        return "idle"\n'
+        '    return "hot"\n'
+    )
+    assert run_checkers(str(f), [TraceDisciplineChecker()]) == []
+    g = tmp_path / "journal.py"
+    g.write_text('EV = "fleet.freeze"\n')
+    findings = run_checkers(str(g), [TraceDisciplineChecker()])
+    assert codes_of(findings) == ["NOS014"]
+
+
+def test_pressure_vocabulary_real_surface_is_clean():
+    # telemetry.py and the serving monitor sit inside the state scope
+    # and must derive every verdict/event from constants.
+    for rel in (
+        "telemetry.py",
+        os.path.join("serving", "monitor.py"),
+        os.path.join("serving", "replica.py"),
     ):
         findings = run_checkers(
             os.path.join(TREE, rel), [TraceDisciplineChecker()]
