@@ -58,9 +58,13 @@ bench:
 # counter-corroborated + off-arm noise floor; NOS_TPU_TRACE_OVERHEAD_PCT),
 # dispatch_floor (bursts must drop dispatches/token and host
 # overhead/token), sharded_decode (bit-identical across tp, host-sync
-# budget flat with the mesh), and fleet_pressure (bit-identical monitor
+# budget flat with the mesh), fleet_pressure (bit-identical monitor
 # on/off, injected hot/starved transitions detected within one sampling
-# window, journal bounded + replayable, NOS_TPU_MONITOR_OVERHEAD_PCT).
+# window, journal bounded + replayable, NOS_TPU_MONITOR_OVERHEAD_PCT),
+# and multi_turn_chat (docs/radix-cache.md: cold/chain/tree arms
+# bit-identical greedy AND temperature, tree cached tokens >= 2x chain,
+# COW + output registration engaged, charged prefill down,
+# NOS_TPU_RADIX_TTFT_TOLERANCE_PCT backstop on turn-2+ TTFT).
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) hack/bench_smoke.py
 

@@ -692,6 +692,166 @@ def _sharded_decode(
     }
 
 
+def _multi_turn_chat(
+    np,
+    cfg,
+    params,
+    n_convs: int = 4,
+    turns: int = 3,
+    sys_tokens: int = 4,
+    greet_shared: int = 2,
+    greet_tokens: int = 4,
+    user_tokens: int = 4,
+    gen_tokens: int = 48,
+    block_size: int = 4,
+    max_len: int = 192,
+    temperatures=(0.0, 0.8),
+) -> dict:
+    """Multi-turn chat A/B (ISSUE 13, docs/radix-cache.md): the
+    production fan-out shape the radix tree exists for — zipf-skewed
+    tenants sharing system prompts, conversations diverging MID-BLOCK
+    right after the shared prefix (distinct greetings with a common
+    head), and every follow-up turn re-submitting its whole grown
+    history plus a fresh user message.
+
+    Three arms on IDENTICAL traffic (same seeds, same submission order,
+    so admission serials — and temperature PRNG streams — align by
+    construction): `cold` (prefix_cache off), `chain` (the PR 5 flat
+    chain index), `tree` (the radix cache). Run at every temperature in
+    `temperatures`; outputs must be bit-identical across the three arms
+    at each one — the exactness half of the gate. The performance half
+    is counter-based and noise-free: the tree arm's cached tokens
+    (full-block hits + COW-copied tokens) must MULTIPLY the chain
+    arm's, and its charged prefill tokens drop with them (the flat
+    chain re-prefills every turn's generated history forever; the tree
+    walks it). Turn-2+ TTFT tails ride along as wall-clock evidence —
+    the smoke gates them with a wide regression-backstop tolerance
+    (structural ms-scale deltas on a tiny CPU model sit near scheduler
+    noise; the counter gates carry the real protection). Histories may
+    outgrow `cfg.max_seq` (params are max_seq-independent; RoPE is
+    positional), so the engines run a widened config copy.
+
+    Conversation 0 is the turn-1 POPULATOR (it finishes before the rest
+    arrive — the deployed-system-prompt-is-warm shape every cache
+    scenario here uses), so the followers' greetings actually find the
+    shared head; later turns interleave WITHIN each turn (all of a
+    turn's re-admissions submitted together), so the tree serves
+    concurrent grown histories, not one pampered stream. The assistant
+    generates far more than the user types (`gen_tokens` >>
+    `user_tokens`, the real chat shape) — which is exactly the content
+    the flat chain re-prefills every turn and the tree does not."""
+    import dataclasses
+
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.telemetry import percentile
+
+    if cfg.max_seq < max_len:
+        cfg = dataclasses.replace(cfg, max_seq=max_len)
+    srng = np.random.default_rng([2026, 13, n_convs, turns])
+    # Zipf-skewed tenants: tenant 0 owns ~3/4 of the conversations.
+    sys_prompts = [
+        srng.integers(1, cfg.vocab, sys_tokens).tolist() for _ in range(2)
+    ]
+    conv_tenant = [0 if i < max(1, (3 * n_convs) // 4) else 1 for i in range(n_convs)]
+    greet_head = srng.integers(1, cfg.vocab, greet_shared).tolist()
+    histories0 = [
+        # Shared head + distinct tail INSIDE one block: the mid-block
+        # divergence every conversation pays (COW serves the head).
+        sys_prompts[conv_tenant[i]]
+        + greet_head
+        + srng.integers(1, cfg.vocab, max(0, greet_tokens - greet_shared)).tolist()
+        for i in range(n_convs)
+    ]
+    user_msgs = [
+        [srng.integers(1, cfg.vocab, user_tokens).tolist() for _ in range(n_convs)]
+        for _ in range(turns - 1)
+    ]
+
+    def run_arm(prefix_cache, radix_cache, temperature):
+        server = DecodeServer(
+            params,
+            cfg,
+            n_slots=n_convs,
+            max_len=max_len,
+            prompt_buckets=(8, 16),
+            steps_per_dispatch=4,
+            block_size=block_size,
+            seed=11,
+            temperature=temperature,
+            prefix_cache=prefix_cache,
+            radix_cache=radix_cache,
+        ).prewarm()
+        server.start()
+        histories = [list(h) for h in histories0]
+        outputs = []
+        ttft_turn1_end = 0
+        try:
+            for t in range(turns):
+                order = list(range(n_convs))
+                outs = [None] * n_convs
+                if t == 0:
+                    # Turn-1 populator: conv 0 completes before the
+                    # fan-out arrives (its warm prefix is what the
+                    # followers' greetings diverge from, mid-block).
+                    outs[0] = server.generate(
+                        histories[0], max_new=gen_tokens, timeout=600
+                    )
+                    order = order[1:]
+                futs = {
+                    i: server.submit(histories[i], max_new=gen_tokens)
+                    for i in order
+                }
+                for i, fut in futs.items():
+                    outs[i] = fut.result(timeout=600)
+                outputs.append(outs)
+                if t == 0:
+                    ttft_turn1_end = len(server.ttft_s)
+                if t + 1 < turns:
+                    for i in range(n_convs):
+                        histories[i] = histories[i] + outs[i] + user_msgs[t][i]
+            later_ttft = server.ttft_s[ttft_turn1_end:]
+            stats = {
+                "cached_tokens": server.prefix_hit_tokens + server.prefix_cow_tokens,
+                "hit_tokens": server.prefix_hit_tokens,
+                "cow_hits": server.prefix_cow_hits,
+                "cow_tokens": server.prefix_cow_tokens,
+                "output_blocks_registered": server.output_blocks_registered,
+                "prefill_tokens": server.prefill_tokens,
+                "radix_nodes": server.radix_nodes,
+                "ttft_p50_turn2_s": round(percentile(later_ttft, 50), 4),
+                "ttft_p95_turn2_s": round(percentile(later_ttft, 95), 4),
+            }
+        finally:
+            server.stop()
+        return outputs, stats
+
+    arms = {}
+    out = {
+        "n_convs": n_convs,
+        "turns": turns,
+        "tenants": 2,
+        "gen_tokens": gen_tokens,
+        "arms": arms,
+    }
+    for temperature in temperatures:
+        tkey = "greedy" if temperature == 0.0 else f"temp_{temperature}"
+        cold_out, cold = run_arm(False, False, temperature)
+        chain_out, chain = run_arm(True, False, temperature)
+        tree_out, tree = run_arm(True, True, temperature)
+        arms[tkey] = {
+            "outputs_identical": cold_out == chain_out == tree_out,
+            "cold": cold,
+            "chain": chain,
+            "tree": tree,
+            "cached_token_ratio_tree_vs_chain": (
+                round(tree["cached_tokens"] / chain["cached_tokens"], 2)
+                if chain["cached_tokens"]
+                else float(tree["cached_tokens"])
+            ),
+        }
+    return out
+
+
 def _fleet_pressure(
     np,
     cfg,
@@ -1612,6 +1772,20 @@ def _decode_phase(jax, jnp) -> dict:
     # the input half of ROADMAP item 2's future autoscale A/B.
     out["fleet_pressure"] = _retry(
         "decode:fleet_pressure", lambda: _fleet_pressure(np, cfg, params)
+    )
+
+    # Multi-turn chat A/B (ISSUE 13, docs/radix-cache.md): zipf tenants
+    # x growing histories x mid-block divergence, cold vs flat-chain vs
+    # radix-tree prefix cache — outputs bit-identical across all three
+    # arms (greedy and temperature), tree-arm cached tokens multiplying
+    # the chain arm's, turn-2+ TTFT tails riding along.
+    out["multi_turn_chat"] = _retry(
+        "decode:multi_turn_chat",
+        lambda: _multi_turn_chat(
+            np, cfg, params,
+            sys_tokens=64, greet_shared=16, greet_tokens=64,
+            user_tokens=32, gen_tokens=256, block_size=32, max_len=2048,
+        ),
     )
     return out
 

@@ -16,6 +16,7 @@ def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+    from nos_tpu.analysis.checkers.radix_discipline import RadixDisciplineChecker
     from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
     from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
     from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
@@ -32,6 +33,7 @@ def all_checkers() -> List[Checker]:
         BlockDisciplineChecker(),
         FaultDisciplineChecker(),
         SpillDisciplineChecker(),
+        RadixDisciplineChecker(),
         StagingDisciplineChecker(),
         DevicePlacementChecker(),
         TraceDisciplineChecker(),

@@ -297,6 +297,9 @@ TRACE_EV_SPILL = "req.spill"
 TRACE_EV_REVIVE = "req.revive"
 TRACE_EV_RESTORE = "req.restore"
 TRACE_EV_DRAIN_MIGRATE = "req.drain_migrate"
+# Radix COW (PR 13): a diverging block's shared head copied into the
+# request's private page instead of recomputed.
+TRACE_EV_COW = "req.cow"
 TRACE_EVENTS = (
     TRACE_EV_ROUTER_SELECT,
     TRACE_EV_SUBMIT,
@@ -310,6 +313,7 @@ TRACE_EVENTS = (
     TRACE_EV_REVIVE,
     TRACE_EV_RESTORE,
     TRACE_EV_DRAIN_MIGRATE,
+    TRACE_EV_COW,
 )
 
 # Engine flight-recorder event names (bounded per-engine ring buffer;
@@ -329,6 +333,7 @@ FLIGHT_EV_PREEMPT = "engine.preempt"
 FLIGHT_EV_SPILL = "engine.spill"
 FLIGHT_EV_EVICT = "engine.evict"
 FLIGHT_EV_REVIVE = "engine.revive"
+FLIGHT_EV_COW = "engine.cow"
 FLIGHT_EVENTS = (
     FLIGHT_EV_ADMIT,
     FLIGHT_EV_BURST,
@@ -344,6 +349,7 @@ FLIGHT_EVENTS = (
     FLIGHT_EV_SPILL,
     FLIGHT_EV_EVICT,
     FLIGHT_EV_REVIVE,
+    FLIGHT_EV_COW,
 )
 
 # Tick-phase profiler phase names (tracing.TickProfiler): label values of

@@ -46,6 +46,28 @@ refcount-0 blocks straight to host, freeing HBM immediately. Host
 payloads are device-independent: `reset()` rebuilds the device pool but
 leaves the tier intact, so post-recovery replays still hit.
 
+RADIX-TREE GENERALIZATION (PR 13, docs/radix-cache.md): with
+`radix=True` the flat chain-key index becomes the residency layer UNDER
+a radix tree over token-block edges (runtime/radix_tree.py — same
+chain_key space, so router keys, flat keys, and tree keys agree by
+construction). The tree buys three reuse shapes the flat walk cannot
+see: (a) PARTIAL-BLOCK SHARING — a prompt diverging mid-block takes the
+deepest resident node's child sharing the longest token prefix and
+stages a COPY-ON-WRITE: the shared block's head is copied into the
+requester's private page (charged to its prefill budget, staged via
+`claim_cow`, source pinned with a refcount until `cow_done`), shared
+nodes stay immutable; (b) MULTI-TURN RE-ADMISSION — `register_output`
+keys the full blocks a finished request's generated tokens produced
+(decode-derived KV is bit-identical to the prefill replay of the same
+tokens — the PR 6/7 replay-exactness property), so a follow-up turn
+re-submitting `history + new tokens` walks the tree to the end of the
+history instead of re-prefilling turn N-1's output; (c) SUBTREE-LRU
+EVICTION — `_alloc_one` evicts the oldest refcount-0 block whose node
+has no device-resident child (leaves before trunks), and the PR 7
+spill tier is the tree's cold storage (the hit walk continues into
+host node by node and stages revives as before). `radix=False` keeps
+the PR 5 flat-chain behavior bit-for-bit — the A/B baseline.
+
 DEVICE-COUNT-AGNOSTIC by contract (PR 11, docs/sharded-decode.md):
 everything here is bookkeeping over LOGICAL block ids. Under
 tensor-parallel serving the pool's device arrays are partitioned on the
@@ -65,36 +87,22 @@ the same discipline under NOS013 (mutations only inside SpillTier).
 
 from __future__ import annotations
 
-import hashlib
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from nos_tpu import constants
+
+# The key scheme and the cap helper live with the tree (the walk needs
+# both); re-exported here because this module is their historical home —
+# the router and the tests import them from either, and both resolve to
+# ONE implementation.
+from nos_tpu.runtime.radix_tree import (  # noqa: F401  (re-exports)
+    RadixTree,
+    cacheable_block_cap,
+    chain_key,
+    prompt_chain_keys,
+)
 from nos_tpu.runtime.spill import SpillTier
-
-
-def chain_key(parent: str, tokens: Sequence[int]) -> str:
-    """Content key of one full block: sha256 chained over (parent key,
-    the block's token ids). The chain makes a key a commitment to the
-    whole prefix ending at this block — equal keys mean equal token
-    prefixes (sha256 collisions are the only exception, which is the
-    standard bet prefix caches make; an exact-compare radix tree is the
-    alternative if it ever stops being acceptable)."""
-    payload = parent + ":" + ",".join(str(int(t)) for t in tokens)
-    return hashlib.sha256(payload.encode()).hexdigest()
-
-
-def prompt_chain_keys(prompt: Sequence[int], block_size: int) -> List[str]:
-    """Chain keys for every block FULLY covered by `prompt`, in prefix
-    order. Module-level so the cluster router (nos_tpu/serving/router.py)
-    computes the SAME keys engines index under — router keys and engine
-    keys agree by construction, never by convention."""
-    keys: List[str] = []
-    parent = ""
-    for b in range(len(prompt) // block_size):
-        parent = chain_key(parent, prompt[b * block_size : (b + 1) * block_size])
-        keys.append(parent)
-    return keys
 
 
 class BlockManager:
@@ -104,7 +112,12 @@ class BlockManager:
     never managed here."""
 
     def __init__(
-        self, total_blocks: int, block_size: int, n_slots: int, fault_injector=None
+        self,
+        total_blocks: int,
+        block_size: int,
+        n_slots: int,
+        fault_injector=None,
+        radix: bool = False,
     ):
         if total_blocks < 2:
             raise ValueError("total_blocks must be >= 2 (scratch + 1)")
@@ -132,6 +145,25 @@ class BlockManager:
         # full-block keys, and how many of them are already indexed.
         self._slot_keys: List[List[str]] = [[] for _ in range(self.n_slots)]
         self._slot_indexed: List[int] = [0] * self.n_slots
+        # Radix mode (PR 13, runtime/radix_tree.py): the structural tree
+        # over the same chain-key space, the prompt's block token tuples
+        # per slot (node edges need content, not just hashes), whether
+        # the slot's admission used the cache (gates output
+        # registration), the staged copy-on-write match per slot —
+        # (token offset, dst block, src block or None, src chain key,
+        # copy length), claimed one-shot by the engine — and the pinned
+        # COW source block per slot (an extra refcount not backed by a
+        # page table, held until `cow_done`/release so eviction cannot
+        # reuse the source before the copy dispatches).
+        self._tree: Optional[RadixTree] = RadixTree() if radix else None
+        self._slot_blocks_tokens: List[List[Tuple[int, ...]]] = [
+            [] for _ in range(self.n_slots)
+        ]
+        self._slot_use_cache: List[bool] = [False] * self.n_slots
+        self._slot_cow: List[Optional[Tuple[int, int, Optional[int], str, int]]] = [
+            None
+        ] * self.n_slots
+        self._cow_pins: List[Optional[int]] = [None] * self.n_slots
         # Host spill tier (optional, runtime/spill.py): `_spilled` holds
         # device blocks whose contents live on host — allocatable like
         # free, preferred after it (reusing one destroys nothing the
@@ -149,6 +181,12 @@ class BlockManager:
         self.hit_tokens = 0
         self.evictions = 0
         self.spill_hit_blocks = 0
+        # Radix-tree counters: staged copy-on-write matches, the tokens
+        # they copied instead of recomputing, and the generated-token
+        # blocks keyed at request completion (the multi-turn enabler).
+        self.cow_hits = 0
+        self.cow_hit_tokens = 0
+        self.output_blocks = 0
         # Optional flight recorder (nos_tpu/tracing.py): pool-pressure
         # events (spill/evict) recorded through its API — block ids and
         # counts only, never chain keys or content.
@@ -249,7 +287,12 @@ class BlockManager:
         replica's cache must not change which block the next allocation
         evicts, or the probe itself would perturb the very recency order
         it reports on (pinned by the LRU-no-touch property test)."""
-        cap = max(0, (len(prompt) - 1) // self.block_size)
+        if self._tree is not None:
+            dev_keys, host_keys, _ = self._tree.match(
+                prompt, self.block_size, self._on_device, self._on_host
+            )
+            return len(dev_keys), len(host_keys)
+        cap = cacheable_block_cap(len(prompt), self.block_size)
         keys = prompt_chain_keys(prompt, self.block_size)[:cap]
         dev = 0
         for key in keys:
@@ -265,6 +308,22 @@ class BlockManager:
                     break
                 host += 1
         return dev, host
+
+    def _on_device(self, key: str) -> bool:
+        return key in self._prefix_index
+
+    def _on_host(self, key: str) -> bool:
+        # SpillTier.__contains__ is a plain membership test — residency
+        # probes never reorder the tier's LRU.
+        return self._spill is not None and key in self._spill
+
+    def _resident(self, key: str) -> bool:
+        """Either tier holds the node's data — the tree's prune guard."""
+        return self._on_device(key) or self._on_host(key)
+
+    def radix_nodes(self) -> int:
+        """Tree size (0 in flat-chain mode) — a telemetry gauge."""
+        return 0 if self._tree is None else len(self._tree)
 
     def index_keys(self) -> frozenset:
         """Snapshot of every chain key currently resident — device index
@@ -308,7 +367,13 @@ class BlockManager:
         device run into the host tier (same cap): host-resident keys
         become fresh private blocks staged as pending revives
         (`claim_revives`) — the engine copies their contents in, charged
-        against the prefill budget, instead of recomputing them."""
+        against the prefill budget, instead of recomputing them.
+
+        In radix mode the walk is the TREE's (radix_tree.py `match`):
+        device run, host continuation, then at most one copy-on-write
+        match at the divergence block — staged via `claim_cow`, its
+        device source pinned with a refcount until `cow_done`, its
+        copied tokens charged like the revives they resemble."""
         if self._slot_blocks[idx]:
             raise RuntimeError(f"slot {idx} already holds blocks")
         if self._faults is not None:
@@ -316,36 +381,56 @@ class BlockManager:
         keys = self.prompt_keys(prompt) if use_cache else []
         hits: List[int] = []
         spill_keys: List[str] = []
+        cow = None  # (src_key, copy_len, src_on_device) from the tree walk
         if use_cache:
             self.lookups += 1
-            cap = (len(prompt) - 1) // self.block_size
-            for key in keys[:cap]:
-                block = self._prefix_index.get(key)
-                if block is None:
-                    break
-                hits.append(block)
-            if self._spill is not None:
-                # Contiguous extension of the hit run on the host tier.
-                for key in keys[len(hits) : cap]:
-                    if key not in self._spill:
+            if self._tree is not None:
+                dev_keys, spill_keys, cow = self._tree.match(
+                    prompt, self.block_size, self._on_device, self._on_host
+                )
+                hits = [self._prefix_index[key] for key in dev_keys]
+            else:
+                cap = cacheable_block_cap(len(prompt), self.block_size)
+                for key in keys[:cap]:
+                    block = self._prefix_index.get(key)
+                    if block is None:
                         break
-                    spill_keys.append(key)
+                    hits.append(block)
+                if self._spill is not None:
+                    # Contiguous extension of the hit run on the host tier.
+                    for key in keys[len(hits) : cap]:
+                        if key not in self._spill:
+                            break
+                        spill_keys.append(key)
         # Take the hits: refcount bumps; a resting block leaves the LRU.
         for block in hits:
             if self._refcount[block] == 0:
                 self._cached_free.pop(block)
             self._refcount[block] += 1
+        # Pin a device-resident COW source the same way: the copy
+        # dispatches ticks later, and an unpinned source could be
+        # evicted (and its device block REUSED) in between.
+        pin: Optional[int] = None
+        if cow is not None and cow[2]:
+            pin = self._prefix_index[cow[0]]
+            if self._refcount[pin] == 0:
+                self._cached_free.pop(pin)
+            self._refcount[pin] += 1
 
         def _rollback(fresh: List[int]) -> None:
             # Return every block already taken — fresh allocations back
             # to the plain free list (a spill-evicted one's content is
             # already host-resident, nothing is lost), hit bumps dropped,
             # resting blocks restored to the cached LRU (MRU end: they
-            # were just touched) — so repeated rejected admissions cannot
-            # leak pool capacity.
+            # were just touched), the COW pin released — so repeated
+            # rejected admissions cannot leak pool capacity.
             for block in fresh:
                 self._refcount[block] -= 1
                 self._free_blocks.append(block)
+            if pin is not None:
+                self._refcount[pin] -= 1
+                if self._refcount[pin] == 0:
+                    self._cached_free[pin] = self._block_key[pin]
             for block in reversed(hits):
                 self._refcount[block] -= 1
                 if self._refcount[block] == 0:
@@ -375,16 +460,70 @@ class BlockManager:
         self._slot_blocks[idx] = blocks
         self._slot_keys[idx] = keys
         self._slot_indexed[idx] = len(hits)
+        self._slot_use_cache[idx] = bool(use_cache)
         # Stage the host hits: blocks[len(hits) : len(hits)+len(spill_keys)]
         # are the revive targets, in prefix order.
         self._slot_revives[idx] = [
             ((len(hits) + j) * self.block_size, blocks[len(hits) + j], key)
             for j, key in enumerate(spill_keys)
         ]
+        if self._tree is not None:
+            # Node edges need token content, not just hashes: remember
+            # the prompt's full-block tuples for registration.
+            self._slot_blocks_tokens[idx] = [
+                tuple(prompt[b * self.block_size : (b + 1) * self.block_size])
+                for b in range(len(keys))
+            ]
+            for key in self._slot_keys[idx][: len(hits)]:
+                self._tree.ref(key)
+            covered = len(hits) + len(spill_keys)
+            if cow is not None:
+                # The COW lands in the first block AFTER the covered
+                # run — a fresh private page by construction.
+                self._slot_cow[idx] = (
+                    covered * self.block_size,
+                    blocks[covered],
+                    pin,
+                    cow[0],
+                    cow[1],
+                )
+                self._cow_pins[idx] = pin
+                self.cow_hits += 1
+                self.cow_hit_tokens += cow[1]
         self.hit_blocks += len(hits)
         self.hit_tokens += len(hits) * self.block_size
         self.spill_hit_blocks += len(spill_keys)
         return blocks, len(hits)
+
+    def claim_cow(
+        self, idx: int
+    ) -> Optional[Tuple[int, int, Optional[int], str, int]]:
+        """Hand the engine slot `idx`'s staged copy-on-write match,
+        one-shot: (token offset, destination block, pinned source block
+        or None when the source is host-resident, source chain key,
+        tokens to copy). The engine performs the copy (budget-charged,
+        like a revive) and calls `cow_done` — or lets release() drop
+        the pin if the slot dies first."""
+        cow = self._slot_cow[idx]
+        self._slot_cow[idx] = None
+        return cow
+
+    def cow_done(self, idx: int, spill: bool = False) -> None:
+        """The engine finished (or abandoned) slot `idx`'s COW copy:
+        release the pinned source block. Idempotent; host-sourced COWs
+        have no pin and this is a no-op for them."""
+        pin = self._cow_pins[idx]
+        self._cow_pins[idx] = None
+        if pin is None:
+            return
+        self._refcount[pin] -= 1
+        if self._refcount[pin] == 0:
+            key = self._block_key[pin]
+            if spill and self._spill is not None:
+                self._spill_out(pin, key)
+                self._spilled.append(pin)
+            else:
+                self._cached_free[pin] = key
 
     def claim_revives(self, idx: int) -> List[Tuple[int, int, str]]:
         """Hand the engine slot `idx`'s staged host hits, one-shot:
@@ -410,6 +549,17 @@ class BlockManager:
         if self._spilled:
             return self._spilled.pop()
         block = next(iter(self._cached_free))
+        if self._tree is not None:
+            # Subtree-LRU: the oldest resting block whose node has no
+            # device-resident child — leaves evict before trunks, so a
+            # hot path's device run is never holed by its own LRU (and
+            # the walk's device-then-host shape stays prefix-closed).
+            # Falls back to the plain oldest when every candidate is an
+            # interior node (possible under COW pins).
+            for cand, cand_key in self._cached_free.items():
+                if not self._tree.has_resident_child(cand_key, self._on_device):
+                    block = cand
+                    break
         key = self._cached_free[block]
         if self._spill is not None:
             if self._faults is not None:
@@ -422,6 +572,11 @@ class BlockManager:
             self._cached_free.pop(block)
             del self._prefix_index[key]
             del self._block_key[block]
+            if self._tree is not None:
+                # Tier-less eviction destroys the node's only copy:
+                # prune it (or leave a tombstone for resident
+                # descendants — it ends hit runs, like a missing key).
+                self._tree.note_nonresident(key, self._resident)
         self.evictions += 1
         if self._recorder is not None:
             self._recorder.record(constants.FLIGHT_EV_EVICT, block=block)
@@ -442,7 +597,48 @@ class BlockManager:
             if keys[b] not in self._prefix_index and block not in self._block_key:
                 self._prefix_index[keys[b]] = block
                 self._block_key[block] = keys[b]
+                if self._tree is not None:
+                    # Find-or-create the node chain (an ancestor pruned
+                    # by a tier-less eviction re-creates as a tombstone)
+                    # and count this slot's table mapping on the node.
+                    self._tree.ensure_path(
+                        self._slot_blocks_tokens[idx][: b + 1], keys[: b + 1]
+                    )
+                    self._tree.ref(keys[b])
         self._slot_indexed[idx] = max(self._slot_indexed[idx], done)
+
+    def register_output(self, idx: int, seq: Sequence[int]) -> None:
+        """Multi-turn re-admission's enabler (radix mode only): key the
+        full blocks slot `idx`'s GENERATED tokens completed. `seq` is
+        the request's whole token sequence — original prompt + replay +
+        generated output, exactly what a follow-up turn re-submits as
+        its history. Every block fully covered by `seq[:-1]` (the last
+        token's KV is never written — it was sampled, not re-attended)
+        holds KV bit-identical to what a monolithic prefill of `seq`
+        would write (the PR 6/7 replay-exactness property: restored
+        slots replay generated tokens through prefill and continue
+        bit-identically, greedy AND temperature), so a later walk may
+        serve them like any prompt block. Called at request completion,
+        BEFORE the slot releases; blocks another slot already indexed
+        stay private, like the note_progress race."""
+        if self._tree is None or not self._slot_use_cache[idx]:
+            return
+        bs = self.block_size
+        n_full = max(0, (len(seq) - 1) // bs)
+        existing = len(self._slot_keys[idx])
+        if n_full <= existing or n_full > len(self._slot_blocks[idx]):
+            return
+        keys = prompt_chain_keys(seq, bs)[:n_full]
+        blocks_tokens = [tuple(seq[b * bs : (b + 1) * bs]) for b in range(n_full)]
+        for b in range(existing, n_full):
+            block = self._slot_blocks[idx][b]
+            if keys[b] in self._prefix_index or block in self._block_key:
+                continue
+            self._prefix_index[keys[b]] = block
+            self._block_key[block] = keys[b]
+            self._tree.ensure_path(blocks_tokens[: b + 1], keys[: b + 1])
+            self._tree.ref(keys[b])
+            self.output_blocks += 1
 
     # -- release / reset -----------------------------------------------------
     def release(self, idx: int, spill: bool = False) -> None:
@@ -463,10 +659,18 @@ class BlockManager:
             # slot's references fully intact (the caller re-raises into
             # the engine's fault classification).
             self._faults.check("spill", slot=idx)
+        # An unconsumed COW pin dies with the slot (the copy never
+        # dispatched; the source just returns to rest/host).
+        self.cow_done(idx, spill=spill)
         for block in self._slot_blocks[idx]:
             self._refcount[block] -= 1
+            key = self._block_key.get(block)
+            if key is not None and self._tree is not None:
+                # This table's mapping of the node's indexed block ends
+                # (private duplicates have no key and were never
+                # counted). Residency keeps the node from pruning.
+                self._tree.unref(key, self._resident)
             if self._refcount[block] == 0:
-                key = self._block_key.get(block)
                 if key is None:
                     self._free_blocks.append(block)
                 elif spill:
@@ -478,6 +682,14 @@ class BlockManager:
         self._slot_keys[idx] = []
         self._slot_indexed[idx] = 0
         self._slot_revives[idx] = []
+        self._slot_blocks_tokens[idx] = []
+        self._slot_use_cache[idx] = False
+        self._slot_cow[idx] = None
+        if self._tree is not None and len(self._tree) > 4 * self.total_blocks:
+            # Amortized tombstone sweep: host-tier LRU drops lose
+            # residency without a callback, so dead leaf chains only
+            # disappear here. The bound keeps the tree O(pool + tier).
+            self._tree.sweep(self._resident)
 
     def reset(self) -> None:
         """Forget the DEVICE pool — cached content included. Used when
@@ -497,3 +709,12 @@ class BlockManager:
         self._slot_indexed = [0] * self.n_slots
         self._spilled = []
         self._slot_revives = [[] for _ in range(self.n_slots)]
+        self._slot_blocks_tokens = [[] for _ in range(self.n_slots)]
+        self._slot_use_cache = [False] * self.n_slots
+        self._slot_cow = [None] * self.n_slots
+        self._cow_pins = [None] * self.n_slots
+        if self._tree is not None:
+            # Mirror the index/tier split structurally: device nodes die
+            # with the pool, host-resident paths survive (with their
+            # tombstone ancestors) for post-recovery replays to hit.
+            self._tree.device_reset(self._on_host)

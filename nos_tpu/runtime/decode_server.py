@@ -254,6 +254,12 @@ class _Slot:
     # in prefix order, consumed front-first as the cursor advances.
     tenant: Optional[str] = None
     pending_revives: List[Tuple[int, int, str]] = field(default_factory=list)
+    # Radix-tree COW state (PR 13): the staged copy-on-write the budget
+    # scheduler still has to perform — (token offset, destination block,
+    # pinned source block or None for a host-tier source, source chain
+    # key, tokens to copy) — consumed right after the revives, before
+    # recompute chunks.
+    pending_cow: Optional[Tuple[int, int, Optional[int], str, int]] = None
     # Tracing state (nos_tpu/tracing.py): the request's trace id, and
     # whether the slot's `req.decode` span event has been recorded (once,
     # on its first post-prefill dispatch).
@@ -291,6 +297,7 @@ class DecodeServer:
         spec_sync: bool = False,
         prefill_budget_tokens: Optional[int] = None,
         prefix_cache: bool = True,
+        radix_cache: bool = True,
         spill_blocks: Optional[int] = None,
         quota: Optional[QuotaPolicy] = None,
         mesh=None,
@@ -431,6 +438,28 @@ class DecodeServer:
         dispatched chunk computes. False disables lookup and
         registration (the A/B baseline; per-request block accounting is
         unchanged either way).
+
+        `radix_cache` (default True; effective only with `prefix_cache`)
+        generalizes the flat chain-key index into a RADIX TREE over
+        token-block edges (runtime/radix_tree.py, docs/radix-cache.md):
+        (a) a prompt diverging MID-BLOCK from a cached path stages a
+        copy-on-write — the shared block's head is copied into the
+        slot's private page by one device-side block copy (or a
+        host-payload revive when the source lives in the spill tier),
+        charged against the prefill budget like the recompute it
+        replaces, and the cursor resumes mid-block; (b) a FINISHED
+        request's generated tokens register their full blocks under the
+        same chain-key scheme, so a follow-up turn re-submitting
+        `history + new tokens` walks the tree to the end of the history
+        and is charged ~the new suffix (multi-turn re-admission — the
+        registered KV is bit-identical to a prefill replay of the same
+        tokens, the PR 6/7 replay-exactness property); (c) eviction
+        becomes subtree-LRU (leaves before trunks) with the PR 7 spill
+        tier as the tree's cold storage. Outputs are bit-identical
+        tree-on vs chain-on vs cold — greedy AND temperature: the tree
+        changes which chunks DISPATCH, never what any dispatched chunk
+        computes. False keeps the PR 5 flat-chain behavior bit-for-bit
+        (the chain-index A/B baseline).
 
         `spill_blocks` sizes the HOST-RAM spill tier of the prefix cache
         (runtime/spill.py), in KV blocks: a cached-free block about to be
@@ -614,6 +643,7 @@ class DecodeServer:
         # block lists, the prefix index) lives in the BlockManager —
         # NOS011 flags pool-state mutation anywhere else.
         self.prefix_cache = bool(prefix_cache)
+        self.radix_cache = bool(radix_cache) and self.prefix_cache
         self._fault_injector = fault_injector
         # Tracing bundle (nos_tpu/tracing.py): tracer/recorder hooks are
         # None-guarded; the profiler is a per-engine disabled instance
@@ -625,7 +655,8 @@ class DecodeServer:
             tracing.profiler if tracing is not None else TickProfiler(enabled=False)
         )
         self._block_mgr = BlockManager(
-            self.total_blocks, self.block_size, n_slots, fault_injector=fault_injector
+            self.total_blocks, self.block_size, n_slots,
+            fault_injector=fault_injector, radix=self.radix_cache,
         )
         if self._recorder is not None:
             self._block_mgr.attach_recorder(self._recorder)
@@ -1012,6 +1043,31 @@ class DecodeServer:
             donate_argnums=(0,),
         )
 
+        # Radix-tree COW copy (PR 13): the first `length` positions of a
+        # SHARED source block copied into a PRIVATE destination block,
+        # device-side — no host round trip, and the shared source is
+        # only ever READ (immutability holds). Rides the donated-cache
+        # chain, so the chunk that prefills the destination's tail is
+        # device-ordered behind the copy. Per-shard local at any tp
+        # width (each device copies its own KV-head slice); `src`/`dst`/
+        # `length` are traced scalars — one compiled program serves
+        # every (source, destination, length) triple.
+        def _cow_copy(cache, src, dst, length):
+            mask = (jnp.arange(bs) < length)[None, :, None]
+            for i in range(L):
+                k = cache[str(i)]["k"]
+                v = cache[str(i)]["v"]
+                cache[str(i)] = {
+                    "k": k.at[dst].set(jnp.where(mask, k[src], k[dst])),
+                    "v": v.at[dst].set(jnp.where(mask, v[src], v[dst])),
+                }
+            return cache
+
+        self._cow_fn = jax.jit(
+            _tp_shard(_cow_copy, (_CS, _R, _R, _R), _CS),
+            donate_argnums=(0,),
+        )
+
     def _extract_block(self, block: int):
         """Copy one block's K/V off the device for the spill tier:
         (payload, nbytes). The reads below are DELIBERATE synchronous
@@ -1023,6 +1079,42 @@ class DecodeServer:
         k = np.asarray(k)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
         v = np.asarray(v)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
         return (k, v), k.nbytes + v.nbytes
+
+    def prewarm(self) -> "DecodeServer":
+        """Compile every PREFILL program shape — mid-chunk, batched
+        window, and final-chunk per prompt bucket — before traffic
+        arrives (ISSUE 13 satellite). The gotcha this closes: a
+        full-prefix HIT starts its final chunk at the hit boundary, so
+        the chunk lands in a bucket (often the smallest) that no COLD
+        prompt of the deployment's shapes ever compiled — a one-time
+        multi-second compile stall in the middle of an admission wave,
+        at peak cache effectiveness. The dummy dispatches write only the
+        scratch page / slot 0's first-token lanes (garbage-tolerated by
+        construction: a real admission's final chunk overwrites its
+        lane before any read). Call once at engine start, before
+        serving; pinned by the no-recompile counter test."""
+        self._sync_tick_state(for_table_only=True)
+        table = self._tick_state.table
+        for bucket in self.prompt_buckets:
+            dummy = np.zeros((1, bucket), dtype=np.int32)
+            self.cache = self._prefill_chunk(
+                self.params, self._stage.to_device(dummy), self.cache,
+                table[0], 0, 1,
+            )
+            self.cache, self._last_dev, self._first_dev = self._prefill_last(
+                self.params, self._stage.to_device(dummy), self.cache,
+                table[0], 0, 1, self._last_dev, self._first_dev, 0, 0, 0,
+            )
+            window = np.zeros((self.n_slots, bucket), dtype=np.int32)
+            zeros = np.zeros((self.n_slots,), dtype=np.int32)
+            self.cache = self._prefill_window(
+                self.params, self._stage.to_device(window), self.cache,
+                table,
+                self._stage.to_device(zeros),
+                self._stage.to_device(zeros),
+                self._stage.to_device(np.zeros((self.n_slots,), dtype=bool)),
+            )
+        return self
 
     # -- client side ---------------------------------------------------------
     def submit(
@@ -1554,6 +1646,15 @@ class DecodeServer:
                 # private blocks the budget scheduler will fill by
                 # copy-in (_pump_revives) instead of recompute.
                 slot.pending_revives = self._block_mgr.claim_revives(idx)
+                # Radix COW right behind those: the diverging block's
+                # shared head, copied (not recomputed) by _pump_cow.
+                slot.pending_cow = self._block_mgr.claim_cow(idx)
+                if self.metrics is not None and slot.pending_cow is not None:
+                    self.metrics.inc("nos_tpu_decode_prefix_cow_hits")
+                    self.metrics.inc(
+                        "nos_tpu_decode_prefix_cow_tokens",
+                        slot.pending_cow[4],
+                    )
                 slot.t_submit = req.t_submit
                 slot.pos = slot.prefill_cursor
                 slot.remaining = eff_new - 1
@@ -1676,6 +1777,18 @@ class DecodeServer:
                         exhausted = True
                         break
                     continue  # this wave's visit went to the copy-ins
+                if slot.pending_cow is not None:
+                    with self._prof.phase(constants.TICK_PHASE_PUMP_REVIVES):
+                        n_copies, used = self._pump_cow(idx, budget, spent)
+                    revived += n_copies
+                    dispatches += n_copies
+                    spent += used
+                    if slot.pending_cow is not None:
+                        # Budget closed before the copy fit: it (and
+                        # everything behind it) waits for the next tick.
+                        exhausted = True
+                        break
+                    continue  # this wave's visit went to the copy
                 start = slot.prefill_cursor
                 piece = slot.pending_prompt[start : start + chunk]
                 if budget and spent and spent + len(piece) > budget:
@@ -1749,6 +1862,73 @@ class DecodeServer:
             # concurrent same-prefix arrivals hit the device tier.
             self._block_mgr.note_progress(idx, slot.prefill_cursor)
         return copies, used
+
+    def _pump_cow(self, idx: int, budget: int, spent: int) -> Tuple[int, int]:
+        """Perform slot `idx`'s staged copy-on-write: the diverging
+        block's shared head copied into the slot's private page,
+        charging `copy_len` budget tokens (the same tokens the cursor
+        advances — a partial hit competes for the tick's prefill
+        bandwidth exactly like the recompute it replaces). A
+        device-resident source is one `_cow_fn` dispatch (the pinned
+        source is released after the copy rides the donated chain); a
+        host-resident source is a full-payload revive into the private
+        block, of which only the matched head counts — the foreign tail
+        is overwritten by this slot's own prefill chunks before any
+        position attends it. A payload the tier dropped meanwhile
+        downgrades the block to recompute — bit-identical output, paid
+        in forward passes. Returns (copies dispatched, budget used);
+        `slot.pending_cow` still set afterwards means the budget closed
+        before the copy fit."""
+        slot = self._slots[idx]
+        offset, dst, src, key, n = slot.pending_cow
+        if offset != slot.prefill_cursor:
+            # Defensive: a copy not at the cursor means the compute path
+            # already owns this range — recompute instead.
+            slot.pending_cow = None
+            self._block_mgr.cow_done(idx)
+            return 0, 0
+        if budget and spent and spent + n > budget:
+            return 0, 0  # pending_cow stays set: next tick's budget
+        self._check_fault("cow", idx)
+        if src is not None:
+            with self._prof.dispatch():
+                self.cache = self._cow_fn(self.cache, src, dst, n)
+            self._block_mgr.cow_done(idx)
+        else:
+            payload = (
+                self.spill_tier.get(key) if self.spill_tier is not None else None
+            )
+            if payload is None:
+                slot.pending_cow = None
+                return 0, 0  # dropped under host pressure: recompute
+            kx, vx = payload
+            with self._prof.dispatch():
+                self.cache = self._revive_fn(
+                    self.cache,
+                    self._stage.to_device(kx),
+                    self._stage.to_device(vx),
+                    dst,
+                )
+        slot.pending_cow = None
+        slot.prefill_cursor = offset + n
+        slot.pos = slot.prefill_cursor
+        if slot.phase == "reserved":
+            slot.phase = "prefilling"
+        self._tick_state.mark_dirty()
+        if self._tracer is not None:
+            self._tracer.event(
+                slot.trace_id,
+                constants.TRACE_EV_COW,
+                slot=idx,
+                block=dst,
+                offset=offset,
+                tokens=n,
+            )
+        if self._recorder is not None:
+            self._recorder.record(
+                constants.FLIGHT_EV_COW, slot=idx, block=dst, tokens=n
+            )
+        return 1, n
 
     def _dispatch_prefill_wave(self, wave: List[Tuple[int, int, list]]) -> int:
         """Dispatch one wave (at most one chunk per slot). Mid-prompt
@@ -1946,9 +2126,28 @@ class DecodeServer:
             return
         if slot.remaining <= 0 or slot.pos >= self.max_len:
             out = self._finalize(slot)
+            self._register_output(idx, slot, out)
             slot.future.set_result(out)
             self._trace_finish(idx, slot, len(out))
             self._release_slot(idx)
+
+    def _register_output(self, idx: int, slot: _Slot, out: List[int]) -> None:
+        """Radix mode: key the finished request's generated-token blocks
+        (runtime/block_manager.py `register_output`) so a follow-up turn
+        re-submitting `history + new tokens` walks the tree to the end
+        of the history instead of re-prefilling it. Runs just before the
+        slot releases — the registered blocks retire to the cached-free
+        LRU instead of the plain free list."""
+        if not self.radix_cache:
+            return
+        before = self._block_mgr.output_blocks
+        self._block_mgr.register_output(idx, list(slot.request_prompt or []) + out)
+        if self.metrics is not None:
+            registered = self._block_mgr.output_blocks - before
+            if registered:
+                self.metrics.inc(
+                    "nos_tpu_decode_output_blocks_registered", registered
+                )
 
     def _scan_eos(self) -> None:
         """With an eos_id, sequence termination depends on token values; scan
@@ -1971,6 +2170,7 @@ class DecodeServer:
                 if token == self.eos_id:
                     slot.refs = slot.refs[: slot.eos_scanned]
                     out = self._finalize(slot)
+                    self._register_output(idx, slot, out)
                     slot.future.set_result(out)
                     self._trace_finish(idx, slot, len(out))
                     self._release_slot(idx)
@@ -2958,6 +3158,28 @@ class DecodeServer:
     def prefix_evictions(self) -> int:
         return self._block_mgr.evictions
 
+    @property
+    def prefix_cow_hits(self) -> int:
+        """Admissions that staged a mid-block copy-on-write match —
+        partial-block sharing the flat chain index cannot see."""
+        return self._block_mgr.cow_hits
+
+    @property
+    def prefix_cow_tokens(self) -> int:
+        """Prompt tokens served by COW copies instead of recompute."""
+        return self._block_mgr.cow_hit_tokens
+
+    @property
+    def output_blocks_registered(self) -> int:
+        """Generated-token blocks keyed at request completion — the
+        multi-turn re-admission enabler."""
+        return self._block_mgr.output_blocks
+
+    @property
+    def radix_nodes(self) -> int:
+        """Radix-tree size (0 in flat-chain mode) — a gauge."""
+        return self._block_mgr.radix_nodes()
+
     # -- spill-tier / quota counters (read-through; telemetry's
     # collect_serving duck-types these as plain attributes) -------------------
     @property
@@ -3069,6 +3291,7 @@ class DecodeServer:
         m.set_gauge("nos_tpu_decode_kv_blocks_shared", pool["shared"])
         m.set_gauge("nos_tpu_decode_kv_blocks_spilled", pool["spilled"])
         m.set_gauge("nos_tpu_decode_spill_host_bytes", self.spill_host_bytes)
+        m.set_gauge("nos_tpu_decode_radix_nodes", self.radix_nodes)
         for name, cur in (
             ("nos_tpu_decode_spills", self.spills),
             ("nos_tpu_decode_revives", self.revives),

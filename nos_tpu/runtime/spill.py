@@ -116,6 +116,17 @@ class SpillTier:
             self._spill_bytes -= n
             self.drops += 1
 
+    def get(self, key: str) -> Optional[object]:
+        """Read one payload WITHOUT removing it — the radix COW's
+        source read (PR 13): the copy consumes only the block's head,
+        and the full block stays valid host content for future
+        full-prefix hits, so popping it (take) would destroy residency
+        the copy never used. Deliberately no recency touch, mirroring
+        `__contains__`: a partial read must not change which entry the
+        next capacity drop takes (the peek-must-not-perturb property)."""
+        entry = self._spill_store.get(key)
+        return None if entry is None else entry[0]
+
     def take(self, key: str) -> Optional[object]:
         """Pop one payload for revival (copy-in to a fresh device block).
         Returns None when the key was dropped under host pressure or

@@ -16,13 +16,24 @@ N CPU-backed engines — and tracks, per replica:
     requests, prefill backlog) — plain host reads, no device traffic;
   - a router-side **shadow of the replica's prefix index**: the chain
     keys (runtime/block_manager.py `chain_key` sha256 chain) the router
-    believes are resident on that replica. The shadow is updated
+    believes are resident on that replica, PLUS (PR 13) a router-side
+    RADIX TREE over the routed prompts' token-block edges — the same
+    `RadixTree` class the engine's BlockManager walks, so
+    deepest-tree-match scoring (`shadow_hit_tokens`: full resident run
+    + the partial-block COW match the engine would stage) shares the
+    engine's key and walk code BY CONSTRUCTION. The shadow is updated
     OPTIMISTICALLY at routing time (the routed prompt's full blocks will
     index as its prefill dispatches) and reconciled against engine truth
     (`DecodeServer.prefix_keys()`, again host-side dict reads) on
-    demand. Staleness is safe by construction: a wrong shadow can only
-    misroute, and a misrouted request simply prefills cold — outputs are
-    bit-identical regardless of placement (docs/serving-cluster.md).
+    demand: the key SET is replaced wholesale and the shadow tree's
+    dead structure pruned against it. The tree deliberately
+    under-predicts multi-turn hits (the router never sees generated
+    tokens, so output-registered blocks are invisible until the same
+    conversation re-routes — sticky tenants land it on the right
+    replica anyway). Staleness is safe by construction: a wrong shadow
+    can only misroute, and a misrouted request simply prefills cold —
+    outputs are bit-identical regardless of placement
+    (docs/serving-cluster.md).
 
 Replica construction contract: every engine in one set must share
 `block_size` (router keys and engine keys must agree — enforced here).
@@ -48,9 +59,10 @@ other tail.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from nos_tpu import constants
+from nos_tpu.runtime.radix_tree import RadixTree
 from nos_tpu.telemetry import ServingReport, collect_serving
 
 
@@ -68,6 +80,11 @@ class ReplicaHandle:
         #: Router-side shadow of the replica's content-addressed prefix
         #: index: chain keys believed resident (device or host tier).
         self.shadow: set = set()
+        #: Structural shadow (PR 13): the routed prompts' token-block
+        #: edges, for deepest-tree-match scoring. Residency stays in
+        #: `shadow` — the tree walk takes it as a predicate, exactly
+        #: like the engine's tree takes its index.
+        self.shadow_tree = RadixTree()
         #: Requests the router has placed on this replica (lifetime).
         self.routed_requests = 0
 
@@ -95,8 +112,8 @@ class ReplicaHandle:
 
     def shadow_hit_blocks(self, keys: List[str]) -> int:
         """Longest leading run of `keys` present in the shadow — the
-        router's prediction of the prefix blocks this replica would
-        serve from cache."""
+        flat-chain prediction, kept for consumers that score in whole
+        blocks (and as the pre-PR-13 baseline shape)."""
         hit = 0
         for key in keys:
             if key not in self.shadow:
@@ -104,17 +121,39 @@ class ReplicaHandle:
             hit += 1
         return hit
 
-    def note_routed(self, keys: Iterable[str]) -> None:
+    def shadow_hit_tokens(self, prompt: Sequence[int]) -> int:
+        """Deepest-tree-match prediction, in TOKENS: the resident run's
+        full blocks plus the partial-block COW match the engine would
+        stage at the divergence point — the same walk
+        (`RadixTree.match`) the engine's admission runs, against the
+        shadow's believed-resident key set."""
+        resident_keys, _, cow = self.shadow_tree.match(
+            prompt, self.engine.block_size, lambda key: key in self.shadow
+        )
+        return len(resident_keys) * self.engine.block_size + (
+            cow[1] if cow is not None else 0
+        )
+
+    def note_routed(self, keys: Iterable[str], prompt: Optional[Sequence[int]] = None) -> None:
         """Optimistic shadow update at routing time: the routed prompt's
-        full blocks will be indexed as its prefill dispatches."""
+        full blocks will be indexed as its prefill dispatches. With the
+        prompt given, its token-block edges join the shadow tree too
+        (deepest-match scoring needs content, not just hashes)."""
+        keys = list(keys)
         self.shadow.update(keys)
+        if prompt is not None and keys:
+            self.shadow_tree.insert_path(
+                prompt, self.engine.block_size, len(keys)
+            )
         self.routed_requests += 1
 
     def reconcile_shadow(self) -> None:
         """Replace the shadow with engine truth (device index + host
-        tier). Host-side reads only — the 'no new device traffic'
-        contract of the shadow design."""
+        tier) and prune the shadow tree's dead structure against it.
+        Host-side reads only — the 'no new device traffic' contract of
+        the shadow design."""
         self.shadow = set(self.engine.prefix_keys())
+        self.shadow_tree.sweep(lambda key: key in self.shadow)
 
     def snapshot(self) -> Dict[str, object]:
         """Wire-format view of the replica for fleet telemetry."""

@@ -7,11 +7,19 @@ chain with the SAME sha256 chain-key scheme the engines index under
 both sides, so router keys and engine keys agree by construction) and
 scores every admitting replica by
 
-    score = shadow_hit_blocks x block_size - load_penalty_tokens x load
+    score = shadow_hit_tokens - load_penalty_tokens x load
 
 i.e. the prefix tokens the replica is predicted to serve from cache,
 minus a load penalty in the same token currency (`load` is the replica's
-probe snapshot: active slots + queued requests + backlog blocks). The
+probe snapshot: active slots + queued requests + backlog blocks).
+`shadow_hit_tokens` (PR 13) is DEEPEST-TREE-MATCH, not longest-chain:
+each handle keeps a radix tree over its routed prompts' token-block
+edges (the same RadixTree class — and the same walk — the engine's
+BlockManager admits through, so the router's prediction and the
+engine's admission agree by construction, down to the partial-block
+COW match at a mid-block divergence and the below-the-last-token cap,
+which both sides take from ONE shared helper,
+`block_manager.cacheable_block_cap`). The
 argmax wins; exact ties rotate round-robin, which also makes the
 no-cache-signal case (cold fleet, disjoint traffic) degrade to plain
 round-robin load balancing. `policy="round_robin"` disables the scoring
@@ -42,7 +50,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from nos_tpu import constants
-from nos_tpu.runtime.block_manager import prompt_chain_keys
+from nos_tpu.runtime.block_manager import cacheable_block_cap, prompt_chain_keys
 from nos_tpu.serving.replica import ReplicaHandle, ReplicaSet
 
 
@@ -134,10 +142,10 @@ class PrefixRouter:
         by the drain controller to re-home extracted work (`exclude`
         masks the draining source even before its state flips)."""
         with self._lock:
-            handle, keys, hit = self._select_locked(prompt, tenant, exclude)
-            handle.note_routed(keys)
+            handle, keys, hit_tokens = self._select_locked(prompt, tenant, exclude)
+            handle.note_routed(keys, prompt)
             self.routed_requests += 1
-            self.predicted_hit_tokens += hit * self.block_size
+            self.predicted_hit_tokens += hit_tokens
             if self.sticky_tenants and tenant is not None:
                 self._sticky[tenant] = handle.replica_id
             return handle
@@ -162,30 +170,32 @@ class PrefixRouter:
         exclude: Optional[ReplicaHandle],
     ) -> Tuple[ReplicaHandle, List[str], int]:
         """Returns (handle, the prompt's cacheable chain keys, predicted
-        hit blocks). Caller holds the lock."""
+        hit tokens — deepest-tree-match). Caller holds the lock."""
         active = self._candidates(exclude)
-        # Same below-the-last-token cap admission applies: the final
-        # block is always recomputed privately, so it can never hit.
-        cap = max(0, (len(prompt) - 1) // self.block_size)
+        # The same below-the-last-token cap admission applies (ONE
+        # shared helper — router and engine can never disagree on it):
+        # the final block is always recomputed privately, so it can
+        # never hit.
+        cap = cacheable_block_cap(len(prompt), self.block_size)
         keys = prompt_chain_keys(prompt, self.block_size)[:cap]
         if self.policy == constants.ROUTER_POLICY_ROUND_ROBIN:
             handle = active[self._rr % len(active)]
             self._rr += 1
             self.rr_routed += 1
-            return handle, keys, handle.shadow_hit_blocks(keys)
+            return handle, keys, handle.shadow_hit_tokens(prompt)
         if self.sticky_tenants and tenant is not None:
             pinned = self._sticky.get(tenant)
             if pinned is not None:
                 for h in active:
                     if h.replica_id == pinned:
                         self.sticky_routed += 1
-                        return h, keys, h.shadow_hit_blocks(keys)
+                        return h, keys, h.shadow_hit_tokens(prompt)
                 # Pin points at a draining/retired replica: dissolve it
                 # and fall through to a fresh scored placement.
                 del self._sticky[tenant]
         scored = [
             (
-                h.shadow_hit_blocks(keys) * self.block_size
+                h.shadow_hit_tokens(prompt)
                 - self.load_penalty_tokens * h.load(),
                 h,
             )
@@ -195,12 +205,12 @@ class PrefixRouter:
         ties = [h for score, h in scored if score == best]
         handle = ties[self._rr % len(ties)]
         self._rr += 1
-        hit = handle.shadow_hit_blocks(keys)
-        if hit > 0:
+        hit_tokens = handle.shadow_hit_tokens(prompt)
+        if hit_tokens > 0:
             self.prefix_routed += 1
         else:
             self.rr_routed += 1
-        return handle, keys, hit
+        return handle, keys, hit_tokens
 
     # -- shadow maintenance ---------------------------------------------------
     def reconcile(self) -> None:

@@ -112,6 +112,17 @@ class ServingReport:
     kv_blocks_free: int = 0
     kv_blocks_cached: int = 0
     kv_blocks_shared: int = 0
+    # Radix-tree prefix cache (PR 13, docs/radix-cache.md): admissions
+    # that staged a mid-block copy-on-write match, the prompt tokens
+    # those copies served instead of recompute (prefix_hit_tokens's
+    # partial-block sibling — total cached tokens = hit + cow),
+    # generated-token blocks keyed at request completion (the
+    # multi-turn re-admission enabler), and the tree's node count
+    # (a gauge; 0 in flat-chain mode).
+    prefix_cow_hits: int = 0
+    prefix_cow_tokens: int = 0
+    output_blocks_registered: int = 0
+    radix_nodes: int = 0
     # Tiered KV + elastic quotas (PR 7): blocks spilled device -> host
     # instead of destroyed, host-resident blocks revived by copy-in,
     # host entries dropped under host-capacity pressure, bytes resident
@@ -284,6 +295,7 @@ REPORT_GAUGE_FIELDS = frozenset(
         "kv_blocks_cached",
         "kv_blocks_shared",
         "kv_blocks_spilled",
+        "radix_nodes",
         "spill_host_bytes",
         "inflight_dispatches",
         "pending_verifies",
@@ -383,6 +395,12 @@ def collect_serving(server) -> ServingReport:
         prefix_hit_blocks=int(getattr(server, "prefix_hit_blocks", 0)),
         prefix_hit_tokens=int(getattr(server, "prefix_hit_tokens", 0)),
         prefix_evictions=int(getattr(server, "prefix_evictions", 0)),
+        prefix_cow_hits=int(getattr(server, "prefix_cow_hits", 0)),
+        prefix_cow_tokens=int(getattr(server, "prefix_cow_tokens", 0)),
+        output_blocks_registered=int(
+            getattr(server, "output_blocks_registered", 0)
+        ),
+        radix_nodes=int(getattr(server, "radix_nodes", 0)),
         spills=int(getattr(server, "spills", 0)),
         revives=int(getattr(server, "revives", 0)),
         spill_drops=int(getattr(server, "spill_drops", 0)),

@@ -1,0 +1,44 @@
+"""NOS017 negatives: the RadixTree/RadixNode classes own their
+structure — mutations inside either class body are the sanctioned
+sites; engines and router shadows that route through tree METHODS and
+merely read the structure stay clean. Similarly-named attributes that
+are not tree structure (`_node_count`) are out of scope.
+"""
+
+
+class RadixNode:
+    def __init__(self, key, parent):
+        self.key = key
+        self.parent = parent
+        self._edges = {}
+        self._node_ref = 0
+
+
+class RadixTree:
+    def __init__(self):
+        self._root = RadixNode("", None)
+        self._nodes = {}
+
+    def ensure_child(self, node, tokens, key):
+        child = RadixNode(key, node)
+        node._edges[tokens] = child
+        node._node_ref += 1
+        self._nodes[key] = child
+        return child
+
+    def unref(self, key):
+        node = self._nodes.pop(key)
+        node.parent._node_ref -= 1
+        del node.parent._edges[node.key]
+
+
+class Engine:
+    def __init__(self):
+        self._tree = RadixTree()
+        self._node_count = 0  # not tree structure
+
+    def _tick(self, node, tokens, key):
+        self._tree.ensure_child(node, tokens, key)  # method: sanctioned
+        self._node_count = 1  # not tree structure
+        child = node._edges.get(tokens)  # read: legal
+        return child is not None and len(self._tree._nodes)  # read: legal

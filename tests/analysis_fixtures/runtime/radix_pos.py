@@ -1,0 +1,32 @@
+"""NOS017 positives: radix-tree structure mutated outside the tree
+classes.
+
+Expected findings (6): the engine's direct `_edges[tokens]` subscript
+assignment, the reach-through `node._node_ref` augmented assignment, a
+`.pop()` on the key map, a `del` on an edge, a module-level `.clear()`
+of the key map — and the non-owner constructor's `_nodes` assignment:
+like NOS011/NOS013 there is no constructor exemption, because tree
+structure EXISTING outside the tree classes is the drift the rule
+guards against. Reads (`len(...)`, membership, iteration, the walk's
+edge lookups) stay legal.
+"""
+
+
+class Engine:
+    def __init__(self, tree):
+        self._tree = tree
+        self._nodes = {}
+
+    def _tick(self, node, tokens, child, key):
+        node._edges[tokens] = child
+        node._node_ref += 1
+        self._tree._nodes.pop(key)
+        del node._edges[tokens]
+        return len(self._tree._nodes)  # read: legal
+
+    def resident(self, node, tokens):
+        return tokens in node._edges  # read: legal
+
+
+def sweep(tree):
+    tree._nodes.clear()

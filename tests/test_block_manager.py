@@ -25,9 +25,12 @@ def check_invariants(mgr):
     DISTINCT blocks: a shared block counts once however many tables map
     it): every managed block is in exactly one of in-use / free /
     cached-free / spilled (host-backed), and a block's refcount equals
-    the number of page tables mapping it — so no block can sit in two
-    tables with refcount < 2. With a spill tier attached, the host
-    tier's bytes must balance too."""
+    the number of page tables mapping it plus any COW pin — so no block
+    can sit in two tables with refcount < 2. With a spill tier
+    attached, the host tier's bytes must balance too; in radix mode the
+    ISSUE 13 node law holds on top: node refcount == number of mapping
+    page tables + child refs, with the flat index and the tree agreeing
+    key for key."""
     blocks = range(1, mgr.total_blocks)
     in_use = {b for b in blocks if mgr._refcount[b] > 0}
     free = set(mgr._free_blocks)
@@ -50,14 +53,16 @@ def check_invariants(mgr):
     # resident payload sizes and respects capacity.
     if mgr._spill is not None:
         assert mgr._spill.conserved(), "host-tier bytes out of balance"
+    pins = [p for p in mgr._cow_pins if p is not None]
     owners = {}
     for row in mgr._slot_blocks:
         assert len(set(row)) == len(row), "one table maps a block twice"
         for b in row:
             owners[b] = owners.get(b, 0) + 1
     for b in blocks:
-        assert mgr._refcount[b] == owners.get(b, 0), (
-            f"block {b}: refcount {mgr._refcount[b]} != {owners.get(b, 0)} tables"
+        want = owners.get(b, 0) + pins.count(b)
+        assert mgr._refcount[b] == want, (
+            f"block {b}: refcount {mgr._refcount[b]} != {want} tables+pins"
         )
     # Index consistency: the index and its inverse agree; every
     # cached-free resident is indexed (that is what makes it reusable).
@@ -67,6 +72,40 @@ def check_invariants(mgr):
         assert mgr._prefix_index.get(key) == b
     for b in cached:
         assert b in mgr._block_key
+    check_tree_invariants(mgr, owners, pins)
+
+
+def check_tree_invariants(mgr, owners, pins):
+    """ISSUE 13's node law + index/tree agreement (no-op in flat-chain
+    mode): every node's refcount equals the page tables mapping its
+    indexed block plus its child count; every indexed key has a node
+    whose recomputed chain key matches its path; every node is in the
+    key map exactly once and reachable from the root."""
+    tree = mgr._tree
+    if tree is None:
+        return
+    for key, node in tree._nodes.items():
+        assert node.key == key
+        blk = mgr._prefix_index.get(key)
+        tables = 0 if blk is None else owners.get(blk, 0)
+        want = tables + len(node._edges)
+        assert node._node_ref == want, (
+            f"node {key[:12]}: ref {node._node_ref} != "
+            f"{tables} tables + {len(node._edges)} children"
+        )
+        # The chain key recomputed over the node's path must equal the
+        # stored key — index and tree agree by content, not convention.
+        parent_key = node.parent.key if node.parent is not None else ""
+        assert chain_key(parent_key, node.tokens) == key
+        # Reachability: the parent edge points back at this node.
+        assert node.parent._edges.get(node.tokens) is node
+    # Every indexed key is in the tree (the flat index never runs ahead
+    # of the structure the walk needs).
+    for key in mgr._prefix_index:
+        assert key in tree._nodes, f"indexed key {key[:12]} has no node"
+    # Every refcounted block is accounted: a pin's block is indexed.
+    for p in pins:
+        assert p in mgr._block_key, "COW pin on an unkeyed block"
 
 
 # -- chain keys ----------------------------------------------------------------
@@ -411,24 +450,227 @@ def test_index_keys_snapshots_device_and_host():
     assert mgr.index_keys() == frozenset(keys)  # tier survives device reset
 
 
+# -- the radix tree (ISSUE 13 tentpole) ---------------------------------------
+def mk_radix(total=32, n_slots=4, capacity_bytes=None):
+    """Radix-mode manager; with `capacity_bytes` a host tier rides
+    along (fake 16-byte payloads, as in mk_spilling)."""
+    from nos_tpu.runtime.spill import SpillTier
+
+    mgr = BlockManager(total, BS, n_slots, radix=True)
+    tier = None
+    if capacity_bytes is not None:
+        tier = SpillTier(capacity_bytes)
+        mgr.attach_spill(tier, lambda block: (f"kv-of-{block}", 16))
+    return mgr, tier
+
+
+def test_cacheable_block_cap_is_one_helper_for_router_and_engine():
+    """ISSUE 13 satellite: the below-the-last-token cap is written ONCE.
+    The manager's probe/admit and the router's scoring all call
+    `cacheable_block_cap`; pin its arithmetic here (exact-multiple
+    prompts exclude their last block, +1 token lifts the cap)."""
+    from nos_tpu.runtime.block_manager import cacheable_block_cap
+    from nos_tpu.serving import router as router_mod
+
+    assert cacheable_block_cap(0, BS) == 0
+    assert cacheable_block_cap(1, BS) == 0
+    assert cacheable_block_cap(BS, BS) == 0  # last-token block excluded
+    assert cacheable_block_cap(BS + 1, BS) == 1
+    assert cacheable_block_cap(3 * BS, BS) == 2
+    assert cacheable_block_cap(3 * BS + 1, BS) == 3
+    # The router imports the SAME helper (dedupe gate: no local copy).
+    assert router_mod.cacheable_block_cap is cacheable_block_cap
+
+
+def test_radix_full_block_traffic_matches_chain_mode():
+    """Pure full-block-prefix traffic: the tree walk serves exactly the
+    hits the flat chain serves — same counts, same cap, same shared
+    blocks — so the A/B arms differ only where the tree SEES more."""
+    chain = mk(total=32, n_slots=3)
+    radix, _ = mk_radix(total=32, n_slots=3)
+    prompt = list(range(10))
+    for mgr in (chain, radix):
+        mgr.admit(0, prompt, n_blocks_for(10, 4))
+        mgr.note_progress(0, 10)
+        _, hits = mgr.admit(1, prompt, n_blocks_for(10, 4))
+        assert hits == 2
+        assert mgr.counts()["shared"] == 2
+        check_invariants(mgr)
+    assert radix.claim_cow(1) is None  # full match: nothing to copy
+
+
+def test_radix_midblock_divergence_stages_cow_with_pin():
+    """Partial-block sharing: a prompt diverging mid-block takes the
+    shared run and stages a COW of the diverging block's common head —
+    source pinned (refcount without a table) until cow_done, copy
+    charged at the staged length, cursor resuming mid-block is the
+    ENGINE's half (test_radix_serving pins the exactness)."""
+    mgr, _ = mk_radix()
+    donor = [1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3, 9]  # 3 full blocks + tail
+    mgr.admit(0, donor, n_blocks_for(13, 4))
+    mgr.note_progress(0, 13)
+    mgr.release(0)
+    div = [1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 7, 7, 9]  # diverges inside block 2
+    blocks, hits = mgr.admit(1, div, n_blocks_for(13, 4))
+    assert hits == 2  # blocks 0,1 shared outright
+    cow = mgr.claim_cow(1)
+    assert cow is not None
+    offset, dst, src, key, n = cow
+    assert offset == 8 and n == 2  # the two shared tokens of block 2
+    assert dst == blocks[2] and src is not None
+    assert mgr.claim_cow(1) is None  # one-shot
+    # The pin holds an extra refcount (no table maps src).
+    check_invariants(mgr)
+    assert mgr._refcount[src] == 1
+    assert mgr.cow_hits == 1 and mgr.cow_hit_tokens == 2
+    mgr.cow_done(1)
+    assert mgr._refcount[src] == 0  # back at rest
+    check_invariants(mgr)
+    mgr.release(1)
+    check_invariants(mgr)
+    assert mgr.conserved()
+
+
+def test_radix_cow_applies_to_the_last_token_block():
+    """The ISSUE 5 cap forbids MAPPING the last-token block; COW copies
+    into a private page, so a full-prefix re-admission of an
+    exact-multiple prompt copies bs-1 tokens and recomputes ONE — the
+    1-token final chunk the prewarm satellite compiles ahead of time."""
+    mgr, _ = mk_radix()
+    prompt = list(range(8))  # exactly 2 blocks
+    mgr.admit(0, prompt, n_blocks_for(8, 4))
+    mgr.note_progress(0, 8)
+    mgr.release(0)
+    blocks, hits = mgr.admit(1, prompt, n_blocks_for(8, 4))
+    assert hits == 1  # block 0 mapped; block 1 holds the last token
+    cow = mgr.claim_cow(1)
+    assert cow is not None and cow[0] == 4 and cow[4] == 3  # copy 3 of 4
+    mgr.cow_done(1)
+    mgr.release(1)
+    check_invariants(mgr)
+
+
+def test_radix_multi_turn_register_output_extends_the_walk():
+    """Multi-turn re-admission: registering a finished request's
+    generated blocks lets `history + new tokens` walk past the prompt
+    into the generated region — the flat chain stops at the prompt."""
+    mgr, _ = mk_radix()
+    prompt = [5, 6, 7, 8, 9, 10]  # 1 full block + tail
+    mgr.admit(0, prompt, n_blocks_for(6, 8))
+    mgr.note_progress(0, 6)
+    out = [50, 51, 52, 53, 54, 55, 56, 57]
+    mgr.register_output(0, prompt + out)  # seq 14 -> blocks 0,1,2 keyed
+    assert mgr.output_blocks == 2
+    mgr.release(0)
+    check_invariants(mgr)
+    turn2 = prompt + out + [60, 61, 62]
+    _, hits = mgr.admit(1, turn2, n_blocks_for(len(turn2), 4))
+    assert hits == 3  # the whole history's full blocks, generated included
+    # No COW: the history's last block never filled (its final position
+    # is the last token, whose KV is never written), so block 3 has no
+    # registered sibling to copy from — turn 2 recomputes only tokens
+    # 12.. (the ~new-suffix cost the ISSUE names).
+    assert mgr.claim_cow(1) is None
+    mgr.release(1)
+    check_invariants(mgr)
+    assert mgr.conserved()
+
+
+def test_radix_subtree_lru_evicts_leaves_before_trunks():
+    """Subtree-LRU: eviction takes the oldest resting block whose node
+    has no device-resident child, so a path's trunk outlives its leaf
+    even when the trunk is older in the flat LRU."""
+    mgr, _ = mk_radix(total=1 + 5, n_slots=3)
+    donor = [1, 1, 1, 1, 2, 2, 2, 2, 9]  # blocks A (trunk), B (leaf) + tail
+    mgr.admit(0, donor, 3)
+    mgr.note_progress(0, 9)
+    mgr.release(0)  # cached LRU order: A, B — flat LRU would evict A first
+    a_key, b_key = mgr.prompt_keys(donor)
+    mgr.admit(1, [7] * 13, 4, use_cache=False)  # 3 free + 1 evicted
+    assert mgr.evictions == 1
+    assert a_key in mgr._prefix_index  # the trunk survived...
+    assert b_key not in mgr._prefix_index  # ...the leaf was the casualty
+    check_invariants(mgr)
+    # And the trunk still hits (device run stays prefix-closed).
+    mgr.release(1)
+    _, hits = mgr.admit(2, donor, 3)
+    assert hits == 1
+    check_invariants(mgr)
+
+
+def test_radix_spilled_subtree_walk_continues_into_host():
+    """The spill tier is the tree's cold storage: a spilled path stays
+    walkable node by node — device run first, host continuation staged
+    as revives, COW sources found in EITHER tier."""
+    mgr, tier = mk_radix(total=1 + 8, n_slots=3, capacity_bytes=1 << 10)
+    donor = [1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3, 9]
+    mgr.admit(0, donor, n_blocks_for(13, 4))
+    mgr.note_progress(0, 13)
+    mgr.release(0, spill=True)  # all 3 keyed blocks -> host
+    assert len(tier) == 3
+    assert mgr.peek_prefix(donor) == (0, 3)
+    # Host-sourced COW for a mid-block divergence of a spilled path.
+    div = [1, 1, 1, 1, 2, 2, 7, 7, 9]
+    blocks, hits = mgr.admit(1, div, n_blocks_for(9, 4))
+    assert hits == 0
+    revives = mgr.claim_revives(1)
+    assert len(revives) == 1  # block 0 revived from host
+    cow = mgr.claim_cow(1)
+    assert cow is not None
+    _, _, src, key, n = cow
+    assert src is None and n == 2  # host source: no pin, payload copy
+    assert tier.get(key) is not None  # non-popping read, content intact
+    mgr.cow_done(1)
+    mgr.release(1)
+    check_invariants(mgr)
+    assert mgr.conserved()
+
+
+def test_radix_reset_keeps_host_paths_prunes_device_nodes():
+    mgr, tier = mk_radix(total=1 + 8, n_slots=3, capacity_bytes=1 << 10)
+    donor = list(range(13))
+    mgr.admit(0, donor, n_blocks_for(13, 4))
+    mgr.note_progress(0, 13)
+    mgr.release(0, spill=True)
+    nodes_before = mgr.radix_nodes()
+    assert nodes_before == 3
+    mgr.reset()
+    check_invariants(mgr)
+    assert mgr.radix_nodes() == 3  # host-resident path survives
+    assert mgr.peek_prefix(donor) == (0, 3)
+    # Without a tier the device nodes die with the pool.
+    mgr2, _ = mk_radix()
+    mgr2.admit(0, donor, n_blocks_for(13, 4))
+    mgr2.note_progress(0, 13)
+    mgr2.reset()
+    assert mgr2.radix_nodes() == 0
+    check_invariants(mgr2)
+
+
 # -- the randomized invariant satellite ---------------------------------------
-def test_randomized_interleaving_preserves_invariants():
-    """ISSUE 5 satellite, extended by ISSUE 6 and ISSUE 7: after ANY
-    admit/prefill/decode/finish/evict interleaving — now with
+@pytest.mark.parametrize("radix", [False, True])
+def test_randomized_interleaving_preserves_invariants(radix):
+    """ISSUE 5 satellite, extended by ISSUE 6, ISSUE 7, and ISSUE 13:
+    after ANY admit/prefill/decode/finish/evict interleaving — with
     FAULT-INJECTED admissions, recovery-shaped reset/restore cycles,
-    and SPILL/REVIVE/PREEMPT ops woven into the schedule — the
-    conservation law holds: every managed block in exactly one of
-    in-use/free/cached-free/spilled (their sizes summing to
-    total_blocks - 1, scratch excluded), no block mapped by two page
-    tables with refcount < 2 (refcount == number of mapping tables),
-    and the HOST tier's bytes balance at every step. The injector fires
-    at the manager's `block_admit` site (entry, before any mutation), so
-    a raised admission must leave the pool untouched; a "device-lost
-    recovery" op replays the engine's recovery sequence — release all,
-    reset, re-admit the survivors' replay prompts — and the invariants
-    must hold at every sub-step (the tier deliberately SURVIVES the
-    reset, so post-reset restores may stage host revives). Seeded:
-    failures replay."""
+    SPILL/REVIVE/PREEMPT ops, and (radix arm) TREE ops woven into the
+    schedule: admits at divergence points (a known prompt mutated
+    mid-block), multi-turn re-admits (a finished prompt + its
+    registered output + fresh tokens), COW tails consumed/abandoned,
+    output registration before release, subtree evict/spill under
+    pressure — the conservation law holds: every managed block in
+    exactly one of in-use/free/cached-free/spilled (their sizes summing
+    to total_blocks - 1, scratch excluded), a block's refcount equals
+    its mapping tables plus COW pin, the HOST tier's bytes balance, and
+    in radix mode the node law (node refcount == number of mapping page
+    tables + child refs) plus index/tree agreement hold — at EVERY
+    sub-step. The injector fires at the manager's `block_admit` site
+    (entry, before any mutation), so a raised admission must leave the
+    pool untouched; a "device-lost recovery" op replays the engine's
+    recovery sequence — release all, reset, re-admit the survivors'
+    replay prompts (the tier deliberately SURVIVES the reset, so
+    post-reset restores may stage host revives). Seeded: failures
+    replay."""
     from nos_tpu.runtime.faults import FaultInjector, FaultSpec, PoisonRequestError
     from nos_tpu.runtime.spill import SpillTier
 
@@ -438,16 +680,19 @@ def test_randomized_interleaving_preserves_invariants():
     injector = FaultInjector(
         [FaultSpec("block_admit", rng.randint(1, 40), "poison")]
     )
-    mgr = BlockManager(1 + 10, BS, 4, fault_injector=injector)
+    mgr = BlockManager(1 + 10, BS, 4, fault_injector=injector, radix=radix)
     # Small host tier (6 x 16-byte fake payloads): capacity drops fire
     # alongside spills and revives.
     tier = SpillTier(capacity_bytes=6 * 16)
     mgr.attach_spill(tier, lambda block: (f"kv-of-{block}", 16))
-    live = {}  # slot -> (prompt, cursor)
+    live = {}  # slot -> (prompt, cursor, max_new)
+    finished = []  # (prompt, registered output) pool for multi-turn ops
     injected = 0
     recoveries = 0
     preempts = 0
     revived = 0
+    cows = 0
+    multi_turns = 0
 
     def consume_revives(idx):
         # The engine's half of a revive, compressed: claim the staged
@@ -460,18 +705,68 @@ def test_randomized_interleaving_preserves_invariants():
                 break
             revived += 1
 
+    def consume_cow(idx):
+        # The engine's half of a COW: claim the staged copy and (most
+        # of the time) perform it — a host-sourced copy reads the
+        # payload non-popping; sometimes the slot dies with the pin
+        # still held, which release() must drop.
+        nonlocal cows
+        cow = mgr.claim_cow(idx)
+        if cow is None:
+            return
+        cows += 1
+        _, _, src, key, _ = cow
+        if rng.random() < 0.85:
+            if src is None:
+                tier.get(key)  # payload read; drop downgrades to recompute
+            mgr.cow_done(idx)
+        # else: pin rides until release(idx) drops it.
+
+    def make_prompt():
+        # Small vocab + short lengths: frequent genuine prefix
+        # collisions AND frequent pool-exhaustion rejections. In the
+        # radix arm, a third of the prompts are DERIVED — a known
+        # prompt mutated at a random position (mid-block divergence) or
+        # a finished prompt regrown with its output + fresh tokens
+        # (multi-turn) — so tree-specific edges fire constantly.
+        nonlocal multi_turns
+        if radix and finished and rng.random() < 0.35:
+            base, out = rng.choice(finished)
+            if out and rng.random() < 0.6:
+                multi_turns += 1
+                grown = base + out + [rng.randint(0, 2) for _ in range(rng.randint(1, 6))]
+                return grown[:20]
+            div = list(base)
+            if div:
+                div[rng.randrange(len(div))] = rng.randint(3, 5)
+            return div + [rng.randint(0, 2) for _ in range(rng.randint(0, 4))]
+        plen = rng.randint(1, 20)
+        return [rng.randint(0, 2) for _ in range(plen)]
+
+    def finish_and_release(idx, spill=False):
+        # The engine's completion path, compressed: register the
+        # generated blocks (radix) then release. Registration is keyed
+        # off what the pool actually holds, so a short generation
+        # registers nothing — both shapes exercised.
+        prompt, _, max_new = live.pop(idx)
+        out = [rng.randint(0, 2) for _ in range(rng.randint(0, max_new))]
+        if radix and rng.random() < 0.8:
+            mgr.register_output(idx, prompt + out)
+            if out:
+                finished.append((prompt, out))
+                del finished[:-12]  # bounded pool of histories
+        mgr.release(idx, spill=spill)
+
     for step in range(3000):
         op = rng.random()
         idle = [i for i in range(mgr.n_slots) if i not in live]
         if op < 0.4 and idle:
             idx = rng.choice(idle)
-            # Small vocab + short lengths: frequent genuine prefix
-            # collisions AND frequent pool-exhaustion rejections.
-            plen = rng.randint(1, 20)
-            prompt = [rng.randint(0, 2) for _ in range(plen)]
+            prompt = make_prompt()
+            plen = len(prompt)
             max_new = rng.randint(1, 6)
             n = n_blocks_for(plen, max_new)
-            if n <= mgr.total_blocks - 1:
+            if plen and n <= mgr.total_blocks - 1:
                 before = mgr.counts()
                 try:
                     got = mgr.admit(idx, prompt, n, use_cache=rng.random() < 0.8)
@@ -489,23 +784,23 @@ def test_randomized_interleaving_preserves_invariants():
                     got = None
                 if got is not None:
                     consume_revives(idx)
-                    live[idx] = (prompt, got[1] * BS)
+                    consume_cow(idx)
+                    live[idx] = (prompt, got[1] * BS, max_new)
         elif op < 0.7 and live:
             idx = rng.choice(list(live))
-            prompt, cursor = live[idx]
+            prompt, cursor, max_new = live[idx]
             cursor = min(len(prompt), cursor + rng.randint(1, 8))
             mgr.note_progress(idx, cursor)
-            live[idx] = (prompt, cursor)
+            live[idx] = (prompt, cursor, max_new)
         elif op < 0.95 and live:
-            # Release — every third-ish one PREEMPT-shaped (KV straight
-            # to the host tier instead of the device LRU).
+            # Finish+release — every third-ish one PREEMPT-shaped (KV
+            # straight to the host tier instead of the device LRU).
             idx = rng.choice(list(live))
-            del live[idx]
             if rng.random() < 0.35:
                 preempts += 1
-                mgr.release(idx, spill=True)
+                finish_and_release(idx, spill=True)
             else:
-                mgr.release(idx)
+                finish_and_release(idx)
         elif op >= 0.985:
             # Device-lost recovery, as the engine performs it: every slot
             # checkpoints (host state survives), the pool resets, and the
@@ -521,7 +816,7 @@ def test_randomized_interleaving_preserves_invariants():
             live.clear()
             check_invariants(mgr)
             assert mgr.conserved()
-            for idx, (prompt, _) in survivors:
+            for idx, (prompt, _, max_new) in survivors:
                 n = n_blocks_for(len(prompt), rng.randint(1, 6))
                 if n > mgr.total_blocks - 1:
                     continue
@@ -544,7 +839,8 @@ def test_randomized_interleaving_preserves_invariants():
                     # revives for the replay.
                     assert got[1] == 0
                     consume_revives(idx)
-                    live[idx] = (prompt, got[1] * BS)
+                    consume_cow(idx)
+                    live[idx] = (prompt, got[1] * BS, max_new)
                 check_invariants(mgr)
         elif op >= 0.98:
             mgr.reset()
@@ -558,6 +854,10 @@ def test_randomized_interleaving_preserves_invariants():
     assert tier.spills > 0, "the schedule never spilled a block to host"
     assert revived > 0, "the schedule never revived a host-resident block"
     assert tier.drops > 0, "the schedule never hit host-capacity pressure"
+    if radix:
+        assert cows > 0, "the schedule never staged a COW tail"
+        assert multi_turns > 0, "the schedule never re-admitted a grown history"
+        assert mgr.output_blocks > 0, "the schedule never registered output blocks"
     for idx in list(live):
         mgr.release(idx)
     check_invariants(mgr)

@@ -1,0 +1,210 @@
+"""Radix-tree prefix cache, engine level (ISSUE 13 tentpole,
+docs/radix-cache.md): the exactness oracles and the prewarm satellite.
+
+House bar: the tree changes which chunks DISPATCH, never what any
+dispatched chunk computes — so every arm (cold / flat chain / radix
+tree) must produce bit-identical outputs, greedy AND temperature, for
+every reuse shape the tree adds: mid-block-divergence COW, multi-turn
+re-admission of a grown history, and spilled-subtree revival. The
+temperature arms are the sharp edge: a single ulp of logit drift at any
+served-from-cache position would flip a categorical draw.
+
+Kept lean (tier-1 headroom is thin): one tiny shared model
+(conftest.serving_test_config), short prompts, few tokens.
+"""
+
+import jax
+import pytest
+
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.telemetry import collect_serving
+from tests.conftest import serving_test_config
+
+CFG = serving_test_config()
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="cache-hit bit-exactness crosses program shapes: needs the "
+    "deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+def mk(params, **kw):
+    defaults = dict(
+        n_slots=2, max_len=64, prompt_buckets=(8, 16), block_size=8, seed=11
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, **defaults)
+
+
+def run_seq(server, reqs):
+    """Serve `reqs` ([(prompt, max_new)]) strictly in order — serials
+    (and temperature PRNG streams) are identical across arms by FIFO."""
+    outs = []
+    server.start()
+    try:
+        for p, n in reqs:
+            outs.append(server.generate(p, max_new=n, timeout=300))
+    finally:
+        server.stop()
+    return outs
+
+
+DONOR = [((i * 5) % 91) + 1 for i in range(24)]  # 3 full blocks
+DIV = DONOR[:12] + [((i * 7) % 91) + 2 for i in range(12)]  # diverges mid-block 1
+
+
+# -- THE exactness oracles -----------------------------------------------------
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_midblock_divergence_cow_bit_identical(params, temperature):
+    """Mid-block-divergence COW == cold, all three arms: the copied head
+    is the very KV a cold prefill would write, and the tail recomputes
+    from the mid-block cursor."""
+    reqs = [(DONOR, 6), (DIV, 6)]
+    cold = run_seq(mk(params, prefix_cache=False, temperature=temperature), reqs)
+    chain = run_seq(mk(params, radix_cache=False, temperature=temperature), reqs)
+    tree_srv = mk(params, temperature=temperature)
+    tree = run_seq(tree_srv, reqs)
+    assert cold == chain == tree
+    # The tree actually exercised the new edge: a COW staged and served.
+    assert tree_srv.prefix_cow_hits >= 1
+    assert tree_srv.prefix_cow_tokens >= 1
+    # ...and was charged LESS prefill than the flat chain would be
+    # (the copied tokens never hit the budget as recompute).
+
+
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_multi_turn_readmission_equals_monolithic_reprefill(params, temperature):
+    """Multi-turn re-admission == monolithic re-prefill, bit-identical:
+    turn 2 re-submits `history + new tokens`; the tree serves the
+    history (generated blocks included, via register_output) and the
+    output must equal a cold engine prefilling the whole thing — same
+    serials, so the temperature PRNG streams align by construction."""
+    turn1 = DONOR[:20]
+
+    def run(server):
+        server.start()
+        try:
+            out1 = server.generate(turn1, max_new=12, timeout=300)
+            turn2 = turn1 + out1 + [33, 44, 55]
+            out2 = server.generate(turn2, max_new=8, timeout=300)
+        finally:
+            server.stop()
+        return out1, out2
+
+    tree_srv = mk(params, temperature=temperature)
+    out_tree = run(tree_srv)
+    chain_srv = mk(params, radix_cache=False, temperature=temperature)
+    out_chain = run(chain_srv)
+    out_cold = run(mk(params, prefix_cache=False, temperature=temperature))
+    assert out_tree == out_chain == out_cold
+    # The multi-turn machinery engaged: generated blocks registered and
+    # turn 2's walk went deeper than the flat chain's.
+    assert tree_srv.output_blocks_registered > 0
+    tree_cached = tree_srv.prefix_hit_tokens + tree_srv.prefix_cow_tokens
+    chain_cached = chain_srv.prefix_hit_tokens + chain_srv.prefix_cow_tokens
+    assert tree_cached > chain_cached
+    # ...which is prefill work the engine never dispatched.
+    assert tree_srv.prefill_tokens < chain_srv.prefill_tokens
+
+
+@cpu_only
+def test_spilled_subtree_revive_equals_recompute(params):
+    """Spilled-subtree revive == recompute: a path evicted to the host
+    tier under allocation pressure is walked node by node on
+    re-admission (revives + host-sourced COW), bit-identical to cold."""
+    # 28-token donor: blocks 0..2 are below the last-token cap, so the
+    # spilled mid-path block comes back as a staged REVIVE (a 24-token
+    # donor's block 2 would be its last-token block — served by a
+    # host-sourced COW instead, which is also exercised via DIV below).
+    donor = DONOR + [77, 78, 79, 80]
+    filler = [((i * 11) % 91) + 3 for i in range(28)]
+    reqs = [(donor, 4), (filler, 4), (donor, 4), (DIV, 4)]
+    # Pool sized so the filler's blocks evict the donor's cached path
+    # into the spill tier (spill_blocks defaults to one pool's worth).
+    cold = run_seq(
+        mk(params, prefix_cache=False, total_blocks=1 + 6, n_slots=1), reqs
+    )
+    tree_srv = mk(params, total_blocks=1 + 6, n_slots=1)
+    tree = run_seq(tree_srv, reqs)
+    assert cold == tree
+    rep = collect_serving(tree_srv)
+    assert rep.spills > 0, "the pool pressure never spilled the path"
+    assert rep.revives > 0, "the re-admission never revived from host"
+
+
+# -- counters flow end-to-end --------------------------------------------------
+@cpu_only
+def test_radix_counters_flow_to_report_and_registry(params):
+    from nos_tpu.observability import Metrics
+
+    registry = Metrics()
+    server = mk(params, metrics=registry)
+    outs = run_seq(server, [(DONOR, 6), (DIV, 6)])
+    assert len(outs) == 2
+    rep = collect_serving(server)
+    assert rep.prefix_cow_hits == server.prefix_cow_hits >= 1
+    assert rep.prefix_cow_tokens == server.prefix_cow_tokens >= 1
+    assert rep.output_blocks_registered == server.output_blocks_registered
+    assert rep.radix_nodes == server.radix_nodes > 0
+    assert registry.get("nos_tpu_decode_prefix_cow_hits") == float(
+        server.prefix_cow_hits
+    )
+    assert registry.get("nos_tpu_decode_radix_nodes") == float(server.radix_nodes)
+
+
+# -- the prewarm satellite -----------------------------------------------------
+@cpu_only
+def test_prewarm_pins_the_hit_shape_bucket_no_recompile(params):
+    """ISSUE 13 satellite: a full-prefix hit serves its shortened final
+    chunk through a bucket no cold prompt of the same shape ever
+    compiled — a one-time compile stall mid-admission-wave. First show
+    the gotcha is real (without prewarm, the hit admission grows the
+    final-chunk jit cache), then pin the fix (after prewarm, cold AND
+    hit traffic add zero compiles)."""
+    prompt = [((i * 3) % 91) + 1 for i in range(48)]  # cold: 32-chunk + 16-final
+
+    def caches(server):
+        return (
+            server._prefill_last._cache_size(),
+            server._prefill_chunk._cache_size(),
+            server._prefill_window._cache_size(),
+        )
+
+    # The gotcha: the hit path's 1-token final chunk lands in bucket 8,
+    # which the cold 48-token prompt (32-chunk + 16-final) never built.
+    gotcha = mk(params, prompt_buckets=(8, 16, 32), max_len=64)
+    gotcha.start()
+    try:
+        gotcha.generate(prompt, max_new=4, timeout=300)
+        after_cold = caches(gotcha)
+        gotcha.generate(prompt, max_new=4, timeout=300)  # full-prefix hit
+        after_hit = caches(gotcha)
+    finally:
+        gotcha.stop()
+    assert after_hit[0] > after_cold[0], (
+        "expected the hit-shape final chunk to compile a NEW bucket "
+        "(the regression this satellite fixes no longer reproduces)"
+    )
+
+    # The fix: prewarm compiles every bucket's shapes up front; the
+    # same traffic then adds nothing.
+    warm = mk(params, prompt_buckets=(8, 16, 32), max_len=64).prewarm()
+    before = caches(warm)
+    warm.start()
+    try:
+        cold_out = warm.generate(prompt, max_new=4, timeout=300)
+        hot_out = warm.generate(prompt, max_new=4, timeout=300)
+    finally:
+        warm.stop()
+    assert caches(warm) == before, "prewarmed engine recompiled under traffic"
+    # And prewarm is schedule-neutral: outputs match the unwarmed engine.
+    assert cold_out == hot_out
+    assert warm.prefix_cow_hits + warm.prefix_hit_blocks > 0

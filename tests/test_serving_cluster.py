@@ -143,6 +143,36 @@ def test_prefix_routing_follows_the_shadow(params):
     assert rs.handles[0].engine.prefix_hit_blocks >= 2
 
 
+@cpu_only
+def test_deepest_match_routing_sees_partial_prefix(params):
+    """ISSUE 13: the shadow scores by deepest-TREE-match, so traffic
+    sharing only a PARTIAL block with routed work still lands where the
+    prefix lives — the old longest-chain score saw zero full blocks
+    here, tied every replica, and rotated the request away from its
+    COW source."""
+    rs = make_fleet(params, n=2)
+    router = PrefixRouter(rs)
+    donor = [((i * 5) % 91) + 1 for i in range(16)]  # 2 full blocks
+    f1 = router.submit(donor, max_new=4)
+    assert drive_fleet(rs, f1.done)
+    # Shares only donor's first 6 tokens (block 0 diverges mid-block):
+    # zero full-block overlap, 6 matchable head tokens.
+    partial = donor[:6] + [((i * 13) % 91) + 3 for i in range(10)]
+    f2 = router.submit(partial, max_new=4)
+    assert drive_fleet(rs, f2.done)
+    assert f1.result(1) and f2.result(1)
+    assert rs.handles[0].routed_requests == 2  # followed the partial match
+    assert router.prefix_routed >= 1
+    assert router.predicted_hit_tokens > 0
+    # The prediction came true on the engine: admission staged the COW.
+    assert rs.handles[0].engine.prefix_cow_hits >= 1
+    # Reconcile keeps the tree honest: every surviving shadow-tree node
+    # is backed by a believed-resident key.
+    router.reconcile()
+    holder = rs.handles[0]
+    assert all(k in holder.shadow for k in holder.shadow_tree._nodes)
+
+
 def test_load_penalty_spills_cold_traffic_over(params):
     """With no cache signal, scoring degrades to load balancing: a
     loaded replica loses to an idle one."""

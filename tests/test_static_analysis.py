@@ -22,6 +22,7 @@ from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
 from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+from nos_tpu.analysis.checkers.radix_discipline import RadixDisciplineChecker
 from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
 from nos_tpu.analysis.checkers.device_placement import DevicePlacementChecker
 from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
@@ -400,6 +401,62 @@ def test_spill_discipline_real_engine_is_clean():
             os.path.join(TREE, "runtime", fname), [SpillDisciplineChecker()]
         )
         assert findings == [], fname
+
+
+# -- NOS017 radix-tree structure outside the tree classes ----------------------
+def test_radix_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "radix_pos.py"), [RadixDisciplineChecker()]
+    )
+    assert codes_of(findings) == ["NOS017"]
+    # Constructor assign, edge subscript assign, node-ref augassign,
+    # .pop on the key map, del on an edge, and the module-level
+    # .clear() — and NOT the len()/membership reads (no constructor
+    # exemption: tree structure existing outside the tree classes IS
+    # the finding).
+    assert len(findings) == 6
+    msgs = " | ".join(f.message for f in findings)
+    assert "_edges" in msgs
+    assert "_node_ref" in msgs
+    assert "_nodes" in msgs
+    assert all("RadixTree" in f.message for f in findings)
+
+
+def test_radix_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "radix_neg.py"), [RadixDisciplineChecker()]
+    )
+    assert findings == []
+
+
+def test_radix_discipline_scope_needs_runtime_or_serving_dir(tmp_path):
+    # The same mutation OUTSIDE a runtime/ or serving/ directory is out
+    # of scope — the rule guards the prefix cache's tree and its router
+    # shadow, not every dict named _nodes in the repo.
+    f = tmp_path / "tree_like.py"
+    f.write_text(
+        "class Engine:\n"
+        "    def grow(self, node, tokens, child):\n"
+        "        node._edges[tokens] = child\n"
+    )
+    assert run_checkers(str(f), [RadixDisciplineChecker()]) == []
+
+
+def test_radix_discipline_real_tree_is_clean():
+    # The tentpole's enforcement, checked directly: the BlockManager,
+    # the engine, and the router shadow all route tree surgery through
+    # RadixTree methods — mutation stays inside radix_tree.py.
+    for rel in (
+        ("runtime", "radix_tree.py"),
+        ("runtime", "block_manager.py"),
+        ("runtime", "decode_server.py"),
+        ("serving", "replica.py"),
+        ("serving", "router.py"),
+    ):
+        findings = run_checkers(
+            os.path.join(TREE, *rel), [RadixDisciplineChecker()]
+        )
+        assert findings == [], rel
 
 
 # -- NOS014 tracing event names / recorder state outside their APIs ------------
