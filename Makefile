@@ -61,6 +61,11 @@ bench:
 # budget flat with the mesh), fleet_pressure (bit-identical monitor
 # on/off, injected hot/starved transitions detected within one sampling
 # window, journal bounded + replayable, NOS_TPU_MONITOR_OVERHEAD_PCT),
+# fleet_failover (docs/robustness.md "Fleet failure domains": a replica
+# host killed mid-decode — supervisor-on replays checkpointed streams
+# bit-identically with goodput retention >= 0.9 and zero stranded
+# futures, supervisor-off strands them as the documented baseline;
+# failover latency p50/p95 reported, never wall-gated),
 # and multi_turn_chat (docs/radix-cache.md: cold/chain/tree arms
 # bit-identical greedy AND temperature, tree cached tokens >= 2x chain,
 # COW + output registration engaged, charged prefill down,

@@ -1144,6 +1144,208 @@ def _fleet_pressure(
     }
 
 
+def _fleet_failover(
+    np,
+    cfg,
+    params,
+    max_new: int = 24,
+    n_replicas: int = 3,
+    n_streams: int = 6,
+    kill_wave: int = 5,
+    max_waves: int = 600,
+) -> dict:
+    """Fleet failover A/B (ISSUE 14, docs/robustness.md "Fleet failure
+    domains"): identical traffic over a 3-replica fleet whose replica-0
+    host dies mid-decode, three arms —
+
+      - REFERENCE: fault-free supervised run (the bit-exactness oracle
+        and the goodput denominator);
+      - SUPERVISOR ON: consecutive probe failures walk the health
+        machine to DEAD, checkpointed streams replay onto survivors
+        (bit-identical to the reference), the rest resolve with a
+        classified ReplicaLostError — zero stranded futures;
+      - SUPERVISOR OFF (the documented baseline): nothing watches the
+        replica, so its in-flight streams STRAND — their futures never
+        resolve however long the survivors run.
+
+    Gates are counter/bit-exactness primary (outputs match reference,
+    goodput retention, stranded counts, zero dead-replica selections —
+    all noise-free); failover latency p50/p95 is the wall-clock
+    secondary, reported but tolerance-free (the PR 12 lesson: wall
+    gates flake on loaded CI, counters do not)."""
+    from nos_tpu import constants
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.serving import (
+        FleetSupervisor,
+        PrefixRouter,
+        ReplicaFaultInjector,
+        ReplicaSet,
+    )
+
+    srng = np.random.default_rng([2026, 14, 1])
+    prompts = [
+        srng.integers(1, cfg.vocab, 12).tolist() for _ in range(n_streams)
+    ]
+    victim = f"{constants.REPLICA_ID_PREFIX}0"
+    state = {"victim_idx": None, "kill_wave": None}
+
+    def build():
+        engines = [
+            DecodeServer(
+                params,
+                cfg,
+                n_slots=2,
+                max_len=64,
+                prompt_buckets=(8, 16),
+                steps_per_dispatch=2,
+                burst_windows=1,
+                block_size=8,
+                seed=11,
+            )
+            for _ in range(n_replicas)
+        ]
+        rs = ReplicaSet(engines)
+        return rs, PrefixRouter(rs)
+
+    def run(arm):
+        rs, router = build()
+        inj = ReplicaFaultInjector() if arm == "on" else None
+        sup = (
+            FleetSupervisor(
+                rs,
+                router,
+                suspect_after=2,
+                dead_after=3,
+                fault_injector=inj,
+                sleep=lambda s: None,
+            )
+            if arm != "off"
+            else None
+        )
+        submit = sup.submit if sup is not None else router.submit
+        futs = [submit(p, max_new=max_new) for p in prompts]
+        if arm == "on":
+            state["victim_idx"] = [
+                i
+                for i, f in enumerate(futs)
+                if id(f) in (sup._streams.get(victim) or {})
+            ]
+        victim_handle = rs.get(victim)
+        killed_at = None
+        dead_sel = None
+        waves = 0
+        while waves < max_waves:
+            waves += 1
+            for h in rs.handles:
+                if h.replica_id == victim and killed_at is not None:
+                    continue  # the host is dead: nobody ticks it
+                if (
+                    h.state == constants.REPLICA_STATE_ACTIVE
+                    and h.engine._thread is None
+                ):
+                    h.engine._tick()
+            if arm != "reference" and killed_at is None and waves >= kill_wave:
+                # ON: kill once every victim stream has a captured
+                # checkpoint (deterministic: the probe sweep captures
+                # passively each wave). OFF: kill at the wave the ON
+                # arm recorded, so both arms lose the same work.
+                if arm == "on":
+                    cks = sup._checkpoints.get(victim) or {}
+                    ready = all(
+                        id(f) in cks and len(cks[id(f)].generated) >= 1
+                        for i, f in enumerate(futs)
+                        if i in state["victim_idx"]
+                    )
+                    if ready:
+                        inj.kill(victim)
+                        killed_at = waves
+                        state["kill_wave"] = waves
+                elif state["kill_wave"] is not None and waves >= state["kill_wave"]:
+                    killed_at = waves
+            if sup is not None:
+                sup.probe()
+            if (
+                dead_sel is None
+                and victim_handle.health == constants.REPLICA_HEALTH_DEAD
+            ):
+                dead_sel = victim_handle.routed_requests
+            live = [
+                f
+                for i, f in enumerate(futs)
+                if arm != "off" or i not in (state["victim_idx"] or [])
+            ]
+            if all(f.done() for f in live):
+                break
+        completed = [
+            f.result(0) if f.done() and f.exception() is None else None
+            for f in futs
+        ]
+        survivors_conserved = all(
+            h.engine._block_mgr.conserved()
+            for h in rs.handles
+            if h.replica_id != victim
+        )
+        out = {
+            "arm": arm,
+            "waves": waves,
+            "completed": sum(1 for c in completed if c is not None),
+            "stranded_futures": sum(1 for f in futs if not f.done()),
+            "outputs": completed,
+            "survivors_conserved": survivors_conserved,
+            "router_selections_of_dead_after_detection": (
+                0
+                if dead_sel is None
+                else victim_handle.routed_requests - dead_sel
+            ),
+        }
+        if sup is not None:
+            rep = sup.report()
+            out.update(
+                {
+                    "replica_suspects": rep.replica_suspects,
+                    "replica_deaths": rep.replica_deaths,
+                    "failovers": rep.failovers,
+                    "futures_failed_over": rep.futures_failed_over,
+                    "futures_errored": rep.futures_errored,
+                    "failover_replay_tokens": rep.failover_replay_tokens,
+                    "failover_latency_p50_s": round(
+                        rep.failover_latency_p50_s, 6
+                    ),
+                    "failover_latency_p95_s": round(
+                        rep.failover_latency_p95_s, 6
+                    ),
+                }
+            )
+        rs.stop()
+        return out
+
+    ref = run("reference")
+    on = run("on")
+    off = run("off")
+    want = ref["outputs"]
+    on_match = all(
+        got is None or got == want[i] for i, got in enumerate(on["outputs"])
+    ) and all(got is not None for got in on["outputs"])
+    denom = float(n_streams)
+    artifact = {
+        "streams": n_streams,
+        "victim": victim,
+        "victim_streams": len(state["victim_idx"] or []),
+        "kill_wave": state["kill_wave"],
+        "reference": {"completed": ref["completed"], "waves": ref["waves"]},
+        "supervisor_on": {
+            **{k: v for k, v in on.items() if k not in ("outputs", "arm")},
+            "goodput_retention": round(on["completed"] / denom, 3),
+            "outputs_match_reference": bool(on_match),
+        },
+        "supervisor_off": {
+            **{k: v for k, v in off.items() if k not in ("outputs", "arm")},
+            "goodput_retention": round(off["completed"] / denom, 3),
+        },
+    }
+    return artifact
+
+
 def _decode_phase(jax, jnp) -> dict:
     """Driver-captured serving throughput (VERDICT r4 #3: the README's
     tok/s claims lived only in docs — now the artifact carries them).
@@ -1772,6 +1974,15 @@ def _decode_phase(jax, jnp) -> dict:
     # the input half of ROADMAP item 2's future autoscale A/B.
     out["fleet_pressure"] = _retry(
         "decode:fleet_pressure", lambda: _fleet_pressure(np, cfg, params)
+    )
+
+    # Fleet failover A/B (ISSUE 14, docs/robustness.md): a replica host
+    # killed mid-decode, supervisor on vs off on identical traffic —
+    # supervisor-on re-homes the checkpointed streams bit-identically
+    # (goodput retained), supervisor-off strands them (the documented
+    # baseline); failover latency tails ride along.
+    out["fleet_failover"] = _retry(
+        "decode:fleet_failover", lambda: _fleet_failover(np, cfg, params)
     )
 
     # Multi-turn chat A/B (ISSUE 13, docs/radix-cache.md): zipf tenants

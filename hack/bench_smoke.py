@@ -61,6 +61,21 @@ gates:
   - monitor overhead within NOS_TPU_MONITOR_OVERHEAD_PCT (default 3%),
     measured with the same noise-robust best-of/corroborated method.
 
+ISSUE 14 adds the `fleet_failover` A/B (a replica host killed
+mid-decode; supervisor on vs off on identical traffic,
+docs/robustness.md "Fleet failure domains") with its own gates, all
+counter/bit-exactness primary per the PR 12 noise lesson (the failover
+latency tails are REPORTED, never gated on wall clock):
+
+  - supervisor-on outputs match the fault-free reference BIT-IDENTICALLY
+    (checkpointed streams replayed onto survivors) with ZERO stranded
+    futures and goodput retention >= 0.9;
+  - supervisor-off strands the killed replica's streams (the documented
+    baseline: stranded > 0, retention strictly below the on arm);
+  - the router issues zero selections of the replica after it is marked
+    dead; pool conservation holds on every survivor;
+  - failover latency p50/p95 present in the artifact.
+
 ISSUE 13 adds the `multi_turn_chat` A/B (zipf tenants x growing
 histories x mid-block divergence; cold vs flat-chain vs radix-tree
 prefix cache, docs/radix-cache.md) with its own gates:
@@ -288,6 +303,52 @@ def main() -> int:
             f"{fleet_parsed['wall_noise_pct']}%)"
         )
 
+    # -- ISSUE 14: fleet failover (supervisor on vs off) -------------------
+    failover = bench._fleet_failover(np, cfg, params)
+    failover_payload = json.dumps(failover, sort_keys=True)
+    failover_parsed = json.loads(failover_payload)
+    print(failover_payload)
+
+    fo_on = failover_parsed["supervisor_on"]
+    fo_off = failover_parsed["supervisor_off"]
+    if not fo_on["outputs_match_reference"]:
+        failures.append(
+            "fleet_failover: supervisor-on outputs diverge from the "
+            "fault-free reference (failover replay not bit-identical)"
+        )
+    if fo_on["stranded_futures"]:
+        failures.append(
+            f"fleet_failover: supervisor-on stranded "
+            f"{fo_on['stranded_futures']} future(s)"
+        )
+    if fo_on["goodput_retention"] < 0.9:
+        failures.append(
+            f"fleet_failover: supervisor-on goodput retention "
+            f"{fo_on['goodput_retention']} < 0.9"
+        )
+    if not fo_off["stranded_futures"]:
+        failures.append(
+            "fleet_failover: supervisor-off baseline stranded nothing "
+            "(the kill never cost the unsupervised fleet)"
+        )
+    if fo_off["goodput_retention"] >= fo_on["goodput_retention"]:
+        failures.append(
+            f"fleet_failover: off-arm retention {fo_off['goodput_retention']}"
+            f" did not trail on-arm {fo_on['goodput_retention']}"
+        )
+    if fo_on["router_selections_of_dead_after_detection"]:
+        failures.append(
+            "fleet_failover: router selected the dead replica after "
+            "detection"
+        )
+    if not fo_on["survivors_conserved"]:
+        failures.append("fleet_failover: survivor pool conservation violated")
+    if not fo_on["failovers"]:
+        failures.append("fleet_failover: no stream actually failed over")
+    for key in ("failover_latency_p50_s", "failover_latency_p95_s"):
+        if key not in fo_on:
+            failures.append(f"fleet_failover: artifact missing {key}")
+
     # -- ISSUE 13: the radix-tree multi-turn chat A/B ----------------------
     chat = bench._multi_turn_chat(np, cfg, params)
     chat_payload = json.dumps(chat, sort_keys=True)
@@ -369,7 +430,12 @@ def main() -> int:
         f"w{fleet_parsed['starved']['detected_window']}, monitor overhead "
         f"{fleet_parsed['monitor_overhead_pct']:.2f}%, journal "
         f"{fleet_parsed['journal']['lines']} lines, "
-        f"{fleet_parsed['windows_sampled']} windows; multi-turn chat: "
+        f"{fleet_parsed['windows_sampled']} windows; fleet failover: "
+        f"retention {fo_off['goodput_retention']} off -> "
+        f"{fo_on['goodput_retention']} on ({fo_on['failovers']} failovers, "
+        f"{fo_off['stranded_futures']} stranded off-arm, latency p50/p95 "
+        f"{fo_on['failover_latency_p50_s']}/"
+        f"{fo_on['failover_latency_p95_s']}s); multi-turn chat: "
         + ", ".join(
             f"{tkey} cached {arm['chain']['cached_tokens']} -> "
             f"{arm['tree']['cached_tokens']} tok "

@@ -10,14 +10,25 @@ it *looks* handled, NOS003 is satisfied by the log call, and the taxonomy
 never sees the error. That drift is invisible in tests that don't inject
 faults, which is exactly why it gets its own checker.
 
-Scope: files under `runtime/` containing an engine-loop class (a class
-defining `_tick` or `_run`). Flagged regions are those classes' methods
-reachable from the `_tick`/`_run` roots via `self.method()` calls (the
-NOS010 reachability). In scope, a handler for Exception/BaseException must
-show the error is ROUTED, not just observed: a `raise` (re-raise or
-escalation), or a call into the taxonomy/recovery machinery
-(`classify_fault`, `poison_slot_of`, `self._recover(...)`). Narrow
-handlers (`except RuntimeError:` around a checkpoint materialization)
+Scope, two tiers:
+
+  - files under `runtime/` containing an engine-loop class (a class
+    defining `_tick` or `_run`): those classes' methods reachable from
+    the `_tick`/`_run` roots via `self.method()` calls (the NOS010
+    reachability);
+  - EVERY function in `nos_tpu/serving/` (the fleet plane): the fleet
+    loops — monitor sampling, supervisor probe sweeps, drain/failover
+    re-homing, router scoring — are all cross-replica interaction
+    paths, and a swallowed error there hides a replica death instead of
+    reporting it (the monitor.py:738 lesson: the thread-level backstop
+    masked every probe failure as a log line).
+
+In scope, a handler for Exception/BaseException must show the error is
+ROUTED, not just observed: a `raise` (re-raise or escalation), or a call
+into the taxonomy/recovery/supervision machinery (`classify_fault`,
+`poison_slot_of`, `self._recover(...)`, `supervised_call`). Narrow
+handlers (`except RuntimeError:` around a checkpoint materialization,
+`except ReplicaUnreachableError:` in a failover loop)
 remain deliberate control flow; bare `except:` stays NOS004's.
 Deliberately-unclassified last-resort backstops carry an inline
 `# nos-lint: ignore[NOS012]` with a rationale.
@@ -32,7 +43,13 @@ from nos_tpu.analysis.core import Checker, FileContext, Report
 from nos_tpu.analysis.checkers.exception_hygiene import _is_broad
 from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 
-_ROUTERS = {"classify_fault", "poison_slot_of", "_recover", "recover"}
+_ROUTERS = {
+    "classify_fault",
+    "poison_slot_of",
+    "_recover",
+    "recover",
+    "supervised_call",
+}
 
 
 def _routes_through_taxonomy(handler: ast.ExceptHandler) -> bool:
@@ -58,8 +75,17 @@ class FaultDisciplineChecker(Checker):
         self._scope_funcs: Set[ast.AST] = set()
 
     def begin_file(self, ctx: FileContext) -> None:
-        self._active = "runtime" in ctx.segments[:-1]
+        segments = ctx.segments[:-1]
         self._scope_funcs = set()
+        if "serving" in segments:
+            # Fleet-plane tier: the whole package is cross-replica
+            # interaction surface — every function is in scope.
+            self._active = True
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._scope_funcs.add(node)
+            return
+        self._active = "runtime" in segments
         if not self._active:
             return
         found_engine = False

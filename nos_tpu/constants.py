@@ -194,9 +194,26 @@ REPLICA_STATES = (
     REPLICA_STATE_DRAINING,
     REPLICA_STATE_RETIRED,
 )
+# Replica HEALTH states (serving/supervisor.py) — a second axis beside
+# the drain lifecycle above: lifecycle is what the operator ASKED of the
+# replica (drain it, retire it), health is what probing OBSERVED of it
+# (answering, flaking, gone). The supervisor drives health active ->
+# suspect (K consecutive probe failures — point blips never demote) ->
+# dead (failover fires), and back suspect -> active only after a FULL
+# healthy window (no flapping). Suspect and dead replicas are excluded
+# from router placement.
+REPLICA_HEALTH_ACTIVE = "active"
+REPLICA_HEALTH_SUSPECT = "suspect"
+REPLICA_HEALTH_DEAD = "dead"
+REPLICA_HEALTH_STATES = (
+    REPLICA_HEALTH_ACTIVE,
+    REPLICA_HEALTH_SUSPECT,
+    REPLICA_HEALTH_DEAD,
+)
 # Replica snapshot keys (ReplicaHandle.snapshot() / fleet telemetry rows).
 REPLICA_KEY_ID = "replica_id"
 REPLICA_KEY_STATE = "state"
+REPLICA_KEY_HEALTH = "health"
 REPLICA_KEY_SHADOW_KEYS = "shadow_keys"
 REPLICA_KEY_ROUTED_REQUESTS = "routed_requests"
 # Engine load-probe keys (DecodeServer.probe() -> router scoring).
@@ -231,11 +248,17 @@ PRESSURE_REPLICA_HOT = "hot"          # saturated AND work is waiting
 PRESSURE_REPLICA_OK = "ok"            # serving within capacity
 PRESSURE_REPLICA_IDLE = "idle"        # no slots, no queue, no tokens
 PRESSURE_REPLICA_DRAINING = "draining"  # lifecycle: not admitting
+# A probe raised or timed out this window: the replica's state is
+# UNKNOWN, not zero — its capacity must neither count toward headroom
+# nor freeze at its last value (serving/monitor.py unreachable
+# handling; the supervisor's health machine consumes the same signal).
+PRESSURE_REPLICA_UNREACHABLE = "unreachable"
 PRESSURE_REPLICA_STATES = (
     PRESSURE_REPLICA_HOT,
     PRESSURE_REPLICA_OK,
     PRESSURE_REPLICA_IDLE,
     PRESSURE_REPLICA_DRAINING,
+    PRESSURE_REPLICA_UNREACHABLE,
 )
 # Per-tenant pressure verdicts (PressureReport.tenants).
 PRESSURE_TENANT_STARVED = "starved"      # under its guarantee with work waiting
@@ -252,11 +275,23 @@ FLEET_EV_WINDOW = "fleet.window"    # one sampling window's journal line
 FLEET_EV_FREEZE = "fleet.freeze"    # journal frozen on an engine recovery
 SLO_EV_BREACH = "slo.breach"        # sustained K-of-N breach began
 SLO_EV_RECOVER = "slo.recover"      # sustained breach cleared
+# Fleet failure-domain events (serving/supervisor.py + the monitor's
+# unreachable handling, docs/robustness.md "Fleet failure domains").
+FLEET_EV_UNREACHABLE = "fleet.unreachable"  # a probe raised/timed out
+FLEET_EV_SUSPECT = "fleet.suspect"          # health active -> suspect
+FLEET_EV_RECOVERED = "fleet.recovered"      # health suspect -> active
+FLEET_EV_DEATH = "fleet.death"              # health -> dead, failover fires
+FLEET_EV_FAILOVER = "fleet.failover"        # one stream re-homed/resolved
 FLEET_EVENTS = (
     FLEET_EV_WINDOW,
     FLEET_EV_FREEZE,
     SLO_EV_BREACH,
     SLO_EV_RECOVER,
+    FLEET_EV_UNREACHABLE,
+    FLEET_EV_SUSPECT,
+    FLEET_EV_RECOVERED,
+    FLEET_EV_DEATH,
+    FLEET_EV_FAILOVER,
 )
 # Engine per-tenant probe keys (DecodeServer.tenant_probe() — plain
 # host-side reads the monitor converts into windowed per-tenant rates).
@@ -297,6 +332,10 @@ TRACE_EV_SPILL = "req.spill"
 TRACE_EV_REVIVE = "req.revive"
 TRACE_EV_RESTORE = "req.restore"
 TRACE_EV_DRAIN_MIGRATE = "req.drain_migrate"
+# Fleet failover (serving/supervisor.py): the stream's replica died and
+# its last checkpoint replayed onto a survivor — one trace id survives
+# replica death exactly as it survives device-lost.
+TRACE_EV_FAILOVER = "req.failover"
 # Radix COW (PR 13): a diverging block's shared head copied into the
 # request's private page instead of recomputed.
 TRACE_EV_COW = "req.cow"
@@ -313,6 +352,7 @@ TRACE_EVENTS = (
     TRACE_EV_REVIVE,
     TRACE_EV_RESTORE,
     TRACE_EV_DRAIN_MIGRATE,
+    TRACE_EV_FAILOVER,
     TRACE_EV_COW,
 )
 

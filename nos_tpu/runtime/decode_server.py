@@ -308,6 +308,7 @@ class DecodeServer:
         surgical_recovery: bool = True,
         max_transient_retries: int = 4,
         transient_backoff_s: float = 0.02,
+        checkpoint_hook=None,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
         softmax sampling with a deterministic per-slot, per-step PRNG stream
@@ -552,7 +553,18 @@ class DecodeServer:
         `fault_injector` (optional, runtime/faults.py FaultInjector)
         threads deterministic chaos through the engine's named dispatch
         sites — test/benchmark machinery, never enabled in production
-        serving."""
+        serving.
+
+        `checkpoint_hook` (optional, default None = zero cost) is the
+        fleet supervisor's periodic capture seam
+        (nos_tpu/serving/supervisor.py): called with
+        `checkpoint_snapshot()`'s passive checkpoint list at every
+        FUSED-BURST boundary — the natural cheap cadence, since a burst
+        boundary is already a host crossing and the previous burst's
+        token refs are materializable there. The hook must only READ the
+        checkpoints (they alias live Futures); it never changes engine
+        behavior — outputs and dispatch counters are bit-identical hook
+        armed vs not."""
         # Tensor-parallel serving (docs/sharded-decode.md): a mesh whose
         # tp axis is wider than 1 arms sharded decode — params placed by
         # the decode rules, pool head-partitioned, every program
@@ -645,6 +657,7 @@ class DecodeServer:
         self.prefix_cache = bool(prefix_cache)
         self.radix_cache = bool(radix_cache) and self.prefix_cache
         self._fault_injector = fault_injector
+        self._checkpoint_hook = checkpoint_hook
         # Tracing bundle (nos_tpu/tracing.py): tracer/recorder hooks are
         # None-guarded; the profiler is a per-engine disabled instance
         # when tracing is off, so the tick path stays branch-light.
@@ -1413,6 +1426,123 @@ class DecodeServer:
         if not self._block_mgr.conserved():
             raise RuntimeError("pool conservation violated during drain")
         return checkpoints, pending
+
+    def checkpoint_snapshot(self) -> List[SlotCheckpoint]:
+        """PASSIVE checkpoint capture of every active, unresolved slot —
+        the fleet supervisor's periodic failover substrate
+        (nos_tpu/serving/supervisor.py). Unlike `_checkpoint_slot` (the
+        recovery path), this capture never blocks and never resolves a
+        future: only token refs ALREADY materializable on the host are
+        read (readiness-probed; the first unready or dead buffer ends
+        the run), and a capture that happens to reach eos/budget is
+        simply truncated there. Any PREFIX of a stream is a valid
+        checkpoint — the replay regenerates everything past the capture
+        point bit-identically (the PR 6 replay-exactness argument), so
+        a stale snapshot costs replay tokens, never correctness. The
+        returned checkpoints alias the live client Futures: a failover
+        resolves the original caller."""
+        out: List[SlotCheckpoint] = []
+        for idx, slot in enumerate(self._slots):
+            if not slot.active or slot.future is None or slot.future.done():
+                continue
+            if slot.request_prompt is None:
+                continue
+            tokens: List[int] = list(slot.replay)
+            for ref, lane, row in slot.refs:
+                if not ref.is_ready():
+                    break
+                try:
+                    tokens.append(self._token_at(ref, lane, row))
+                except RuntimeError:
+                    break
+            # Truncate STRICTLY BEFORE eos/budget so the capture never
+            # completes the request: a restored checkpoint then always
+            # takes the uniform replay path on its destination and the
+            # DESTINATION regenerates the terminal token(s)
+            # bit-identically — the failover never has to resolve a
+            # future out-of-band.
+            if self.eos_id is not None and self.eos_id in tokens:
+                tokens = tokens[: tokens.index(self.eos_id)]
+            tokens = tokens[: max(0, slot.max_new - 1)]
+            spec = (
+                slot.adapt.snapshot(len(tokens))
+                if slot.adapt is not None
+                else None
+            )
+            out.append(
+                SlotCheckpoint(
+                    prompt=list(slot.request_prompt),
+                    generated=tokens,
+                    max_new=slot.max_new,
+                    serial=int(self._slot_serial[idx]),
+                    t_submit=slot.t_submit,
+                    prefill_cursor=slot.prefill_cursor,
+                    spec=spec,
+                    tenant=slot.tenant,
+                    trace_id=slot.trace_id,
+                    future=slot.future,
+                )
+            )
+        return out
+
+    def set_checkpoint_hook(self, hook) -> None:
+        """Arm (or, with None, disarm) the burst-boundary checkpoint
+        hook post-construction — the fleet supervisor attaches to an
+        already-built fleet. Same contract as the constructor param:
+        the hook only READS the passive checkpoints."""
+        self._checkpoint_hook = hook
+
+    def forsake(self) -> List[Future]:
+        """Disown every outstanding Future WITHOUT resolving it: the
+        fleet supervisor has taken ownership of this replica's streams
+        (failover re-homed or error-resolved each one), so the
+        subsequent `stop()`/`ReplicaSet.retire` must not fail them a
+        second time — `set_exception` on a future a survivor is about
+        to resolve would kill a stream the failover just saved. Closes
+        admission, stops the loop thread if one is attached, clears
+        every queue/slot/accepted reference, and returns the disowned
+        (still-unresolved) futures for observability."""
+        self._closed.set()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        disowned: List[Future] = []
+        for slot in self._slots:
+            if slot.future is not None and not slot.future.done():
+                disowned.append(slot.future)
+            slot.future = None
+        while self._waiting:
+            req = self._waiting.popleft()
+            if not req.future.done():
+                disowned.append(req.future)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                disowned.append(req.future)
+        self._inflight.clear()
+        self._pending_verifies.clear()
+        with self._accept_lock:
+            self._accepted = []
+        return disowned
+
+    def reopen(self) -> None:
+        """Reverse the admission close after an extraction whose
+        re-home FAILED (serving/drain.py destination-failure rollback):
+        `drain_extract` left the engine stopped, empty, and conserved,
+        so clearing the stop/closed latches makes it a valid (cold)
+        destination again — the rolled-back checkpoints transfer back
+        in and the caller resumes ticking (or `start()`s a fresh loop
+        thread). Only legal on an engine whose loop thread has exited."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "reopen() on an engine whose loop thread is still attached"
+            )
+        self._stop.clear()
+        self._closed.clear()
 
     def _fail_outstanding(self, exc: Exception) -> None:
         for idx, slot in enumerate(self._slots):
@@ -3069,6 +3199,11 @@ class DecodeServer:
             self._finish_if_done(idx)
         while len(self._inflight) > self.pipeline_depth:
             self._inflight.popleft().np()
+        if self._checkpoint_hook is not None:
+            # Burst boundaries are the supervisor's cheap periodic
+            # capture cadence: the host is already crossing, and every
+            # ref dispatched BEFORE this burst is materializable.
+            self._checkpoint_hook(self.checkpoint_snapshot())
 
     def _dispatch_macro(self, idxs: List[int]) -> None:
         """One K-step macro dispatch for the non-drafting active slots.

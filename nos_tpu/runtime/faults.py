@@ -19,9 +19,20 @@ that:
     still materialize, reallocates the pool, and re-admits the
     checkpoints through the normal admission queue.
 
-``classify_fault`` maps ANY exception into one of the three kinds:
-explicit taxonomy types (directly or anywhere on the ``__cause__``/
-``__context__`` chain) pass through; runtime errors whose message matches
+The FLEET plane (nos_tpu/serving/supervisor.py) extends the taxonomy one
+scope up with ``ReplicaUnreachableError`` (a cross-replica call raised or
+timed out — the replica boundary failed, not this process) and
+``ReplicaLostError`` (a stream's replica died with no checkpoint; the
+error carries the request for client resubmit). They are EngineFault
+subclasses with their own kinds, so ``classify_fault`` surfaces them
+through the same cause/context walk — but they are deliberately NOT in
+``FAULT_KINDS``: the per-engine injector draws schedules from that
+tuple, and widening it would move every pinned chaos schedule.
+
+``classify_fault`` maps ANY exception into a fault kind: explicit
+taxonomy types (directly or anywhere on the ``__cause__``/
+``__context__`` chain) pass through with their own kind — the fleet
+kinds included; runtime errors whose message matches
 a known transient-transport marker classify transient; everything else is
 conservatively DEVICE-LOST — with checkpoint/restore, "rebuild the pool
 and replay" is the safe default, unlike the old "fail everyone".
@@ -48,7 +59,20 @@ FAULT_POISON = "poison"
 FAULT_TRANSIENT = "transient"
 FAULT_DEVICE_LOST = "device-lost"
 
+#: The ENGINE-scope kinds the per-engine injector/recovery loop knows.
+#: Deliberately unchanged by the fleet extension below: `seeded()`
+#: draws from this tuple, and widening it would move every pinned
+#: 7-seed chaos schedule.
 FAULT_KINDS = (FAULT_POISON, FAULT_TRANSIENT, FAULT_DEVICE_LOST)
+
+# Fleet-scope kinds (serving/supervisor.py, docs/robustness.md "Fleet
+# failure domains"): faults of the REPLICA BOUNDARY, not the device —
+# a probe/submit/transfer that raised or timed out (unreachable), and
+# a stream whose replica died with no checkpoint to fail over
+# (replica-lost, the classified terminal error a client can act on).
+FAULT_REPLICA_UNREACHABLE = "replica-unreachable"
+FAULT_REPLICA_LOST = "replica-lost"
+FLEET_FAULT_KINDS = (FAULT_REPLICA_UNREACHABLE, FAULT_REPLICA_LOST)
 
 # Message fragments that identify a transport-level flake (the remote
 # dispatch tunnel's observed failure modes — bench.py's retry rationale).
@@ -97,6 +121,56 @@ class TransientDispatchError(EngineFault):
 
 class DeviceLostError(EngineFault):
     kind = FAULT_DEVICE_LOST
+
+
+class ReplicaUnreachableError(EngineFault):
+    """A cross-replica call (probe / submit / transfer_in /
+    drain_extract / reconcile) raised or timed out after its retry
+    budget: the REPLICA boundary failed, not this process. Carries the
+    replica id and call site so the supervisor's health machine and the
+    monitor's unreachable rows can attribute it. `classify_fault`
+    surfaces the fleet kind through the same cause/context walk as the
+    engine kinds — a broad fleet-loop handler routes it like any other
+    taxonomy member (NOS012, serving scope)."""
+
+    kind = FAULT_REPLICA_UNREACHABLE
+
+    def __init__(
+        self,
+        message: str = "",
+        site: Optional[str] = None,
+        replica: Optional[str] = None,
+    ):
+        super().__init__(message, site)
+        self.replica = replica
+
+
+class ReplicaLostError(EngineFault):
+    """Terminal classification of a stream whose replica DIED with no
+    checkpoint to fail over from: the future resolves with this error —
+    never a silent hang — and the error CARRIES the original request
+    (prompt/max_new/tenant/trace_id) so the client can resubmit without
+    re-deriving anything. Streams with a checkpoint never see this:
+    they replay onto a survivor bit-identically instead."""
+
+    kind = FAULT_REPLICA_LOST
+
+    def __init__(
+        self,
+        message: str = "",
+        site: Optional[str] = None,
+        replica: Optional[str] = None,
+        prompt: Optional[Sequence[int]] = None,
+        max_new: Optional[int] = None,
+        tenant: Optional[str] = None,
+        trace_id: Optional[str] = None,
+    ):
+        super().__init__(message, site)
+        self.replica = replica
+        self.prompt = list(prompt) if prompt is not None else None
+        self.max_new = max_new
+        self.tenant = tenant
+        self.trace_id = trace_id
 
 
 def _taxonomy_instance(exc: BaseException) -> Optional[EngineFault]:

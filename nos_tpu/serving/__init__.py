@@ -11,7 +11,12 @@ checkpoint/spill substrate, and a `FleetMonitor` (docs/fleet-monitor.md)
 watches the whole fleet continuously — windowed rates, per-tenant SLO
 tracking, and the planner-ready `PressureReport` the item-2 autoscale
 loop will consume — admission, routing, capacity replanning, and
-pressure observation as one system.
+pressure observation as one system. A `FleetSupervisor`
+(docs/robustness.md "Fleet failure domains") wraps every cross-replica
+call in a guarded wrapper, drives the per-replica health machine
+(active -> suspect -> dead), and fails a dead replica's in-flight
+streams over onto survivors — checkpointed streams replay
+bit-identically, the rest resolve with a classified `ReplicaLostError`.
 """
 
 from nos_tpu.serving.drain import (  # noqa: F401
@@ -27,3 +32,9 @@ from nos_tpu.serving.monitor import (  # noqa: F401
 )
 from nos_tpu.serving.replica import ReplicaHandle, ReplicaSet  # noqa: F401
 from nos_tpu.serving.router import PrefixRouter  # noqa: F401
+from nos_tpu.serving.supervisor import (  # noqa: F401
+    FailoverReport,
+    FleetSupervisor,
+    ReplicaFaultInjector,
+    ReplicaFaultSpec,
+)

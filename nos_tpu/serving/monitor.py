@@ -71,6 +71,7 @@ from dataclasses import asdict, dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from nos_tpu import constants
+from nos_tpu.runtime.faults import classify_fault
 from nos_tpu.telemetry import (
     collect_serving,
     percentile,
@@ -99,10 +100,13 @@ PER_REPLICA_GAUGES = (
 def classify_replica(row: Dict[str, object]) -> str:
     """Pressure verdict for one replica window row. A pure function of
     the journaled fields, so `replay` re-derives exactly what `sample`
-    concluded: DRAINING when the lifecycle says so, HOT when the replica
-    is slot-saturated AND work is waiting it cannot host, IDLE when the
-    window moved no tokens with nothing admitted or queued, OK
-    otherwise."""
+    concluded: UNREACHABLE when the window's probe raised/timed out
+    (`probe_error` carries the classified fault kind), DRAINING when
+    the lifecycle says so, HOT when the replica is slot-saturated AND
+    work is waiting it cannot host, IDLE when the window moved no
+    tokens with nothing admitted or queued, OK otherwise."""
+    if row.get("probe_error"):
+        return constants.PRESSURE_REPLICA_UNREACHABLE
     if (
         row.get(constants.PROBE_KEY_DRAINING)
         or row.get("lifecycle") != constants.REPLICA_STATE_ACTIVE
@@ -151,7 +155,13 @@ def fleet_headroom(replica_rows: Dict[str, Dict[str, object]]) -> Dict[str, obje
     slots_free = slots_total = kv_free = kv_total = 0
     active = 0
     for row in replica_rows.values():
-        if row.get("pressure") == constants.PRESSURE_REPLICA_DRAINING:
+        if row.get("pressure") in (
+            constants.PRESSURE_REPLICA_DRAINING,
+            # Unknown is not zero, but it is not capacity either: an
+            # unreachable replica must not count toward headroom the
+            # planner would spend.
+            constants.PRESSURE_REPLICA_UNREACHABLE,
+        ):
             continue
         active += 1
         st = int(row.get("slots_total", 0) or 0)
@@ -388,6 +398,43 @@ class FleetMonitor:
             self.sample_wall_s += time.perf_counter() - t0
         return report
 
+    def _unreachable_row_locked(
+        self, rid: str, handle, now: float, kind: str
+    ) -> Dict[str, object]:
+        """The window row of a replica whose probe raised/timed out:
+        every rate and gauge zero (unknown, and deliberately not
+        counted as capacity — see `fleet_headroom`), `probe_error`
+        carrying the classified kind so `classify_replica` — live and
+        on replay — derives the UNREACHABLE verdict from the row
+        alone."""
+        row: Dict[str, object] = {
+            "replica_id": rid,
+            "lifecycle": handle.state,
+            "t": now,
+            "dt_s": 0.0,
+            "probe_error": kind,
+            "tokens": 0,
+            "prefill_tokens": 0,
+            "admissions": 0,
+            "recoveries": 0,
+            "tok_s": 0.0,
+            "prefill_tok_s": 0.0,
+            "admissions_s": 0.0,
+            "spills_s": 0.0,
+            "revives_s": 0.0,
+            "recoveries_s": 0.0,
+            "preemptions_s": 0.0,
+            "queue_depth": 0,
+            "slots_active": 0,
+            "slots_total": 0,
+            "prefill_backlog": 0,
+            "kv_blocks_free": 0,
+            "kv_blocks_total": 0,
+            constants.PROBE_KEY_DRAINING: False,
+        }
+        row["pressure"] = classify_replica(row)
+        return row
+
     def _sample_locked(self, now: Optional[float]) -> PressureReport:
         now = float(self._clock() if now is None else now)
         self.windows_sampled += 1
@@ -418,11 +465,49 @@ class FleetMonitor:
                 self._drop_replica_locked(rid)
                 continue
             engine = handle.engine
-            report = collect_serving(engine)
-            probe = engine.probe()
-            tprobe = (
-                engine.tenant_probe() if hasattr(engine, "tenant_probe") else {}
-            )
+            try:
+                report = collect_serving(engine)
+                probe = engine.probe()
+                tprobe = (
+                    engine.tenant_probe()
+                    if hasattr(engine, "tenant_probe")
+                    else {}
+                )
+            except Exception as exc:
+                # An unreachable replica must not be silently swallowed
+                # (the old thread-level backstop hid the death) NOR take
+                # the rest of the fleet's window down with it: classify
+                # the fault, emit an UNREACHABLE row (one-hot state
+                # gauge included via the normal publish path), journal
+                # the event, and keep sampling the other replicas. The
+                # cumulative baselines are KEPT so a replica that comes
+                # back diffs against its last good sample.
+                kind = classify_fault(exc)
+                row = self._unreachable_row_locked(rid, handle, now, kind)
+                replica_rows[rid] = row
+                self._rings.setdefault(
+                    rid, deque(maxlen=self.max_windows)
+                ).append(row)
+                self._journal.append(
+                    json.dumps(
+                        {
+                            "v": 1,
+                            "event": constants.FLEET_EV_UNREACHABLE,
+                            "window": window,
+                            "t": now,
+                            "replica": rid,
+                            "kind": kind,
+                        },
+                        sort_keys=True,
+                    )
+                )
+                logger.warning(
+                    "fleet monitor: probe of %s failed (%s); marked "
+                    "unreachable for this window",
+                    rid,
+                    kind,
+                )
+                continue
             prev = self._prev_report.get(rid)
             prev_t = self._prev_t.get(rid)
             dt = max(0.0, now - prev_t) if prev_t is not None else 0.0
@@ -735,8 +820,15 @@ class FleetMonitor:
         while not self._stop_ev.wait(self.interval_s):
             try:
                 self.sample()
-            except Exception:  # noqa: BLE001 — monitor must never kill serving
-                logger.exception("fleet monitor sample failed")
+            except Exception as exc:
+                # Last-resort backstop: per-replica probe failures are
+                # already handled INSIDE `sample()` (unreachable rows),
+                # so only monitor-internal faults land here — classify
+                # them like every other fleet-loop error instead of
+                # hiding the death behind a bare log line.
+                logger.exception(
+                    "fleet monitor sample failed (%s)", classify_fault(exc)
+                )
 
     def stop(self) -> None:
         self._stop_ev.set()

@@ -61,9 +61,14 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional, Sequence
 
+import logging
+
 from nos_tpu import constants
+from nos_tpu.runtime.faults import classify_fault
 from nos_tpu.runtime.radix_tree import RadixTree
 from nos_tpu.telemetry import ServingReport, collect_serving
+
+logger = logging.getLogger(__name__)
 
 
 class ReplicaHandle:
@@ -77,6 +82,14 @@ class ReplicaHandle:
         self.replica_id = replica_id
         self.engine = engine
         self.state = constants.REPLICA_STATE_ACTIVE
+        #: Health axis (serving/supervisor.py, docs/robustness.md):
+        #: what PROBING observed of the replica, beside the lifecycle
+        #: axis above (what the operator asked of it). active ->
+        #: suspect (K consecutive probe failures) -> dead (failover);
+        #: suspect -> active only after a full healthy window. Without
+        #: a supervisor it stays `active` forever — the pre-supervisor
+        #: fleet byte-for-byte.
+        self.health = constants.REPLICA_HEALTH_ACTIVE
         #: Router-side shadow of the replica's content-addressed prefix
         #: index: chain keys believed resident (device or host tier).
         self.shadow: set = set()
@@ -90,8 +103,15 @@ class ReplicaHandle:
 
     @property
     def admitting(self) -> bool:
-        """Whether the router may place new work here."""
-        return self.state == constants.REPLICA_STATE_ACTIVE
+        """Whether the router may place new work here: lifecycle ACTIVE
+        *and* health ACTIVE — a suspect replica is excluded from
+        placement until it clears a full healthy probe window, a dead
+        one forever (the router-never-selects-a-dead-replica half of
+        the failover contract)."""
+        return (
+            self.state == constants.REPLICA_STATE_ACTIVE
+            and self.health == constants.REPLICA_HEALTH_ACTIVE
+        )
 
     def probe(self) -> Dict[str, object]:
         """The engine's load snapshot (constants.PROBE_KEY_*)."""
@@ -156,13 +176,22 @@ class ReplicaHandle:
         self.shadow_tree.sweep(lambda key: key in self.shadow)
 
     def snapshot(self) -> Dict[str, object]:
-        """Wire-format view of the replica for fleet telemetry."""
+        """Wire-format view of the replica for fleet telemetry. An
+        unreachable engine's probe must not take the whole fleet
+        snapshot down with it: the failure classifies through the fault
+        taxonomy and the row carries `probe_error` instead of load
+        keys — identity and state always report."""
+        try:
+            probe = self.probe()
+        except Exception as exc:
+            probe = {"probe_error": classify_fault(exc)}
         return {
             constants.REPLICA_KEY_ID: self.replica_id,
             constants.REPLICA_KEY_STATE: self.state,
+            constants.REPLICA_KEY_HEALTH: self.health,
             constants.REPLICA_KEY_SHADOW_KEYS: len(self.shadow),
             constants.REPLICA_KEY_ROUTED_REQUESTS: self.routed_requests,
-            **self.probe(),
+            **probe,
         }
 
 
@@ -249,7 +278,18 @@ class ReplicaSet:
         /metrics, not freeze at its last value."""
         handle = self.get(replica_id)
         if handle.state != constants.REPLICA_STATE_RETIRED:
-            handle.engine.stop()
+            try:
+                handle.engine.stop()
+            except Exception as exc:
+                # A DEAD replica's stop may itself be unreachable; the
+                # retirement (and its gauge hygiene) must proceed
+                # anyway — the supervisor already took ownership of the
+                # streams (forsake/failover) before retiring it.
+                logger.warning(
+                    "retire(%s): engine.stop failed (%s); retiring anyway",
+                    replica_id,
+                    classify_fault(exc),
+                )
             handle.state = constants.REPLICA_STATE_RETIRED
         return handle
 

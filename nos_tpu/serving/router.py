@@ -49,9 +49,14 @@ import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import logging
+
 from nos_tpu import constants
 from nos_tpu.runtime.block_manager import cacheable_block_cap, prompt_chain_keys
+from nos_tpu.runtime.faults import classify_fault
 from nos_tpu.serving.replica import ReplicaHandle, ReplicaSet
+
+logger = logging.getLogger(__name__)
 
 
 class PrefixRouter:
@@ -135,12 +140,14 @@ class PrefixRouter:
         self,
         prompt: Sequence[int],
         tenant: Optional[str] = None,
-        exclude: Optional[ReplicaHandle] = None,
+        exclude=None,
     ) -> ReplicaHandle:
         """Pick (and account) the destination replica for `prompt`
         without submitting — the placement half of `submit`, also used
-        by the drain controller to re-home extracted work (`exclude`
-        masks the draining source even before its state flips)."""
+        by the drain controller and the fleet supervisor to re-home
+        extracted/failed-over work. `exclude` masks one handle or an
+        iterable of handles (the draining source before its state
+        flips; the set of destinations a failover already saw fail)."""
         with self._lock:
             handle, keys, hit_tokens = self._select_locked(prompt, tenant, exclude)
             handle.note_routed(keys, prompt)
@@ -151,23 +158,51 @@ class PrefixRouter:
             return handle
 
     # -- placement ------------------------------------------------------------
-    def _candidates(self, exclude: Optional[ReplicaHandle]) -> List[ReplicaHandle]:
+    @staticmethod
+    def _excluded_set(exclude) -> frozenset:
+        """Normalize `exclude` (None, one handle, or an iterable of
+        handles) into an identity set."""
+        if exclude is None:
+            return frozenset()
+        if isinstance(exclude, ReplicaHandle):
+            return frozenset({id(exclude)})
+        return frozenset(id(h) for h in exclude)
+
+    def _candidates(self, exclude=None) -> List[ReplicaHandle]:
+        excluded = self._excluded_set(exclude)
         active = [
             h
             for h in self.replica_set.handles
-            if h.admitting and h is not exclude
+            if h.admitting and id(h) not in excluded
         ]
         if not active:
             raise RuntimeError(
-                "no admitting replica (all draining/retired): cannot route"
+                "no admitting replica (all draining/retired/unhealthy): "
+                "cannot route"
             )
         return active
+
+    @staticmethod
+    def _safe_load(handle: ReplicaHandle) -> Optional[float]:
+        """A candidate's load score, or None when its probe raises —
+        an unreachable replica must not take scoring down with it (the
+        supervisor's health machine will demote it on its own probe
+        cadence; here it simply stops being a candidate)."""
+        try:
+            return handle.load()
+        except Exception as exc:
+            logger.warning(
+                "router: load probe of %s failed (%s); skipping candidate",
+                handle.replica_id,
+                classify_fault(exc),
+            )
+            return None
 
     def _select_locked(
         self,
         prompt: Sequence[int],
         tenant: Optional[str],
-        exclude: Optional[ReplicaHandle],
+        exclude,
     ) -> Tuple[ReplicaHandle, List[str], int]:
         """Returns (handle, the prompt's cacheable chain keys, predicted
         hit tokens — deepest-tree-match). Caller holds the lock."""
@@ -193,14 +228,23 @@ class PrefixRouter:
                 # Pin points at a draining/retired replica: dissolve it
                 # and fall through to a fresh scored placement.
                 del self._sticky[tenant]
-        scored = [
-            (
-                h.shadow_hit_tokens(prompt)
-                - self.load_penalty_tokens * h.load(),
-                h,
+        scored = []
+        for h in active:
+            load = self._safe_load(h)
+            if load is None:
+                continue  # unreachable probe: not a candidate this round
+            scored.append(
+                (
+                    h.shadow_hit_tokens(prompt)
+                    - self.load_penalty_tokens * load,
+                    h,
+                )
             )
-            for h in active
-        ]
+        if not scored:
+            raise RuntimeError(
+                "no admitting replica (all draining/retired/unhealthy): "
+                "cannot route"
+            )
         best = max(score for score, _ in scored)
         ties = [h for score, h in scored if score == best]
         handle = ties[self._rr % len(ties)]
@@ -218,10 +262,34 @@ class PrefixRouter:
         (device index + host tier — host-side reads, no device
         traffic). Optimistic routing entries for work that was evicted,
         spilled away, or never finished prefilling are corrected here;
-        between reconciles, staleness costs routing quality only."""
+        between reconciles, staleness costs routing quality only. An
+        engine whose reconcile read raises (unreachable replica the
+        supervisor has not yet demoted) keeps its stale shadow — a
+        wrong shadow can only misroute."""
         with self._lock:
             for h in self.replica_set.active_handles():
-                h.reconcile_shadow()
+                try:
+                    h.reconcile_shadow()
+                except Exception as exc:
+                    logger.warning(
+                        "router: shadow reconcile of %s failed (%s); "
+                        "keeping the stale shadow",
+                        h.replica_id,
+                        classify_fault(exc),
+                    )
+
+    def dissolve_pins(self, replica_id: str) -> int:
+        """Drop every tenant pin pointing at `replica_id` (a dead or
+        retiring replica): the next request of each tenant re-scores
+        and re-pins. Returns how many pins dissolved. (Pins also
+        dissolve lazily at select time when the pinned replica stops
+        admitting; the eager form exists so a failover leaves no
+        dangling placement state behind at all.)"""
+        with self._lock:
+            stale = [t for t, rid in self._sticky.items() if rid == replica_id]
+            for t in stale:
+                del self._sticky[t]
+            return len(stale)
 
     # -- telemetry ------------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:

@@ -158,6 +158,24 @@ class ServingReport:
     fail_all_recoveries: int = 0
     restore_latency_p50_s: float = 0.0
     restore_latency_p95_s: float = 0.0
+    # Fleet failure domains (nos_tpu/serving/supervisor.py,
+    # docs/robustness.md): replicas demoted to suspect / declared dead
+    # by the supervisor's health machine, streams re-homed onto a
+    # survivor (failovers == futures_failed_over today; kept separate so
+    # a future partial-failover can diverge them), checkpointed tokens
+    # replayed by failovers, and futures resolved with a classified
+    # ReplicaLostError (no checkpoint — client resubmits). Zero on plain
+    # engines; populated by FleetSupervisor.report() and pooled by
+    # `merge` like every other counter. The failover-latency percentiles
+    # re-derive from pooled samples (detection -> last stream placed).
+    replica_suspects: int = 0
+    replica_deaths: int = 0
+    failovers: int = 0
+    failover_replay_tokens: int = 0
+    futures_failed_over: int = 0
+    futures_errored: int = 0
+    failover_latency_p50_s: float = 0.0
+    failover_latency_p95_s: float = 0.0
     # Decoupled-round shape: ticks that dispatched a verify AND a macro
     # window (neighbors kept the pipeline while a slot speculated), and
     # the per-slot split totals.
@@ -203,6 +221,7 @@ class ServingReport:
     ttft_samples: List[float] = field(default_factory=list)
     queue_wait_samples: List[float] = field(default_factory=list)
     restore_latency_samples: List[float] = field(default_factory=list)
+    failover_latency_samples: List[float] = field(default_factory=list)
     # Tick-phase profiler (PR 9, nos_tpu/tracing.py, docs/tracing.md):
     # profiled engine ticks, total measured wall, the per-tick
     # host-overhead vs dispatch split (dispatch = wall inside jitted-call
@@ -266,6 +285,7 @@ class ServingReport:
             ("ttft", merged.ttft_samples),
             ("queue_wait", merged.queue_wait_samples),
             ("restore_latency", merged.restore_latency_samples),
+            ("failover_latency", merged.failover_latency_samples),
             ("host_overhead", merged.host_overhead_samples),
             ("dispatch", merged.dispatch_samples),
         ):
@@ -370,6 +390,7 @@ def collect_serving(server) -> ServingReport:
     ttft = list(getattr(server, "ttft_s", ()))
     queue_wait = list(getattr(server, "queue_wait_s", ()))
     restore = list(getattr(server, "restore_latency_s", ()))
+    failover = list(getattr(server, "failover_latency_s", ()))
     host_over = [float(v) for v in getattr(server, "host_overhead_samples", ())]
     dispatch = [float(v) for v in getattr(server, "dispatch_samples", ())]
     report = ServingReport(
@@ -413,6 +434,17 @@ def collect_serving(server) -> ServingReport:
         requests_poisoned=int(getattr(server, "requests_poisoned", 0)),
         transient_retries=int(getattr(server, "transient_retries", 0)),
         fail_all_recoveries=int(getattr(server, "fail_all_recoveries", 0)),
+        replica_suspects=int(getattr(server, "replica_suspects", 0)),
+        replica_deaths=int(getattr(server, "replica_deaths", 0)),
+        failovers=int(getattr(server, "failovers", 0)),
+        failover_replay_tokens=int(
+            getattr(server, "failover_replay_tokens", 0)
+        ),
+        futures_failed_over=int(getattr(server, "futures_failed_over", 0)),
+        futures_errored=int(getattr(server, "futures_errored", 0)),
+        failover_latency_p50_s=percentile(failover, 50),
+        failover_latency_p95_s=percentile(failover, 95),
+        failover_latency_samples=[float(v) for v in failover],
         restore_latency_p50_s=percentile(restore, 50),
         restore_latency_p95_s=percentile(restore, 95),
         ttft_p50_s=percentile(ttft, 50),

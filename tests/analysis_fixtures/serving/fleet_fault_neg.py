@@ -1,0 +1,43 @@
+"""NOS012 negative fixture, SERVING scope: every broad except in the
+fleet plane routes — classification (`classify_fault`), the supervised
+call wrapper (`supervised_call`), or a re-raise/escalation — so the
+checker stays silent."""
+
+import logging
+
+from nos_tpu.runtime.faults import classify_fault
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    def _run(self):
+        while True:
+            try:
+                self.sample()
+            except Exception as exc:  # classified before logging: clean
+                logger.exception("sample failed (%s)", classify_fault(exc))
+
+    def sample(self):
+        for handle in self.handles:
+            try:
+                handle.probe()
+            except Exception as exc:  # classified into the row: clean
+                self.mark_unreachable(handle, classify_fault(exc))
+
+
+def rehome(supervisor, dst, checkpoints):
+    for ck in checkpoints:
+        try:
+            supervisor.supervised_call(
+                dst, "transfer_in", dst.engine.transfer_in_checkpoint, ck
+            )
+        except Exception:  # escalation counts as routing: clean
+            raise
+
+
+def guard(fn):
+    try:
+        return fn()
+    except ValueError:  # narrow: out of the rule regardless of scope
+        return None

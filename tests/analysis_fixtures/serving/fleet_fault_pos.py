@@ -1,0 +1,42 @@
+"""NOS012 positive fixture, SERVING scope: the fleet plane's loops
+(monitor sampling, supervisor sweeps, drain re-homing — and module-level
+functions, which the runtime tier never covers) must route broad excepts
+through the taxonomy. Expected findings: the log-only sample handler,
+the swallow in the module-level rehome function, and the pass-only
+probe handler — and NOT the narrow KeyError handler."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    def _run(self):
+        while True:
+            try:
+                self.sample()
+            except Exception:  # log-only: the replica death vanishes -> NOS012
+                logger.exception("sample failed")
+
+    def sample(self):
+        for handle in self.handles:
+            try:
+                handle.probe()
+            except Exception:  # swallowed wholesale -> NOS012
+                continue
+
+    def lookup(self, rid):
+        try:
+            return self.rings[rid]
+        except KeyError:  # narrow handler: deliberate control flow, clean
+            return None
+
+
+def rehome(router, checkpoints):
+    # Module-level fleet-loop function: in scope under serving/ (the
+    # runtime tier only covers engine-class methods).
+    for ck in checkpoints:
+        try:
+            router.select(ck.prompt).engine.transfer_in_checkpoint(ck)
+        except Exception as exc:  # stream vanishes between replicas -> NOS012
+            logger.warning("transfer failed: %s", exc)

@@ -433,6 +433,74 @@ def test_retired_replica_drops_gauges_and_rings():
     assert mon.last_report.replicas_active == 1
 
 
+def test_unreachable_replica_is_marked_not_swallowed():
+    """ISSUE 14 satellite: a raising probe must NOT vanish into the
+    background-loop backstop — the replica's window row classifies
+    UNREACHABLE (one-hot state gauge included), the event is journaled,
+    the REST of the fleet keeps sampling, its capacity leaves headroom,
+    and a recovered replica returns to a normal verdict with deltas
+    diffed against its last GOOD sample (never negative)."""
+    registry = Metrics()
+    rs = stub_fleet(n=2)
+    engines = [h.engine for h in rs.handles]
+    mon = FleetMonitor(rs, metrics=registry)
+    mon.sample(now=1.0)  # healthy baseline
+    engines[0].steps_run = 10
+    engines[0].macro_tokens_by_slot = [40, 0]
+
+    def _dead_probe():
+        raise ConnectionError("connection refused by host")
+
+    engines[0].probe = _dead_probe
+    rep = mon.sample(now=2.0)
+    assert rep.replicas["replica-0"] == constants.PRESSURE_REPLICA_UNREACHABLE
+    assert rep.replicas["replica-1"] != constants.PRESSURE_REPLICA_UNREACHABLE
+    # One-hot state gauge flipped for the unreachable replica only.
+    assert (
+        registry.get(
+            "nos_tpu_fleet_replica_state",
+            replica="replica-0",
+            state=constants.PRESSURE_REPLICA_UNREACHABLE,
+        )
+        == 1.0
+    )
+    assert (
+        registry.get(
+            "nos_tpu_fleet_replica_state",
+            replica="replica-1",
+            state=constants.PRESSURE_REPLICA_UNREACHABLE,
+        )
+        == 0.0
+    )
+    # Unknown capacity is not headroom: only the reachable replica's
+    # slots count.
+    assert rep.slots_total == 2 and rep.replicas_active == 1
+    # The event is journaled (classified), and replay re-derives the
+    # verdict from the window rows alone.
+    events = [json.loads(line) for line in mon.journal_lines()]
+    unreach = [
+        e for e in events if e["event"] == constants.FLEET_EV_UNREACHABLE
+    ]
+    assert len(unreach) == 1
+    assert unreach[0]["replica"] == "replica-0"
+    assert unreach[0]["kind"] == "transient"  # "connection refused" marker
+    replayed = FleetMonitor.replay(mon.journal_lines())
+    assert (
+        replayed[-1].replicas["replica-0"]
+        == constants.PRESSURE_REPLICA_UNREACHABLE
+    )
+    # Recovery: the probe answers again; the verdict normalizes and the
+    # window delta diffs against the last GOOD baseline — the tokens
+    # produced while unreachable are attributed, never negative.
+    del engines[0].probe  # restore the class method
+    rep3 = mon.sample(now=3.0)
+    assert (
+        rep3.replicas["replica-0"] != constants.PRESSURE_REPLICA_UNREACHABLE
+    )
+    row = mon.replica_windows("replica-0")[-1]
+    assert row["tokens"] == 40 and row["tokens"] >= 0
+
+
 def test_monitor_background_thread_samples_and_stops():
     rs = stub_fleet(n=1)
     mon = FleetMonitor(rs, interval_s=0.01).start()

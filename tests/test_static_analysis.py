@@ -355,6 +355,64 @@ def test_fault_discipline_real_engine_is_clean():
         assert findings == [], fname
 
 
+# -- NOS012, serving (fleet-plane) scope ---------------------------------------
+def test_fault_discipline_serving_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "fleet_fault_pos.py"),
+        [FaultDisciplineChecker()],
+    )
+    assert codes_of(findings) == ["NOS012"]
+    # Log-only _run, the swallowed per-handle probe, and the
+    # MODULE-LEVEL rehome handler (the runtime tier never covers
+    # module functions) — and NOT the narrow KeyError handler.
+    assert len(findings) == 3
+
+
+def test_fault_discipline_serving_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "fleet_fault_neg.py"),
+        [FaultDisciplineChecker()],
+    )
+    assert findings == []
+
+
+def test_fault_discipline_serving_scope_covers_module_functions(tmp_path):
+    # The SAME module-level swallow is in scope under a serving/ dir and
+    # out of scope elsewhere — the tier boundary, pinned.
+    src = (
+        "def rehome(router, ck):\n"
+        "    try:\n"
+        "        router.place(ck)\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    serving_dir = tmp_path / "serving"
+    serving_dir.mkdir()
+    f_in = serving_dir / "loop.py"
+    f_in.write_text(src)
+    f_out = tmp_path / "loop.py"
+    f_out.write_text(src)
+    assert codes_of(run_checkers(str(f_in), [FaultDisciplineChecker()])) == [
+        "NOS012"
+    ]
+    assert run_checkers(str(f_out), [FaultDisciplineChecker()]) == []
+
+
+def test_fault_discipline_real_serving_plane_is_clean():
+    # The satellite's enforcement: every broad except in the fleet plane
+    # (supervisor, monitor, drain, router, replica registry) routes
+    # through classify_fault / the supervised wrapper / a raise, or
+    # carries a rationale-annotated inline suppression.
+    serving_dir = os.path.join(TREE, "serving")
+    for fname in sorted(os.listdir(serving_dir)):
+        if not fname.endswith(".py"):
+            continue
+        findings = run_checkers(
+            os.path.join(serving_dir, fname), [FaultDisciplineChecker()]
+        )
+        assert findings == [], fname
+
+
 # -- NOS013 spill-tier state outside the SpillTier -----------------------------
 def test_spill_discipline_positives():
     findings = run_checkers(

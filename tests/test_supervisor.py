@@ -1,0 +1,844 @@
+"""Fleet failure domains (ISSUE 14 tentpole): the FleetSupervisor's
+guarded call wrapper, the replica health machine
+(active -> suspect -> dead with full-healthy-window re-admission), the
+seeded ReplicaFaultInjector, in-flight failover (checkpointed streams
+replay bit-identically onto survivors; the rest resolve with a
+classified ReplicaLostError carrying the request), the drain
+destination-failure rollback satellite, and the fleet chaos gate.
+
+Two substrates, the fleet-monitor pattern: STUB engines (the duck-typed
+probe/submit surface, no jax cost) for the wrapper/state-machine/
+injector mechanics, REAL DecodeServer fleets (shared tiny serving
+model, manual ticking — a killed replica simply stops being ticked,
+exactly what a dead host looks like from the survivors) for the
+failover exactness oracles and the seeded multi-replica chaos gate.
+"""
+
+import random
+import time
+from concurrent.futures import Future
+
+import jax
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.runtime.faults import (
+    FAULT_REPLICA_LOST,
+    FAULT_REPLICA_UNREACHABLE,
+    FAULT_TRANSIENT,
+    ReplicaLostError,
+    ReplicaUnreachableError,
+    TransientDispatchError,
+    classify_fault,
+)
+from nos_tpu.serving import (
+    FleetSupervisor,
+    PrefixRouter,
+    ReplicaFaultInjector,
+    ReplicaFaultSpec,
+    ReplicaSet,
+    drain_replica,
+)
+from nos_tpu.serving.supervisor import (
+    REPLICA_SITES,
+    SITE_DRAIN_EXTRACT,
+    SITE_PROBE,
+    SITE_SUBMIT,
+    SITE_TRANSFER_IN,
+)
+from nos_tpu.telemetry import ServingReport
+from tests.conftest import serving_test_config
+from tests.test_block_manager import check_invariants
+
+CFG = serving_test_config()
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="failover/replay bit-exactness crosses program shapes: needs "
+    "the deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+# ---------------------------------------------------------------------------
+# Stub substrate
+# ---------------------------------------------------------------------------
+class StubEngine:
+    """Minimal duck-typed replica engine for supervisor mechanics."""
+
+    block_size = 8
+
+    def __init__(self):
+        self.submitted = []
+        self.transfers = []
+        self.stopped = False
+
+    def probe(self):
+        return {
+            constants.PROBE_KEY_ACTIVE_SLOTS: 0,
+            constants.PROBE_KEY_QUEUED_REQUESTS: 0,
+            constants.PROBE_KEY_PREFILL_BACKLOG: 0,
+            constants.PROBE_KEY_DRAINING: False,
+            constants.PROBE_KEY_TP_DEVICES: 1,
+            constants.PROBE_KEY_SLOTS_TOTAL: 2,
+            constants.PROBE_KEY_KV_BLOCKS_TOTAL: 15,
+        }
+
+    def prefix_keys(self):
+        return frozenset()
+
+    def submit(self, prompt, max_new, tenant=None, trace_id=None):
+        fut = Future()
+        self.submitted.append((list(prompt), max_new, tenant))
+        return fut
+
+    def transfer_in_checkpoint(self, ck, t_restore=None):
+        self.transfers.append(ck)
+
+    def drain_extract(self):
+        return [], []
+
+    def stop(self, **kw):
+        self.stopped = True
+
+
+def make_stub_fleet(n=3):
+    rs = ReplicaSet([StubEngine() for _ in range(n)])
+    router = PrefixRouter(rs)
+    return rs, router
+
+
+def make_supervisor(rs, router, **kw):
+    defaults = dict(
+        suspect_after=2,
+        dead_after=4,
+        recover_after=3,
+        sleep=lambda s: None,
+    )
+    defaults.update(kw)
+    return FleetSupervisor(rs, router, **defaults)
+
+
+# ---------------------------------------------------------------------------
+# ReplicaFaultSpec / ReplicaFaultInjector
+# ---------------------------------------------------------------------------
+def test_replica_fault_spec_validation():
+    with pytest.raises(ValueError, match="site"):
+        ReplicaFaultSpec("replica-0", "tickle", 1)
+    with pytest.raises(ValueError, match="kind"):
+        ReplicaFaultSpec("replica-0", SITE_PROBE, 1, kind="poison")
+    with pytest.raises(ValueError, match="1-based"):
+        ReplicaFaultSpec("replica-0", SITE_PROBE, 0)
+    with pytest.raises(ValueError, match="persistent"):
+        ReplicaFaultSpec(
+            "replica-0", SITE_PROBE, 1, kind=FAULT_TRANSIENT, persistent=True
+        )
+    assert set(REPLICA_SITES) == {
+        SITE_PROBE,
+        SITE_SUBMIT,
+        SITE_TRANSFER_IN,
+        SITE_DRAIN_EXTRACT,
+    }
+
+
+def test_injector_fires_on_occurrence_and_persists_host_death():
+    inj = ReplicaFaultInjector(
+        schedule=[
+            ReplicaFaultSpec("replica-1", SITE_PROBE, 2, persistent=True),
+            ReplicaFaultSpec(
+                "replica-0", SITE_SUBMIT, 1, kind=FAULT_TRANSIENT
+            ),
+        ]
+    )
+    inj.check("replica-1", SITE_PROBE)  # occurrence 1: clean
+    with pytest.raises(TransientDispatchError):
+        inj.check("replica-0", SITE_SUBMIT)
+    with pytest.raises(ReplicaUnreachableError):
+        inj.check("replica-1", SITE_PROBE)  # occurrence 2 fires, downs it
+    # Host death is a STATE: every later site on replica-1 raises...
+    with pytest.raises(ReplicaUnreachableError):
+        inj.check("replica-1", SITE_SUBMIT)
+    # ...until revived; other replicas never affected.
+    inj.check("replica-0", SITE_PROBE)
+    inj.revive("replica-1")
+    inj.check("replica-1", SITE_PROBE)
+    assert inj.visits("replica-1", SITE_PROBE) == 3
+    assert len(inj.fired) == 2
+
+
+def test_injector_seeded_is_reproducible_and_kills_one():
+    rids = ["replica-0", "replica-1", "replica-2"]
+    a = ReplicaFaultInjector.seeded(7, rids)
+    b = ReplicaFaultInjector.seeded(7, rids)
+    assert a.schedule == b.schedule
+    kills = [s for s in a.schedule if s.persistent]
+    assert len(kills) == 1 and kills[0].kind == FAULT_REPLICA_UNREACHABLE
+    assert ReplicaFaultInjector.seeded(8, rids).schedule != a.schedule
+
+
+# ---------------------------------------------------------------------------
+# The supervised call wrapper
+# ---------------------------------------------------------------------------
+def test_supervised_call_retries_transient_with_capped_jittered_backoff():
+    rs, router = make_stub_fleet(2)
+    delays = []
+    sup = make_supervisor(
+        rs,
+        router,
+        max_call_retries=3,
+        backoff_base_s=0.01,
+        backoff_cap_s=0.02,
+        sleep=delays.append,
+    )
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise TransientDispatchError("tunnel flake")
+        return "ok"
+
+    assert sup.supervised_call(rs.handles[0], SITE_PROBE, flaky) == "ok"
+    assert calls["n"] == 3
+    assert sup.supervised_retries == 2
+    # Capped jittered exponential: every delay in (0, cap], jitter keeps
+    # it under the raw step, and the schedule is seeded-deterministic.
+    assert len(delays) == 2
+    assert all(0.0 < d <= 0.02 for d in delays)
+    sup2 = make_supervisor(
+        rs, router, max_call_retries=3, backoff_base_s=0.01,
+        backoff_cap_s=0.02, sleep=(delays2 := []).append,
+    )
+    calls["n"] = 0
+    sup2.supervised_call(rs.handles[0], SITE_PROBE, flaky)
+    assert delays2 == delays[:2]
+
+
+def test_supervised_call_escalates_to_replica_unreachable():
+    rs, router = make_stub_fleet(2)
+    sup = make_supervisor(rs, router, max_call_retries=1)
+
+    def always_flaky():
+        raise TransientDispatchError("connection reset")
+
+    with pytest.raises(ReplicaUnreachableError) as exc_info:
+        sup.supervised_call(rs.handles[0], SITE_SUBMIT, always_flaky)
+    err = exc_info.value
+    assert err.replica == "replica-0"
+    assert err.site == SITE_SUBMIT
+    assert classify_fault(err) == FAULT_REPLICA_UNREACHABLE
+    assert isinstance(err.__cause__, TransientDispatchError)
+    # Non-transient classifications never burn the retry budget.
+    calls = {"n": 0}
+
+    def hard():
+        calls["n"] += 1
+        raise ValueError("schema corrupt")
+
+    with pytest.raises(ReplicaUnreachableError):
+        sup.supervised_call(rs.handles[0], SITE_PROBE, hard)
+    assert calls["n"] == 1
+
+
+def test_supervised_call_timeout_classifies_unreachable():
+    rs, router = make_stub_fleet(2)
+    sup = make_supervisor(
+        rs, router, call_timeout_s=0.05, max_call_retries=0
+    )
+
+    def hung():
+        time.sleep(1.0)
+        return "too late"
+
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaUnreachableError):
+        sup.supervised_call(rs.handles[0], SITE_PROBE, hung)
+    assert time.monotonic() - t0 < 0.8  # bounded, not the full hang
+
+
+# ---------------------------------------------------------------------------
+# Health machine
+# ---------------------------------------------------------------------------
+def test_point_blips_never_demote():
+    rs, router = make_stub_fleet(2)
+    inj = ReplicaFaultInjector()
+    sup = make_supervisor(rs, router, fault_injector=inj)
+    for occurrence in (1, 3, 5, 7):  # alternating blip / success
+        inj.add(ReplicaFaultSpec("replica-0", SITE_PROBE, occurrence))
+    for _ in range(8):
+        sup.probe()
+    # Failures never ran CONSECUTIVELY to suspect_after: still active.
+    assert rs.handles[0].health == constants.REPLICA_HEALTH_ACTIVE
+    assert sup.replica_suspects == 0
+
+
+def test_health_machine_suspect_excludes_from_routing_then_dead_fails_over():
+    rs, router = make_stub_fleet(3)
+    inj = ReplicaFaultInjector()
+    sup = make_supervisor(rs, router, fault_injector=inj)
+    fut = sup.submit([1, 2, 3, 4, 5, 6, 7, 8], max_new=4, tenant="t")
+    pinned = router._sticky["t"]
+    inj.kill(pinned)
+    sup.probe()
+    assert rs.get(pinned).health == constants.REPLICA_HEALTH_ACTIVE
+    sup.probe()  # 2nd consecutive failure -> suspect
+    assert rs.get(pinned).health == constants.REPLICA_HEALTH_SUSPECT
+    assert not rs.get(pinned).admitting
+    # Suspect is excluded from selection (and the stale pin dissolves).
+    for _ in range(6):
+        assert router.select([9, 9, 9], tenant="t").replica_id != pinned
+    sup.probe()
+    sup.probe()  # 4th consecutive failure -> dead + failover
+    handle = rs.get(pinned)
+    assert handle.health == constants.REPLICA_HEALTH_DEAD
+    assert handle.state == constants.REPLICA_STATE_RETIRED
+    assert sup.replica_suspects == 1 and sup.replica_deaths == 1
+    # The stream had no checkpoint (stub engines never produce one):
+    # its future resolves with the classified error CARRYING the request.
+    assert fut.done()
+    err = fut.exception()
+    assert isinstance(err, ReplicaLostError)
+    assert classify_fault(err) == FAULT_REPLICA_LOST
+    assert err.prompt == [1, 2, 3, 4, 5, 6, 7, 8]
+    assert err.max_new == 4 and err.tenant == "t" and err.replica == pinned
+    assert sup.futures_errored == 1
+    # Hygiene: shadow dropped, pins dissolved (the tenant's later
+    # selections above re-pinned it to a SURVIVOR), events journaled.
+    assert handle.shadow == set()
+    assert router._sticky.get("t") != pinned
+    assert [e["event"] for e in sup.events] == [
+        constants.FLEET_EV_SUSPECT,
+        constants.FLEET_EV_DEATH,
+        constants.FLEET_EV_FAILOVER,
+    ]
+    # Zero selections of a dead replica after detection.
+    for _ in range(6):
+        assert router.select([5, 5, 5]).replica_id != pinned
+
+
+def test_suspect_recovery_requires_full_healthy_window():
+    """Acceptance criterion: a suspect that recovers within K-of-N
+    returns to active and is ROUTED TO again — but only after a full
+    healthy window (no flapping on the first good probe)."""
+    rs, router = make_stub_fleet(2)
+    inj = ReplicaFaultInjector()
+    sup = make_supervisor(rs, router, dead_after=10, recover_after=3,
+                          fault_injector=inj)
+    inj.kill("replica-1")
+    sup.probe()
+    sup.probe()
+    assert rs.handles[1].health == constants.REPLICA_HEALTH_SUSPECT
+    inj.revive("replica-1")
+    sup.probe()
+    # One good probe is NOT re-admission.
+    assert rs.handles[1].health == constants.REPLICA_HEALTH_SUSPECT
+    assert all(
+        router.select([i, i]).replica_id == "replica-0" for i in range(4)
+    )
+    sup.probe()
+    sup.probe()  # full healthy window
+    assert rs.handles[1].health == constants.REPLICA_HEALTH_ACTIVE
+    picked = {router.select([7, 7, 7 + i]).replica_id for i in range(6)}
+    assert "replica-1" in picked  # routed to again
+    assert [e["event"] for e in sup.events] == [
+        constants.FLEET_EV_SUSPECT,
+        constants.FLEET_EV_RECOVERED,
+    ]
+    # Flap guard the other way: a new failure resets the ok streak.
+    inj.kill("replica-1")
+    sup.probe()
+    inj.revive("replica-1")
+    sup.probe()
+    assert rs.handles[1].health == constants.REPLICA_HEALTH_ACTIVE
+
+
+def test_submit_retries_next_replica_on_unreachable():
+    rs, router = make_stub_fleet(3)
+    inj = ReplicaFaultInjector()
+    sup = make_supervisor(rs, router, fault_injector=inj)
+    prompt = list(range(1, 18))  # 2 cacheable blocks: shadow-scorable
+    first = router.select(prompt)  # peek who scores first (and seed
+    # its shadow, so the NEXT select of the same prompt picks it again)
+    inj.kill(first.replica_id)
+    fut = sup.submit(prompt, max_new=4)
+    assert isinstance(fut, Future) and not fut.done()
+    # The flake landed somewhere healthy; the failed replica took a
+    # health strike.
+    assert sum(len(h.engine.submitted) for h in rs.handles) == 1
+    assert rs.get(first.replica_id).engine.submitted == []
+    assert sup._health[first.replica_id].fail_streak == 1
+
+
+def test_supervised_drain_routes_sites_through_wrapper():
+    rs, router = make_stub_fleet(2)
+    inj = ReplicaFaultInjector(
+        schedule=[
+            ReplicaFaultSpec(
+                "replica-0", SITE_DRAIN_EXTRACT, 1, kind=FAULT_TRANSIENT
+            )
+        ]
+    )
+    sup = make_supervisor(rs, router, fault_injector=inj)
+    report = drain_replica(rs, router, "replica-0", supervisor=sup)
+    # The transient extract flake was retried through the wrapper — the
+    # drain completed instead of retiring a half-drained replica.
+    assert report.rolled_back == 0
+    assert rs.handles[0].state == constants.REPLICA_STATE_RETIRED
+    assert inj.visits("replica-0", SITE_DRAIN_EXTRACT) == 2
+    assert sup.supervised_retries == 1
+
+
+def test_failover_rides_the_streams_existing_trace():
+    """Satellite: one trace id survives replica death like it survives
+    device-lost — the failover is a `req.failover` EDGE on the span
+    chain the router opened, never a fresh trace on the destination."""
+    from nos_tpu.runtime.checkpoint import SlotCheckpoint
+    from nos_tpu.tracing import Tracer
+
+    tracer = Tracer()
+    rs = ReplicaSet([StubEngine() for _ in range(2)])
+    router = PrefixRouter(rs, tracer=tracer)
+    sup = make_supervisor(rs, router)
+    fut = sup.submit([1, 2, 3], max_new=6)
+    rid = next(r for r, streams in sup._streams.items() if streams)
+    (stream,) = sup._streams[rid].values()
+    assert stream.trace_id is not None
+    # Hand the supervisor a last-known checkpoint for the stream (the
+    # probe ride-along would have captured one on a real engine).
+    sup._checkpoints.setdefault(rid, {})[id(fut)] = SlotCheckpoint(
+        prompt=[1, 2, 3],
+        generated=[7, 8],
+        max_new=6,
+        serial=1,
+        trace_id=stream.trace_id,
+        future=fut,
+    )
+    report = sup.mark_dead(rid)
+    assert report.failed_over == 1
+    events = tracer.trace(stream.trace_id)
+    names = [e["name"] for e in events]
+    assert constants.TRACE_EV_ROUTER_SELECT in names
+    assert constants.TRACE_EV_FAILOVER in names
+    edge = next(
+        e for e in events if e["name"] == constants.TRACE_EV_FAILOVER
+    )
+    assert edge["attrs"]["src"] == rid and edge["attrs"]["dst"] != rid
+    assert edge["attrs"]["replayed"] == 2
+    # No new trace was minted for the re-homed stream.
+    assert len(tracer.trace_ids()) == 1
+
+
+# ---------------------------------------------------------------------------
+# Telemetry plumbing
+# ---------------------------------------------------------------------------
+def test_supervisor_report_pools_into_fleet_merge():
+    rs, router = make_stub_fleet(2)
+    inj = ReplicaFaultInjector()
+    sup = make_supervisor(rs, router, fault_injector=inj)
+    fut = sup.submit([1, 2, 3], max_new=4, tenant="t")
+    inj.kill(router._sticky["t"])
+    for _ in range(4):
+        sup.probe()
+    assert fut.done()
+    rep = sup.report()
+    assert rep.replicas == 0
+    assert rep.replica_deaths == 1 and rep.replica_suspects == 1
+    assert rep.futures_errored == 1  # stub fleet: no checkpoint
+    assert len(rep.failover_latency_samples) == 1
+    merged = ServingReport.merge([ServingReport(steps_run=5), rep])
+    assert merged.replica_deaths == 1 and merged.futures_errored == 1
+    assert merged.steps_run == 5 and merged.replicas == 1
+    assert merged.failover_latency_p95_s == rep.failover_latency_p95_s
+
+
+# ---------------------------------------------------------------------------
+# Real-engine substrate
+# ---------------------------------------------------------------------------
+def make_engine(params, **kw):
+    defaults = dict(
+        n_slots=2, max_len=64, prompt_buckets=(8, 16), block_size=8, seed=11
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, **defaults)
+
+
+def make_fleet(params, n=3, **kw):
+    return ReplicaSet([make_engine(params, **kw) for _ in range(n)])
+
+
+def tickable(handle, downed):
+    return (
+        handle.state == constants.REPLICA_STATE_ACTIVE
+        and handle.replica_id not in downed
+        and handle.engine._thread is None
+    )
+
+
+def drive(rs, pred, downed=(), sup=None, n=600):
+    """Deterministic manual ticking: one tick per alive replica per
+    wave (a downed host simply stops being ticked), a supervisor probe
+    sweep per wave."""
+    for _ in range(n):
+        for h in rs.handles:
+            if tickable(h, downed):
+                h.engine._tick()
+        if sup is not None:
+            sup.probe()
+        if pred():
+            return True
+    return False
+
+
+PROMPTS = [
+    [4, 9, 2, 33, 7, 1, 8, 5],
+    [40, 41, 42, 43, 44, 45, 46, 47],
+    [9, 8, 7, 6, 5, 4, 3, 2],
+    [11, 3, 11, 3, 11, 3, 11, 3],
+]
+
+
+def solo_reference(params, prompts, max_new):
+    """Fault-free GREEDY outputs from one engine (greedy outputs are
+    fully placement-independent; temperature streams key their PRNG on
+    the per-engine admission serial, so they need the fleet-shaped
+    reference below)."""
+    eng = make_engine(params)
+    futs = [eng.submit(p, max_new=max_new) for p in prompts]
+    for _ in range(2000):
+        if all(f.done() for f in futs):
+            break
+        eng._tick()
+    outs = [f.result(1) for f in futs]
+    eng.stop()
+    return outs
+
+
+_FLEET_REF_CACHE = {}
+
+
+def fleet_reference(params, temperature, prompts, max_new, n=3, **engine_kw):
+    """THE fault-free oracle for the chaos/drain/failover runs: the
+    SAME fleet shape, router, and submission sequence — so placement
+    (and with it each stream's sampling serial) matches the faulted run
+    up to the kill, and checkpoint re-homing preserves serial + PRNG
+    step from there. Cached per shape: the 5-seed chaos gate reuses ONE
+    reference per temperature instead of recomputing it per seed (the
+    tier-1 budget on the 1-CPU box is thin — the reference is
+    deterministic, so recomputation buys nothing)."""
+    key = (
+        temperature,
+        tuple(tuple(p) for p in prompts),
+        max_new,
+        n,
+        tuple(sorted(engine_kw.items())),
+    )
+    if key in _FLEET_REF_CACHE:
+        return _FLEET_REF_CACHE[key]
+    rs = make_fleet(params, n=n, temperature=temperature, **engine_kw)
+    router = PrefixRouter(rs)
+    futs = [router.submit(p, max_new=max_new) for p in prompts]
+    assert drive(rs, lambda: all(f.done() for f in futs))
+    outs = [f.result(1) for f in futs]
+    rs.stop()
+    _FLEET_REF_CACHE[key] = outs
+    return outs
+
+
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_failover_replays_checkpoint_bit_identical(params, temperature):
+    """THE failover oracle: a replica killed mid-decode re-homes its
+    checkpointed streams onto survivors and every such stream finishes
+    BIT-IDENTICALLY to the fault-free run — greedy and temperature
+    (checkpoint keeps serial + PRNG step; the fleet shares one seed)."""
+    max_new = 10
+    want = fleet_reference(params, temperature, PROMPTS, max_new, n=3)
+
+    rs = make_fleet(params, n=3, temperature=temperature)
+    router = PrefixRouter(rs)
+    inj = ReplicaFaultInjector()
+    sup = make_supervisor(
+        rs, router, suspect_after=2, dead_after=3, fault_injector=inj
+    )
+    futs = [sup.submit(p, max_new=max_new) for p in PROMPTS]
+    victim = rs.handles[0]
+    vid = victim.replica_id
+    victim_futs = [
+        s.future for s in sup._streams.get(vid, {}).values()
+    ]
+    assert victim_futs, "scenario needs streams on the victim"
+    # Drive until the supervisor holds a checkpoint for every victim
+    # stream with >= 1 generated token (mid-decode, capture complete).
+    assert drive(
+        rs,
+        lambda: all(
+            len(ck.generated) >= 1
+            for ck in [
+                sup._checkpoints.get(vid, {}).get(id(f)) for f in victim_futs
+            ]
+            if ck is not None
+        )
+        and len(sup._checkpoints.get(vid, {})) >= len(victim_futs),
+        sup=sup,
+        n=64,
+    )
+    inj.kill(vid)
+    downed = {vid}
+    assert drive(rs, lambda: all(f.done() for f in futs), downed=downed, sup=sup)
+    assert victim.state == constants.REPLICA_STATE_RETIRED
+    got = [f.result(1) for f in futs]
+    assert got == want  # bit-identical, failover included
+    assert sup.failovers >= len(victim_futs)
+    assert sup.futures_errored == 0
+    assert sup.failover_replay_tokens >= 1
+    assert len(sup.failover_latency_s) == 1
+    for h in rs.handles[1:]:
+        assert h.engine._block_mgr.conserved()
+        check_invariants(h.engine._block_mgr)
+    rs.stop()
+
+
+@cpu_only
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_fleet_chaos_gate(params, seed):
+    """The fleet chaos gate (acceptance): seeded kill/suspect/recover
+    chaos over a 3-replica fleet mid-traffic, greedy AND temperature
+    per seed. Every surviving-replica stream bit-identical to its
+    fault-free run; every dead-replica future RESOLVES (checkpoint
+    failover replaying bit-identically, or a classified
+    ReplicaLostError — zero stranded futures); the router issues zero
+    selections of a replica after it is marked dead; `conserved()`
+    holds on every surviving engine."""
+    rng = random.Random(seed)
+    for temperature in (0.0, 0.8):
+        # burst_windows=1 keeps the engines on per-tick dispatch so the
+        # kill wave reliably lands MID-traffic (a bursting tiny engine
+        # finishes these streams before any health streak can mature).
+        max_new = 12
+        want = fleet_reference(
+            params, temperature, PROMPTS, max_new, n=3, burst_windows=1
+        )
+        rs = make_fleet(
+            params, n=3, temperature=temperature, burst_windows=1
+        )
+        router = PrefixRouter(rs)
+        inj = ReplicaFaultInjector(
+            schedule=[
+                # A transient blip somewhere early: must never demote.
+                ReplicaFaultSpec(
+                    f"{constants.REPLICA_ID_PREFIX}{rng.randrange(3)}",
+                    SITE_PROBE,
+                    rng.randint(1, 3),
+                    kind=FAULT_TRANSIENT,
+                )
+            ]
+        )
+        sup = make_supervisor(
+            rs, router, suspect_after=2, dead_after=3, fault_injector=inj
+        )
+        futs = [sup.submit(p, max_new=max_new) for p in PROMPTS]
+        victim = rs.handles[rng.randrange(3)]
+        vid = victim.replica_id
+        kill_wave = rng.randint(2, 5)
+        downed = set()
+        dead_selindex = None
+        for wave in range(600):
+            for h in rs.handles:
+                if tickable(h, downed):
+                    h.engine._tick()
+            if wave == kill_wave:
+                inj.kill(vid)
+                downed.add(vid)
+            sup.probe()
+            if (
+                dead_selindex is None
+                and victim.health == constants.REPLICA_HEALTH_DEAD
+            ):
+                dead_selindex = victim.routed_requests
+            if all(f.done() for f in futs):
+                break
+        # Zero stranded futures.
+        assert all(f.done() for f in futs), "stranded futures after death"
+        for i, fut in enumerate(futs):
+            if fut.exception() is None:
+                assert fut.result(0) == want[i], f"stream {i} diverged"
+            else:
+                err = fut.exception()
+                assert isinstance(err, ReplicaLostError)
+                assert err.prompt == PROMPTS[i]
+        # Router issued ZERO selections of the dead replica after
+        # detection (routed_requests frozen at the detection count).
+        assert victim.health == constants.REPLICA_HEALTH_DEAD
+        assert victim.routed_requests == dead_selindex
+        assert victim.state == constants.REPLICA_STATE_RETIRED
+        for h in rs.handles:
+            if h.replica_id == vid:
+                continue
+            assert h.engine._block_mgr.conserved(), h.replica_id
+            check_invariants(h.engine._block_mgr)
+        rs.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drain destination-failure rollback (satellite)
+# ---------------------------------------------------------------------------
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_drain_transfer_failure_falls_to_next_candidate(params, temperature):
+    """An injected transfer fault on the first-scored destination must
+    land the checkpointed stream on the NEXT candidate — never strand
+    it between replicas; the drain still completes and retires the
+    source; outputs stay bit-identical."""
+    max_new = 10
+    want = fleet_reference(params, temperature, PROMPTS[:3], max_new, n=3)
+    rs = make_fleet(params, n=3, temperature=temperature)
+    router = PrefixRouter(rs)
+    futs = [router.submit(p, max_new=max_new) for p in PROMPTS[:3]]
+    src = rs.handles[0]
+    assert drive(
+        rs,
+        lambda: any(
+            s.active and s.phase == "decoding" for s in src.engine._slots
+        ),
+        n=64,
+    )
+    # Poison ONE destination's transfer path permanently.
+    broken = rs.handles[1]
+    broken.engine.transfer_in_checkpoint = _raise_transfer  # type: ignore
+    broken.engine.transfer_in_request = _raise_transfer  # type: ignore
+    report = drain_replica(rs, router, src.replica_id)
+    assert report.rolled_back == 0
+    assert src.state == constants.REPLICA_STATE_RETIRED
+    assert set(report.destinations) <= {"replica-2"}
+    assert src.engine._block_mgr.conserved()
+    assert drive(rs, lambda: all(f.done() for f in futs))
+    assert [f.result(1) for f in futs] == want
+    assert rs.handles[2].engine._block_mgr.conserved()
+    check_invariants(rs.handles[2].engine._block_mgr)
+    rs.stop()
+
+
+def _raise_transfer(*a, **kw):
+    raise RuntimeError("injected destination transfer failure")
+
+
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_drain_rolls_back_to_reopened_source_when_no_candidate(
+    params, temperature
+):
+    """When EVERY destination fails mid-transfer, the checkpointed
+    streams are restored onto the REOPENED source instead of vanishing:
+    the source stays ACTIVE, serves them to completion bit-identically,
+    and conservation holds on both ends."""
+    max_new = 10
+    want = fleet_reference(params, temperature, PROMPTS[:2], max_new, n=2)
+    rs = make_fleet(params, n=2, temperature=temperature)
+    router = PrefixRouter(rs)
+    futs = [router.submit(p, max_new=max_new) for p in PROMPTS[:2]]
+    src = rs.handles[0]
+    assert drive(
+        rs,
+        lambda: any(
+            s.active and s.phase == "decoding" for s in src.engine._slots
+        ),
+        n=64,
+    )
+    broken = rs.handles[1]
+    broken.engine.transfer_in_checkpoint = _raise_transfer  # type: ignore
+    broken.engine.transfer_in_request = _raise_transfer  # type: ignore
+    report = drain_replica(rs, router, src.replica_id)
+    assert report.rolled_back >= 1
+    # The move failed: the source holds the streams again and is NOT
+    # retired.
+    assert src.state == constants.REPLICA_STATE_ACTIVE
+    assert src.engine._block_mgr.conserved()
+    assert drive(rs, lambda: all(f.done() for f in futs))
+    assert [f.result(1) for f in futs] == want
+    check_invariants(src.engine._block_mgr)
+    assert broken.engine._block_mgr.conserved()
+    rs.stop()
+
+
+# ---------------------------------------------------------------------------
+# Engine hooks (passive capture / forsake / reopen)
+# ---------------------------------------------------------------------------
+@cpu_only
+def test_checkpoint_snapshot_is_passive_and_prefix_valid(params):
+    eng = make_engine(params, burst_windows=1)
+    max_new = 12
+    fut = eng.submit(PROMPTS[0], max_new=max_new)
+    for _ in range(200):
+        eng._tick()
+        if any(
+            s.active and s.phase == "decoding" and len(s.refs) >= 2
+            for s in eng._slots
+        ):
+            break
+    cks = eng.checkpoint_snapshot()
+    assert len(cks) == 1
+    ck = cks[0]
+    assert ck.prompt == PROMPTS[0]
+    assert 0 <= len(ck.generated) < max_new  # strictly before budget
+    assert ck.future is fut and not fut.done()
+    # Passive: the engine finishes normally, output untouched by the
+    # capture — and equals the no-capture reference.
+    for _ in range(2000):
+        if fut.done():
+            break
+        eng._tick()
+    out = fut.result(1)
+    eng.stop()
+    assert out == solo_reference(params, [PROMPTS[0]], max_new)[0]
+    # The captured generated tokens are a strict prefix of the output.
+    assert out[: len(ck.generated)] == ck.generated
+
+
+@cpu_only
+def test_burst_boundary_checkpoint_hook_fires(params):
+    captured = []
+    eng = make_engine(
+        params, n_slots=1, checkpoint_hook=captured.append, burst_windows=4,
+        steps_per_dispatch=2,
+    )
+    fut = eng.submit(PROMPTS[0], max_new=16)
+    for _ in range(400):
+        if fut.done():
+            break
+        eng._tick()
+    assert fut.done() and eng.burst_dispatches >= 1
+    assert len(captured) >= 1  # one capture per burst boundary
+    assert all(isinstance(cks, list) for cks in captured)
+    eng.stop()
+
+
+@cpu_only
+def test_forsake_disowns_without_failing_then_reopen_accepts(params):
+    eng = make_engine(params, burst_windows=1)
+    fut = eng.submit(PROMPTS[0], max_new=32)
+    for _ in range(6):
+        eng._tick()
+    assert not fut.done()
+    disowned = eng.forsake()
+    assert fut in disowned and not fut.done()
+    eng.stop()  # must NOT fail the disowned future
+    assert not fut.done()
+    # reopen() is the drain-rollback seam: a fresh engine drains empty,
+    # reopens, and accepts work again.
+    eng2 = make_engine(params)
+    eng2.stop(drain=True, drain_timeout_s=10)
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng2.submit(PROMPTS[0], max_new=2)
+    eng2.reopen()
+    fut2 = eng2.submit(PROMPTS[0], max_new=2)
+    for _ in range(200):
+        if fut2.done():
+            break
+        eng2._tick()
+    assert len(fut2.result(1)) == 2
+    eng2.stop()
