@@ -138,6 +138,10 @@ def drain_replica(
     router._candidates(exclude=handle)  # raises when none admit
     handle.state = constants.REPLICA_STATE_DRAINING
     report = DrainReport(replica_id=replica_id)
+    # drain_extract joins and clears a running loop thread; remember
+    # whether one was attached so a destination-failure rollback can
+    # restart it (reopen() only clears the stop/closed latches).
+    thread_driven = getattr(handle.engine, "_thread", None) is not None
     try:
         if supervisor is not None:
             from nos_tpu.serving.supervisor import SITE_DRAIN_EXTRACT
@@ -251,7 +255,12 @@ def drain_replica(
     if reopened:
         # The source holds rolled-back work again: it stays ACTIVE (the
         # move failed; the report says so) instead of retiring with
-        # streams aboard.
+        # streams aboard. A thread-driven engine gets its loop BACK
+        # before re-admitting — reopen() alone leaves the rolled-back
+        # streams queued on a dead-quiet engine that the router would
+        # keep placing new work on.
+        if thread_driven:
+            handle.engine.start()
         handle.state = constants.REPLICA_STATE_ACTIVE
         logger.warning(
             "drain of %s rolled back %d stream(s) onto the reopened "

@@ -355,6 +355,11 @@ class FleetSupervisor:
         self._clock = clock
         self._sleep = sleep if sleep is not None else time.sleep
         self._lock = threading.RLock()
+        # Sweeps serialize on their own lock so concurrent probe()
+        # callers (manual + background cadence) cannot double-count a
+        # failure streak, WITHOUT holding the state lock across the
+        # supervised calls themselves.
+        self._probe_lock = threading.Lock()
         self._health: Dict[str, _Health] = {}
         # Per-replica stream tracking: replica id -> {id(future): stream}
         # and the last-known checkpoint per stream, same key. Checkpoints
@@ -492,6 +497,43 @@ class FleetSupervisor:
                 tried.append(handle)
                 continue
             with self._lock:
+                if (
+                    handle.state == constants.REPLICA_STATE_RETIRED
+                    or handle.health == constants.REPLICA_HEALTH_DEAD
+                ):
+                    # Lost the race with the prober: the replica died
+                    # (its failover already swept the tracking tables
+                    # and forsook the engine queue) between the
+                    # successful engine.submit and this lock. Tracking
+                    # now would file the stream under a retired key no
+                    # failover will ever visit — the silent hang this
+                    # module exists to prevent. Resolve it like any
+                    # uncheckpointed stream on a dead replica:
+                    # classified, carrying the request for resubmit.
+                    exc = ReplicaLostError(
+                        f"replica {handle.replica_id} died during "
+                        "submit; resubmit the attached request",
+                        replica=handle.replica_id,
+                        prompt=list(prompt),
+                        max_new=max_new,
+                        tenant=tenant,
+                        trace_id=trace_id,
+                    )
+                    try:
+                        fut.set_exception(exc)
+                        self.futures_errored += 1
+                        if self.metrics is not None:
+                            self.metrics.inc("nos_tpu_fleet_futures_errored")
+                        self._event_locked(
+                            constants.FLEET_EV_FAILOVER,
+                            replica=handle.replica_id,
+                            failed_over=0,
+                            errored=1,
+                            replay_tokens=0,
+                        )
+                    except InvalidStateError:
+                        pass  # the engine resolved it first: keep that
+                    return fut
                 self._streams.setdefault(handle.replica_id, {})[id(fut)] = (
                     _TrackedStream(
                         prompt=list(prompt),
@@ -523,25 +565,50 @@ class FleetSupervisor:
                 continue
             table[id(ck.future)] = ck
         # Prune entries whose stream resolved (bounded by construction:
-        # one entry per outstanding future).
+        # one entry per OUTSTANDING future) — the stream tracking too,
+        # or a long-running fleet retains every request it ever served
+        # and each failover walks that whole history.
         for key in [k for k, c in table.items() if c.future.done()]:
             del table[key]
+        streams = self._streams.get(replica_id)
+        if streams:
+            for key in [k for k, s in streams.items() if s.future.done()]:
+                del streams[key]
 
     # -- health machine -------------------------------------------------------
     def probe(self) -> Dict[str, str]:
         """One supervised health sweep over every non-retired replica:
         probe + passive checkpoint ride-along through the guarded
         wrapper, success/failure folded into the health machine, DEAD
-        transitions fire failover inline. Returns the health map."""
-        with self._lock:
-            for handle in list(self.replica_set.handles):
-                rid = handle.replica_id
-                if handle.state == constants.REPLICA_STATE_RETIRED:
-                    self._streams.pop(rid, None)
-                    self._checkpoints.pop(rid, None)
-                    continue
-                if handle.health == constants.REPLICA_HEALTH_DEAD:
-                    continue
+        transitions fire failover inline. Returns the health map.
+
+        The supervised calls run OUTSIDE the state lock (a sweep-only
+        lock serializes concurrent probers): an unreachable replica
+        costs up to (timeout + backoff) x retries per call, and holding
+        the state lock through that would stall every healthy engine's
+        burst-boundary checkpoint hook and every submit() — a
+        fleet-wide pause exactly during failure handling. Each result
+        folds into the health machine under the state lock afterwards,
+        re-checking the handle (a concurrent `mark_dead`/retire may
+        have raced the call)."""
+        with self._probe_lock:
+            with self._lock:
+                targets: List[ReplicaHandle] = []
+                for handle in list(self.replica_set.handles):
+                    rid = handle.replica_id
+                    if handle.state == constants.REPLICA_STATE_RETIRED:
+                        # Retirement hygiene. Failover retirement
+                        # resolved/re-homed every tracked future before
+                        # retiring, and graceful drain re-homed each
+                        # stream with its client Future INTACT — so
+                        # dropping the tracking here strands nothing.
+                        self._streams.pop(rid, None)
+                        self._checkpoints.pop(rid, None)
+                        continue
+                    if handle.health == constants.REPLICA_HEALTH_DEAD:
+                        continue
+                    targets.append(handle)
+            for handle in targets:
                 engine = handle.engine
 
                 def _probe_and_capture(engine=engine):
@@ -555,15 +622,21 @@ class FleetSupervisor:
                         handle, SITE_PROBE, _probe_and_capture
                     )
                 except ReplicaUnreachableError as exc:
-                    self._note_failure_locked(handle, exc)
+                    with self._lock:
+                        if handle.state != constants.REPLICA_STATE_RETIRED:
+                            self._note_failure_locked(handle, exc)
                     continue
-                self._absorb_checkpoints_locked(rid, cks)
-                self._note_success_locked(handle)
-            return {
-                h.replica_id: h.health
-                for h in self.replica_set.handles
-                if h.state != constants.REPLICA_STATE_RETIRED
-            }
+                with self._lock:
+                    if handle.state == constants.REPLICA_STATE_RETIRED:
+                        continue
+                    self._absorb_checkpoints_locked(handle.replica_id, cks)
+                    self._note_success_locked(handle)
+            with self._lock:
+                return {
+                    h.replica_id: h.health
+                    for h in self.replica_set.handles
+                    if h.state != constants.REPLICA_STATE_RETIRED
+                }
 
     def health(self, replica_id: str) -> str:
         return self.replica_set.get(replica_id).health

@@ -15,6 +15,7 @@ failover exactness oracles and the seeded multi-replica chaos gate.
 """
 
 import random
+import threading
 import time
 from concurrent.futures import Future
 
@@ -372,6 +373,93 @@ def test_submit_retries_next_replica_on_unreachable():
     assert sum(len(h.engine.submitted) for h in rs.handles) == 1
     assert rs.get(first.replica_id).engine.submitted == []
     assert sup._health[first.replica_id].fail_streak == 1
+
+
+def test_submit_racing_replica_death_resolves_future():
+    """Race closure: engine.submit succeeds, then the prober marks the
+    replica dead (failover sweeps the tracking tables and retires it)
+    BEFORE submit() takes the lock. Tracking the stream under the
+    now-retired key would strand the future forever — instead it must
+    resolve like any uncheckpointed stream on a dead replica: a
+    classified ReplicaLostError carrying the request."""
+    rs, router = make_stub_fleet(1)
+    sup = make_supervisor(rs, router)
+    victim = rs.handles[0]
+    orig_submit = victim.engine.submit
+
+    def racing_submit(prompt, max_new, tenant=None, trace_id=None):
+        fut = orig_submit(prompt, max_new, tenant=tenant, trace_id=trace_id)
+        # The prober wins the race on the supervisor's own lock, after
+        # the engine accepted the request but before it is tracked.
+        sup.mark_dead(victim.replica_id)
+        return fut
+
+    victim.engine.submit = racing_submit
+    fut = sup.submit([1, 2, 3], max_new=4, tenant="t")
+    assert fut.done(), "stream submitted into a dead replica hung"
+    err = fut.exception()
+    assert isinstance(err, ReplicaLostError)
+    assert err.prompt == [1, 2, 3] and err.max_new == 4
+    assert err.tenant == "t" and err.replica == victim.replica_id
+    assert sup.futures_errored == 1
+    # Nothing is filed under the retired key for a failover to miss.
+    assert not sup._streams.get(victim.replica_id)
+
+
+def test_probe_releases_state_lock_during_supervised_calls():
+    """A sweep stuck on one unreachable replica (timeout x retries x
+    backoff) must not stall the healthy fleet: the supervised calls run
+    outside the state lock, so engine burst-boundary checkpoint hooks
+    and submit() tracking proceed while the prober waits."""
+    rs, router = make_stub_fleet(2)
+    sup = make_supervisor(rs, router)
+    entered = threading.Event()
+    release = threading.Event()
+    orig_probe = rs.handles[0].engine.probe
+
+    def slow_probe():
+        entered.set()
+        assert release.wait(10), "probe never released"
+        return orig_probe()
+
+    rs.handles[0].engine.probe = slow_probe
+    t = threading.Thread(target=sup.probe, daemon=True)
+    t.start()
+    assert entered.wait(10)
+    try:
+        # Mid-call the state lock is FREE...
+        assert sup._lock.acquire(timeout=2), (
+            "probe held the state lock across a supervised call"
+        )
+        sup._lock.release()
+        # ...so a submit (tracking under that lock) completes.
+        fut = sup.submit([1, 2, 3], max_new=4)
+        assert isinstance(fut, Future)
+    finally:
+        release.set()
+    t.join(10)
+    assert not t.is_alive()
+    # The racing submit's tracking survived the sweep's fold-in.
+    assert sum(len(v) for v in sup._streams.values()) == 1
+
+
+def test_tracked_streams_pruned_after_completion():
+    """Resolved streams leave the tracking tables on the next sweep:
+    without pruning, a long-running fleet retains every request it ever
+    served and each failover walks that whole history."""
+    rs, router = make_stub_fleet(2)
+    sup = make_supervisor(rs, router)
+    futs = [sup.submit([1, 2, i], max_new=4) for i in range(6)]
+    assert sum(len(v) for v in sup._streams.values()) == 6
+    for f in futs[:4]:
+        f.set_result([0])
+    sup.probe()
+    assert sum(len(v) for v in sup._streams.values()) == 2
+    for f in futs[4:]:
+        f.set_result([0])
+    sup.probe()
+    assert sum(len(v) for v in sup._streams.values()) == 0
+    assert sum(len(v) for v in sup._checkpoints.values()) == 0
 
 
 def test_supervised_drain_routes_sites_through_wrapper():
@@ -762,6 +850,41 @@ def test_drain_rolls_back_to_reopened_source_when_no_candidate(
     assert [f.result(1) for f in futs] == want
     check_invariants(src.engine._block_mgr)
     assert broken.engine._block_mgr.conserved()
+    rs.stop()
+
+
+@cpu_only
+def test_drain_rollback_restarts_thread_driven_source(params):
+    """Destination-failure rollback on a THREAD-DRIVEN fleet: reopen()
+    only clears the stop/closed latches, and drain_extract already
+    joined and cleared the loop thread — so the rollback must start()
+    a fresh one, or the rolled-back streams sit queued forever on an
+    ACTIVE (routable) replica. The streams must finish with NOBODY
+    ticking manually."""
+    max_new = 24
+    want = solo_reference(params, PROMPTS[:2], max_new)
+    rs = make_fleet(params, n=2)
+    router = PrefixRouter(rs)
+    src = rs.handles[0]
+    broken = rs.handles[1]
+    broken.engine.transfer_in_checkpoint = _raise_transfer  # type: ignore
+    broken.engine.transfer_in_request = _raise_transfer  # type: ignore
+    # Queue on the source BEFORE starting threads, so the drain
+    # deterministically finds work to roll back (greedy outputs are
+    # placement-independent; the solo reference applies).
+    futs = [
+        src.engine.submit(p, max_new=max_new) for p in PROMPTS[:2]
+    ]
+    for h in rs.handles:
+        h.engine.start()
+    report = drain_replica(rs, router, src.replica_id)
+    assert report.rolled_back >= 1
+    assert src.state == constants.REPLICA_STATE_ACTIVE
+    # The loop thread is BACK — without it these futures hang forever.
+    assert src.engine._thread is not None
+    assert [f.result(30) for f in futs] == want
+    assert src.engine._block_mgr.conserved()
+    check_invariants(src.engine._block_mgr)
     rs.stop()
 
 
