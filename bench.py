@@ -137,8 +137,11 @@ def _multi_replica(np, cfg, params, policy: str) -> dict:
     import time as _time
 
     from nos_tpu.runtime.decode_server import DecodeServer
-    from nos_tpu.serving import PrefixRouter, ReplicaSet
-    from nos_tpu.telemetry import percentile
+    from nos_tpu.serving import PrefixRouter, ReplicaSet, utilization_block
+    from nos_tpu.telemetry import collect_serving, percentile
+    from nos_tpu.tracing import EngineTracing, Tracer
+
+    shared_tracer = Tracer()
 
     srng = np.random.default_rng([2026, 8, 3])
     tenants = [f"t{k}" for k in range(6)]
@@ -179,6 +182,11 @@ def _multi_replica(np, cfg, params, policy: str) -> dict:
             prompt_buckets=(16, 32, 64, 128, 256),
             steps_per_dispatch=16,
             block_size=32,
+            # Tick profiler armed so the artifact can carry the
+            # chip-second duty-cycle block (outputs are bit-identical
+            # tracing-on vs off — the PR 9 oracle). One SHARED tracer:
+            # fleet-unique trace ids.
+            tracing=EngineTracing(tracer=shared_tracer),
         )
         for _ in range(3)
     ]
@@ -217,6 +225,12 @@ def _multi_replica(np, cfg, params, policy: str) -> dict:
         )
         return {
             "policy": policy,
+            # Per-chip-hour normalization (serving/accounting.py): wall
+            # here is the engines' profiled tick wall — counter math,
+            # so busy + overhead + waste == chip_seconds exactly.
+            "chip_accounting": utilization_block(
+                [collect_serving(h.engine) for h in replicas.handles]
+            ),
             "tok_s_aggregate": round(len(outs) * 32 / wall, 1),
             "ttft_p50_s": round(percentile(ttft_timed, 50), 4),
             "ttft_p95_s": round(percentile(ttft_timed, 95), 4),
@@ -743,7 +757,9 @@ def _multi_turn_chat(
     import dataclasses
 
     from nos_tpu.runtime.decode_server import DecodeServer
-    from nos_tpu.telemetry import percentile
+    from nos_tpu.serving import utilization_block
+    from nos_tpu.telemetry import collect_serving, percentile
+    from nos_tpu.tracing import EngineTracing
 
     if cfg.max_seq < max_len:
         cfg = dataclasses.replace(cfg, max_seq=max_len)
@@ -780,6 +796,7 @@ def _multi_turn_chat(
             temperature=temperature,
             prefix_cache=prefix_cache,
             radix_cache=radix_cache,
+            tracing=EngineTracing(),
         ).prewarm()
         server.start()
         histories = [list(h) for h in histories0]
@@ -820,6 +837,12 @@ def _multi_turn_chat(
                 "radix_nodes": server.radix_nodes,
                 "ttft_p50_turn2_s": round(percentile(later_ttft, 50), 4),
                 "ttft_p95_turn2_s": round(percentile(later_ttft, 95), 4),
+                # Chip-second accounting over the arm's profiled wall
+                # (counter math; docs/benchmark.md honesty note — the
+                # CPU-smoke duty cycle is not TPU MFU).
+                "chip_accounting": utilization_block(
+                    [collect_serving(server)]
+                ),
             }
         finally:
             server.stop()
@@ -889,7 +912,15 @@ def _fleet_pressure(
     from nos_tpu.observability import Metrics
     from nos_tpu.runtime.decode_server import DecodeServer
     from nos_tpu.runtime.quota import QuotaPolicy, TenantShare
-    from nos_tpu.serving import FleetMonitor, ReplicaSet, SLOTarget
+    from nos_tpu.serving import (
+        CostLedger,
+        FleetMonitor,
+        ReplicaSet,
+        SLOTarget,
+        utilization_block,
+    )
+    from nos_tpu.telemetry import collect_serving
+    from nos_tpu.tracing import EngineTracing, Tracer
 
     srng = np.random.default_rng([2026, 12, 3])
     shares = {"gold": TenantShare(0.5, 1.0), "bulk": TenantShare(0.0, 1.0)}
@@ -910,6 +941,13 @@ def _fleet_pressure(
     ]
 
     def run(monitor_on):
+        # One CostLedger AND one Tracer shared across the fleet, BOTH
+        # arms (the accounting plane must not perturb the schedule —
+        # the outputs/counters-identical gates below cover it alongside
+        # the monitor); the tick profiler feeds the chip_accounting
+        # block, the shared tracer keeps receipt keys fleet-unique.
+        ledger = CostLedger()
+        shared_tracer = Tracer()
         engines = [
             DecodeServer(
                 params,
@@ -922,6 +960,8 @@ def _fleet_pressure(
                 block_size=8,
                 seed=11,
                 quota=QuotaPolicy(dict(shares), window_ticks=64),
+                tracing=EngineTracing(tracer=shared_tracer),
+                cost_ledger=ledger,
             )
             for _ in range(3)
         ]
@@ -931,6 +971,7 @@ def _fleet_pressure(
                 rs,
                 metrics=Metrics(),
                 slo={"gold": SLOTarget(ttft_p95_s=2.0, min_tok_s=1.0)},
+                ledger=ledger,
             )
             if monitor_on
             else None
@@ -1016,6 +1057,9 @@ def _fleet_pressure(
             for e in engines
         )
         journal = mon.journal_lines() if mon is not None else []
+        chip = utilization_block([collect_serving(e) for e in engines])
+        busy_slot_s = sum(e.slot_seconds_total for e in engines)
+        charged_slot_s = ledger.charged_slot_seconds()
         for e in engines:
             e.stop()
         return {
@@ -1028,6 +1072,20 @@ def _fleet_pressure(
             "w_inj_hot": w_inj_hot,
             "w_inj_starved": w_inj_starved,
             "quota_starved_at_detection": detect["quota_starved_at_detection"],
+            "chip": chip,
+            # Conservation law: per-tenant charged slot-seconds ==
+            # fleet busy slot-seconds (same release-site accumulation
+            # on both sides — a drifted charge site shows up here).
+            "conservation": {
+                "charged_slot_seconds": round(charged_slot_s, 6),
+                "busy_slot_seconds": round(busy_slot_s, 6),
+                "holds": abs(charged_slot_s - busy_slot_s)
+                <= 1e-6 * max(1.0, busy_slot_s),
+            },
+            "tenant_cost": {
+                t: {k: round(v, 6) for k, v in acct.items()}
+                for t, acct in ledger.tenant_totals().items()
+            },
         }
 
     walls_off, walls_on = [], []
@@ -1130,6 +1188,13 @@ def _fleet_pressure(
             "parses": parses,
             "replay_verdicts_match": replay_matches,
         },
+        "chip_accounting": on["chip"],
+        "conservation": on["conservation"],
+        "tenant_cost": on["tenant_cost"],
+        "tok_s_per_chip_hour_final": round(
+            on["reports"][-1].tok_s_per_chip_hour, 2
+        ),
+        "waste_fraction_final": round(on["reports"][-1].waste_fraction, 4),
         "slo_events": len(mon.slo.events) if mon.slo is not None else 0,
         "headroom_final": round(on["reports"][-1].headroom, 4),
         "timeline": [
@@ -1180,7 +1245,10 @@ def _fleet_failover(
         PrefixRouter,
         ReplicaFaultInjector,
         ReplicaSet,
+        utilization_block,
     )
+    from nos_tpu.telemetry import collect_serving
+    from nos_tpu.tracing import EngineTracing, Tracer
 
     srng = np.random.default_rng([2026, 14, 1])
     prompts = [
@@ -1190,6 +1258,7 @@ def _fleet_failover(
     state = {"victim_idx": None, "kill_wave": None}
 
     def build():
+        shared_tracer = Tracer()
         engines = [
             DecodeServer(
                 params,
@@ -1201,6 +1270,7 @@ def _fleet_failover(
                 burst_windows=1,
                 block_size=8,
                 seed=11,
+                tracing=EngineTracing(tracer=shared_tracer),
             )
             for _ in range(n_replicas)
         ]
@@ -1291,6 +1361,12 @@ def _fleet_failover(
             "completed": sum(1 for c in completed if c is not None),
             "stranded_futures": sum(1 for f in futs if not f.done()),
             "outputs": completed,
+            # Chip-second decomposition over the whole fleet's profiled
+            # wall — the dead replica's chips stop accruing when it
+            # stops ticking, so the kill is visible as lost capacity.
+            "chip_accounting": utilization_block(
+                [collect_serving(h.engine) for h in rs.handles]
+            ),
             "survivors_conserved": survivors_conserved,
             "router_selections_of_dead_after_detection": (
                 0

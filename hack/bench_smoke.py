@@ -91,6 +91,20 @@ prefix cache, docs/radix-cache.md) with its own gates:
     arm (NOS_TPU_RADIX_TTFT_TOLERANCE_PCT, default 50% — the counter
     gates carry the protection; tiny-model TTFT deltas are ms-scale).
 
+ISSUE 15 adds the chip-second accounting blocks (serving/accounting.py,
+docs/telemetry.md "Utilization & cost accounting") with gates that are
+counter math end to end, never wall-clock thresholds:
+
+  - every fleet-scope scenario artifact (`fleet_pressure`,
+    `fleet_failover`, `multi_turn_chat`; `multi_replica` in the full
+    bench) carries a `chip_accounting` block with a real `chip_hours`
+    denominator and `tok_s_per_chip_hour` / `waste_fraction`;
+  - the duty-cycle partition is EXACT: busy + overhead + waste == wall
+    (`identity_residual_s` ~ 0 by construction — the decomposition
+    clamps, it never estimates);
+  - the cost conservation law holds on the `fleet_pressure` fleet:
+    per-tenant charged slot-seconds == summed engine busy slot-seconds.
+
 Exit 0 and print the artifacts on success; exit 1 with the failed gate
 otherwise.
 """
@@ -303,6 +317,54 @@ def main() -> int:
             f"{fleet_parsed['wall_noise_pct']}%)"
         )
 
+    # -- ISSUE 15: chip-second accounting blocks + conservation ------------
+    def check_chip_block(scenario, block):
+        """Per-chip-hour normalization gates, counter math only (never
+        wall-clock-gated): the block is present, the denominator is
+        real, and busy + overhead + waste == wall exactly."""
+        if not isinstance(block, dict):
+            failures.append(f"{scenario}: chip_accounting block missing")
+            return
+        for key in (
+            "chip_hours",
+            "tok_s_per_chip_hour",
+            "waste_fraction",
+            "identity_residual_s",
+        ):
+            if key not in block:
+                failures.append(f"{scenario}: chip_accounting missing {key}")
+                return
+        if block["chip_hours"] <= 0:
+            failures.append(
+                f"{scenario}: chip_hours denominator is "
+                f"{block['chip_hours']} (profiler never ran?)"
+            )
+        if block["tok_s_per_chip_hour"] <= 0:
+            failures.append(
+                f"{scenario}: tok_s_per_chip_hour is "
+                f"{block['tok_s_per_chip_hour']}"
+            )
+        wall = float(block["chip_seconds"])
+        if abs(block["identity_residual_s"]) > 1e-6 * max(1.0, wall):
+            failures.append(
+                f"{scenario}: busy+overhead+waste != wall "
+                f"(residual {block['identity_residual_s']}s of {wall}s)"
+            )
+        if not (0.0 <= block["waste_fraction"] <= 1.0):
+            failures.append(
+                f"{scenario}: waste_fraction {block['waste_fraction']} "
+                "outside [0, 1]"
+            )
+
+    check_chip_block("fleet_pressure", fleet_parsed.get("chip_accounting"))
+    if not fleet_parsed.get("conservation", {}).get("holds"):
+        failures.append(
+            "fleet_pressure: cost conservation violated: charged "
+            f"{fleet_parsed.get('conservation', {}).get('charged_slot_seconds')}"
+            " slot-s vs busy "
+            f"{fleet_parsed.get('conservation', {}).get('busy_slot_seconds')}"
+        )
+
     # -- ISSUE 14: fleet failover (supervisor on vs off) -------------------
     failover = bench._fleet_failover(np, cfg, params)
     failover_payload = json.dumps(failover, sort_keys=True)
@@ -348,6 +410,7 @@ def main() -> int:
     for key in ("failover_latency_p50_s", "failover_latency_p95_s"):
         if key not in fo_on:
             failures.append(f"fleet_failover: artifact missing {key}")
+    check_chip_block("fleet_failover", fo_on.get("chip_accounting"))
 
     # -- ISSUE 13: the radix-tree multi-turn chat A/B ----------------------
     chat = bench._multi_turn_chat(np, cfg, params)
@@ -399,6 +462,9 @@ def main() -> int:
                 f"{tree['ttft_p95_turn2_s']}s regressed beyond {ttft_tol}% of "
                 f"chain {chain['ttft_p95_turn2_s']}s"
             )
+        check_chip_block(
+            f"multi_turn_chat[{tkey}].tree", tree.get("chip_accounting")
+        )
 
     if failures:
         for f in failures:
@@ -424,6 +490,12 @@ def main() -> int:
         f"{shard_parsed['tp2']['h2d_uploads']}/"
         f"{shard_parsed['tp2']['staging_syncs']}/"
         f"{shard_parsed['tp2']['blocking_syncs']} uploads/syncs/reads); "
+        f"chip accounting: fleet_pressure "
+        f"{fleet_parsed['chip_accounting']['chip_seconds']:.2f} chip-s, "
+        f"{fleet_parsed['chip_accounting']['tok_s_per_chip_hour']:.0f} "
+        f"tok/chip-h, waste "
+        f"{fleet_parsed['chip_accounting']['waste_fraction']:.3f}, "
+        f"conservation {fleet_parsed['conservation']['holds']}; "
         f"fleet pressure: hot w{fleet_parsed['hot']['injected_window']}->"
         f"w{fleet_parsed['hot']['detected_window']}, starved "
         f"w{fleet_parsed['starved']['injected_window']}->"

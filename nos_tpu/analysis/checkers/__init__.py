@@ -10,6 +10,7 @@ from nos_tpu.analysis.core import Checker
 
 def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
+    from nos_tpu.analysis.checkers.cost_discipline import CostDisciplineChecker
     from nos_tpu.analysis.checkers.device_placement import DevicePlacementChecker
     from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
     from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
@@ -37,4 +38,5 @@ def all_checkers() -> List[Checker]:
         StagingDisciplineChecker(),
         DevicePlacementChecker(),
         TraceDisciplineChecker(),
+        CostDisciplineChecker(),
     ]

@@ -293,6 +293,82 @@ FLEET_EVENTS = (
     FLEET_EV_DEATH,
     FLEET_EV_FAILOVER,
 )
+# ---------------------------------------------------------------------------
+# Fleet utilization & cost-attribution plane (nos_tpu/serving/accounting.py,
+# the `metricsexporter` port — docs/telemetry.md "Utilization & cost
+# accounting"). The key strings below ARE the accounting protocol: the
+# duty-cycle fields journaled inside FLEET_EV_WINDOW rows (so
+# `FleetMonitor.replay` re-derives the decomposition from the journal
+# alone), the CostLedger charge-field vocabulary (receipts and per-tenant
+# totals), and the waste taxonomy. A field spelled inline in the serving
+# plane would drift exactly like a mistyped annotation — the NOS018
+# checker (analysis/checkers/cost_discipline.py) flags these values used
+# as literals outside this file.
+# ---------------------------------------------------------------------------
+# Duty-cycle inputs journaled on each replica window row (deltas of the
+# engine's profiler/recovery counters over the window, seconds, per
+# ENGINE — the chip scaling by tp_devices happens in the decomposition).
+ACCT_KEY_DISPATCH_S = "dispatch_s"          # wall inside jitted calls
+ACCT_KEY_HOST_S = "host_overhead_s"         # tick wall minus dispatch
+ACCT_KEY_TICK_WALL_S = "tick_wall_s"        # profiled tick wall
+ACCT_KEY_IDLE_S = "idle_s"                  # idle tick-phase wall
+ACCT_KEY_REVIVE_S = "revive_pump_s"         # spill-revive pump phase wall
+ACCT_KEY_RESTORE_S = "restore_s"            # restore-latency sample sum
+ACCT_KEY_KV_BLOCK_TICKS = "kv_block_ticks"  # sum over ticks of blocks held
+# The derived decomposition attached to the row (and re-derivable from
+# the inputs above — `accounting.duty_cycle` is pure over the row).
+ACCT_KEY_DUTY = "duty"
+ACCT_KEY_WALL_CHIP_S = "wall_chip_s"
+ACCT_KEY_BUSY_CHIP_S = "busy_chip_s"
+ACCT_KEY_OVERHEAD_CHIP_S = "overhead_chip_s"
+ACCT_KEY_WASTE_CHIP_S = "waste_chip_s"
+ACCT_KEY_WASTE = "waste"
+# Fleet roll-up fields (PressureReport / bench chip_accounting block).
+ACCT_KEY_CHIP_SECONDS = "chip_seconds"
+ACCT_KEY_CHIP_HOURS = "chip_hours"
+ACCT_KEY_TOK_S_PER_CHIP_HOUR = "tok_s_per_chip_hour"
+ACCT_KEY_WASTE_FRACTION = "waste_fraction"
+# Named waste taxonomy ("where did the rest of the chip-seconds go"):
+# the dotted prefix keeps the names distinctive (a bare "idle" is the
+# slot phase machine's vocabulary, not this one).
+WASTE_IDLE = "waste.idle"                  # nothing to do (incl. unmeasured slack)
+WASTE_DRAINING = "waste.draining"          # capacity leaving the fleet
+WASTE_UNREACHABLE = "waste.unreachable"    # suspect/unreachable window
+WASTE_RECOVERY = "waste.recovery"          # restore/replay host time
+WASTE_SPILL_REVIVE = "waste.spill_revive"  # spill/revive copy traffic
+WASTE_CAUSES = (
+    WASTE_IDLE,
+    WASTE_DRAINING,
+    WASTE_UNREACHABLE,
+    WASTE_RECOVERY,
+    WASTE_SPILL_REVIVE,
+)
+# CostLedger charge fields: what a request/tenant is billed, at the
+# engine's existing bookkeeping sites (macro/burst/spec-accept, the
+# prefill charge, spill/revive, failover replay, slot release).
+COST_SLOT_SECONDS = "slot_seconds"              # decode-slot hold time
+COST_CHIP_MS = "chip_ms"                        # slot_seconds x tp/n_slots
+COST_DECODE_TOKENS = "decode_tokens"            # generated tokens
+COST_PREFILL_CHARGED = "prefill_tokens_charged"  # prompt tokens computed
+COST_PREFILL_CACHED = "prefill_tokens_cached"    # prompt tokens served from cache
+COST_KV_BLOCK_TICKS = "kv_block_ticks"          # pool-block x tick products
+COST_SPILL_BYTES = "spill_bytes"                # spill/revive bytes moved
+COST_REPLAY_TOKENS = "replay_tokens"            # recovery/failover replay
+COST_FIELDS = (
+    COST_SLOT_SECONDS,
+    COST_CHIP_MS,
+    COST_DECODE_TOKENS,
+    COST_PREFILL_CHARGED,
+    COST_PREFILL_CACHED,
+    COST_KV_BLOCK_TICKS,
+    COST_SPILL_BYTES,
+    COST_REPLAY_TOKENS,
+)
+# Receipt status vocabulary (the req.finish/failure terminus).
+RECEIPT_STATUS_OK = "ok"
+RECEIPT_STATUS_FAILED = "failed"
+RECEIPT_STATUSES = (RECEIPT_STATUS_OK, RECEIPT_STATUS_FAILED)
+
 # Engine per-tenant probe keys (DecodeServer.tenant_probe() — plain
 # host-side reads the monitor converts into windowed per-tenant rates).
 TENANT_KEY_TOKENS = "tokens"            # cumulative decode tokens produced
@@ -426,6 +502,11 @@ TICK_PHASES = (
 DEBUG_PATH_EVENTS = "/debug/events"
 DEBUG_PATH_TRACE_PREFIX = "/debug/trace/"
 DEBUG_PATH_PRESSURE = "/debug/pressure"
+# Per-tenant cost roll-up + receipts (serving/accounting.py CostLedger).
+DEBUG_PATH_ACCOUNTING = "/debug/accounting"
+# Discoverability index: a JSON list of the ARMED debug surfaces above
+# (404 when none is armed, bearer-guarded like each of them).
+DEBUG_PATH_INDEX = "/debug"
 # Prometheus text exposition format version (what scrapers negotiate on).
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
 

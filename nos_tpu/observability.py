@@ -244,6 +244,7 @@ class ObservabilityServer:
         tracer=None,
         recorder=None,
         pressure=None,
+        accounting=None,
     ):
         """In-cluster deployments bind host='0.0.0.0' on the configured
         health_probe_port so kubelet httpGet probes can reach the pod IP;
@@ -269,13 +270,26 @@ class ObservabilityServer:
         SLO state, and journal bookkeeping (docs/fleet-monitor.md).
         Same auth posture as the other debug paths — fleet pressure is
         capacity-planning intelligence, at least as sensitive as the
-        metrics."""
+        metrics.
+
+        `accounting` (optional, duck-typed to
+        serving/accounting.py CostLedger — anything exposing
+        `snapshot()` and `receipt(trace_id)`) arms /debug/accounting
+        (the per-tenant cost roll-up + recent receipts) and attaches
+        each request's cost RECEIPT to its /debug/trace/<id> payload.
+        Billing data is tenant-identifying — same auth posture again.
+
+        GET /debug (constants.DEBUG_PATH_INDEX) is the discoverability
+        index: a JSON list of whichever debug surfaces above are armed,
+        404 when none is (the same bearer-token and 404-unarmed
+        semantics as the surfaces it lists)."""
         self.metrics = metrics_registry
         self.health = health
         self.metrics_token = metrics_token
         self.tracer = tracer
         self.recorder = recorder
         self.pressure = pressure
+        self.accounting = accounting
         obs = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -349,6 +363,40 @@ class ObservabilityServer:
                         body = json.dumps(obs.pressure.pressure_snapshot()).encode()
                         ctype = "application/json"
                         self.send_response(200)
+                elif self.path == constants.DEBUG_PATH_ACCOUNTING:
+                    if not self._authorized():
+                        self._reply_401()
+                        return
+                    if obs.accounting is None:
+                        body = b"cost ledger not attached"
+                        self.send_response(404)
+                    else:
+                        body = json.dumps(obs.accounting.snapshot()).encode()
+                        ctype = "application/json"
+                        self.send_response(200)
+                elif self.path == constants.DEBUG_PATH_INDEX:
+                    # Discoverability: which debug surfaces are armed.
+                    if not self._authorized():
+                        self._reply_401()
+                        return
+                    surfaces = []
+                    if obs.recorder is not None:
+                        surfaces.append(constants.DEBUG_PATH_EVENTS)
+                    if obs.tracer is not None:
+                        surfaces.append(
+                            constants.DEBUG_PATH_TRACE_PREFIX + "<id>"
+                        )
+                    if obs.pressure is not None:
+                        surfaces.append(constants.DEBUG_PATH_PRESSURE)
+                    if obs.accounting is not None:
+                        surfaces.append(constants.DEBUG_PATH_ACCOUNTING)
+                    if not surfaces:
+                        body = b"no debug surface armed"
+                        self.send_response(404)
+                    else:
+                        body = json.dumps({"surfaces": surfaces}).encode()
+                        ctype = "application/json"
+                        self.send_response(200)
                 elif self.path.startswith(constants.DEBUG_PATH_TRACE_PREFIX):
                     if not self._authorized():
                         self._reply_401()
@@ -361,9 +409,12 @@ class ObservabilityServer:
                         body = b"no such trace"
                         self.send_response(404)
                     else:
-                        body = json.dumps(
-                            {"trace_id": tid, "events": events}
-                        ).encode()
+                        payload = {"trace_id": tid, "events": events}
+                        if obs.accounting is not None:
+                            # The request's cost receipt rides its trace
+                            # (None while open / for unknown ids).
+                            payload["receipt"] = obs.accounting.receipt(tid)
+                        body = json.dumps(payload).encode()
                         ctype = "application/json"
                         self.send_response(200)
                 else:

@@ -254,6 +254,10 @@ class _Slot:
     # in prefix order, consumed front-first as the cursor advances.
     tenant: Optional[str] = None
     pending_revives: List[Tuple[int, int, str]] = field(default_factory=list)
+    # Cost-attribution state (nos_tpu/serving/accounting.py): when this
+    # slot's reservation began — the start of the slot-seconds interval
+    # charged to the tenant at release (0.0 = ledger off / never held).
+    t_reserved: float = 0.0
     # Radix-tree COW state (PR 13): the staged copy-on-write the budget
     # scheduler still has to perform — (token offset, destination block,
     # pinned source block or None for a host-tier source, source chain
@@ -309,6 +313,7 @@ class DecodeServer:
         max_transient_retries: int = 4,
         transient_backoff_s: float = 0.02,
         checkpoint_hook=None,
+        cost_ledger=None,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
         softmax sampling with a deterministic per-slot, per-step PRNG stream
@@ -564,7 +569,24 @@ class DecodeServer:
         token refs are materializable there. The hook must only READ the
         checkpoints (they alias live Futures); it never changes engine
         behavior — outputs and dispatch counters are bit-identical hook
-        armed vs not."""
+        armed vs not.
+
+        `cost_ledger` (optional, duck-typed to
+        nos_tpu/serving/accounting.py CostLedger; default None = zero
+        cost) arms PER-TENANT COST ATTRIBUTION: the engine charges
+        slot-seconds (+ the chip-ms estimate `slot_seconds x tp /
+        n_slots`), decode tokens, charged-vs-cached prefill tokens,
+        KV-block-tick products, spill/revive bytes, and recovery/
+        failover replay tokens to the request's tenant at the existing
+        bookkeeping sites, and closes a bounded per-request RECEIPT at
+        the req.finish/failure terminus (keyed by the trace id, so arm
+        a tracer for receipts; tenant totals accrue either way). Share
+        ONE ledger across a replica fleet — tenant and trace identity
+        ride SlotCheckpoint, so a preempted/migrated/failed-over
+        stream's charges follow it. The ledger only observes host
+        bookkeeping the engine already performs: outputs and dispatch
+        counters are bit-identical ledger-on vs ledger-off (the
+        counter-gated oracle in tests/test_accounting.py)."""
         # Tensor-parallel serving (docs/sharded-decode.md): a mesh whose
         # tp axis is wider than 1 arms sharded decode — params placed by
         # the decode rules, pool head-partitioned, every program
@@ -679,6 +701,9 @@ class DecodeServer:
         if spill_blocks is None:
             spill_blocks = self.total_blocks
         self.spill_tier: Optional[SpillTier] = None
+        # Full-width payload size of one spilled block (the cost plane's
+        # spill/revive byte unit; 0 with the tier disabled).
+        self._bytes_per_block = 0
         if spill_blocks > 0:
             bytes_per_block = (
                 cfg.layers
@@ -688,6 +713,7 @@ class DecodeServer:
                 * cfg.head_dim
                 * np.dtype(cfg.jdtype).itemsize
             )
+            self._bytes_per_block = int(bytes_per_block)
             self.spill_tier = SpillTier(int(spill_blocks) * bytes_per_block)
             self._block_mgr.attach_spill(self.spill_tier, self._extract_block)
         # Elastic tenant quotas (PR 7, runtime/quota.py): None = no quota
@@ -696,6 +722,19 @@ class DecodeServer:
         self._quota = quota
         self._tick_tokens: Dict[str, int] = {}
         self.preemptions = 0
+        # Cost-attribution plane (nos_tpu/serving/accounting.py): the
+        # shared fleet CostLedger (None = default-off, zero cost) plus
+        # the engine-side conservation counters — slot-seconds
+        # accumulate at the SAME release site the ledger is charged
+        # from, so per-tenant charges sum to the engine total by
+        # construction. chip-ms per request is estimated at
+        # slot_seconds x (devices / slots): one slot's share of the
+        # replica's chips for the time it was held.
+        self._cost = cost_ledger
+        self._chip_rate = float(self.tp) / float(max(1, n_slots))
+        self.slot_seconds_total = 0.0
+        self.kv_block_ticks = 0
+        self.cost_receipts = 0
         # Delta-mirror shadow for monotonic counters owned by the tier /
         # manager / policy (published into the metrics registry per tick).
         self._metric_shadow: Dict[str, int] = {}
@@ -1548,6 +1587,7 @@ class DecodeServer:
         for idx, slot in enumerate(self._slots):
             if slot.future is not None and not slot.future.done():
                 slot.future.set_exception(exc)
+                self._close_receipt(slot, constants.RECEIPT_STATUS_FAILED, 0)
             self._release_slot(idx)
         self._inflight.clear()
         # Unresolved verify rounds refer to slots that no longer exist.
@@ -1569,10 +1609,48 @@ class DecodeServer:
         lane. Shared blocks only DECREMENT; refcount-0 indexed blocks
         retire to the cached-free LRU for the next prefix hit —
         `spill=True` (preemption) sends them to the HOST tier instead,
-        freeing HBM immediately."""
+        freeing HBM immediately. With a CostLedger armed this is ALSO
+        the single slot-seconds charge site: every release (finish,
+        eos, poison, preemption, drain extract, recovery sweep) bills
+        the held interval to the slot's tenant AND accumulates the same
+        value into `slot_seconds_total`, so per-tenant charges sum to
+        the engine total by construction (the conservation law)."""
+        if self._cost is not None:
+            self._note_slot_release(idx)
         self._block_mgr.release(idx, spill=spill)
         self._slots[idx] = _Slot()
         self._tick_state.mark_table_dirty()
+
+    def _note_slot_release(self, idx: int) -> None:
+        slot = self._slots[idx]
+        if not slot.active or not slot.t_reserved:
+            return
+        held = max(0.0, time.monotonic() - slot.t_reserved)
+        slot.t_reserved = 0.0
+        self.slot_seconds_total += held
+        self._cost.charge(
+            slot.trace_id,
+            slot.tenant or "",
+            slot_seconds=held,
+            chip_ms=held * 1000.0 * self._chip_rate,
+        )
+
+    def _close_receipt(
+        self, slot: _Slot, status: str, tokens: Optional[int] = None
+    ) -> Optional[dict]:
+        """Finalize the request's cost receipt at its finish/failure
+        terminus (no-op without a ledger or a trace id — tenant totals
+        accrued regardless). Charges that land after the close (the
+        release's trailing slot-seconds on some recovery paths) fold
+        into the closed receipt inside the ledger."""
+        if self._cost is None:
+            return None
+        rec = self._cost.close_request(
+            slot.trace_id, slot.tenant or "", status=status, tokens=tokens
+        )
+        if rec is not None:
+            self.cost_receipts += 1
+        return rec
 
     def _reset_device_state(self) -> None:
         """After an engine error the donated cache chain is untrustworthy;
@@ -1821,6 +1899,29 @@ class DecodeServer:
                     self.admissions_by_tenant[tname] = (
                         self.admissions_by_tenant.get(tname, 0) + 1
                     )
+                if self._cost is not None:
+                    # Cost plane: the slot-seconds interval opens at the
+                    # reservation; cached prefill (device hits + the
+                    # staged COW head) and recovery/failover replay are
+                    # charged from values admission just computed.
+                    slot.t_reserved = time.monotonic()
+                    acct_tenant = req.tenant or ""
+                    self._cost.open_request(slot.trace_id, acct_tenant)
+                    cached = n_hit * self.block_size
+                    if slot.pending_cow is not None:
+                        cached += int(slot.pending_cow[4])
+                    if cached:
+                        self._cost.charge(
+                            slot.trace_id,
+                            acct_tenant,
+                            prefill_tokens_cached=cached,
+                        )
+                    if req.t_restore:
+                        self._cost.charge(
+                            slot.trace_id,
+                            acct_tenant,
+                            replay_tokens=len(full_prompt),
+                        )
                 if self._tracer is not None:
                     self._tracer.event(
                         slot.trace_id,
@@ -1988,6 +2089,16 @@ class DecodeServer:
                 slot.phase = "prefilling"
             copies += 1
             used += cost
+            if self._cost is not None:
+                # A revive serves `block_size` prompt tokens from the
+                # host tier instead of recompute (cached service), at
+                # the price of one full-width payload copy-in.
+                self._cost.charge(
+                    slot.trace_id,
+                    slot.tenant or "",
+                    prefill_tokens_cached=cost,
+                    spill_bytes=self._bytes_per_block,
+                )
             # The revived block is device-resident again: re-index it so
             # concurrent same-prefix arrivals hit the device tier.
             self._block_mgr.note_progress(idx, slot.prefill_cursor)
@@ -2149,6 +2260,12 @@ class DecodeServer:
             if slot.phase == "reserved":
                 slot.phase = "prefilling"
             self.prefill_tokens += len(piece)
+            if self._cost is not None:
+                self._cost.charge(
+                    slot.trace_id,
+                    slot.tenant or "",
+                    prefill_tokens_charged=len(piece),
+                )
             if self._tracer is not None:
                 self._tracer.event(
                     slot.trace_id,
@@ -2232,12 +2349,30 @@ class DecodeServer:
             tokens = tokens[: tokens.index(self.eos_id) + 1]
         return list(slot.replay) + tokens
 
-    def _trace_finish(self, idx: int, slot: _Slot, n_tokens: int) -> None:
+    def _trace_finish(
+        self, idx: int, slot: _Slot, n_tokens: int, receipt: Optional[dict] = None
+    ) -> None:
         """The lifecycle terminus: one span event + one recorder event
-        per completed request (counts/ids only)."""
+        per completed request (counts/ids only). When the cost plane
+        issued a receipt, its numeric fields ride the finish span as
+        scalar attrs — the per-request cost summary attached exactly
+        where the request's trace ends."""
         if self._tracer is not None:
+            attrs = {}
+            if receipt is not None:
+                attrs = {
+                    k: round(v, 6) if isinstance(v, float) else v
+                    for k, v in receipt.items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                    and k not in ("tokens", "slot")
+                }
             self._tracer.event(
-                slot.trace_id, constants.TRACE_EV_FINISH, slot=idx, tokens=n_tokens
+                slot.trace_id,
+                constants.TRACE_EV_FINISH,
+                slot=idx,
+                tokens=n_tokens,
+                **attrs,
             )
         if self._recorder is not None:
             self._recorder.record(
@@ -2258,8 +2393,13 @@ class DecodeServer:
             out = self._finalize(slot)
             self._register_output(idx, slot, out)
             slot.future.set_result(out)
-            self._trace_finish(idx, slot, len(out))
+            # Release BEFORE the trace terminus so the receipt closed
+            # there carries the final slot-seconds interval.
             self._release_slot(idx)
+            receipt = self._close_receipt(
+                slot, constants.RECEIPT_STATUS_OK, len(out)
+            )
+            self._trace_finish(idx, slot, len(out), receipt)
 
     def _register_output(self, idx: int, slot: _Slot, out: List[int]) -> None:
         """Radix mode: key the finished request's generated-token blocks
@@ -2302,8 +2442,11 @@ class DecodeServer:
                     out = self._finalize(slot)
                     self._register_output(idx, slot, out)
                     slot.future.set_result(out)
-                    self._trace_finish(idx, slot, len(out))
                     self._release_slot(idx)
+                    receipt = self._close_receipt(
+                        slot, constants.RECEIPT_STATUS_OK, len(out)
+                    )
+                    self._trace_finish(idx, slot, len(out), receipt)
                     break
 
     # -- speculative rounds ---------------------------------------------------
@@ -2475,6 +2618,10 @@ class DecodeServer:
                 self.tokens_by_tenant[tname] = (
                     self.tokens_by_tenant.get(tname, 0) + len(accepted)
                 )
+                if self._cost is not None:
+                    self._cost.charge(
+                        slot.trace_id, tname, decode_tokens=len(accepted)
+                    )
             if self._quota is not None and accepted:
                 tenant = slot.tenant or ""
                 self._tick_tokens[tenant] = (
@@ -2615,6 +2762,10 @@ class DecodeServer:
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_exception(exc)
                 self.requests_poisoned += 1
+                # Failure terminus: the poisoned request's receipt
+                # closes FAILED (the release below folds its trailing
+                # slot-seconds into the closed receipt).
+                self._close_receipt(slot, constants.RECEIPT_STATUS_FAILED, 0)
                 if self._tracer is not None:
                     # The poisoned request's trace terminates here — a
                     # finish marked failed, not a silent dead end.
@@ -2702,12 +2853,18 @@ class DecodeServer:
             tokens = tokens[: tokens.index(self.eos_id) + 1]
             if slot.future is not None and not slot.future.done():
                 slot.future.set_result(tokens)
-                self._trace_finish(idx, slot, len(tokens))
+                receipt = self._close_receipt(
+                    slot, constants.RECEIPT_STATUS_OK, len(tokens)
+                )
+                self._trace_finish(idx, slot, len(tokens), receipt)
             return None
         if len(tokens) >= slot.max_new:
             if slot.future is not None and not slot.future.done():
                 slot.future.set_result(tokens[: slot.max_new])
-                self._trace_finish(idx, slot, slot.max_new)
+                receipt = self._close_receipt(
+                    slot, constants.RECEIPT_STATUS_OK, slot.max_new
+                )
+                self._trace_finish(idx, slot, slot.max_new, receipt)
             return None
         spec = slot.adapt.snapshot(len(slot.refs)) if slot.adapt is not None else None
         return SlotCheckpoint(
@@ -2767,7 +2924,19 @@ class DecodeServer:
         if self._recorder is not None:
             self._recorder.record(constants.FLIGHT_EV_PREEMPT, slot=idx)
         ck = self._checkpoint_slot(idx)
+        spill_bytes0 = (
+            self.spill_tier.host_bytes if self.spill_tier is not None else 0
+        )
         self._release_slot(idx, spill=True)
+        if self._cost is not None and self.spill_tier is not None:
+            # The preemption's device->host traffic, billed to the
+            # preempted stream's own account (its revival charges the
+            # copy-in the same way).
+            moved = max(0, self.spill_tier.host_bytes - spill_bytes0)
+            if moved:
+                self._cost.charge(
+                    slot.trace_id, slot.tenant or "", spill_bytes=moved
+                )
         self.preemptions += 1
         if self.metrics is not None:
             self.metrics.inc("nos_tpu_decode_preemptions")
@@ -2919,6 +3088,7 @@ class DecodeServer:
             for i, s in enumerate(self._slots)
             if s.active and s.phase == "decoding" and not s.verifying
         ]
+        n_burst = 0
         if macro:
             # Steady state? Fuse up to N macro windows into ONE burst
             # dispatch (host boundary crossed once per K*N tokens);
@@ -2945,6 +3115,8 @@ class DecodeServer:
             with prof.phase(constants.TICK_PHASE_RESOLVE):
                 self._resolve_verifies(block=True)
         self._note_quota_tick()
+        if self._cost is not None:
+            self._note_cost_tick(n_burst if n_burst else 1)
         if self.metrics is not None:
             with prof.phase(constants.TICK_PHASE_PUBLISH):
                 self._publish_gauges(n_drafting, len(macro))
@@ -2965,6 +3137,23 @@ class DecodeServer:
             return
         self._quota.observe_tick(self._tick_tokens)
         self._tick_tokens = {}
+
+    def _note_cost_tick(self, weight: int) -> None:
+        """Fold one tick's pool-block holdings into the cost plane:
+        each active slot's tenant is charged `blocks held x weight`
+        KV-block-ticks (`weight` = the fused windows of a burst tick,
+        else 1, so burst-on and burst-off bill the same holding time).
+        Host-side reads only; runs solely while a ledger is armed."""
+        w = max(1, int(weight))
+        for idx, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            held = len(self._block_mgr.slot_blocks(idx)) * w
+            if held:
+                self.kv_block_ticks += held
+                self._cost.charge(
+                    slot.trace_id, slot.tenant or "", kv_block_ticks=held
+                )
 
     def _sync_tick_state(self, for_table_only: bool = False) -> None:
         """Re-sync the device-resident tick metadata from the host
@@ -3177,6 +3366,8 @@ class DecodeServer:
                 self.tokens_by_tenant[tname] = (
                     self.tokens_by_tenant.get(tname, 0) + total
                 )
+                if self._cost is not None:
+                    self._cost.charge(slot.trace_id, tname, decode_tokens=total)
                 # Windows in which this lane made progress.
                 self.macro_dispatches_by_slot[idx] += -(-total // K)
         if self._quota is not None:
@@ -3262,6 +3453,10 @@ class DecodeServer:
                 self.tokens_by_tenant[tname] = (
                     self.tokens_by_tenant.get(tname, 0) + executed
                 )
+                if self._cost is not None:
+                    self._cost.charge(
+                        slot.trace_id, tname, decode_tokens=executed
+                    )
             if self._quota is not None and executed:
                 tenant = slot.tenant or ""
                 self._tick_tokens[tenant] = (

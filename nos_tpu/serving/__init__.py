@@ -17,8 +17,20 @@ call in a guarded wrapper, drives the per-replica health machine
 (active -> suspect -> dead), and fails a dead replica's in-flight
 streams over onto survivors — checkpointed streams replay
 bit-identically, the rest resolve with a classified `ReplicaLostError`.
+The `accounting` module (docs/telemetry.md "Utilization & cost
+accounting") closes the observability suite: chip-second duty-cycle
+decomposition over the monitor's journaled windows, a single-mutator
+`CostLedger` attributing slot-seconds/tokens/KV-block-ticks to
+tenants, and per-request cost receipts served beside
+`/debug/trace/<id>`.
 """
 
+from nos_tpu.serving.accounting import (  # noqa: F401
+    CostLedger,
+    duty_cycle,
+    fleet_utilization,
+    utilization_block,
+)
 from nos_tpu.serving.drain import (  # noqa: F401
     DrainReport,
     drain_replica,

@@ -72,6 +72,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from nos_tpu import constants
 from nos_tpu.runtime.faults import classify_fault
+from nos_tpu.serving.accounting import duty_cycle, fleet_utilization
 from nos_tpu.telemetry import (
     collect_serving,
     percentile,
@@ -91,7 +92,27 @@ PER_REPLICA_GAUGES = (
     "nos_tpu_fleet_queue_depth",
     "nos_tpu_fleet_slots_active",
     "nos_tpu_fleet_kv_blocks_free",
+    # Utilization plane (serving/accounting.py): per-replica busy /
+    # waste chip-seconds of the latest window.
+    "nos_tpu_fleet_util_busy_chip_s",
+    "nos_tpu_fleet_util_waste_chip_s",
 )
+
+#: Per-tenant gauge families (labeled ``tenant=<name>``), the tenant
+#: mirror of PER_REPLICA_GAUGES: the idle-tenant sweep removes exactly
+#: these (plus the one-hot state series and, with a ledger attached,
+#: the nos_tpu_tenant_cost_* series) so label cardinality stays bounded
+#: by the ACTIVE tenant set, not the historical one.
+PER_TENANT_GAUGES = (
+    "nos_tpu_fleet_tenant_tok_s",
+    "nos_tpu_fleet_tenant_waiting",
+    "nos_tpu_fleet_tenant_slo_breached",
+    "nos_tpu_fleet_tenant_ttft_p95_s",
+)
+
+#: Per-tenant cost gauge name for one CostLedger charge field.
+def _cost_gauge(field: str) -> str:
+    return f"nos_tpu_tenant_cost_{field}"
 
 
 # ---------------------------------------------------------------------------
@@ -324,6 +345,19 @@ class PressureReport:
     slots_free: int
     slots_total: int
     replicas_active: int
+    # Utilization plane (serving/accounting.py, the `metricsexporter`
+    # port): this window's generated tokens per chip-HOUR of wall
+    # capacity — the "tok/s per chip-hour" denominator ROADMAP item 2's
+    # autoscale loop scores carves on — and the fraction of the
+    # window's wall chip-seconds the duty-cycle decomposition classed
+    # as waste (idle/draining/unreachable/recovery/spill traffic).
+    # Both derive purely from the journaled window rows, so replay
+    # reproduces them. The wall denominator (dt_s x tp_devices) exists
+    # for any sampled fleet; an UNPROFILED engine contributes zero
+    # busy, so its whole wall reads as idle waste — arm the tick
+    # profiler (EngineTracing) for a real decomposition.
+    tok_s_per_chip_hour: float = 0.0
+    waste_fraction: float = 0.0
 
     def to_dict(self) -> Dict[str, object]:
         return asdict(self)
@@ -349,6 +383,8 @@ class FleetMonitor:
         max_frozen: int = 4,
         interval_s: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
+        ledger=None,
+        tenant_idle_windows: int = 8,
     ):
         """`slo` is an `SLOTracker` or a plain ``{tenant: SLOTarget}``
         dict (None = no SLO evaluation). `metrics` is an
@@ -358,10 +394,24 @@ class FleetMonitor:
         journal, `max_frozen` the recovery-frozen journal snapshots.
         `interval_s` paces the optional `start()` thread; manual
         `sample()` ignores it. `clock` is injectable for deterministic
-        window math in tests."""
+        window math in tests.
+
+        `ledger` (optional, serving/accounting.py CostLedger — the one
+        shared with the fleet's engines) adds the per-tenant
+        ``nos_tpu_tenant_cost_*`` gauge series to each sample's
+        publish. `tenant_idle_windows` is the label-hygiene horizon:
+        a tenant with NO activity (tokens, admissions, waiting, or
+        fresh latency samples) for more than this many consecutive
+        windows has every per-tenant gauge series removed and its rate
+        ring dropped — bounded label cardinality over the ACTIVE tenant
+        set; a returning tenant re-seeds cleanly because the cumulative
+        per-replica baselines are kept (its first active window diffs
+        against the last snapshot, never against zero)."""
         self.replica_set = replica_set
         self.slo = _coerce_slo(slo)
         self.metrics = metrics
+        self.ledger = ledger
+        self.tenant_idle_windows = int(tenant_idle_windows)
         self.max_windows = int(max_windows)
         self.journal_windows = int(journal_windows)
         self.interval_s = float(interval_s)
@@ -381,6 +431,10 @@ class FleetMonitor:
         self._frozen: deque = deque(maxlen=int(max_frozen))
         # Which replica ids currently own published gauge series.
         self._published: set = set()
+        # Tenant label hygiene: which tenants own published series, and
+        # the last window each showed activity (the idle-sweep clock).
+        self._tenant_published: set = set()
+        self._tenant_last_active: Dict[str, int] = {}
         self.windows_sampled = 0
         self.sample_wall_s = 0.0
         self.last_report: Optional[PressureReport] = None
@@ -406,12 +460,18 @@ class FleetMonitor:
         counted as capacity — see `fleet_headroom`), `probe_error`
         carrying the classified kind so `classify_replica` — live and
         on replay — derives the UNREACHABLE verdict from the row
-        alone."""
+        alone. `dt_s` still spans the window (clock since the last
+        GOOD sample) so the duty-cycle decomposition can account the
+        wall as WASTE_UNREACHABLE — a provisional verdict: baselines
+        are kept, so the window after the replica returns re-attributes
+        the gap with real counter deltas."""
+        prev_t = self._prev_t.get(rid)
+        dt = max(0.0, now - prev_t) if prev_t is not None else 0.0
         row: Dict[str, object] = {
             "replica_id": rid,
             "lifecycle": handle.state,
             "t": now,
-            "dt_s": 0.0,
+            "dt_s": round(dt, 6),
             "probe_error": kind,
             "tokens": 0,
             "prefill_tokens": 0,
@@ -432,7 +492,14 @@ class FleetMonitor:
             "kv_blocks_total": 0,
             constants.PROBE_KEY_DRAINING: False,
         }
+        # Last-known width, so the unreachable wall scales to the chips
+        # that went dark (a fleet loses tp chip-seconds, not 1).
+        prev = self._prev_report.get(rid)
+        row[constants.PROBE_KEY_TP_DEVICES] = int(
+            getattr(prev, "tp_devices", 1) or 1
+        )
         row["pressure"] = classify_replica(row)
+        row[constants.ACCT_KEY_DUTY] = duty_cycle(row)
         return row
 
     def _sample_locked(self, now: Optional[float]) -> PressureReport:
@@ -562,7 +629,60 @@ class FleetMonitor:
                     probe.get(constants.PROBE_KEY_DRAINING, False)
                 ),
             }
+            # Duty-cycle inputs (serving/accounting.py): profiler and
+            # recovery-time deltas over the window, journaled so replay
+            # re-derives the exact decomposition. All zeros when the
+            # engine runs unprofiled — the window then decomposes to
+            # idle waste, never raises.
+            def _fdelta(attr: str) -> float:
+                cur_v = float(getattr(report, attr, 0.0) or 0.0)
+                prev_v = float(getattr(prev, attr, 0.0) or 0.0) if prev else 0.0
+                return max(0.0, cur_v - prev_v)
+
+            def _phase_delta(phase: str) -> float:
+                cur_v = float(
+                    dict(getattr(report, "tick_phase_s", {}) or {}).get(phase, 0.0)
+                )
+                prev_v = (
+                    float(
+                        dict(getattr(prev, "tick_phase_s", {}) or {}).get(
+                            phase, 0.0
+                        )
+                    )
+                    if prev
+                    else 0.0
+                )
+                return max(0.0, cur_v - prev_v)
+
+            def _restore_sum(rep) -> float:
+                return sum(
+                    float(v)
+                    for v in getattr(rep, "restore_latency_samples", ()) or ()
+                )
+
+            row[constants.PROBE_KEY_TP_DEVICES] = int(report.tp_devices or 1)
+            # ACCT_KEY_TICK_WALL_S's value deliberately mirrors the
+            # ServingReport field name it windows over.
+            row[constants.ACCT_KEY_TICK_WALL_S] = _fdelta(
+                constants.ACCT_KEY_TICK_WALL_S
+            )
+            row[constants.ACCT_KEY_DISPATCH_S] = _fdelta("tick_dispatch_s")
+            row[constants.ACCT_KEY_HOST_S] = _fdelta("tick_host_overhead_s")
+            row[constants.ACCT_KEY_IDLE_S] = _phase_delta(
+                constants.TICK_PHASE_IDLE
+            )
+            row[constants.ACCT_KEY_REVIVE_S] = _phase_delta(
+                constants.TICK_PHASE_PUMP_REVIVES
+            )
+            row[constants.ACCT_KEY_RESTORE_S] = max(
+                0.0,
+                _restore_sum(report) - (_restore_sum(prev) if prev else 0.0),
+            )
+            row[constants.ACCT_KEY_KV_BLOCK_TICKS] = delta.get(
+                constants.ACCT_KEY_KV_BLOCK_TICKS, 0
+            )
             row["pressure"] = classify_replica(row)
+            row[constants.ACCT_KEY_DUTY] = duty_cycle(row)
             replica_rows[rid] = row
             self._rings.setdefault(rid, deque(maxlen=self.max_windows)).append(row)
             if delta["recoveries"] > 0:
@@ -660,11 +780,33 @@ class FleetMonitor:
                 trow["slo_window_breach"] = False
                 trow["slo_breached"] = False
             tenant_rows[tenant] = trow
-            self._tenant_rings.setdefault(
-                tenant, deque(maxlen=self.max_windows)
-            ).append(trow)
+            # Label-hygiene clock: any activity this window (work done,
+            # work waiting, or fresh latency samples) re-arms the
+            # tenant's gauge series; pure idleness ages it toward the
+            # sweep.
+            if (
+                int(acc["tokens"])
+                or int(acc["admissions"])
+                or int(acc["waiting"])
+                or ttft
+                or queue_wait
+            ):
+                self._tenant_last_active[tenant] = window
+            # A tenant past the idle horizon stops accumulating ring
+            # rows too (the engines' probe surface remembers every
+            # historical tenant forever — the monitor must not).
+            if (
+                self._tenant_last_active.get(tenant, -1)
+                >= window - self.tenant_idle_windows
+            ):
+                self._tenant_rings.setdefault(
+                    tenant, deque(maxlen=self.max_windows)
+                ).append(trow)
 
         head = fleet_headroom(replica_rows)
+        # Fleet utilization roll-up (serving/accounting.py): pure over
+        # the same rows the journal carries, so replay reproduces it.
+        util = fleet_utilization(replica_rows)
         pressure = PressureReport(
             window=window,
             t=now,
@@ -679,6 +821,10 @@ class FleetMonitor:
             slots_free=int(head["slots_free"]),
             slots_total=int(head["slots_total"]),
             replicas_active=int(head["replicas_active"]),
+            tok_s_per_chip_hour=float(
+                util[constants.ACCT_KEY_TOK_S_PER_CHIP_HOUR]
+            ),
+            waste_fraction=float(util[constants.ACCT_KEY_WASTE_FRACTION]),
         )
         self._journal.append(
             json.dumps(
@@ -749,8 +895,34 @@ class FleetMonitor:
                     replica=rid,
                     state=state,
                 )
+            m.set_gauge(
+                "nos_tpu_fleet_util_busy_chip_s",
+                float(
+                    row[constants.ACCT_KEY_DUTY][constants.ACCT_KEY_BUSY_CHIP_S]
+                ),
+                replica=rid,
+            )
+            m.set_gauge(
+                "nos_tpu_fleet_util_waste_chip_s",
+                float(
+                    row[constants.ACCT_KEY_DUTY][constants.ACCT_KEY_WASTE_CHIP_S]
+                ),
+                replica=rid,
+            )
             self._published.add(rid)
+        # Tenant label hygiene: publish only tenants ACTIVE within the
+        # idle horizon; everyone else is swept below — per-tenant label
+        # cardinality stays bounded by the live tenant set.
+        horizon = self.windows_sampled - self.tenant_idle_windows
+        cost_totals = (
+            self.ledger.tenant_totals() if self.ledger is not None else {}
+        )
         for tenant, trow in tenant_rows.items():
+            if self._tenant_last_active.get(tenant, -1) < horizon:
+                continue
+            self._tenant_published.add(tenant)
+            for field, value in cost_totals.get(tenant, {}).items():
+                m.set_gauge(_cost_gauge(field), float(value), tenant=tenant)
             m.set_gauge(
                 "nos_tpu_fleet_tenant_tok_s", float(trow["tok_s"]), tenant=tenant
             )
@@ -783,6 +955,41 @@ class FleetMonitor:
             "nos_tpu_fleet_replicas_active", float(pressure.replicas_active)
         )
         m.set_gauge("nos_tpu_fleet_windows_sampled", float(self.windows_sampled))
+        m.set_gauge(
+            "nos_tpu_fleet_util_tok_s_per_chip_hour",
+            float(pressure.tok_s_per_chip_hour),
+        )
+        m.set_gauge(
+            "nos_tpu_fleet_util_waste_fraction", float(pressure.waste_fraction)
+        )
+        self._sweep_idle_tenants_locked()
+
+    def _sweep_idle_tenants_locked(self) -> None:
+        """The tenant mirror of replica-retirement gauge hygiene: every
+        per-tenant series of a tenant idle beyond `tenant_idle_windows`
+        is REMOVED from the registry (a quiet tenant frozen at its last
+        rate reads as live load and its label set grows without bound),
+        and its rate ring is dropped. Cumulative baselines are KEPT —
+        a returning tenant's first active window diffs against its last
+        snapshot, so its series re-seed with correct deltas."""
+        horizon = self.windows_sampled - self.tenant_idle_windows
+        stale = [
+            t
+            for t in self._tenant_published
+            if self._tenant_last_active.get(t, -1) < horizon
+        ]
+        for tenant in stale:
+            for name in PER_TENANT_GAUGES:
+                self.metrics.remove_gauge(name, tenant=tenant)
+            for state in constants.PRESSURE_TENANT_STATES:
+                self.metrics.remove_gauge(
+                    "nos_tpu_fleet_tenant_state", tenant=tenant, state=state
+                )
+            for field in constants.COST_FIELDS:
+                self.metrics.remove_gauge(_cost_gauge(field), tenant=tenant)
+            self._tenant_published.discard(tenant)
+            self._tenant_rings.pop(tenant, None)
+            self._tenant_last_active.pop(tenant, None)
 
     def _drop_replica_locked(self, rid: str) -> None:
         """Gauge/ring hygiene for a retired replica: its rate rings,
@@ -928,6 +1135,12 @@ class FleetMonitor:
                 else:
                     slo_map[tenant] = bool(trow.get("slo_breached", False))
             head = fleet_headroom(head_rows)
+            # Re-derive the utilization roll-up from the journaled raw
+            # fields (duty_cycle is pure over them — the attached
+            # `duty` dicts are ignored), so replay == live extends to
+            # the accounting plane. Rows from journals predating the
+            # plane decompose to zero and contribute nothing.
+            util = fleet_utilization(replica_rows)
             reports.append(
                 PressureReport(
                     window=int(rec.get("window", 0)),
@@ -941,6 +1154,12 @@ class FleetMonitor:
                     slots_free=int(head["slots_free"]),
                     slots_total=int(head["slots_total"]),
                     replicas_active=int(head["replicas_active"]),
+                    tok_s_per_chip_hour=float(
+                        util[constants.ACCT_KEY_TOK_S_PER_CHIP_HOUR]
+                    ),
+                    waste_fraction=float(
+                        util[constants.ACCT_KEY_WASTE_FRACTION]
+                    ),
                 )
             )
         return reports

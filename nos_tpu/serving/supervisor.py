@@ -313,6 +313,7 @@ class FleetSupervisor:
         jitter_seed: int = 0,
         fault_injector: Optional[ReplicaFaultInjector] = None,
         metrics=None,
+        ledger=None,
         max_events: int = 256,
         interval_s: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
@@ -331,7 +332,16 @@ class FleetSupervisor:
         reproduce). `sleep` is injectable so tests pay no wall clock.
         `arm_checkpoint_hooks` wires each engine's burst-boundary
         checkpoint hook into this supervisor's last-known table
-        (engines without the hook are probed-captured only)."""
+        (engines without the hook are probed-captured only).
+
+        `ledger` (optional, serving/accounting.py CostLedger — the one
+        shared with the fleet's engines) closes the cost receipt of
+        every stream this supervisor ERROR-resolves (a dead replica's
+        uncheckpointed stream, or a submit racing a death) with a
+        FAILED status: those streams never reach an engine's finish/
+        failure terminus, so without the hook their receipts would sit
+        open forever. Failed-over streams need nothing here — their
+        receipts close on the survivor that finishes them."""
         if not (1 <= suspect_after < dead_after):
             raise ValueError(
                 f"need 1 <= suspect_after < dead_after, got "
@@ -351,6 +361,7 @@ class FleetSupervisor:
         self._jitter = random.Random(jitter_seed)
         self.fault_injector = fault_injector
         self.metrics = metrics
+        self.ledger = ledger
         self.interval_s = float(interval_s)
         self._clock = clock
         self._sleep = sleep if sleep is not None else time.sleep
@@ -524,6 +535,16 @@ class FleetSupervisor:
                         self.futures_errored += 1
                         if self.metrics is not None:
                             self.metrics.inc("nos_tpu_fleet_futures_errored")
+                        if self.ledger is not None:
+                            # Failure terminus for the accounting
+                            # plane: no engine will ever close this
+                            # stream's receipt.
+                            self.ledger.close_request(
+                                trace_id,
+                                tenant,
+                                status=constants.RECEIPT_STATUS_FAILED,
+                                tokens=0,
+                            )
                         self._event_locked(
                             constants.FLEET_EV_FAILOVER,
                             replica=handle.replica_id,
@@ -737,6 +758,15 @@ class FleetSupervisor:
                 self.futures_errored += 1
                 if self.metrics is not None:
                     self.metrics.inc("nos_tpu_fleet_futures_errored")
+                if self.ledger is not None:
+                    # Failure terminus for the accounting plane: the
+                    # dead replica can no longer close this receipt.
+                    self.ledger.close_request(
+                        stream.trace_id,
+                        stream.tenant,
+                        status=constants.RECEIPT_STATUS_FAILED,
+                        tokens=0,
+                    )
         # Placement hygiene, exactly as on graceful drain: the dead
         # replica's shadow drops (its cache is gone with the host),
         # tenant pins dissolve, and retirement triggers the monitor's

@@ -205,6 +205,17 @@ class ServingReport:
     # different tp widths stay comparable (pinned by the mixed-tp merge
     # test).
     tp_devices: int = 1
+    # Cost-attribution plane (nos_tpu/serving/accounting.py,
+    # docs/telemetry.md "Utilization & cost accounting"): busy
+    # slot-seconds accumulated at slot release (the conservation law's
+    # engine side — per-tenant ledger charges must sum to this),
+    # pool-block x tick products accumulated per tick while a CostLedger
+    # is armed (a fused burst of N windows counts N), and receipts
+    # closed at the req.finish/failure terminus. All zero on an engine
+    # without a ledger — the accounting plane is default-off.
+    slot_seconds_total: float = 0.0
+    kv_block_ticks: int = 0
+    cost_receipts: int = 0
     # Queue depths at snapshot time.
     inflight_dispatches: int = 0
     pending_verifies: int = 0
@@ -277,6 +288,7 @@ class ServingReport:
                     "tick_wall_s",
                     "tick_dispatch_s",
                     "tick_host_overhead_s",
+                    "slot_seconds_total",
                 ):
                     setattr(merged, f.name, cur + float(val))
                 elif isinstance(cur, int):
@@ -352,17 +364,22 @@ def report_delta(cur: ServingReport, prev: Optional[ServingReport]) -> Dict[str,
             # window yet — the engine's whole life is not "this window".
             out[name] = 0
         else:
+            # BOTH sides tolerate absent fields: an old-version snapshot
+            # (rehydrated journal, foreign collector) on either end of
+            # the diff contributes zero rather than raising mid-window.
             out[name] = max(
-                0, int(getattr(cur, name)) - int(getattr(prev, name, 0))
+                0,
+                int(getattr(cur, name, 0) or 0)
+                - int(getattr(prev, name, 0) or 0),
             )
     if prev is None:
         out["tokens"] = 0
     else:
-        macro_cur = sum(cur.macro_tokens_by_slot.values())
-        macro_prev = sum(prev.macro_tokens_by_slot.values())
+        macro_cur = sum(dict(getattr(cur, "macro_tokens_by_slot", {}) or {}).values())
+        macro_prev = sum(dict(getattr(prev, "macro_tokens_by_slot", {}) or {}).values())
         out["tokens"] = max(0, macro_cur - macro_prev) + out["spec_tokens_accepted"]
     for name in REPORT_GAUGE_FIELDS:
-        out[name] = int(getattr(cur, name))
+        out[name] = int(getattr(cur, name, 0) or 0)
     return out
 
 
@@ -454,6 +471,9 @@ def collect_serving(server) -> ServingReport:
         ttft_samples=[float(v) for v in ttft],
         queue_wait_samples=[float(v) for v in queue_wait],
         restore_latency_samples=[float(v) for v in restore],
+        slot_seconds_total=float(getattr(server, "slot_seconds_total", 0.0)),
+        kv_block_ticks=int(getattr(server, "kv_block_ticks", 0)),
+        cost_receipts=int(getattr(server, "cost_receipts", 0)),
         ticks_profiled=int(getattr(server, "ticks_profiled", 0)),
         tick_wall_s=float(getattr(server, "tick_wall_s", 0.0)),
         tick_dispatch_s=float(getattr(server, "tick_dispatch_s", 0.0)),

@@ -1,0 +1,50 @@
+"""NOS018 negative fixture — the same accounting surface used
+correctly in a serving-plane file: mutation routed through the
+CostLedger API, field names derived from nos_tpu.constants, ledger
+state READ freely (conservation predicates and /debug payloads may
+inspect), and the vocabulary quoted only in prose (a charge may be
+"slot_seconds" or "waste.idle" — docstrings are exempt)."""
+
+from nos_tpu import constants
+
+
+class CostLedger:
+    """A ledger look-alike: writes INSIDE the owning class body are the
+    sanctioned single-mutator surface."""
+
+    def __init__(self):
+        self._cost_tenants = {}
+        self._cost_open = {}
+        self._cost_receipts = {}
+
+    def charge(self, tenant, field, value):
+        self._cost_tenants.setdefault(tenant, {})[field] = value
+
+    def close(self, key, rec):
+        self._cost_open.pop(key, None)
+        self._cost_receipts[key] = rec
+
+
+def bill(ledger, key, tenant, held):
+    ledger.charge(tenant, constants.COST_SLOT_SECONDS, held)
+
+
+def conservation(ledger, engines):
+    # Reads stay legal everywhere.
+    charged = sum(
+        acct.get(constants.COST_SLOT_SECONDS, 0.0)
+        for acct in ledger._cost_tenants.values()
+    )
+    busy = sum(e.slot_seconds_total for e in engines)
+    return abs(charged - busy) < 1e-9
+
+
+def row_keys(row):
+    return (
+        row[constants.COST_SLOT_SECONDS],
+        row[constants.ACCT_KEY_TOK_S_PER_CHIP_HOUR],
+    )
+
+
+def classify_waste(duty):
+    return duty[constants.WASTE_IDLE]

@@ -1,0 +1,35 @@
+"""NOS018 positive fixture — cost-ledger state mutated outside the
+CostLedger, and accounting field-name literals spelled inline in a
+serving-plane file (the `serving/` directory segment puts this file in
+both scopes). Quoting "slot_seconds" or "waste.idle" here in the
+docstring is fine; the code below is not."""
+
+
+class Engine:
+    def bill_directly(self, ledger, tenant, held):
+        # Tenant-total write outside CostLedger: flagged (subscript
+        # chains unwrap to the protected attribute).
+        ledger._cost_tenants[tenant]["x"] = held
+
+    def forge_receipt(self, ledger, key, rec):
+        # Receipt-ring write outside CostLedger: flagged.
+        ledger._cost_receipts[key] = rec
+
+    def drop_open(self, ledger, key):
+        # Open-accumulator mutation via a mutating call: flagged.
+        ledger._cost_open.pop(key)
+
+
+def erase(ledger, key):
+    # Deletion outside the class: flagged.
+    del ledger._cost_receipts[key]
+
+
+def row_keys(row):
+    # Inline accounting field names: flagged (wire vocabulary).
+    return row["slot_seconds"], row["tok_s_per_chip_hour"]
+
+
+def classify_waste(duty):
+    # Inline waste-taxonomy name: flagged.
+    return duty["waste.idle"]

@@ -1,0 +1,791 @@
+"""Fleet utilization & cost-attribution plane (ISSUE 15 tentpole,
+nos_tpu/serving/accounting.py — the `metricsexporter` port): duty-cycle
+decomposition (pure, replayable, exact partition), the single-mutator
+CostLedger (tenant totals, bounded receipts, the conservation law), the
+/debug/accounting + /debug index endpoints, and receipt attachment to
+/debug/trace/<id>.
+
+Two substrates, the house pattern: STUB rows/reports for the pure math
+and ledger mechanics (no jax cost), REAL DecodeServer engines for the
+counter-gated purity oracle and the conservation law under preemption,
+drain migration, and a seeded PR 14 failover.
+"""
+
+import http.client
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.observability import HealthManager, Metrics, ObservabilityServer
+from nos_tpu.serving import (
+    CostLedger,
+    FleetMonitor,
+    ReplicaSet,
+    duty_cycle,
+    fleet_utilization,
+    utilization_block,
+)
+from nos_tpu.telemetry import ServingReport, collect_serving
+from nos_tpu.tracing import EngineTracing, Tracer
+
+# ---------------------------------------------------------------------------
+# CostLedger mechanics
+# ---------------------------------------------------------------------------
+def test_ledger_charge_totals_and_receipt_lifecycle():
+    led = CostLedger()
+    led.open_request("tr-1", "gold")
+    led.charge("tr-1", "gold", decode_tokens=5, slot_seconds=1.5, chip_ms=750.0)
+    led.charge("tr-1", "gold", decode_tokens=3)
+    totals = led.tenant_totals()
+    assert totals["gold"][constants.COST_DECODE_TOKENS] == 8
+    assert totals["gold"][constants.COST_SLOT_SECONDS] == 1.5
+    # Open receipt readable before the terminus (no status yet).
+    live = led.receipt("tr-1")
+    assert live[constants.COST_DECODE_TOKENS] == 8 and "status" not in live
+    rec = led.close_request("tr-1", "gold", tokens=9)
+    assert rec["status"] == constants.RECEIPT_STATUS_OK
+    assert rec["tokens"] == 9
+    assert rec[constants.COST_DECODE_TOKENS] == 8
+    assert led.receipts_issued == 1
+    # Closing twice is a no-op.
+    assert led.close_request("tr-1", "gold") is None
+
+
+def test_ledger_rejects_unknown_charge_field():
+    led = CostLedger()
+    with pytest.raises(ValueError, match="unknown cost field"):
+        led.charge("tr-1", "gold", widgets=3)
+
+
+def test_ledger_none_key_charges_tenant_totals_only():
+    led = CostLedger()
+    led.open_request(None, "a")  # no-op
+    led.charge(None, "a", decode_tokens=4)
+    assert led.tenant_totals()["a"][constants.COST_DECODE_TOKENS] == 4
+    assert led.snapshot()["open_requests"] == 0
+    assert led.close_request(None, "a") is None
+
+
+def test_ledger_charge_after_close_folds_into_closed_receipt():
+    # A release's trailing slot-seconds can land after the finish
+    # terminus on recovery paths: both the tenant totals AND the closed
+    # receipt must absorb them.
+    led = CostLedger()
+    led.open_request("tr-1", "a")
+    led.close_request("tr-1", "a")
+    led.charge("tr-1", "a", slot_seconds=0.25)
+    assert led.receipt("tr-1")[constants.COST_SLOT_SECONDS] == 0.25
+    assert led.charged_slot_seconds() == 0.25
+
+
+def test_ledger_receipts_bounded_with_drop_count():
+    led = CostLedger(max_receipts=4)
+    for i in range(10):
+        led.open_request(f"tr-{i}", "a")
+        led.close_request(f"tr-{i}", "a")
+    snap = led.snapshot()
+    assert snap["receipts_issued"] == 10
+    assert snap["dropped_receipts"] == 6
+    assert len(snap["receipts"]) == 4
+    assert led.receipt("tr-0") is None  # aged out
+    assert led.receipt("tr-9") is not None
+
+
+# ---------------------------------------------------------------------------
+# duty_cycle: the exact partition
+# ---------------------------------------------------------------------------
+def _identity(duty):
+    attributed = (
+        duty[constants.ACCT_KEY_BUSY_CHIP_S]
+        + duty[constants.ACCT_KEY_OVERHEAD_CHIP_S]
+        + duty[constants.ACCT_KEY_WASTE_CHIP_S]
+    )
+    return abs(attributed - duty[constants.ACCT_KEY_WALL_CHIP_S])
+
+
+def test_duty_cycle_partitions_exactly_with_named_waste():
+    row = {
+        "dt_s": 10.0,
+        constants.PROBE_KEY_TP_DEVICES: 2,
+        constants.ACCT_KEY_DISPATCH_S: 6.0,
+        constants.ACCT_KEY_HOST_S: 3.0,  # 1.0s of slack remains
+        constants.ACCT_KEY_IDLE_S: 1.0,
+        constants.ACCT_KEY_REVIVE_S: 0.5,
+        constants.ACCT_KEY_RESTORE_S: 0.25,
+        "lifecycle": constants.REPLICA_STATE_ACTIVE,
+    }
+    duty = duty_cycle(row)
+    assert duty[constants.ACCT_KEY_WALL_CHIP_S] == 20.0  # 10s x 2 chips
+    assert duty[constants.ACCT_KEY_BUSY_CHIP_S] == 12.0
+    # Host overhead = 3.0 minus the idle/revive/recovery carve-outs.
+    assert duty[constants.ACCT_KEY_OVERHEAD_CHIP_S] == pytest.approx(2.5)
+    waste = duty[constants.ACCT_KEY_WASTE]
+    # Idle absorbs the measured idle phase AND the unmeasured slack.
+    assert waste[constants.WASTE_IDLE] == pytest.approx(4.0)
+    assert waste[constants.WASTE_SPILL_REVIVE] == pytest.approx(1.0)
+    assert waste[constants.WASTE_RECOVERY] == pytest.approx(0.5)
+    assert waste[constants.WASTE_DRAINING] == 0.0
+    assert _identity(duty) < 1e-12
+
+
+def test_duty_cycle_unreachable_window_is_all_waste():
+    row = {
+        "dt_s": 4.0,
+        constants.PROBE_KEY_TP_DEVICES: 2,
+        "probe_error": "transient",
+        constants.ACCT_KEY_DISPATCH_S: 3.0,  # ignored: window unknown
+    }
+    duty = duty_cycle(row)
+    assert duty[constants.ACCT_KEY_BUSY_CHIP_S] == 0.0
+    assert duty[constants.ACCT_KEY_WASTE][constants.WASTE_UNREACHABLE] == 8.0
+    assert _identity(duty) < 1e-12
+
+
+def test_duty_cycle_draining_absorbs_idle_and_slack():
+    row = {
+        "dt_s": 5.0,
+        constants.ACCT_KEY_DISPATCH_S: 1.0,
+        constants.ACCT_KEY_HOST_S: 1.0,
+        constants.ACCT_KEY_IDLE_S: 0.5,
+        "lifecycle": constants.REPLICA_STATE_DRAINING,
+    }
+    duty = duty_cycle(row)
+    waste = duty[constants.ACCT_KEY_WASTE]
+    # slack (3.0) + measured idle (0.5), all attributed to draining.
+    assert waste[constants.WASTE_DRAINING] == pytest.approx(3.5)
+    assert waste[constants.WASTE_IDLE] == 0.0
+    assert _identity(duty) < 1e-12
+
+
+def test_duty_cycle_old_journal_row_contributes_zero_busy():
+    # A pre-accounting journal row has dt_s and nothing else: the
+    # decomposition must not raise, and the whole wall lands in idle.
+    duty = duty_cycle({"dt_s": 2.0})
+    assert duty[constants.ACCT_KEY_BUSY_CHIP_S] == 0.0
+    assert duty[constants.ACCT_KEY_WASTE][constants.WASTE_IDLE] == 2.0
+    assert _identity(duty) < 1e-12
+    # A fully empty row is also fine (wall 0).
+    assert duty_cycle({})[constants.ACCT_KEY_WALL_CHIP_S] == 0.0
+
+
+def test_fleet_utilization_hand_computed():
+    rows = {
+        "r0": {
+            "dt_s": 10.0,
+            constants.ACCT_KEY_DISPATCH_S: 8.0,
+            constants.ACCT_KEY_HOST_S: 2.0,
+            "tokens": 800,
+        },
+        "r1": {
+            "dt_s": 10.0,
+            constants.ACCT_KEY_DISPATCH_S: 2.0,
+            constants.ACCT_KEY_HOST_S: 2.0,
+            "tokens": 200,
+        },
+    }
+    util = fleet_utilization(rows)
+    assert util[constants.ACCT_KEY_CHIP_SECONDS] == 20.0
+    assert util["tokens"] == 1000
+    # 1000 tokens over 20 chip-seconds = 180000 per chip-hour.
+    assert util[constants.ACCT_KEY_TOK_S_PER_CHIP_HOUR] == pytest.approx(
+        1000 / (20.0 / 3600.0)
+    )
+    # waste = r1's 6s of slack-idle; fraction 6/20.
+    assert util[constants.ACCT_KEY_WASTE_FRACTION] == pytest.approx(0.3)
+
+
+def test_utilization_block_from_reports_identity_and_derived_tokens():
+    reports = [
+        ServingReport(
+            tick_wall_s=4.0,
+            tick_dispatch_s=3.0,
+            tick_host_overhead_s=1.0,
+            tick_phase_s={constants.TICK_PHASE_IDLE: 0.5},
+            tp_devices=2,
+            macro_tokens_by_slot={"0": 90},
+            spec_tokens_accepted=10,
+        ),
+        ServingReport(),  # unprofiled engine contributes nothing
+    ]
+    block = utilization_block(reports)
+    assert block[constants.ACCT_KEY_CHIP_SECONDS] == 8.0
+    assert block["tokens"] == 100
+    assert block[constants.ACCT_KEY_BUSY_CHIP_S] == 6.0
+    assert abs(block["identity_residual_s"]) < 1e-12
+    assert block[constants.ACCT_KEY_TOK_S_PER_CHIP_HOUR] > 0
+
+
+# ---------------------------------------------------------------------------
+# Monitor integration on stubs: journaled duty + replay == live
+# ---------------------------------------------------------------------------
+from tests.test_fleet_monitor import StubEngine, stub_fleet  # noqa: E402
+
+
+def test_monitor_windows_carry_duty_and_replay_reproduces_it():
+    rs = stub_fleet(n=1)
+    eng = rs.handles[0].engine
+    # Give the stub a profiler surface (collect_serving duck-types it).
+    eng.tick_wall_s = 0.0
+    eng.tick_dispatch_s = 0.0
+    eng.tick_host_overhead_s = 0.0
+    eng.tp = 2
+    mon = FleetMonitor(rs)
+    live = [mon.sample(now=0.0)]
+    eng.tick_wall_s = 1.6
+    eng.tick_dispatch_s = 1.2
+    eng.tick_host_overhead_s = 0.4
+    eng.macro_tokens_by_slot[0] = 64
+    eng.tokens_by_tenant["a"] = 64
+    live.append(mon.sample(now=2.0))
+    row = mon.replica_windows("replica-0")[-1]
+    duty = row[constants.ACCT_KEY_DUTY]
+    # 2s window x 2 chips; busy 1.2 x 2; host 0.4 x 2; rest idle.
+    assert duty[constants.ACCT_KEY_WALL_CHIP_S] == pytest.approx(4.0)
+    assert duty[constants.ACCT_KEY_BUSY_CHIP_S] == pytest.approx(2.4)
+    assert duty[constants.ACCT_KEY_OVERHEAD_CHIP_S] == pytest.approx(0.8)
+    assert _identity(duty) < 1e-9
+    assert live[-1].tok_s_per_chip_hour == pytest.approx(64 / (4.0 / 3600.0))
+    assert 0.0 < live[-1].waste_fraction < 1.0
+    # Replay over the journal alone reproduces the roll-up exactly.
+    replayed = FleetMonitor.replay(mon.journal_lines())
+    assert [
+        (r.tok_s_per_chip_hour, r.waste_fraction) for r in replayed
+    ] == [(r.tok_s_per_chip_hour, r.waste_fraction) for r in live]
+
+
+def test_unreachable_window_wall_lands_in_unreachable_waste():
+    rs = stub_fleet(n=1)
+    eng = rs.handles[0].engine
+    mon = FleetMonitor(rs)
+    mon.sample(now=1.0)
+
+    def _dead_probe():
+        raise ConnectionError("connection refused by host")
+
+    eng.probe = _dead_probe
+    mon.sample(now=3.0)
+    row = mon.replica_windows("replica-0")[-1]
+    assert row["probe_error"]
+    duty = row[constants.ACCT_KEY_DUTY]
+    # The 2s gap since the last good sample is accounted, all waste.
+    assert duty[constants.ACCT_KEY_WALL_CHIP_S] == pytest.approx(2.0)
+    assert duty[constants.ACCT_KEY_WASTE][
+        constants.WASTE_UNREACHABLE
+    ] == pytest.approx(2.0)
+    assert _identity(duty) < 1e-9
+    # Replay derives the same decomposition from the journal.
+    rep = FleetMonitor.replay(mon.journal_lines())[-1]
+    assert rep.waste_fraction == pytest.approx(1.0)
+
+
+def test_tenant_cost_gauges_published_with_ledger():
+    registry = Metrics()
+    rs = stub_fleet(n=1)
+    eng = rs.handles[0].engine
+    led = CostLedger()
+    led.charge(None, "gold", slot_seconds=2.5, decode_tokens=40)
+    mon = FleetMonitor(rs, metrics=registry, ledger=led)
+    eng.tokens_by_tenant["gold"] = 40
+    eng.macro_tokens_by_slot[0] = 40
+    mon.sample(now=0.0)
+    mon.sample(now=1.0)
+    assert (
+        registry.get("nos_tpu_tenant_cost_slot_seconds", tenant="gold") == 2.5
+    )
+    assert (
+        registry.get("nos_tpu_tenant_cost_decode_tokens", tenant="gold") == 40.0
+    )
+
+
+def test_idle_tenant_series_swept_and_returning_tenant_reseeds():
+    """Satellite: per-tenant gauge series must not grow forever — a
+    tenant idle beyond N windows loses every series (cost series
+    included), and a returning tenant re-seeds with CORRECT deltas
+    (baselines kept — no spike, no negative)."""
+    registry = Metrics()
+    rs = stub_fleet(n=1)
+    eng = rs.handles[0].engine
+    led = CostLedger()
+    led.charge(None, "a", decode_tokens=10)
+    mon = FleetMonitor(rs, metrics=registry, ledger=led, tenant_idle_windows=2)
+    mon.sample(now=0.0)
+    eng.tokens_by_tenant["a"] = 10
+    eng.macro_tokens_by_slot[0] = 10
+    mon.sample(now=1.0)
+    rendered = registry.render()
+    assert 'nos_tpu_fleet_tenant_tok_s{tenant="a"}' in rendered
+    assert 'nos_tpu_tenant_cost_decode_tokens{tenant="a"}' in rendered
+    # Quiet for > tenant_idle_windows windows: every series disappears,
+    # the ring is dropped, but the cumulative baseline stays.
+    for w in range(4):
+        mon.sample(now=2.0 + w)
+    rendered = registry.render()
+    assert 'tenant="a"' not in rendered
+    assert mon.tenant_windows("a") == []
+    # The tenant returns: series re-seed and the windowed delta is the
+    # NEW work only (10 -> 16 = 6 tokens), never the whole history.
+    eng.tokens_by_tenant["a"] = 16
+    eng.macro_tokens_by_slot[0] = 16
+    mon.sample(now=10.0)
+    rendered = registry.render()
+    assert 'nos_tpu_fleet_tenant_tok_s{tenant="a"}' in rendered
+    trow = mon.tenant_windows("a")[-1]
+    assert trow["tokens"] == 6
+
+
+# ---------------------------------------------------------------------------
+# /debug endpoints
+# ---------------------------------------------------------------------------
+def _get(port, path, token=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp, body
+
+
+def test_debug_accounting_serves_roll_up_with_auth():
+    led = CostLedger()
+    led.open_request("tr-00000001", "gold")
+    led.charge("tr-00000001", "gold", decode_tokens=12, slot_seconds=0.5)
+    led.close_request("tr-00000001", "gold", tokens=13)
+    srv = ObservabilityServer(
+        Metrics(), HealthManager(), metrics_token="s3", accounting=led
+    ).start()
+    try:
+        resp, _ = _get(srv.port, constants.DEBUG_PATH_ACCOUNTING)
+        assert resp.status == 401
+        resp, body = _get(srv.port, constants.DEBUG_PATH_ACCOUNTING, token="s3")
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "application/json"
+        payload = json.loads(body)
+        assert payload["tenants"]["gold"][constants.COST_DECODE_TOKENS] == 12
+        assert payload["receipts_issued"] == 1
+        assert payload["receipts"][0]["status"] == constants.RECEIPT_STATUS_OK
+    finally:
+        srv.stop()
+
+
+def test_debug_accounting_404_when_unarmed():
+    srv = ObservabilityServer(Metrics(), HealthManager()).start()
+    try:
+        resp, _ = _get(srv.port, constants.DEBUG_PATH_ACCOUNTING)
+        assert resp.status == 404
+    finally:
+        srv.stop()
+
+
+def test_debug_index_lists_armed_surfaces():
+    """Satellite: GET /debug enumerates exactly the armed surfaces,
+    with the same bearer/404 semantics as the surfaces themselves."""
+    tracer = Tracer()
+    led = CostLedger()
+    srv = ObservabilityServer(
+        Metrics(),
+        HealthManager(),
+        metrics_token="s3",
+        tracer=tracer,
+        accounting=led,
+    ).start()
+    try:
+        resp, _ = _get(srv.port, constants.DEBUG_PATH_INDEX)
+        assert resp.status == 401
+        resp, body = _get(srv.port, constants.DEBUG_PATH_INDEX, token="s3")
+        assert resp.status == 200
+        surfaces = json.loads(body)["surfaces"]
+        assert constants.DEBUG_PATH_ACCOUNTING in surfaces
+        assert constants.DEBUG_PATH_TRACE_PREFIX + "<id>" in surfaces
+        assert constants.DEBUG_PATH_EVENTS not in surfaces  # recorder unarmed
+        assert constants.DEBUG_PATH_PRESSURE not in surfaces
+    finally:
+        srv.stop()
+
+
+def test_debug_index_404_when_nothing_armed():
+    srv = ObservabilityServer(Metrics(), HealthManager()).start()
+    try:
+        resp, _ = _get(srv.port, constants.DEBUG_PATH_INDEX)
+        assert resp.status == 404
+    finally:
+        srv.stop()
+
+
+def test_trace_payload_carries_receipt():
+    tracer = Tracer()
+    tid = tracer.new_trace()
+    tracer.event(tid, constants.TRACE_EV_SUBMIT, prompt_tokens=4)
+    led = CostLedger()
+    led.open_request(tid, "gold")
+    led.charge(tid, "gold", decode_tokens=7)
+    led.close_request(tid, "gold", tokens=8)
+    srv = ObservabilityServer(
+        Metrics(), HealthManager(), tracer=tracer, accounting=led
+    ).start()
+    try:
+        resp, body = _get(srv.port, constants.DEBUG_PATH_TRACE_PREFIX + tid)
+        assert resp.status == 200
+        payload = json.loads(body)
+        assert payload["receipt"][constants.COST_DECODE_TOKENS] == 7
+        assert payload["receipt"]["status"] == constants.RECEIPT_STATUS_OK
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Real-engine substrate
+# ---------------------------------------------------------------------------
+import jax  # noqa: E402
+
+from nos_tpu.runtime.decode_server import DecodeServer  # noqa: E402
+from nos_tpu.runtime.faults import FAULT_TRANSIENT  # noqa: E402
+from nos_tpu.serving import (  # noqa: E402
+    FleetSupervisor,
+    PrefixRouter,
+    ReplicaFaultInjector,
+)
+from tests.conftest import serving_test_config  # noqa: E402
+
+CFG = serving_test_config()
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="bit-exactness oracles need the deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+def make_engine(params, **kw):
+    defaults = dict(
+        n_slots=2, max_len=64, prompt_buckets=(8, 16), block_size=8, seed=11
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, **defaults)
+
+
+PROMPTS = [
+    [4, 9, 2, 33, 7, 1, 8, 5],
+    [40, 41, 42, 43, 44, 45, 46, 47],
+    [9, 8, 7, 6, 5, 4, 3, 2],
+]
+
+
+def drive(engines, pred, n=800):
+    for _ in range(n):
+        for e in engines:
+            e._tick()
+        if pred():
+            return True
+    return False
+
+
+def assert_conserved(ledger, engines):
+    charged = ledger.charged_slot_seconds()
+    busy = sum(e.slot_seconds_total for e in engines)
+    assert charged == pytest.approx(busy, rel=1e-9, abs=1e-9)
+    assert busy > 0.0
+
+
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.7], ids=["greedy", "temp"])
+def test_accounting_purity_counter_gated_oracle(params, temperature):
+    """Acceptance: accounting-on vs off — greedy AND temperature
+    outputs and dispatch counters bit-identical (the ledger only
+    observes host bookkeeping the engine already does)."""
+
+    def run(ledger_on):
+        eng = make_engine(
+            params,
+            temperature=temperature,
+            tracing=EngineTracing() if ledger_on else None,
+            cost_ledger=CostLedger() if ledger_on else None,
+        )
+        futs = [
+            eng.submit(p, max_new=8, tenant=t)
+            for t, p in zip("abc", PROMPTS)
+        ]
+        assert drive([eng], lambda: all(f.done() for f in futs))
+        outs = [list(f.result(timeout=60)) for f in futs]
+        counters = (
+            eng.steps_run,
+            eng.macro_dispatches,
+            eng.prefill_dispatches,
+            eng.burst_dispatches,
+            eng.h2d_uploads,
+            eng.blocking_syncs,
+        )
+        eng.stop()
+        return outs, counters
+
+    outs_off, counters_off = run(False)
+    outs_on, counters_on = run(True)
+    assert outs_on == outs_off
+    assert counters_on == counters_off
+
+
+@cpu_only
+def test_receipts_and_conservation_solo(params):
+    led = CostLedger()
+    eng = make_engine(params, tracing=EngineTracing(), cost_ledger=led)
+    futs = [
+        eng.submit(p, max_new=6, tenant=t) for t, p in zip("ab", PROMPTS[:2])
+    ]
+    assert drive([eng], lambda: all(f.done() for f in futs))
+    outs = [list(f.result(timeout=60)) for f in futs]
+    eng.stop()
+    assert eng.cost_receipts == 2
+    assert eng.kv_block_ticks > 0
+    assert_conserved(led, [eng])
+    snap = led.snapshot()
+    assert snap["receipts_issued"] == 2
+    for rec, out in zip(snap["receipts"], outs):
+        assert rec["status"] == constants.RECEIPT_STATUS_OK
+        assert rec["tokens"] == len(out)
+        # Cold run: the whole prompt was computed, nothing cached.
+        assert rec[constants.COST_PREFILL_CHARGED] == 8
+        assert rec[constants.COST_PREFILL_CACHED] == 0
+        assert rec[constants.COST_KV_BLOCK_TICKS] > 0
+        assert rec[constants.COST_CHIP_MS] > 0
+        # Every generated token after the prefill-sampled first one is
+        # a decode charge.
+        assert rec[constants.COST_DECODE_TOKENS] == len(out) - 1
+    # The tenant totals tie back to the engine's own counters.
+    totals = led.tenant_totals()
+    assert sum(
+        acct[constants.COST_DECODE_TOKENS] for acct in totals.values()
+    ) == sum(eng.macro_tokens_by_slot) + eng.spec_tokens_accepted
+    assert led.charged_slot_seconds() == pytest.approx(
+        eng.slot_seconds_total, rel=1e-9
+    )
+
+
+@cpu_only
+def test_shared_prefix_hit_charges_cached_tokens(params):
+    led = CostLedger()
+    eng = make_engine(params, tracing=EngineTracing(), cost_ledger=led)
+    shared = [7, 7, 7, 7, 7, 7, 7, 7, 3, 3, 3, 3, 3, 3, 3, 3]
+    f1 = eng.submit(shared + [1, 2, 3, 4], max_new=4, tenant="a")
+    assert drive([eng], lambda: f1.done())
+    f2 = eng.submit(shared + [5, 6, 7, 8], max_new=4, tenant="b")
+    assert drive([eng], lambda: f2.done())
+    f1.result(60), f2.result(60)
+    eng.stop()
+    recs = led.snapshot()["receipts"]
+    assert recs[1][constants.COST_PREFILL_CACHED] >= 8  # hit the shared run
+    assert (
+        recs[1][constants.COST_PREFILL_CHARGED]
+        < recs[0][constants.COST_PREFILL_CHARGED]
+    )
+    assert_conserved(led, [eng])
+
+
+@cpu_only
+def test_conservation_and_receipt_continuity_under_preemption(params):
+    """The conservation law pinned under preemption: a preempted slot
+    charges its partial hold at release, the restore re-opens the SAME
+    receipt (trace id rides the checkpoint), replay tokens are billed,
+    and charged slot-seconds still equal engine busy slot-seconds."""
+    led = CostLedger()
+    eng = make_engine(
+        params, tracing=EngineTracing(), cost_ledger=led, burst_windows=1
+    )
+    fut = eng.submit(PROMPTS[0], max_new=10, tenant="a")
+    # Run a few ticks so the stream is mid-decode, then preempt it.
+    for _ in range(6):
+        eng._tick()
+    assert not fut.done()
+    eng._preempt_slot(0)
+    assert eng.preemptions == 1
+    assert drive([eng], lambda: fut.done())
+    out = list(fut.result(timeout=60))
+    eng.stop()
+    assert len(out) == 10
+    assert eng.cost_receipts == 1
+    rec = led.snapshot()["receipts"][0]
+    assert rec[constants.COST_REPLAY_TOKENS] > 0  # the restore's replay
+    assert rec[constants.COST_SPILL_BYTES] > 0  # preemption spilled KV
+    assert rec["status"] == constants.RECEIPT_STATUS_OK
+    assert_conserved(led, [eng])
+
+
+@cpu_only
+def test_conservation_under_drain_migration(params):
+    """Drain migration: the source charges the hold up to the drain,
+    the destination the rest — one receipt per stream, conservation
+    over the SUMMED fleet (one shared ledger, one shared tracer)."""
+    led = CostLedger()
+    tracer = Tracer()
+    src = make_engine(
+        params,
+        tracing=EngineTracing(tracer=tracer),
+        cost_ledger=led,
+        burst_windows=1,
+    )
+    dst = make_engine(
+        params, tracing=EngineTracing(tracer=tracer), cost_ledger=led
+    )
+    fut = src.submit(PROMPTS[1], max_new=10, tenant="gold")
+    for _ in range(6):
+        src._tick()
+    assert not fut.done()
+    cks, waiting = src.drain_extract()
+    assert len(cks) == 1 and not waiting
+    for ck in cks:
+        dst.transfer_in_checkpoint(ck)
+    assert drive([dst], lambda: fut.done())
+    out = list(fut.result(timeout=60))
+    assert len(out) == 10
+    dst.stop()
+    src.stop()
+    # Source charged a partial hold, destination finished the stream.
+    assert src.slot_seconds_total > 0 and dst.slot_seconds_total > 0
+    assert dst.cost_receipts == 1 and src.cost_receipts == 0
+    rec = led.snapshot()["receipts"][0]
+    assert rec["tenant"] == "gold"
+    assert rec[constants.COST_REPLAY_TOKENS] > 0
+    assert_conserved(led, [src, dst])
+
+
+@cpu_only
+def test_conservation_under_seeded_failover(params):
+    """Acceptance: the conservation law holds through a PR 14 seeded
+    replica kill — the dead replica's released holds were charged, the
+    survivors' failover replays are billed to the same receipts, and
+    every future resolves."""
+    led = CostLedger()
+    tracer = Tracer()
+    engines = [
+        make_engine(
+            params,
+            tracing=EngineTracing(tracer=tracer),
+            cost_ledger=led,
+            burst_windows=1,
+        )
+        for _ in range(3)
+    ]
+    rs = ReplicaSet(engines)
+    router = PrefixRouter(rs)
+    inj = ReplicaFaultInjector()
+    sup = FleetSupervisor(
+        rs,
+        router,
+        suspect_after=2,
+        dead_after=3,
+        fault_injector=inj,
+        sleep=lambda s: None,
+    )
+    futs = [sup.submit(p, max_new=10) for p in PROMPTS]
+    victim = rs.handles[0]
+    vid = victim.replica_id
+
+    def wave(pred, downed=(), n=600):
+        for _ in range(n):
+            for h in rs.handles:
+                if (
+                    h.state == constants.REPLICA_STATE_ACTIVE
+                    and h.replica_id not in downed
+                    and h.engine._thread is None
+                ):
+                    h.engine._tick()
+            sup.probe()
+            if pred():
+                return True
+        return False
+
+    victim_futs = [s.future for s in sup._streams.get(vid, {}).values()]
+    assert victim_futs, "scenario needs streams on the victim"
+    assert wave(
+        lambda: len(sup._checkpoints.get(vid, {})) >= len(victim_futs)
+        and all(
+            len(ck.generated) >= 1
+            for ck in sup._checkpoints.get(vid, {}).values()
+        ),
+        n=64,
+    )
+    inj.kill(vid)
+    assert wave(lambda: all(f.done() for f in futs), downed={vid})
+    outs = [list(f.result(timeout=60)) for f in futs]
+    assert all(len(o) == 10 for o in outs)
+    assert sup.failovers >= 1
+    rs.stop()
+    # Conservation over the WHOLE fleet, dead replica included: both
+    # sides of the law accumulate at the same release sites, and a
+    # kill releases nothing extra on either side.
+    assert_conserved(led, engines)
+    # The failed-over streams' receipts carry the failover replay.
+    recs = led.snapshot()["receipts"]
+    assert len(recs) == len(PROMPTS)
+    assert any(r[constants.COST_REPLAY_TOKENS] > 0 for r in recs)
+    assert all(r["status"] == constants.RECEIPT_STATUS_OK for r in recs)
+
+
+@cpu_only
+def test_supervisor_closes_receipts_of_error_resolved_streams(params):
+    """A dead replica's CHECKPOINT-LESS stream resolves with a
+    classified ReplicaLostError and never reaches an engine finish
+    terminus — FleetSupervisor(ledger=...) must close its receipt
+    FAILED, or the open accumulator leaks forever."""
+    led = CostLedger()
+    tracer = Tracer()
+    engines = [
+        make_engine(
+            params,
+            tracing=EngineTracing(tracer=tracer),
+            cost_ledger=led,
+            burst_windows=1,
+        )
+        for _ in range(2)
+    ]
+    rs = ReplicaSet(engines)
+    # Trace ids minted at INGRESS so the supervisor's tracked streams
+    # carry the receipt key (an engine-minted id never leaves the
+    # engine).
+    router = PrefixRouter(rs, tracer=tracer)
+    inj = ReplicaFaultInjector()
+    sup = FleetSupervisor(
+        rs,
+        router,
+        suspect_after=2,
+        dead_after=3,
+        fault_injector=inj,
+        ledger=led,
+        sleep=lambda s: None,
+    )
+    futs = [sup.submit(p, max_new=30) for p in PROMPTS[:2]]
+    # Admit everywhere (receipts open) but capture NO checkpoints: the
+    # first probe sweep happens only after the kill, and it fails.
+    for _ in range(3):
+        for e in engines:
+            e._tick()
+    victim = rs.handles[0]
+    vid = victim.replica_id
+    victim_streams = list(sup._streams.get(vid, {}).values())
+    assert victim_streams, "scenario needs a stream on the victim"
+    victim_tids = [s.trace_id for s in victim_streams]
+    assert led.snapshot()["open_requests"] == len(PROMPTS[:2])
+    inj.kill(vid)
+    for _ in range(6):
+        sup.probe()
+    assert victim.health == constants.REPLICA_HEALTH_DEAD
+    assert sup.futures_errored == len(victim_streams)
+    for tid in victim_tids:
+        rec = led.receipt(tid)
+        assert rec["status"] == constants.RECEIPT_STATUS_FAILED
+        assert rec[constants.COST_SLOT_SECONDS] >= 0.0
+    # Drive the survivor's streams home: nothing stays open.
+    survivors = [e for h, e in zip(rs.handles, engines) if h.replica_id != vid]
+    for _ in range(600):
+        for e in survivors:
+            e._tick()
+        if all(f.done() for f in futs):
+            break
+    rs.stop()
+    assert led.snapshot()["open_requests"] == 0
+    assert_conserved(led, engines)

@@ -156,6 +156,69 @@ def test_merge_single_sample_pool_percentiles():
     assert merged.ttft_p95_s == 0.25
 
 
+def test_merge_tolerates_snapshot_missing_accounting_fields():
+    # ISSUE 15 satellite: snapshots predating the cost-attribution
+    # fields (slot_seconds_total / kv_block_ticks / cost_receipts) must
+    # merge cleanly and contribute zero to them.
+    full = ServingReport(
+        steps_run=2, slot_seconds_total=1.5, kv_block_ticks=8, cost_receipts=1
+    )
+    old = SimpleNamespace(steps_run=3)
+    merged = ServingReport.merge([full, old])
+    assert merged.steps_run == 5
+    assert merged.slot_seconds_total == 1.5
+    assert merged.kv_block_ticks == 8
+    assert merged.cost_receipts == 1
+
+
+def test_report_delta_tolerates_snapshots_missing_accounting_fields():
+    # ...and so must report_delta, on EITHER side of the diff: an old
+    # journal replayed under the new monitor hands it rehydrated
+    # objects that have never heard of kv_block_ticks.
+    old_cur = SimpleNamespace(steps_run=7, macro_tokens_by_slot={"0": 10})
+    old_prev = SimpleNamespace(steps_run=3, macro_tokens_by_slot={"0": 4})
+    d = report_delta(old_cur, old_prev)
+    assert d["steps_run"] == 4
+    assert d["tokens"] == 6
+    assert d["kv_block_ticks"] == 0  # absent contributes zero
+    new_cur = ServingReport(steps_run=9, kv_block_ticks=5)
+    d2 = report_delta(new_cur, old_prev)
+    assert d2["steps_run"] == 6 and d2["kv_block_ticks"] == 5
+
+
+def test_replay_of_pre_accounting_journal_contributes_zero_utilization():
+    # A journal written before the accounting plane replays under the
+    # new monitor: verdicts derive as ever, the utilization roll-up is
+    # zero (no wall to attribute), nothing raises.
+    line = json.dumps(
+        {
+            "v": 1,
+            "event": constants.FLEET_EV_WINDOW,
+            "window": 3,
+            "t": 1.0,
+            "replicas": {
+                "replica-0": {
+                    "lifecycle": constants.REPLICA_STATE_ACTIVE,
+                    "dt_s": 1.0,
+                    "tokens": 12,
+                    "queue_depth": 0,
+                    "slots_active": 1,
+                    "slots_total": 2,
+                }
+            },
+            "tenants": {},
+        }
+    )
+    reports = FleetMonitor.replay([line])
+    assert len(reports) == 1
+    assert reports[0].replicas["replica-0"] == constants.PRESSURE_REPLICA_OK
+    # The wall denominator (dt_s x tp) is real even without profiler
+    # fields, so the normalization still derives; the decomposition
+    # contributes ZERO busy — the whole wall is idle waste.
+    assert reports[0].tok_s_per_chip_hour == pytest.approx(12 / (1.0 / 3600.0))
+    assert reports[0].waste_fraction == pytest.approx(1.0)
+
+
 # ---------------------------------------------------------------------------
 # telemetry: delta/rate derivation
 # ---------------------------------------------------------------------------

@@ -17,6 +17,7 @@ import pytest
 
 from nos_tpu import analysis
 from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
+from nos_tpu.analysis.checkers.cost_discipline import CostDisciplineChecker
 from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
 from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
@@ -626,6 +627,84 @@ def test_pressure_vocabulary_real_surface_is_clean():
     ):
         findings = run_checkers(
             os.path.join(TREE, rel), [TraceDisciplineChecker()]
+        )
+        assert findings == [], rel
+
+
+# -- NOS018 cost-ledger discipline / accounting field names --------------------
+def test_cost_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "cost_pos.py"),
+        [CostDisciplineChecker()],
+    )
+    assert codes_of(findings) == ["NOS018"]
+    # Tenant-total subscript write, receipt-ring assign, .pop on the
+    # open map, del on the ring, and three inline field names
+    # ("slot_seconds", "tok_s_per_chip_hour", "waste.idle") — NOT the
+    # docstring's quoted vocabulary and NOT any read.
+    assert len(findings) == 7
+    msgs = " | ".join(f.message for f in findings)
+    assert "_cost_tenants" in msgs
+    assert "_cost_receipts" in msgs
+    assert "_cost_open" in msgs
+    assert "slot_seconds" in msgs
+    assert "tok_s_per_chip_hour" in msgs
+    assert "waste.idle" in msgs
+
+
+def test_cost_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "cost_neg.py"),
+        [CostDisciplineChecker()],
+    )
+    assert findings == []
+
+
+def test_cost_discipline_scopes(tmp_path):
+    # The literal rule binds only where the accounting protocol lives
+    # (serving/ dirs + observability.py): the same field name elsewhere
+    # is legal. The WRITE rule covers runtime/ and serving/ on any
+    # receiver — and nothing outside them.
+    f = tmp_path / "billing_report.py"
+    f.write_text('COLUMN = "slot_seconds"\n')
+    assert run_checkers(str(f), [CostDisciplineChecker()]) == []
+    g = tmp_path / "serving" / "rollup.py"
+    g.parent.mkdir()
+    g.write_text('COLUMN = "slot_seconds"\n')
+    assert codes_of(run_checkers(str(g), [CostDisciplineChecker()])) == [
+        "NOS018"
+    ]
+    h = tmp_path / "elsewhere.py"
+    h.write_text(
+        "def hack(ledger):\n"
+        "    ledger._cost_open.clear()\n"
+    )
+    assert run_checkers(str(h), [CostDisciplineChecker()]) == []
+    k = tmp_path / "runtime" / "engine_like.py"
+    k.parent.mkdir()
+    k.write_text(
+        "def hack(ledger):\n"
+        "    ledger._cost_open.clear()\n"
+    )
+    assert codes_of(run_checkers(str(k), [CostDisciplineChecker()])) == [
+        "NOS018"
+    ]
+
+
+def test_cost_discipline_real_surface_is_clean():
+    # The tentpole's enforcement, checked directly: the ledger, the
+    # monitor's accounting rows, the engine's charge sites, and the
+    # /debug surface all derive field names from constants and route
+    # ledger mutation through CostLedger.
+    for rel in (
+        "observability.py",
+        os.path.join("serving", "accounting.py"),
+        os.path.join("serving", "monitor.py"),
+        os.path.join("serving", "supervisor.py"),
+        os.path.join("runtime", "decode_server.py"),
+    ):
+        findings = run_checkers(
+            os.path.join(TREE, rel), [CostDisciplineChecker()]
         )
         assert findings == [], rel
 
