@@ -1422,6 +1422,237 @@ def _fleet_failover(
     return artifact
 
 
+def _shared_kv_fleet(
+    np,
+    cfg,
+    params,
+    n_replicas: int = 3,
+    n_streams: int = 6,
+    sys_tokens: int = 16,
+    user_tokens: int = 8,
+    max_new: int = 8,
+) -> dict:
+    """Shared fleet KV store A/B (ISSUE 16, docs/kv-store.md): the
+    MemServe/Mooncake-shaped promotion of the PR 7 host tier from
+    per-engine to fleet scope, witnessed three ways on identical
+    traffic, counters primary (the PR 12 noise lesson):
+
+      - DEDUP: every replica serves the SAME stream set (replicated
+        traffic, the fleet shape N identical frontends produce). With
+        per-engine stores each replica holds its own copy of every
+        chain; ONE shared store holds ~1/N of the summed entries —
+        content addressing makes the N-way copy a dedup hit.
+      - PREWARM: a freshly created replica pulls the store's hot
+        ancestor-closed subtree into its device cache before traffic
+        lands — turn-2 charged prefill drops (counter gate) and TTFT
+        tails ride along as wall-clock evidence.
+      - FAILOVER: the PR 14 scenario with the store underneath — a
+        killed replica's PUBLISHED blocks outlive it, so the re-homed
+        streams' replay (recompute) tokens drop to the un-cached
+        suffix vs the store-less baseline.
+
+    Outputs are bit-identical in every comparison (store hit == cold
+    recompute, the exactness law the keys' content addressing buys)."""
+    from nos_tpu import constants
+    from nos_tpu.serving import (
+        FleetSupervisor,
+        PrefixRouter,
+        ReplicaFaultInjector,
+        ReplicaSet,
+        utilization_block,
+    )
+    from nos_tpu.serving.kv_store import FleetKVStore
+    from nos_tpu.telemetry import collect_serving, percentile
+    from nos_tpu.tracing import EngineTracing
+
+    srng = np.random.default_rng([2026, 16, 1])
+    system = srng.integers(1, cfg.vocab, sys_tokens).tolist()
+    prompts = [
+        system + srng.integers(1, cfg.vocab, user_tokens).tolist()
+        for _ in range(n_streams)
+    ]
+
+    def make(store):
+        from nos_tpu.runtime.decode_server import DecodeServer
+
+        return DecodeServer(
+            params, cfg, n_slots=2, max_len=64, prompt_buckets=(8, 16),
+            steps_per_dispatch=2, burst_windows=1, block_size=8, seed=11,
+            kv_store=store, tracing=EngineTracing(),
+        )
+
+    def serve(engine, reqs, idle_ticks=8, max_ticks=4000):
+        futs = [engine.submit(p, max_new=max_new) for p in reqs]
+        for _ in range(max_ticks):
+            if all(f.done() for f in futs):
+                break
+            engine._tick()
+        outs = [f.result(timeout=10) for f in futs]
+        for _ in range(idle_ticks):
+            engine._tick()  # idle publish drain into the store
+        return outs
+
+    # -- phase 1: dedup under replicated traffic ---------------------------
+    def dedup_arm(shared):
+        fleet_store = FleetKVStore(1 << 24) if shared else None
+        stores, engines, outs = [], [], []
+        for _ in range(n_replicas):
+            store = fleet_store if shared else FleetKVStore(1 << 24)
+            stores.append(store)
+            engine = make(store)
+            engines.append(engine)
+            outs.append(serve(engine, prompts))
+        stats = {
+            "store_entries_total": (
+                fleet_store.entries if shared
+                else sum(s.entries for s in stores)
+            ),
+            "store_bytes_total": (
+                fleet_store.host_bytes if shared
+                else sum(s.host_bytes for s in stores)
+            ),
+            "store_dedup_hits": sum(e.store_dedup_hits for e in engines),
+            "store_hits": sum(e.store_hits for e in engines),
+            "conserved": all(s.conserved() for s in stores),
+            "pins_leaked": sum(s.pinned_entries for s in stores),
+            "chip_accounting": utilization_block(
+                [collect_serving(e) for e in engines]
+            ),
+        }
+        for e in engines:
+            e.stop()
+        return outs, stats, (fleet_store if shared else None)
+
+    private_outs, private, _ = dedup_arm(shared=False)
+    shared_outs, shared, fleet_store = dedup_arm(shared=True)
+
+    # -- phase 2: cold-replica prewarm (turn-2 on a fresh replica) ---------
+    def turn2_arm(store, prewarm):
+        engine = make(store)
+        if prewarm:
+            queued = engine.prewarm_from_store()
+            ticks = 0
+            while engine._pending_prewarm and ticks < 500:
+                engine._tick()
+                ticks += 1
+        else:
+            queued = 0
+        outs = serve(engine, prompts, idle_ticks=0)
+        stats = {
+            "prewarm_blocks_queued": queued,
+            "prewarm_tokens": engine.prewarm_tokens,
+            "prefill_tokens_charged": engine.prefill_tokens,
+            "prefix_hit_tokens": engine.prefix_hit_tokens,
+            "store_hits": engine.store_hits,
+            "ttft_p50_s": round(percentile(engine.ttft_s, 50), 4),
+            "ttft_p95_s": round(percentile(engine.ttft_s, 95), 4),
+        }
+        engine.stop()
+        return outs, stats
+
+    cold_outs, cold_t2 = turn2_arm(None, prewarm=False)
+    warm_outs, warm_t2 = turn2_arm(fleet_store, prewarm=True)
+
+    # -- phase 3: failover replay with the store underneath ----------------
+    fo_prompts = prompts[:2]
+    ref_engine = make(None)
+    fo_want = serve(ref_engine, fo_prompts, idle_ticks=0)
+    ref_engine.stop()
+
+    def failover_arm(store):
+        rs = ReplicaSet([make(store) for _ in range(2)])
+        router = PrefixRouter(rs)
+        inj = ReplicaFaultInjector()
+        sup = FleetSupervisor(
+            rs, router, suspect_after=2, dead_after=3,
+            fault_injector=inj, sleep=lambda s: None,
+        )
+        futs = [sup.submit(p, max_new=max_new) for p in fo_prompts]
+        victim = rs.handles[0]
+        vid = victim.replica_id
+
+        def ticked(pred, downed=(), n=800):
+            for _ in range(n):
+                for h in rs.handles:
+                    if (
+                        h.state == constants.REPLICA_STATE_ACTIVE
+                        and h.replica_id not in downed
+                    ):
+                        h.engine._tick()
+                sup.probe()
+                if pred():
+                    return True
+            return False
+
+        n_victim = len(sup._streams.get(vid, {}))
+        captured = ticked(
+            lambda: len(sup._checkpoints.get(vid, {})) >= n_victim
+            and all(
+                len(ck.generated) >= 2
+                for ck in sup._checkpoints.get(vid, {}).values()
+            )
+        )
+        inj.kill(vid)
+        finished = ticked(lambda: all(f.done() for f in futs), downed={vid})
+        outs = [
+            f.result(0) if f.done() and f.exception() is None else None
+            for f in futs
+        ]
+        survivors = [h for h in rs.handles if h.replica_id != vid]
+        stats = {
+            "captured": bool(captured),
+            "finished": bool(finished),
+            "victim_streams": n_victim,
+            "failovers": sup.failovers,
+            "replay_tokens": sum(
+                h.engine.replay_tokens for h in survivors
+            ),
+            "failover_revive_tokens": sum(
+                h.engine.failover_revive_tokens for h in survivors
+            ),
+            "survivors_conserved": all(
+                h.engine._block_mgr.conserved() for h in survivors
+            ),
+            "outputs_match_reference": outs == fo_want,
+        }
+        rs.stop()
+        return stats
+
+    fo_cold = failover_arm(None)
+    fo_store = failover_arm(FleetKVStore(1 << 24))
+
+    return {
+        "replicas": n_replicas,
+        "streams": n_streams,
+        "dedup": {
+            "outputs_identical": (
+                all(o == private_outs[0] for o in private_outs)
+                and all(o == private_outs[0] for o in shared_outs)
+            ),
+            "per_engine_stores": private,
+            "shared_store": shared,
+            "entries_ratio_shared_vs_summed": (
+                round(
+                    shared["store_entries_total"]
+                    / private["store_entries_total"],
+                    3,
+                )
+                if private["store_entries_total"]
+                else None
+            ),
+        },
+        "prewarm_turn2": {
+            "outputs_identical": warm_outs == cold_outs,
+            "cold": cold_t2,
+            "prewarmed": warm_t2,
+        },
+        "failover": {
+            "baseline": fo_cold,
+            "with_store": fo_store,
+        },
+    }
+
+
 def _decode_phase(jax, jnp) -> dict:
     """Driver-captured serving throughput (VERDICT r4 #3: the README's
     tok/s claims lived only in docs — now the artifact carries them).
@@ -2059,6 +2290,15 @@ def _decode_phase(jax, jnp) -> dict:
     # baseline); failover latency tails ride along.
     out["fleet_failover"] = _retry(
         "decode:fleet_failover", lambda: _fleet_failover(np, cfg, params)
+    )
+
+    # Shared fleet KV store A/B (ISSUE 16, docs/kv-store.md): replicated
+    # traffic dedups to one host copy per chain, a fresh replica
+    # prewarms from the store (turn-2 charged prefill drops), and a
+    # killed replica's published blocks cut failover replay to the
+    # un-cached suffix — outputs bit-identical in every comparison.
+    out["shared_kv_fleet"] = _retry(
+        "decode:shared_kv_fleet", lambda: _shared_kv_fleet(np, cfg, params)
     )
 
     # Multi-turn chat A/B (ISSUE 13, docs/radix-cache.md): zipf tenants

@@ -105,6 +105,27 @@ counter math end to end, never wall-clock thresholds:
   - the cost conservation law holds on the `fleet_pressure` fleet:
     per-tenant charged slot-seconds == summed engine busy slot-seconds.
 
+ISSUE 16 adds the `shared_kv_fleet` A/B (per-engine spill stores vs one
+fleet-shared FleetKVStore under replicated traffic; prewarm-from-store
+on a fresh replica; failover replay with and without the shared store,
+docs/kv-store.md) with its own gates, counter/bit-exactness primary:
+
+  - outputs bit-identical per-engine vs shared-store arms, prewarmed vs
+    cold turn-2, and both failover arms vs the fault-free reference
+    (a store hit is the same bytes the engine would recompute);
+  - dedup witness: the shared store's entry count stays at most HALF
+    the summed per-engine entries under replicated traffic (observed
+    ~1/N for N replicas), with shared-arm store hits > 0;
+  - prewarm cuts turn-2 CHARGED prefill tokens (counter-based) and
+    copied blocks in (prewarm_tokens > 0); TTFT p95 rides along under
+    a wide backstop (NOS_TPU_PREWARM_TTFT_TOLERANCE_PCT, default 25%);
+  - failover-with-store revives checkpointed blocks from the store
+    (failover_revive_tokens > 0) and replays strictly fewer tokens
+    than the store-less baseline; survivor pools conserve;
+  - store conservation (byte ledger == resident bytes, zero leaked
+    pins) holds in every arm, and the shared dedup arm carries a real
+    `chip_accounting` block.
+
 Exit 0 and print the artifacts on success; exit 1 with the failed gate
 otherwise.
 """
@@ -412,6 +433,105 @@ def main() -> int:
             failures.append(f"fleet_failover: artifact missing {key}")
     check_chip_block("fleet_failover", fo_on.get("chip_accounting"))
 
+    # -- ISSUE 16: the shared fleet KV store A/B ---------------------------
+    kv = bench._shared_kv_fleet(np, cfg, params)
+    kv_payload = json.dumps(kv, sort_keys=True)
+    kv_parsed = json.loads(kv_payload)
+    print(kv_payload)
+
+    kv_dedup = kv_parsed["dedup"]
+    if not kv_dedup["outputs_identical"]:
+        failures.append(
+            "shared_kv_fleet: outputs diverge between per-engine and "
+            "shared-store arms (store hit != cold recompute)"
+        )
+    n_rep = kv_parsed["replicas"]
+    summed = kv_dedup["per_engine_stores"]["store_entries_total"]
+    pooled = kv_dedup["shared_store"]["store_entries_total"]
+    # The dedup witness: replicated traffic collapses to ~1/N of the
+    # summed per-engine entries (identical streams -> identical chains;
+    # a half-summed ceiling keeps the gate robust to stragglers).
+    if pooled * 2 > summed:
+        failures.append(
+            f"shared_kv_fleet: shared store holds {pooled} entries vs "
+            f"{summed} summed per-engine — dedup never engaged"
+        )
+    if not kv_dedup["shared_store"]["store_hits"]:
+        failures.append(
+            "shared_kv_fleet: no replica ever revived from the shared "
+            "store under replicated traffic"
+        )
+    for arm_name in ("per_engine_stores", "shared_store"):
+        arm = kv_dedup[arm_name]
+        if not arm["conserved"] or arm["pins_leaked"]:
+            failures.append(
+                f"shared_kv_fleet[{arm_name}]: store conservation "
+                f"violated (conserved={arm['conserved']}, "
+                f"pins_leaked={arm['pins_leaked']})"
+            )
+    check_chip_block(
+        "shared_kv_fleet", kv_dedup["shared_store"].get("chip_accounting")
+    )
+
+    kv_t2 = kv_parsed["prewarm_turn2"]
+    if not kv_t2["outputs_identical"]:
+        failures.append(
+            "shared_kv_fleet: prewarmed-replica outputs diverge from cold"
+        )
+    if not kv_t2["prewarmed"]["prewarm_tokens"]:
+        failures.append("shared_kv_fleet: prewarm never copied a block in")
+    if (
+        kv_t2["prewarmed"]["prefill_tokens_charged"]
+        >= kv_t2["cold"]["prefill_tokens_charged"]
+    ):
+        failures.append(
+            "shared_kv_fleet: prewarm did not cut turn-2 charged "
+            f"prefill: cold {kv_t2['cold']['prefill_tokens_charged']} vs "
+            f"prewarmed {kv_t2['prewarmed']['prefill_tokens_charged']}"
+        )
+    # TTFT rides along with a wide regression backstop (the counter
+    # gate above carries the protection; tiny-model TTFT deltas are
+    # ms-scale and sit near scheduler noise on loaded CI).
+    kv_ttft_tol = float(
+        os.environ.get("NOS_TPU_PREWARM_TTFT_TOLERANCE_PCT", "25.0")
+    )
+    if kv_t2["prewarmed"]["ttft_p95_s"] > kv_t2["cold"]["ttft_p95_s"] * (
+        1.0 + kv_ttft_tol / 100.0
+    ):
+        failures.append(
+            f"shared_kv_fleet: prewarmed TTFT p95 "
+            f"{kv_t2['prewarmed']['ttft_p95_s']}s regressed beyond "
+            f"{kv_ttft_tol}% of cold {kv_t2['cold']['ttft_p95_s']}s"
+        )
+
+    kv_fo = kv_parsed["failover"]
+    for arm_name in ("baseline", "with_store"):
+        arm = kv_fo[arm_name]
+        if not arm["outputs_match_reference"]:
+            failures.append(
+                f"shared_kv_fleet[failover/{arm_name}]: outputs diverge "
+                "from the fault-free reference"
+            )
+        if not arm["survivors_conserved"]:
+            failures.append(
+                f"shared_kv_fleet[failover/{arm_name}]: survivor pool "
+                "conservation violated"
+            )
+    if not kv_fo["with_store"]["failover_revive_tokens"]:
+        failures.append(
+            "shared_kv_fleet: failover never revived from the store "
+            "(the dead replica's cache died with it)"
+        )
+    if (
+        kv_fo["with_store"]["replay_tokens"]
+        >= kv_fo["baseline"]["replay_tokens"]
+    ):
+        failures.append(
+            "shared_kv_fleet: store did not cut failover replay: "
+            f"baseline {kv_fo['baseline']['replay_tokens']} vs store "
+            f"{kv_fo['with_store']['replay_tokens']}"
+        )
+
     # -- ISSUE 13: the radix-tree multi-turn chat A/B ----------------------
     chat = bench._multi_turn_chat(np, cfg, params)
     chat_payload = json.dumps(chat, sort_keys=True)
@@ -507,7 +627,16 @@ def main() -> int:
         f"{fo_on['goodput_retention']} on ({fo_on['failovers']} failovers, "
         f"{fo_off['stranded_futures']} stranded off-arm, latency p50/p95 "
         f"{fo_on['failover_latency_p50_s']}/"
-        f"{fo_on['failover_latency_p95_s']}s); multi-turn chat: "
+        f"{fo_on['failover_latency_p95_s']}s); shared kv: entries "
+        f"{kv_dedup['per_engine_stores']['store_entries_total']} summed -> "
+        f"{kv_dedup['shared_store']['store_entries_total']} pooled "
+        f"(ratio {kv_dedup['entries_ratio_shared_vs_summed']}), prewarm "
+        f"prefill {kv_t2['cold']['prefill_tokens_charged']} -> "
+        f"{kv_t2['prewarmed']['prefill_tokens_charged']} tok, failover "
+        f"replay {kv_fo['baseline']['replay_tokens']} -> "
+        f"{kv_fo['with_store']['replay_tokens']} tok "
+        f"({kv_fo['with_store']['failover_revive_tokens']} revived); "
+        "multi-turn chat: "
         + ", ".join(
             f"{tkey} cached {arm['chain']['cached_tokens']} -> "
             f"{arm['tree']['cached_tokens']} tok "

@@ -20,6 +20,7 @@ def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.radix_discipline import RadixDisciplineChecker
     from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
     from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
+    from nos_tpu.analysis.checkers.store_discipline import StoreDisciplineChecker
     from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
     from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
     from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
@@ -39,4 +40,5 @@ def all_checkers() -> List[Checker]:
         DevicePlacementChecker(),
         TraceDisciplineChecker(),
         CostDisciplineChecker(),
+        StoreDisciplineChecker(),
     ]

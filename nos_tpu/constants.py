@@ -233,6 +233,14 @@ PROBE_KEY_KV_BLOCKS_TOTAL = "kv_blocks_total"
 ROUTER_POLICY_PREFIX = "prefix"
 ROUTER_POLICY_ROUND_ROBIN = "round_robin"
 ROUTER_POLICIES = (ROUTER_POLICY_PREFIX, ROUTER_POLICY_ROUND_ROBIN)
+# Fleet KV store scoring (PrefixRouter + serving/kv_store.py): the value
+# of one SHARED-STORE hit token relative to a device-resident hit token
+# (which scores 1.0). Strictly between 0 and 1 by design: a store hit
+# (host copy-in) beats recompute on any replica, but a replica holding
+# the prefix in HBM beats one that would revive it from host — the same
+# cost order the engine's admit walk applies (device run first, host
+# continuation second).
+ROUTER_STORE_HIT_WEIGHT = 0.5
 
 # ---------------------------------------------------------------------------
 # Fleet pressure plane (nos_tpu/serving/monitor.py, docs/fleet-monitor.md).

@@ -214,9 +214,21 @@ class BlockManager:
     def _spill_out(self, block: int, key: str) -> None:
         """Move one indexed refcount-0 block's contents to the host tier
         and drop its device index entry. The caller owns the block's
-        next state (`_spilled` or immediate reuse)."""
+        next state (`_spilled` or immediate reuse).
+
+        In radix mode the put carries the node's prefix metadata
+        (parent chain key + the block's token tuple) so a SHARED tier
+        (serving/kv_store.py) can rebuild ancestor-closed chains for
+        cold-replica prewarm without consulting any engine's tree; a
+        private SpillTier ignores it."""
         payload, nbytes = self._spill_reader(block)
-        self._spill.put(key, payload, nbytes)
+        parent, tokens = "", ()
+        if self._tree is not None:
+            node = self._tree.node(key)
+            if node is not None:
+                tokens = node.tokens
+                parent = node.parent.key if node.parent is not None else ""
+        self._spill.put(key, payload, nbytes, parent=parent, tokens=tokens)
         del self._prefix_index[key]
         del self._block_key[block]
         if self._recorder is not None:
@@ -272,6 +284,11 @@ class BlockManager:
     def prompt_keys(self, prompt: Sequence[int]) -> List[str]:
         """Chain keys for every block FULLY covered by the prompt."""
         return prompt_chain_keys(prompt, self.block_size)
+
+    def device_resident(self, key: str) -> bool:
+        """Whether a chain key is already indexed on device — the
+        prewarm pump's skip test (a resident key needs no copy-in)."""
+        return key in self._prefix_index
 
     def peek_prefix(self, prompt: Sequence[int]) -> Tuple[int, int]:
         """READ-ONLY prefix probe: how many leading full blocks of
@@ -389,6 +406,27 @@ class BlockManager:
                     prompt, self.block_size, self._on_device, self._on_host
                 )
                 hits = [self._prefix_index[key] for key in dev_keys]
+                if (
+                    self._spill is not None
+                    and getattr(self._spill, "is_shared", False)
+                    and cow is None
+                ):
+                    # A SHARED tier holds chains this engine's tree has
+                    # never walked (another replica computed them — the
+                    # cold-replica case is ALL of them): extend the host
+                    # continuation by direct chain-key membership, the
+                    # flat-chain walk the tree sits on. Sound because
+                    # the keys are content-addressed — membership IS
+                    # bit-identical KV for exactly this prefix — and the
+                    # revives' note_progress re-indexing ensure_path's
+                    # the missing nodes. Skipped past a staged COW: the
+                    # divergence already owns the next block.
+                    cap = cacheable_block_cap(len(prompt), self.block_size)
+                    spill_keys = list(spill_keys)
+                    for key in keys[len(hits) + len(spill_keys) : cap]:
+                        if key not in self._spill:
+                            break
+                        spill_keys.append(key)
             else:
                 cap = cacheable_block_cap(len(prompt), self.block_size)
                 for key in keys[:cap]:
@@ -467,6 +505,12 @@ class BlockManager:
             ((len(hits) + j) * self.block_size, blocks[len(hits) + j], key)
             for j, key in enumerate(spill_keys)
         ]
+        if spill_keys:
+            # Pin the promised host hits against retirement until the
+            # engine's revive pump consumes (or abandons) them — on a
+            # SHARED tier another replica's put burst could otherwise
+            # retire the entry mid-promise. No-op on a private tier.
+            self._spill.stage(spill_keys)
         if self._tree is not None:
             # Node edges need token content, not just hashes: remember
             # the prompt's full-block tuples for registration.
@@ -533,6 +577,73 @@ class BlockManager:
         revives = self._slot_revives[idx]
         self._slot_revives[idx] = []
         return revives
+
+    def admit_prewarm_block(
+        self,
+        key: str,
+        chain_tokens: Sequence[Tuple[int, ...]],
+        chain_keys: Sequence[str],
+        reserve_free: int = 0,
+    ) -> Optional[int]:
+        """Admit one host-tier block into the device cache AHEAD of any
+        request — the cold-replica prewarm path (serving/kv_store.py):
+        a freshly created or drain-destination replica pulls the fleet
+        store's hot subtree into its own radix cache so turn-one traffic
+        hits instead of recomputing.
+
+        Strictly additive by design: allocates ONLY from the plain free
+        list (never evicts or reuses existing cache — prewarm must not
+        degrade a warm pool), refuses when fewer than ``reserve_free``
+        plain blocks would remain (headroom for real admissions), and
+        skips keys already device-resident. The block lands refcount-0
+        on the cached-free LRU (MRU end: it was judged hot), indexed
+        under its chain key with its node chain ensured, exactly as if
+        a request had computed and released it. Returns the device
+        block for the engine's copy-in, or None (resident / no
+        headroom). All pool-state writes stay in this class (NOS011)."""
+        if key in self._prefix_index:
+            return None
+        if len(self._free_blocks) <= reserve_free:
+            return None
+        block = self._free_blocks.pop()
+        self._prefix_index[key] = block
+        self._block_key[block] = key
+        self._cached_free[block] = key
+        if self._tree is not None:
+            self._tree.ensure_path(chain_tokens, chain_keys)
+        return block
+
+    def publish_to_tier(self, max_blocks: int = 0) -> int:
+        """WRITE-THROUGH publish: copy up to ``max_blocks`` indexed
+        device blocks (0 = all) into the host tier WITHOUT dropping
+        their device residency — the shared-store complement of
+        `_spill_out` (which MOVES). A fleet store wants cached content
+        visible before this replica dies, drains, or is asked to seed a
+        prewarm, not only when HBM pressure happens to demote it; a
+        private tier gains nothing from eager copies, so the engine
+        only calls this when the tier `is_shared`. Keys already
+        host-resident are skipped (the store would just dedup), so the
+        steady-state sweep is cheap. Runs on the engine thread — the
+        reader's device copy-out must never race the donated cache
+        chain. Returns the number of blocks actually put."""
+        if self._spill is None:
+            return 0
+        published = 0
+        for key, block in list(self._prefix_index.items()):
+            if key in self._spill:
+                continue
+            payload, nbytes = self._spill_reader(block)
+            parent, tokens = "", ()
+            if self._tree is not None:
+                node = self._tree.node(key)
+                if node is not None:
+                    tokens = node.tokens
+                    parent = node.parent.key if node.parent is not None else ""
+            self._spill.put(key, payload, nbytes, parent=parent, tokens=tokens)
+            published += 1
+            if max_blocks and published >= max_blocks:
+                break
+        return published
 
     def _alloc_one(self) -> int:
         """One block, cheapest casualty first: the plain free list, then
@@ -681,6 +792,12 @@ class BlockManager:
         self._slot_blocks[idx] = []
         self._slot_keys[idx] = []
         self._slot_indexed[idx] = 0
+        if self._slot_revives[idx] and self._spill is not None:
+            # Unclaimed staged revives die with the slot: release their
+            # stage pins so a dead slot never wedges shared-tier
+            # retirement. Claimed revives' pins are the engine's to
+            # drop (take() consumes them; abandonment unstages).
+            self._spill.unstage([key for _, _, key in self._slot_revives[idx]])
         self._slot_revives[idx] = []
         self._slot_blocks_tokens[idx] = []
         self._slot_use_cache[idx] = False
@@ -713,6 +830,11 @@ class BlockManager:
         self._slot_use_cache = [False] * self.n_slots
         self._slot_cow = [None] * self.n_slots
         self._cow_pins = [None] * self.n_slots
+        if self._spill is not None:
+            # Stage pins promised against the dead pool are void; the
+            # tier's CONTENT survives (see docstring) — only this
+            # engine's holds on it are dropped.
+            self._spill.unstage_all()
         if self._tree is not None:
             # Mirror the index/tier split structurally: device nodes die
             # with the pool, host-resident paths survive (with their

@@ -303,6 +303,7 @@ class DecodeServer:
         prefix_cache: bool = True,
         radix_cache: bool = True,
         spill_blocks: Optional[int] = None,
+        kv_store=None,
         quota: Optional[QuotaPolicy] = None,
         mesh=None,
         tp_axis: str = "tp",
@@ -704,7 +705,7 @@ class DecodeServer:
         # Full-width payload size of one spilled block (the cost plane's
         # spill/revive byte unit; 0 with the tier disabled).
         self._bytes_per_block = 0
-        if spill_blocks > 0:
+        if kv_store is not None or spill_blocks > 0:
             bytes_per_block = (
                 cfg.layers
                 * 2
@@ -714,8 +715,30 @@ class DecodeServer:
                 * np.dtype(cfg.jdtype).itemsize
             )
             self._bytes_per_block = int(bytes_per_block)
-            self.spill_tier = SpillTier(int(spill_blocks) * bytes_per_block)
+            if kv_store is not None:
+                # Fleet-scope shared cold tier (serving/kv_store.py):
+                # the engine's host tier becomes a per-engine adapter
+                # over ONE content-addressed FleetKVStore shared by
+                # every replica — same duck surface, so the manager and
+                # every pump below are tier-agnostic. Lazy import: the
+                # serving package imports this module.
+                from nos_tpu.serving.kv_store import StoreTier
+
+                self.spill_tier = StoreTier(kv_store)
+            else:
+                self.spill_tier = SpillTier(int(spill_blocks) * bytes_per_block)
             self._block_mgr.attach_spill(self.spill_tier, self._extract_block)
+        # Shared-store serving state (all inert on a private tier):
+        # chains staged for cold-start prewarm (prewarm_from_store ->
+        # _pump_prewarm, budget-charged like revives), the write-through
+        # publish bound per tick, and the fleet-kv counters telemetry
+        # mirrors per engine.
+        self._pending_prewarm: Deque = deque()
+        self._store_shared = bool(getattr(self.spill_tier, "is_shared", False))
+        self._publish_per_tick = 2
+        self.prewarm_tokens = 0
+        self.failover_revive_tokens = 0
+        self.store_published_blocks = 0
         # Elastic tenant quotas (PR 7, runtime/quota.py): None = no quota
         # behavior. `_tick_tokens` accumulates one tick's decode tokens
         # per tenant for the policy's sliding window.
@@ -1617,6 +1640,11 @@ class DecodeServer:
         the engine total by construction (the conservation law)."""
         if self._cost is not None:
             self._note_slot_release(idx)
+        slot = self._slots[idx]
+        if slot.pending_revives and self.spill_tier is not None:
+            # Claimed-but-unconsumed revives die with the slot: return
+            # their stage pins so the shared store may retire the keys.
+            self.spill_tier.unstage([k for _, _, k in slot.pending_revives])
         self._block_mgr.release(idx, spill=spill)
         self._slots[idx] = _Slot()
         self._tick_state.mark_table_dirty()
@@ -1886,10 +1914,24 @@ class DecodeServer:
                 slot.active = True
                 bound = True
                 if req.t_restore:
-                    self.replay_tokens += len(full_prompt)
+                    # Replay accounting counts only the UN-CACHED suffix:
+                    # device hits, staged host-tier revives and the COW
+                    # head serve their tokens without recompute, so with
+                    # a warm (or fleet-shared) tier a failover's replay
+                    # bill drops toward the suffix the cache never held.
+                    # The cap guarantees cached < len(full_prompt), so a
+                    # restore always replays >= 1 token (the tests' and
+                    # dashboards' restore witness stays nonzero).
+                    cached_replay = n_hit * self.block_size + len(
+                        slot.pending_revives
+                    ) * self.block_size
+                    if slot.pending_cow is not None:
+                        cached_replay += int(slot.pending_cow[4])
+                    replayed = max(0, len(full_prompt) - cached_replay)
+                    self.replay_tokens += replayed
                     if self.metrics is not None:
                         self.metrics.inc(
-                            "nos_tpu_decode_replay_tokens", len(full_prompt)
+                            "nos_tpu_decode_replay_tokens", replayed
                         )
                 else:
                     wait = time.monotonic() - req.t_submit
@@ -1916,11 +1958,11 @@ class DecodeServer:
                             acct_tenant,
                             prefill_tokens_cached=cached,
                         )
-                    if req.t_restore:
+                    if req.t_restore and replayed:
                         self._cost.charge(
                             slot.trace_id,
                             acct_tenant,
-                            replay_tokens=len(full_prompt),
+                            replay_tokens=replayed,
                         )
                 if self._tracer is not None:
                     self._tracer.event(
@@ -2033,6 +2075,11 @@ class DecodeServer:
                 dispatches += self._dispatch_prefill_wave(wave)
             if budget and spent >= budget:
                 break
+        if self._pending_prewarm and not exhausted:
+            # Leftover budget warms the fleet-store prewarm queue:
+            # admissions always outrank speculative cache warming.
+            n_pw, _ = self._pump_prewarm(budget, spent)
+            dispatches += n_pw
         return dispatches
 
     def _pump_revives(self, idx: int, budget: int, spent: int) -> Tuple[int, int]:
@@ -2051,6 +2098,7 @@ class DecodeServer:
             if start != slot.prefill_cursor:
                 # Defensive: a revive not at the cursor means the compute
                 # path already owns this range — recompute the rest.
+                self.spill_tier.unstage([k for _, _, k in slot.pending_revives])
                 slot.pending_revives = []
                 break
             cost = self.block_size
@@ -2059,6 +2107,12 @@ class DecodeServer:
             self._check_fault("revive", idx)
             payload = self.spill_tier.take(key)
             if payload is None:
+                # `take` already returned the missing key's stage pin;
+                # the rest of the run downgrades to recompute, so its
+                # pins go back too.
+                self.spill_tier.unstage(
+                    [k for _, _, k in slot.pending_revives[1:]]
+                )
                 slot.pending_revives = []
                 break
             kx, vx = payload
@@ -2089,6 +2143,12 @@ class DecodeServer:
                 slot.phase = "prefilling"
             copies += 1
             used += cost
+            if slot.t_restore:
+                # Failover/restore admissions that hit the tier serve
+                # their replay from host bytes instead of recompute —
+                # the fleet-level witness that a dead replica's cache
+                # outlived it in the shared store.
+                self.failover_revive_tokens += cost
             if self._cost is not None:
                 # A revive serves `block_size` prompt tokens from the
                 # host tier instead of recompute (cached service), at
@@ -2170,6 +2230,126 @@ class DecodeServer:
                 constants.FLIGHT_EV_COW, slot=idx, block=dst, tokens=n
             )
         return 1, n
+
+    def prewarm_from_store(
+        self,
+        keys: Optional[Sequence[str]] = None,
+        max_blocks: Optional[int] = None,
+    ) -> int:
+        """Queue fleet-store blocks for PREWARM into this engine's
+        device cache — the cold-replica path (docs/kv-store.md): a
+        freshly created or drain-destination replica pulls the store's
+        hot subtree into its own radix cache so turn-one traffic hits
+        instead of recomputing.
+
+        `keys` defaults to the store's MRU-first ancestor-closed hot
+        set; each key's full root chain is reconstructed from store
+        metadata (keys whose chain broke under retirement are skipped —
+        indexing a block the store cannot back would corrupt the hit
+        walk). Planned keys are STAGE-PINNED immediately, so the store
+        cannot retire them between this call and the copy-in, then
+        drained by `_pump_prewarm` through the same prefill-token
+        budget live admissions use — block_size tokens per copy-in,
+        admissions first. Returns the number of blocks queued.
+
+        Thread-tolerant by construction: `ReplicaSet.add` calls this
+        from the control thread while the engine loop may be ticking —
+        the store is lock-guarded, stage pins and the deque are
+        appended atomically, and the engine thread alone consumes the
+        queue and touches the pool."""
+        tier = self.spill_tier
+        if tier is None or not self._store_shared:
+            return 0
+        store = tier.store
+        if keys is None:
+            keys = store.hot_keys()
+        planned = {entry[0] for entry in self._pending_prewarm}
+        plan: List[Tuple[str, List[str], List[Tuple[int, ...]]]] = []
+        for key in keys:
+            # Reconstruct the root-first chain from store metadata.
+            chain: List[Tuple[str, Tuple[int, ...]]] = []
+            node, broken = key, False
+            while node:
+                meta = store.meta(node)
+                if meta is None:
+                    broken = True
+                    break
+                chain.append((node, meta[1]))
+                node = meta[0]
+            if broken:
+                continue
+            chain.reverse()
+            chain_keys = [k for k, _ in chain]
+            chain_tokens = [t for _, t in chain]
+            for i, (k, _) in enumerate(chain):
+                if k in planned or self._block_mgr.device_resident(k):
+                    continue
+                planned.add(k)
+                plan.append((k, chain_keys[: i + 1], chain_tokens[: i + 1]))
+        if max_blocks is not None:
+            plan = plan[:max_blocks]
+        if not plan:
+            return 0
+        tier.stage([k for k, _, _ in plan])
+        self._pending_prewarm.extend(plan)
+        return len(plan)
+
+    def _pump_prewarm(self, budget: int, spent: int) -> Tuple[int, int]:
+        """Drain queued prewarm copy-ins under the tick's remaining
+        prefill budget — block_size tokens per block, the same price a
+        revive pays, so warming never outruns the bandwidth admissions
+        are budgeted to. Allocation is strictly additive (plain free
+        list only, with headroom reserved for a full admission), so a
+        prewarm can slow-start but never degrade a warm pool. Returns
+        (copy-ins dispatched, budget tokens used)."""
+        tier = self.spill_tier
+        copies = 0
+        used = 0
+        # Plain-free headroom kept for admissions. Purely anti-churn,
+        # not anti-deadlock: prewarmed blocks land refcount-0 on the
+        # cached LRU, so they stay allocatable (`available()` counts
+        # them) and an admission burst simply evicts the coldest
+        # prewarm back to the store it came from.
+        reserve = self.n_slots
+        while self._pending_prewarm:
+            key, chain_keys, chain_tokens = self._pending_prewarm[0]
+            cost = self.block_size
+            if budget and (spent + used) and spent + used + cost > budget:
+                break
+            if self._block_mgr.device_resident(key):
+                # Raced by a real admission's revive: already served.
+                self._pending_prewarm.popleft()
+                tier.unstage([key])
+                continue
+            if self._block_mgr.counts()["free"] <= reserve:
+                # No additive headroom: live traffic owns the pool.
+                # Keep the queue — a release may free blocks later.
+                break
+            payload = tier.take(key)
+            if payload is None:
+                # Retired despite the stage pin (reset) — skip.
+                self._pending_prewarm.popleft()
+                continue
+            block = self._block_mgr.admit_prewarm_block(
+                key, chain_tokens, chain_keys, reserve_free=reserve
+            )
+            if block is None:
+                self._pending_prewarm.popleft()
+                continue
+            kx, vx = payload
+            with self._prof.dispatch():
+                self.cache = self._revive_fn(
+                    self.cache,
+                    self._stage.to_device(kx),
+                    self._stage.to_device(vx),
+                    block,
+                )
+            self._pending_prewarm.popleft()
+            self._tick_state.mark_dirty()
+            self.prewarm_tokens += cost
+            copies += 1
+            used += cost
+        return copies, used
 
     def _dispatch_prefill_wave(self, wave: List[Tuple[int, int, list]]) -> int:
         """Dispatch one wave (at most one chunk per slot). Mid-prompt
@@ -2924,15 +3104,20 @@ class DecodeServer:
         if self._recorder is not None:
             self._recorder.record(constants.FLIGHT_EV_PREEMPT, slot=idx)
         ck = self._checkpoint_slot(idx)
-        spill_bytes0 = (
-            self.spill_tier.host_bytes if self.spill_tier is not None else 0
-        )
+        spills0 = self.spill_tier.spills if self.spill_tier is not None else 0
         self._release_slot(idx, spill=True)
         if self._cost is not None and self.spill_tier is not None:
             # The preemption's device->host traffic, billed to the
             # preempted stream's own account (its revival charges the
-            # copy-in the same way).
-            moved = max(0, self.spill_tier.host_bytes - spill_bytes0)
+            # copy-in the same way). Counted by THIS engine's put count
+            # x the full-width payload size, not a host-byte delta: on
+            # a SHARED tier (serving/kv_store.py) the byte gauge moves
+            # with every replica's traffic — and with dedup/LRU churn —
+            # while the put count is exactly the bytes this stream
+            # pushed over the device->host boundary.
+            moved = max(
+                0, (self.spill_tier.spills - spills0) * self._bytes_per_block
+            )
             if moved:
                 self._cost.charge(
                     slot.trace_id, slot.tenant or "", spill_bytes=moved
@@ -3033,7 +3218,11 @@ class DecodeServer:
             prof.end_tick(self.metrics)
 
     def _tick_phases(self, prof) -> None:
-        if self._engine_idle and self._queue.empty():
+        if (
+            self._engine_idle
+            and not self._pending_prewarm
+            and self._queue.empty()
+        ):
             # The idle fast path: the previous tick proved the engine
             # empty (no active slot, no waiting request) and only a
             # client submit can change that — checked above with one
@@ -3059,11 +3248,26 @@ class DecodeServer:
             self._scan_eos()
         if not any(s.active for s in self._slots):
             self._note_quota_tick()
+            if self._pending_prewarm:
+                # No live traffic: the whole prefill budget goes to
+                # prewarm copy-ins (a fresh/drain-destination replica
+                # warming its hot subtree from the fleet store).
+                with prof.phase(constants.TICK_PHASE_PUMP_PREFILL):
+                    self._pump_prewarm(self.prefill_budget_tokens, 0)
+            if self._store_shared:
+                # Quiesced: drain the remaining unpublished cached
+                # blocks into the fleet store in one sweep.
+                self.store_published_blocks += self._block_mgr.publish_to_tier(0)
             self.idle_ticks += 1
             # Arm the fast path only once the engine is provably empty:
             # a waiting (pool-blocked) request still needs the admission
-            # scan every tick.
-            self._engine_idle = not self._waiting and self._queue.empty()
+            # scan every tick, and a pending prewarm still needs pump
+            # visits.
+            self._engine_idle = (
+                not self._waiting
+                and not self._pending_prewarm
+                and self._queue.empty()
+            )
             with prof.phase(constants.TICK_PHASE_IDLE):
                 self._stop.wait(0.005)
             return
@@ -3114,6 +3318,15 @@ class DecodeServer:
             # drafting slots themselves need it — the one blocking read.
             with prof.phase(constants.TICK_PHASE_RESOLVE):
                 self._resolve_verifies(block=True)
+        if self._store_shared:
+            # Write-through publish: a shared tier wants cached blocks
+            # visible fleet-wide BEFORE this replica dies or drains, so
+            # stream a bounded number of still-device-resident indexed
+            # blocks into the store each busy tick (copy-out cost is
+            # bounded per tick; the idle branch drains the rest).
+            self.store_published_blocks += self._block_mgr.publish_to_tier(
+                self._publish_per_tick
+            )
         self._note_quota_tick()
         if self._cost is not None:
             self._note_cost_tick(n_burst if n_burst else 1)
@@ -3533,6 +3746,44 @@ class DecodeServer:
     def spill_host_bytes(self) -> int:
         return self.spill_tier.host_bytes if self.spill_tier is not None else 0
 
+    # -- fleet KV store counters (serving/kv_store.py StoreTier; all
+    # zero when the engine runs a private SpillTier, so the same report
+    # fields serve both wirings). NOTE for fleet merges: store_bytes /
+    # store_entries are gauges on ONE shared store — every replica of a
+    # fleet reports the same store, so a merged report's sum reads
+    # N x the store (the tp_devices pattern); dashboards divide by the
+    # replica count or read a single replica. ------------------------------
+    @property
+    def store_hits(self) -> int:
+        """Revive reads served by the shared store (per-engine)."""
+        return getattr(self.spill_tier, "store_hits", 0)
+
+    @property
+    def store_misses(self) -> int:
+        """Staged revives the store had already retired (per-engine)."""
+        return getattr(self.spill_tier, "store_misses", 0)
+
+    @property
+    def store_puts(self) -> int:
+        """Spills/publishes this engine pushed into the shared store."""
+        return getattr(self.spill_tier, "store_puts", 0)
+
+    @property
+    def store_dedup_hits(self) -> int:
+        """Puts that found the key already resident — the N-replicas/
+        one-copy witness."""
+        return getattr(self.spill_tier, "store_dedup_hits", 0)
+
+    @property
+    def store_bytes(self) -> int:
+        """Shared-store resident bytes (gauge; 0 with a private tier)."""
+        return self.spill_tier.host_bytes if self._store_shared else 0
+
+    @property
+    def store_entries(self) -> int:
+        """Shared-store resident entries (gauge)."""
+        return len(self.spill_tier) if self._store_shared else 0
+
     @property
     def borrowed_ticks(self) -> int:
         """Ticks where a tenant ran above its guaranteed share — the
@@ -3622,10 +3873,22 @@ class DecodeServer:
         m.set_gauge("nos_tpu_decode_kv_blocks_spilled", pool["spilled"])
         m.set_gauge("nos_tpu_decode_spill_host_bytes", self.spill_host_bytes)
         m.set_gauge("nos_tpu_decode_radix_nodes", self.radix_nodes)
+        if self._store_shared:
+            m.set_gauge("nos_tpu_fleet_kv_store_bytes", self.store_bytes)
+            m.set_gauge("nos_tpu_fleet_kv_store_entries", self.store_entries)
         for name, cur in (
             ("nos_tpu_decode_spills", self.spills),
             ("nos_tpu_decode_revives", self.revives),
             ("nos_tpu_decode_spill_drops", self.spill_drops),
+            ("nos_tpu_fleet_kv_store_hits", self.store_hits),
+            ("nos_tpu_fleet_kv_store_misses", self.store_misses),
+            ("nos_tpu_fleet_kv_store_puts", self.store_puts),
+            ("nos_tpu_fleet_kv_store_dedup_hits", self.store_dedup_hits),
+            ("nos_tpu_fleet_kv_prewarm_tokens", self.prewarm_tokens),
+            (
+                "nos_tpu_fleet_kv_failover_revive_tokens",
+                self.failover_revive_tokens,
+            ),
             ("nos_tpu_decode_borrowed_ticks", self.borrowed_ticks),
             ("nos_tpu_decode_h2d_uploads", self.h2d_uploads),
             ("nos_tpu_decode_blocking_syncs", self.blocking_syncs),

@@ -41,7 +41,7 @@ lint finding, not a review comment.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Iterator, Optional, Tuple
+from typing import Iterable, Iterator, Optional, Sequence, Tuple
 
 
 class SpillTier:
@@ -91,12 +91,40 @@ class SpillTier:
             and self._spill_bytes <= self.capacity_bytes
         )
 
+    # -- tier-interface parity (serving/kv_store.StoreTier) ------------------
+    # A private tier has no cross-replica retirement race, so stage
+    # pins are no-ops here; the shared tier makes them real. Keeping
+    # the methods on both tiers lets BlockManager/DecodeServer speak
+    # ONE host-tier surface without isinstance branches.
+    is_shared = False
+
+    def stage(self, keys: Iterable[str]) -> None:
+        return None
+
+    def unstage(self, keys: Iterable[str]) -> None:
+        return None
+
+    def unstage_all(self) -> None:
+        return None
+
     # -- mutation (the only sanctioned sites — NOS013) -----------------------
-    def put(self, key: str, payload: object, nbytes: int) -> None:
+    def put(
+        self,
+        key: str,
+        payload: object,
+        nbytes: int,
+        parent: str = "",
+        tokens: Sequence[int] = (),
+    ) -> None:
         """Admit one spilled block's contents under its chain key,
         retiring LRU entries beyond capacity. Re-putting a key refreshes
         its payload and recency (the content is identical by key
-        construction, so this is bookkeeping, not data loss)."""
+        construction, so this is bookkeeping, not data loss). The
+        ``parent``/``tokens`` prefix metadata is accepted for interface
+        parity with the fleet store's prewarm planner and ignored — a
+        private tier serves only its owner's radix tree, which already
+        knows its chains."""
+        del parent, tokens
         nbytes = int(nbytes)
         if key in self._spill_store:
             _, old = self._spill_store.pop(key)

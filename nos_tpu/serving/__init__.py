@@ -22,7 +22,12 @@ accounting") closes the observability suite: chip-second duty-cycle
 decomposition over the monitor's journaled windows, a single-mutator
 `CostLedger` attributing slot-seconds/tokens/KV-block-ticks to
 tenants, and per-request cost receipts served beside
-`/debug/trace/<id>`.
+`/debug/trace/<id>`. The `kv_store` module (docs/kv-store.md) promotes
+PR 7's per-engine host spill tier to ONE fleet-scope content-addressed
+`FleetKVStore` (chain key -> full-width KV payload, deduped across
+replicas) that engines mount through a `StoreTier` adapter — the
+MemServe/Mooncake-style disaggregated cold tier ROADMAP item 3 names,
+feeding router scoring, cold-replica prewarm, and failover revives.
 """
 
 from nos_tpu.serving.accounting import (  # noqa: F401
@@ -36,6 +41,7 @@ from nos_tpu.serving.drain import (  # noqa: F401
     drain_replica,
     migrate_replica,
 )
+from nos_tpu.serving.kv_store import FleetKVStore, StoreTier  # noqa: F401
 from nos_tpu.serving.monitor import (  # noqa: F401
     FleetMonitor,
     PressureReport,

@@ -238,15 +238,36 @@ class ReplicaSet:
     def active_handles(self) -> List[ReplicaHandle]:
         return [h for h in self.handles if h.admitting]
 
-    def add(self, engine, start: bool = False) -> ReplicaHandle:
+    def add(self, engine, start: bool = False, prewarm: bool = True) -> ReplicaHandle:
         """Register a new replica (the CREATE step of the move protocol:
-        grow the fleet first, then drain the source into it)."""
+        grow the fleet first, then drain the source into it).
+
+        An engine wired to the fleet KV store (serving/kv_store.py)
+        PREWARMS on registration: its hot-subtree revives are queued
+        from the shared store before any traffic routes here, so the
+        created replica — the drain destination, the scale-out target —
+        starts with the fleet's working set instead of stone cold
+        (copy-ins drain through the engine's own prefill budget; this
+        call only stages them). `prewarm=False` opts out (the cold-arm
+        A/B baseline); engines without the hook are unaffected."""
         if engine.block_size != self.block_size:
             raise ValueError(
                 f"new replica block_size {engine.block_size} != fleet "
                 f"block_size {self.block_size}"
             )
         handle = self._add_handle(engine)
+        pw = getattr(engine, "prewarm_from_store", None)
+        if prewarm and pw is not None:
+            try:
+                pw()
+            except Exception:  # nos-lint: ignore[NOS012] prewarm is best-effort, not a recovery path
+                # Prewarm is a performance head start, never a liveness
+                # dependency: a cold replica is still a correct replica.
+                logger.warning(
+                    "replica %s: prewarm_from_store failed; starting cold",
+                    handle.replica_id,
+                    exc_info=True,
+                )
         if start:
             engine.start()
         return handle

@@ -74,6 +74,7 @@ class PrefixRouter:
         load_penalty_tokens: Optional[float] = None,
         sticky_tenants: bool = True,
         tracer=None,
+        kv_store=None,
     ):
         """`load_penalty_tokens` prices one unit of replica load (an
         active slot / queued request) in prefix-hit tokens; default =
@@ -85,7 +86,18 @@ class PrefixRouter:
         starts with a `router.select` span (scoring duration + chosen
         replica) and its id is threaded into the engine, so one request
         is one trace from placement to finish — across restores,
-        preemptions, and drain migrations."""
+        preemptions, and drain migrations.
+
+        `kv_store` (optional, serving/kv_store.py FleetKVStore — the
+        SAME instance the replicas' StoreTiers wrap) extends scoring
+        one tier down: the device-shadow match's contiguous
+        continuation in the shared store is scored at
+        `constants.ROUTER_STORE_HIT_WEIGHT` tokens per token — a store
+        hit (one host copy-in) beats recompute but loses to a
+        device-resident hit, mirroring the engine-side cost order.
+        Membership probes only (peek-must-not-perturb: no recency
+        touch, no pins), so scoring never changes what the store
+        retires next."""
         if policy not in constants.ROUTER_POLICIES:
             raise ValueError(
                 f"unknown router policy {policy!r}; "
@@ -101,6 +113,7 @@ class PrefixRouter:
         )
         self.sticky_tenants = bool(sticky_tenants)
         self.tracer = tracer
+        self.kv_store = kv_store
         self._lock = threading.Lock()
         self._rr = 0
         self._sticky: Dict[str, str] = {}  # tenant -> replica_id
@@ -109,7 +122,9 @@ class PrefixRouter:
         self.prefix_routed = 0  # placements won by a shadow-hit score
         self.sticky_routed = 0  # placements decided by a tenant pin
         self.rr_routed = 0  # pure rotation (round_robin policy or no signal)
+        self.store_routed = 0  # no device signal, but a store-hit score
         self.predicted_hit_tokens = 0
+        self.predicted_store_tokens = 0
 
     # -- client side ----------------------------------------------------------
     def submit(
@@ -228,30 +243,45 @@ class PrefixRouter:
                 # Pin points at a draining/retired replica: dissolve it
                 # and fall through to a fresh scored placement.
                 del self._sticky[tenant]
+        store_run = 0
         scored = []
         for h in active:
             load = self._safe_load(h)
             if load is None:
                 continue  # unreachable probe: not a candidate this round
-            scored.append(
-                (
-                    h.shadow_hit_tokens(prompt)
-                    - self.load_penalty_tokens * load,
-                    h,
-                )
-            )
+            hit = h.shadow_hit_tokens(prompt)
+            score = hit - self.load_penalty_tokens * load
+            store_tokens = 0
+            if self.kv_store is not None:
+                # The device match's CONTIGUOUS continuation in the
+                # shared store: blocks this replica would revive by
+                # copy-in instead of recompute. Discounted (< 1 token
+                # per token) so a genuine device hit elsewhere still
+                # wins — store > recompute, device > store.
+                run = 0
+                for key in keys[hit // self.block_size :]:
+                    if key not in self.kv_store:
+                        break
+                    run += 1
+                store_tokens = run * self.block_size
+                score += constants.ROUTER_STORE_HIT_WEIGHT * store_tokens
+            scored.append((score, h, hit, store_tokens))
         if not scored:
             raise RuntimeError(
                 "no admitting replica (all draining/retired/unhealthy): "
                 "cannot route"
             )
-        best = max(score for score, _ in scored)
-        ties = [h for score, h in scored if score == best]
-        handle = ties[self._rr % len(ties)]
+        best = max(score for score, _, _, _ in scored)
+        ties = [
+            (h, hit, st) for score, h, hit, st in scored if score == best
+        ]
+        handle, hit_tokens, store_run = ties[self._rr % len(ties)]
         self._rr += 1
-        hit_tokens = handle.shadow_hit_tokens(prompt)
+        self.predicted_store_tokens += store_run
         if hit_tokens > 0:
             self.prefix_routed += 1
+        elif store_run > 0:
+            self.store_routed += 1
         else:
             self.rr_routed += 1
         return handle, keys, hit_tokens
@@ -301,6 +331,8 @@ class PrefixRouter:
                 "prefix_routed": self.prefix_routed,
                 "sticky_routed": self.sticky_routed,
                 "rr_routed": self.rr_routed,
+                "store_routed": self.store_routed,
                 "predicted_hit_tokens": self.predicted_hit_tokens,
+                "predicted_store_tokens": self.predicted_store_tokens,
                 "replicas": self.replica_set.snapshot(),
             }

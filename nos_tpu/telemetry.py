@@ -136,6 +136,27 @@ class ServingReport:
     kv_blocks_spilled: int = 0
     preemptions: int = 0
     borrowed_ticks: int = 0
+    # Fleet KV store (PR 16, serving/kv_store.py, docs/kv-store.md):
+    # per-engine traffic against the SHARED content-addressed cold tier
+    # — revive reads served / staged revives the store had retired /
+    # blocks pushed (spill + write-through publish) / puts that found
+    # the key already resident (the N-replicas-one-copy dedup witness)
+    # — plus prewarm copy-in tokens (cold replica warming from the
+    # store) and failover replay tokens served from store bytes instead
+    # of recompute. All zero on a private SpillTier. store_bytes /
+    # store_entries are GAUGES on the one shared store: every replica
+    # reports the same store, so a fleet merge's sum reads ~N x the
+    # store (divide by `replicas`, or read one replica — the tp_devices
+    # caveat one tier down).
+    store_hits: int = 0
+    store_misses: int = 0
+    store_puts: int = 0
+    store_dedup_hits: int = 0
+    store_published_blocks: int = 0
+    prewarm_tokens: int = 0
+    failover_revive_tokens: int = 0
+    store_bytes: int = 0
+    store_entries: int = 0
     # Per-request latency tails (seconds; 0.0 when no samples yet).
     # TTFT is submit -> final-prefill-chunk dispatch; queue wait is
     # submit -> slot reservation.
@@ -329,6 +350,8 @@ REPORT_GAUGE_FIELDS = frozenset(
         "kv_blocks_spilled",
         "radix_nodes",
         "spill_host_bytes",
+        "store_bytes",
+        "store_entries",
         "inflight_dispatches",
         "pending_verifies",
         "waiting_requests",
@@ -443,6 +466,19 @@ def collect_serving(server) -> ServingReport:
         revives=int(getattr(server, "revives", 0)),
         spill_drops=int(getattr(server, "spill_drops", 0)),
         spill_host_bytes=int(getattr(server, "spill_host_bytes", 0)),
+        store_hits=int(getattr(server, "store_hits", 0)),
+        store_misses=int(getattr(server, "store_misses", 0)),
+        store_puts=int(getattr(server, "store_puts", 0)),
+        store_dedup_hits=int(getattr(server, "store_dedup_hits", 0)),
+        store_published_blocks=int(
+            getattr(server, "store_published_blocks", 0)
+        ),
+        prewarm_tokens=int(getattr(server, "prewarm_tokens", 0)),
+        failover_revive_tokens=int(
+            getattr(server, "failover_revive_tokens", 0)
+        ),
+        store_bytes=int(getattr(server, "store_bytes", 0)),
+        store_entries=int(getattr(server, "store_entries", 0)),
         preemptions=int(getattr(server, "preemptions", 0)),
         borrowed_ticks=int(getattr(server, "borrowed_ticks", 0)),
         recoveries=int(getattr(server, "recoveries", 0)),

@@ -1,0 +1,45 @@
+"""NOS019 negatives: the FleetKVStore owns its state — mutations inside
+the class body are the sanctioned (lock-guarded) site; adapters and
+engines that route through store METHODS and merely read stay clean.
+Similarly-named attributes that are not store state (`_store_shared`,
+`_staged`) are out of scope.
+"""
+
+
+class FleetKVStore:
+    def __init__(self, capacity):
+        self._store = {}
+        self._store_bytes = 0
+        self._pins = {}
+        self.capacity = capacity
+
+    def put(self, key, payload, nbytes):
+        self._store[key] = (payload, nbytes)
+        self._store_bytes += nbytes
+
+    def take_pinned(self, key):
+        entry = self._store.get(key)
+        if entry is not None:
+            self._pins[key] = self._pins.get(key, 0) + 1
+        return entry
+
+    def unpin(self, key):
+        if self._pins.get(key, 0) <= 1:
+            self._pins.pop(key, None)
+        else:
+            self._pins[key] -= 1
+
+
+class StoreTier:
+    def __init__(self, fleet):
+        self._fleet = fleet
+        self._staged = {}  # adapter-local, not store state
+        self._store_shared = True  # not store state
+
+    def put(self, key, payload, nbytes):
+        self._fleet.put(key, payload, nbytes)  # method: the sanctioned route
+        self._staged[key] = 1  # adapter-local bookkeeping
+        return len(self._fleet._store)  # read: legal
+
+    def resident(self, key):
+        return key in self._fleet._store  # read: legal

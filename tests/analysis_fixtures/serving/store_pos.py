@@ -1,0 +1,31 @@
+"""NOS019 positives: fleet KV store state mutated outside FleetKVStore.
+
+Expected findings (6): the engine's direct `_store[key]` subscript
+assignment, the reach-through `self._tier._fleet._store_bytes`
+augmented assignment, a `.pop()` on the store's dict, a `del` on a pin
+entry, a module-level function clearing the store — and the adapter's
+constructor assigning store state: like NOS011/NOS013 there is no
+constructor exemption, because store state EXISTING outside the class
+is the drift (and the unlocked cross-replica race) the rule guards
+against. Reads (`len(...)`, membership, gauge copies) stay legal.
+"""
+
+
+class Adapter:
+    def __init__(self, fleet):
+        self._fleet = fleet
+        self._store = {}
+
+    def publish(self, key, payload):
+        self._fleet._store[key] = payload
+        self._tier._fleet._store_bytes += payload.nbytes
+        self._fleet._store.pop(key)
+        del self._fleet._pins[key]
+        return len(self._fleet._store)  # read: legal
+
+    def resident(self, key):
+        return key in self._fleet._store  # read: legal
+
+
+def sweep(fleet):
+    fleet._store.clear()

@@ -11,6 +11,7 @@ import signal
 import subprocess
 import sys
 import time
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -26,6 +27,7 @@ def _spawn(args, env):
     )
 
 
+@pytest.mark.slow
 def test_e2e_check_passes_against_emulator_with_real_binaries(tmp_path):
     kubeconfig = str(tmp_path / "kubeconfig")
     env = dict(os.environ)

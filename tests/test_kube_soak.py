@@ -19,6 +19,7 @@ from nos_tpu.api.objects import ConfigMap, ObjectMeta, Pod
 from nos_tpu.cluster.apiserver import ClusterAPIServer
 from nos_tpu.cluster.client import Cluster, EventType
 from nos_tpu.cluster.kube import KubeCluster, KubeConfig
+import pytest
 
 
 def wait_for(cond, timeout=30.0, interval=0.02, msg="condition"):
@@ -177,6 +178,7 @@ def test_soak_with_apiserver_restart_no_lost_state():
         server.stop()
 
 
+@pytest.mark.slow
 def test_informer_watch_churn_under_concurrent_controllers():
     """Round-4 breadth (VERDICT r3 weak #7): three informer-backed watchers
     on one kind, a writer thread mutating at full speed, and a churn thread

@@ -530,6 +530,7 @@ class TestSharingDemo:
         assert mod.REFERENCE["time-slicing"][1] == 0.0882
         assert set(mod.REFERENCE["mig"]) == {1, 3, 5, 7}
 
+    @pytest.mark.slow
     def test_local_harness_runs_end_to_end_tiny(self):
         """The demo harness executes for real in CI (tiny model, one
         point per mode): client threads, the SliceServer path, and the

@@ -232,6 +232,7 @@ def test_moe_top2_second_choices_overflow_first():
     assert dropped_second > 0
 
 
+@pytest.mark.slow
 def test_moe_aux_loss_balanced_is_one_and_skew_is_larger():
     """Switch eq. 4: a uniform router gives aux ~= 1.0 (the minimum for a
     balanced load); a router biased hard onto one expert drives it toward

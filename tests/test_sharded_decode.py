@@ -248,7 +248,9 @@ def test_steady_state_budget_identical_to_tp1(params, mesh):
 
 
 # -- cross-tp drain/migrate ---------------------------------------------------
-@pytest.mark.parametrize("temperature", [0.0, 0.8])
+@pytest.mark.parametrize(
+    "temperature", [0.0, pytest.param(0.8, marks=pytest.mark.slow)]
+)
 def test_cross_tp_drain_migrate_roundtrip(params, mesh, temperature):
     """Migrate in-flight streams from a tp=2 replica to a tp=1 replica
     and BACK to a fresh tp=2 replica, via the real move protocol

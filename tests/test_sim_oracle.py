@@ -74,6 +74,7 @@ def test_unknown_policy_rejected():
         oracle_schedule([], 4, policy="lifo")
 
 
+@pytest.mark.slow
 def test_cli_trace_p95_close_to_fungible_floor():
     """VERDICT r4 #10: close the loop on the judged single-host p95 (476s).
     The fungible-chip fifo floor on THE CLI default trace (the exact jobs

@@ -27,6 +27,7 @@ from nos_tpu.analysis.checkers.radix_discipline import RadixDisciplineChecker
 from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
 from nos_tpu.analysis.checkers.device_placement import DevicePlacementChecker
 from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
+from nos_tpu.analysis.checkers.store_discipline import StoreDisciplineChecker
 from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
 from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
 from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
@@ -705,6 +706,80 @@ def test_cost_discipline_real_surface_is_clean():
     ):
         findings = run_checkers(
             os.path.join(TREE, rel), [CostDisciplineChecker()]
+        )
+        assert findings == [], rel
+
+
+# -- NOS019 fleet KV store discipline -----------------------------------------
+def test_store_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "store_pos.py"),
+        [StoreDisciplineChecker()],
+    )
+    assert codes_of(findings) == ["NOS019"]
+    # Constructor assign of adapter-local `_store`, the subscript write,
+    # the reach-through byte-gauge AugAssign, .pop on the store dict,
+    # del on a pin entry, and the module-level .clear() — NOT any read.
+    assert len(findings) == 6
+    msgs = " | ".join(f.message for f in findings)
+    assert "_store" in msgs
+    assert "_store_bytes" in msgs
+    assert "_pins" in msgs
+
+
+def test_store_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "serving", "store_neg.py"),
+        [StoreDisciplineChecker()],
+    )
+    assert findings == []
+
+
+def test_store_discipline_scopes(tmp_path):
+    # The write rule binds where store state can leak — runtime/ and
+    # serving/ dirs, any receiver — and nowhere else.
+    f = tmp_path / "elsewhere.py"
+    f.write_text(
+        "def hack(store):\n"
+        "    store._store.clear()\n"
+    )
+    assert run_checkers(str(f), [StoreDisciplineChecker()]) == []
+    g = tmp_path / "serving" / "sweeper.py"
+    g.parent.mkdir()
+    g.write_text(
+        "def hack(store):\n"
+        "    store._store.clear()\n"
+    )
+    assert codes_of(run_checkers(str(g), [StoreDisciplineChecker()])) == [
+        "NOS019"
+    ]
+    k = tmp_path / "runtime" / "engine_like.py"
+    k.parent.mkdir()
+    k.write_text(
+        "def hack(store):\n"
+        "    store._pins.pop('k', None)\n"
+    )
+    assert codes_of(run_checkers(str(k), [StoreDisciplineChecker()])) == [
+        "NOS019"
+    ]
+
+
+def test_store_discipline_real_surface_is_clean():
+    # The tentpole's enforcement, checked directly: the store itself,
+    # the engine's spill/revive/prewarm sites, the block manager's
+    # publish-through, the replica set's prewarm hook, and the router's
+    # store-continuation scoring all route mutation through FleetKVStore.
+    for rel in (
+        os.path.join("serving", "kv_store.py"),
+        os.path.join("serving", "replica.py"),
+        os.path.join("serving", "router.py"),
+        os.path.join("serving", "supervisor.py"),
+        os.path.join("runtime", "decode_server.py"),
+        os.path.join("runtime", "block_manager.py"),
+        os.path.join("runtime", "spill.py"),
+    ):
+        findings = run_checkers(
+            os.path.join(TREE, rel), [StoreDisciplineChecker()]
         )
         assert findings == [], rel
 

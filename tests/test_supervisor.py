@@ -692,7 +692,16 @@ def test_failover_replays_checkpoint_bit_identical(params, temperature):
 
 
 @cpu_only
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize(
+    "seed",
+    [
+        pytest.param(0, marks=pytest.mark.slow),
+        1,
+        pytest.param(2, marks=pytest.mark.slow),
+        3,
+        4,
+    ],
+)
 def test_fleet_chaos_gate(params, seed):
     """The fleet chaos gate (acceptance): seeded kill/suspect/recover
     chaos over a 3-replica fleet mid-traffic, greedy AND temperature
@@ -816,7 +825,9 @@ def _raise_transfer(*a, **kw):
 
 
 @cpu_only
-@pytest.mark.parametrize("temperature", [0.0, 0.8])
+@pytest.mark.parametrize(
+    "temperature", [0.0, pytest.param(0.8, marks=pytest.mark.slow)]
+)
 def test_drain_rolls_back_to_reopened_source_when_no_candidate(
     params, temperature
 ):

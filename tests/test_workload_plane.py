@@ -121,6 +121,7 @@ def test_gpt_forward_and_loss():
     assert np.isfinite(float(loss)) and float(loss) > 0
 
 
+@pytest.mark.slow
 def test_sharded_train_step_dp_tp():
     mesh = build_mesh({"dp": 2, "tp": 4})
     cfg = TrainConfig(model=GPTConfig(vocab=256, hidden=64, layers=2, heads=4, max_seq=32))
@@ -135,6 +136,7 @@ def test_sharded_train_step_dp_tp():
     assert losses[-1] < losses[0], "loss should fall on a repeated batch"
 
 
+@pytest.mark.slow
 def test_sharded_train_step_with_ring_attention():
     mesh = build_mesh({"dp": 2, "sp": 4})
     cfg = TrainConfig(
