@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: all test test-tpu native bench bench-smoke dryrun demo simulate \
 	example clean render cluster kind-cluster docker-build e2e-kind lint \
-	slow-audit
+	lint-cold slow-audit
 
 all: native test
 
@@ -14,6 +14,10 @@ test:
 
 # Domain-aware static analysis (docs/static-analysis.md): the go vet /
 # staticcheck analog, also gated in tier-1 by tests/test_static_analysis.py.
+# Incremental by default — per-file findings are reused from
+# .nos-lint-cache.json when content hashes match, and the stderr summary
+# line reports what was actually recomputed and the wall time. Use
+# `make lint-cold` (or `--no-cache`) when you want a from-scratch run.
 # ruff rides along when installed (pip install -e .[dev]); the analyzer
 # itself has zero dependencies beyond the stdlib.
 lint:
@@ -23,6 +27,9 @@ lint:
 	else \
 		echo "ruff not installed (pip install -e .[dev]); skipped"; \
 	fi
+
+lint-cold:
+	$(PY) -m nos_tpu.cli lint nos_tpu --baseline lint-baseline.txt --no-cache
 
 # Tier-1 wall-clock audit: flag unmarked tests over the per-test budget
 # (default 10s) so the suite's thin headroom (~810s of 870s) is policed,

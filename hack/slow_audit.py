@@ -27,10 +27,12 @@ silently disappearing is exactly what this guards against).
 from __future__ import annotations
 
 import argparse
+import os
 import re
 import subprocess
 import sys
 import tempfile
+import time
 
 # "12.34s call     tests/test_x.py::test_y" — the --durations line shape.
 # Only `call` rows count: setup/teardown of a module-scoped fixture bills
@@ -75,6 +77,41 @@ def audit(text: str, budget_s: float) -> int:
     return 1
 
 
+def audit_lint(budget_s: float) -> int:
+    """Assert the warm `nos-tpu lint` run fits its wall-clock budget.
+
+    The lint suite is part of tier-1 (tests/test_static_analysis.py runs
+    the full tree through every checker), so its runtime eats the same
+    ~60s headroom the per-test budget polices. The incremental cache is
+    what keeps it cheap — this audit runs lint twice (first run warms or
+    refreshes the cache, second run is the timed, steady-state cost) and
+    fails when the WARM run exceeds the budget: that means either the
+    cache stopped being reused or a checker grew a per-run cost that no
+    amount of caching amortizes. Budget override: NOS_TPU_LINT_BUDGET_S
+    or --lint-budget."""
+    cmd = [
+        sys.executable, "-m", "nos_tpu.cli", "lint", "nos_tpu",
+        "--baseline", "lint-baseline.txt",
+    ]
+    subprocess.run(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
+    )
+    elapsed = time.perf_counter() - t0
+    if elapsed > budget_s:
+        print(
+            f"slow-audit: warm lint took {elapsed:.2f}s, over the "
+            f"{budget_s:g}s budget (NOS_TPU_LINT_BUDGET_S to override) — "
+            "the incremental cache is not being reused or a checker grew "
+            "an unamortized per-run cost:"
+        )
+        print(proc.stdout.rstrip())
+        return 1
+    print(f"slow-audit: warm lint {elapsed:.2f}s (budget {budget_s:g}s) — ok")
+    return 0
+
+
 def run_suite() -> str:
     """Run the tier-1 selection with full durations, return its log."""
     with tempfile.NamedTemporaryFile("w+", suffix=".log", delete=False) as fh:
@@ -107,13 +144,29 @@ def main(argv=None) -> int:
         "--budget", type=float, default=10.0,
         help="per-test call-time budget in seconds (default: 10)",
     )
+    ap.add_argument(
+        "--lint-budget",
+        type=float,
+        default=float(os.environ.get("NOS_TPU_LINT_BUDGET_S", "5")),
+        help="warm `nos-tpu lint` wall-clock budget in seconds "
+        "(default: 5; env NOS_TPU_LINT_BUDGET_S overrides)",
+    )
+    ap.add_argument(
+        "--skip-lint",
+        action="store_true",
+        help="audit test durations only, skip the lint-runtime assertion",
+    )
     args = ap.parse_args(argv)
     if args.log:
         with open(args.log) as fh:
             text = fh.read()
     else:
         text = run_suite()
-    return audit(text, args.budget)
+    rc = audit(text, args.budget)
+    if not args.skip_lint:
+        lint_rc = audit_lint(args.lint_budget)
+        rc = rc or lint_rc
+    return rc
 
 
 if __name__ == "__main__":

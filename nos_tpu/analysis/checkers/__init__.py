@@ -12,15 +12,18 @@ def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.block_discipline import BlockDisciplineChecker
     from nos_tpu.analysis.checkers.cost_discipline import CostDisciplineChecker
     from nos_tpu.analysis.checkers.device_placement import DevicePlacementChecker
+    from nos_tpu.analysis.checkers.donation_discipline import DonationDisciplineChecker
     from nos_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
     from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
     from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
     from nos_tpu.analysis.checkers.radix_discipline import RadixDisciplineChecker
+    from nos_tpu.analysis.checkers.replay_purity import ReplayPurityChecker
     from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
     from nos_tpu.analysis.checkers.staging_discipline import StagingDisciplineChecker
     from nos_tpu.analysis.checkers.store_discipline import StoreDisciplineChecker
+    from nos_tpu.analysis.checkers.telemetry_schema import TelemetrySchemaChecker
     from nos_tpu.analysis.checkers.trace_discipline import TraceDisciplineChecker
     from nos_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
     from nos_tpu.analysis.checkers.wire_literals import WireLiteralChecker
@@ -41,4 +44,7 @@ def all_checkers() -> List[Checker]:
         TraceDisciplineChecker(),
         CostDisciplineChecker(),
         StoreDisciplineChecker(),
+        DonationDisciplineChecker(),
+        ReplayPurityChecker(),
+        TelemetrySchemaChecker(),
     ]

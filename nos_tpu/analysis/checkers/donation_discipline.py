@@ -1,0 +1,285 @@
+"""NOS020 — use-after-donate on the host path.
+
+The engine's entire tick composition rides donated buffers: every KV-cache
+program is `jax.jit(..., donate_argnums=...)` so the pool updates in place
+(models/decode.py COMPOSITION CONTRACT), and the discipline that makes it
+safe is documented there by prose: *the caller rebinds the donated variable
+from the call's result in the same statement* (`self.cache =
+self._step_fn(..., self.cache, ...)`). Break the discipline — keep reading
+the old reference after the call consumed its buffer — and JAX either
+errors out or, worse under some configs, hands back garbage from a
+deleted buffer. This checker turns the prose contract into a finding.
+
+Tracked conservatively (a lint, not an escape analysis):
+
+  - registration: `self.NAME = jax.jit(..., donate_argnums=...)` and
+    `name = jax.jit(..., donate_argnums=...)` assignments anywhere in the
+    file, plus direct `jax.jit(f, donate_argnums=...)(args)` calls;
+  - at a donated call site, arguments in donated positions that are a bare
+    name or a `self.attr` become CONSUMED — unless the containing
+    statement rebinds that same variable (tuple targets count: the
+    sanctioned pattern);
+  - a later load of a consumed variable in the same function (no
+    intervening store) is a finding;
+  - a donation inside a loop whose variable is never stored anywhere in
+    that loop is a finding on its own: the back edge re-donates (and
+    re-reads) the already-consumed buffer on iteration two.
+
+Attributes of non-self receivers (`st.pos` where `st` is a local handle)
+are deliberately NOT tracked — the TickState pattern re-scatters results
+through the handle and a name-level analysis cannot see that soundly.
+Nested function bodies are skipped: a read inside a jitted program body is
+tracing, not a host-path read. Scope: files under `runtime/` and
+`models/`, where the donated-pool programs live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nos_tpu.analysis.callgraph import CallGraph, _dotted_name
+from nos_tpu.analysis.core import Checker, FileContext, Report
+
+#: Statement types a donated call realistically sits in.
+_SIMPLE_STMTS = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr, ast.Return)
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# Key for a trackable donated value: ("n", name) or ("a", self_attr).
+_Key = Tuple[str, str]
+
+
+def _arg_key(node: ast.AST) -> Optional[_Key]:
+    if isinstance(node, ast.Name):
+        return ("n", node.id)
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return ("a", node.attr)
+    return None
+
+
+def _target_keys(target: ast.AST) -> Set[_Key]:
+    """Keys (re)bound by one assignment target, tuples included."""
+    out: Set[_Key] = set()
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            out.update(_target_keys(elt))
+    elif isinstance(target, ast.Starred):
+        out.update(_target_keys(target.value))
+    else:
+        key = _arg_key(target)
+        if key is not None:
+            out.add(key)
+    return out
+
+
+def _donate_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The donate_argnums of a jax.jit(...) call, if statically known."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in v.elts
+        ):
+            return tuple(e.value for e in v.elts)
+        return None
+    return None
+
+
+class DonationDisciplineChecker(Checker):
+    name = "donation-discipline"
+    codes = ("NOS020",)
+    description = "a donated buffer must not be read on the host path after the call"
+
+    def __init__(self) -> None:
+        self._graph: Optional[CallGraph] = None
+        self._active = False
+        self._aliases: Dict[str, str] = {}
+        self._donated_attrs: Dict[str, Tuple[int, ...]] = {}
+        self._donated_names: Dict[str, Tuple[int, ...]] = {}
+        self._checked: Set[ast.AST] = set()
+
+    def begin_run(self, graph: CallGraph) -> None:
+        self._graph = graph
+
+    # -- per-file prescan: donated-callable registry ------------------------
+    def begin_file(self, ctx: FileContext) -> None:
+        segs = ctx.segments[:-1]
+        self._active = "runtime" in segs or "models" in segs
+        self._aliases = {}
+        self._donated_attrs = {}
+        self._donated_names = {}
+        self._checked = set()
+        if not self._active:
+            return
+        if self._graph is not None and ctx.rel in self._graph.modules:
+            self._aliases = self._graph.modules[ctx.rel].aliases
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            indices = self._jit_donation(node.value)
+            if indices is None:
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                self._donated_names[target.id] = indices
+            else:
+                key = _arg_key(target)
+                if key is not None and key[0] == "a":
+                    self._donated_attrs[key[1]] = indices
+
+    def _is_jit(self, func: ast.AST) -> bool:
+        dotted = _dotted_name(func)
+        if dotted is None:
+            return False
+        head, _, rest = dotted.partition(".")
+        module = self._aliases.get(head, head)
+        return (f"{module}.{rest}" if rest else module) == "jax.jit"
+
+    def _jit_donation(self, value: ast.AST) -> Optional[Tuple[int, ...]]:
+        if isinstance(value, ast.Call) and self._is_jit(value.func):
+            return _donate_indices(value)
+        return None
+
+    def _call_donation(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
+        """Donated positions of one call site, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self._donated_names.get(fn.id)
+        key = _arg_key(fn)
+        if key is not None and key[0] == "a":
+            return self._donated_attrs.get(key[1])
+        # Immediate jax.jit(f, donate_argnums=...)(args).
+        if isinstance(fn, ast.Call):
+            return self._jit_donation(fn)
+        return None
+
+    # -- per-function flow check --------------------------------------------
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if not self._active:
+            return
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if ctx.enclosing(ast.FunctionDef, ast.AsyncFunctionDef) is not None:
+            return  # nested defs are analyzed as part of nothing: trace bodies
+        if node in self._checked:
+            return
+        self._checked.add(node)
+        self._check_function(ctx, node, report)
+
+    def _check_function(self, ctx: FileContext, func: ast.AST, report: Report) -> None:
+        loads: List[Tuple[int, _Key]] = []
+        stores: List[Tuple[int, _Key]] = []
+        # (end_line, key, rebound, loop (lo, hi) or None, callee label, call line)
+        donations: List[Tuple[int, _Key, bool, Optional[Tuple[int, int]], str, int]] = []
+
+        def scan(node: ast.AST, loop: Optional[Tuple[int, int]]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _NESTED):
+                    continue
+                if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                    inner = (child.lineno, child.end_lineno or child.lineno)
+                    scan(child, inner)
+                    continue
+                if isinstance(child, _SIMPLE_STMTS):
+                    self._scan_stmt(child, loop, donations)
+                if isinstance(child, ast.Name):
+                    key = ("n", child.id)
+                    if isinstance(child.ctx, ast.Load):
+                        loads.append((child.lineno, key))
+                    else:
+                        stores.append((child.lineno, key))
+                elif (
+                    isinstance(child, ast.Attribute)
+                    and isinstance(child.value, ast.Name)
+                    and child.value.id == "self"
+                ):
+                    key = ("a", child.attr)
+                    if isinstance(child.ctx, ast.Load):
+                        loads.append((child.lineno, key))
+                    else:
+                        stores.append((child.lineno, key))
+                scan(child, loop)
+
+        scan(func, None)
+        for end_line, key, rebound, loop, label, call_line in donations:
+            if rebound:
+                continue
+            var = key[1] if key[0] == "n" else f"self.{key[1]}"
+            later = sorted(ln for ln, k in loads if k == key and ln > end_line)
+            if later:
+                first = later[0]
+                saved = any(end_line < ln < first for ln, k in stores if k == key)
+                if not saved:
+                    report.add(
+                        ctx.rel,
+                        first,
+                        "NOS020",
+                        f"use-after-donate: '{var}' was donated to "
+                        f"'{label}' (line {call_line}) and is read here "
+                        "without rebinding; rebind the result in the same "
+                        "statement (x = fn(x, ...)) or copy before donating",
+                    )
+                    continue
+            if loop is not None:
+                lo, hi = loop
+                if not any(lo <= ln <= hi for ln, k in stores if k == key):
+                    report.add(
+                        ctx.rel,
+                        call_line,
+                        "NOS020",
+                        f"use-after-donate: '{var}' is donated to '{label}' "
+                        "inside a loop but never rebound in the loop — the "
+                        "next iteration re-donates the consumed buffer; "
+                        "rebind the result (x = fn(x, ...)) each iteration",
+                    )
+
+    def _scan_stmt(self, stmt: ast.AST, loop, donations) -> None:
+        rebinds: Set[_Key] = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                rebinds.update(_target_keys(t))
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            rebinds.update(_target_keys(stmt.target))
+        # Pruned walk: never descend into nested function/lambda bodies —
+        # a call in a trace body donates at trace time, not per tick.
+        stack: List[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            stack.extend(
+                ch for ch in ast.iter_child_nodes(node) if not isinstance(ch, _NESTED)
+            )
+            if not isinstance(node, ast.Call):
+                continue
+            indices = self._call_donation(node)
+            if not indices:
+                continue
+            label = _dotted_name(node.func) or "<jitted call>"
+            for i in indices:
+                if i >= len(node.args):
+                    continue
+                key = _arg_key(node.args[i])
+                if key is None:
+                    continue
+                # A Return hands the result out of this frame — nothing
+                # here reads the consumed buffer again, and the loop rule
+                # cannot bite either (return exits the loop).
+                rebound = key in rebinds or isinstance(stmt, ast.Return)
+                donations.append(
+                    (
+                        stmt.end_lineno or node.lineno,
+                        key,
+                        rebound,
+                        loop,
+                        label,
+                        node.lineno,
+                    )
+                )

@@ -13,9 +13,10 @@ faults, which is exactly why it gets its own checker.
 Scope, two tiers:
 
   - files under `runtime/` containing an engine-loop class (a class
-    defining `_tick` or `_run`): those classes' methods reachable from
-    the `_tick`/`_run` roots via `self.method()` calls (the NOS010
-    reachability);
+    defining `_tick` or `_run`): everything in the file reachable from
+    the `_tick`/`_run` roots over the shared call graph
+    (analysis/callgraph.py `tick_scope` — the same scope NOS010 uses,
+    minus its helper-class blanket);
   - EVERY function in `nos_tpu/serving/` (the fleet plane): the fleet
     loops — monitor sampling, supervisor probe sweeps, drain/failover
     re-homing, router scoring — are all cross-replica interaction
@@ -37,11 +38,11 @@ Deliberately-unclassified last-resort backstops carry an inline
 from __future__ import annotations
 
 import ast
-from typing import Set
+from typing import Optional, Set
 
+from nos_tpu.analysis.callgraph import CallGraph, tick_scope
 from nos_tpu.analysis.core import Checker, FileContext, Report
 from nos_tpu.analysis.checkers.exception_hygiene import _is_broad
-from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 
 _ROUTERS = {
     "classify_fault",
@@ -71,8 +72,12 @@ class FaultDisciplineChecker(Checker):
     description = "tick/recovery-path broad excepts must route through the fault taxonomy"
 
     def __init__(self) -> None:
+        self._graph: Optional[CallGraph] = None
         self._active = False
         self._scope_funcs: Set[ast.AST] = set()
+
+    def begin_run(self, graph: CallGraph) -> None:
+        self._graph = graph
 
     def begin_file(self, ctx: FileContext) -> None:
         segments = ctx.segments[:-1]
@@ -86,25 +91,19 @@ class FaultDisciplineChecker(Checker):
                     self._scope_funcs.add(node)
             return
         self._active = "runtime" in segments
-        if not self._active:
+        if not self._active or self._graph is None:
             return
-        found_engine = False
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ClassDef):
-                continue
-            methods = {
-                n.name: n
-                for n in node.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-            }
-            if "_tick" not in methods and "_run" not in methods:
-                continue
-            found_engine = True
-            # Same reachability NOS010 uses: roots `_tick`/`_run`, closed
-            # over `self.method()` calls.
-            for name in HostSyncChecker._reachable(methods):
-                self._scope_funcs.add(methods[name])
-        if not found_engine:
+        # Same reachability NOS010 uses (shared call graph, `_tick`/`_run`
+        # roots), but engine classes here include `_run`-only loop classes
+        # and helper classes get no blanket: a helper's broad except is
+        # only in scope when the tick actually reaches it.
+        self._scope_funcs = tick_scope(
+            self._graph,
+            ctx.rel,
+            engine_markers=("_tick", "_run"),
+            include_helpers=False,
+        )
+        if not self._scope_funcs:
             self._active = False
 
     def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:

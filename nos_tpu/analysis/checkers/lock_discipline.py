@@ -109,6 +109,7 @@ class _ClassInfo:
 class LockDisciplineChecker(Checker):
     name = "lock-discipline"
     codes = ("NOS005", "NOS006")
+    cross_file = True  # finish() correlates sites across the whole tree
     description = "shared attributes stay behind their lock; no lock-order cycles"
 
     def __init__(self) -> None:

@@ -53,6 +53,7 @@ _BOTH_METHODS = {"pop"}
 class ProtocolRoundTripChecker(Checker):
     name = "protocol-roundtrip"
     codes = ("NOS002",)
+    cross_file = True  # finish() correlates sites across the whole tree
     description = "ANNOTATION_*/LABEL_* constants need both a writer and a reader"
 
     def __init__(self) -> None:

@@ -12,9 +12,10 @@ uncounted per-dispatch transfer — exactly the ~6-upload-per-macro-
 dispatch pattern PR 10 removed.
 
 Scope: identical to NOS010 — files under `runtime/` containing an ENGINE
-class (a class defining `_tick`); flagged regions are the engine class's
-methods reachable from `_tick`/`_run` via `self.method()` calls plus
-every method of helper classes in the same file. The staging module
+class (a class defining `_tick`); flagged regions come from the shared
+call graph's `tick_scope` (everything in the file reachable from the
+`_tick`/`_run` roots, plus every method of helper classes in the same
+file). The staging module
 itself (runtime/staging.py) defines no engine class and is therefore out
 of scope by construction — it is the ONE sanctioned home of the raw
 transfer. Closures inside `__init__` (the jitted program bodies) are out
@@ -26,12 +27,11 @@ not a transfer. Genuinely sanctioned engine-side sites carry
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set
+from typing import Dict, Optional, Set
 
+from nos_tpu.analysis.callgraph import CallGraph, tick_scope
 from nos_tpu.analysis.core import Checker, FileContext, Report
 from nos_tpu.analysis.checkers.trace_safety import _dotted
-
-_ROOTS = ("_tick", "_run")
 
 _STAGING = {
     "jax.numpy.asarray": "jnp.asarray() (uncounted host->device staging)",
@@ -46,63 +46,28 @@ class StagingDisciplineChecker(Checker):
     description = "host->device staging outside the staging API on the tick path"
 
     def __init__(self) -> None:
+        self._graph: Optional[CallGraph] = None
         self._active = False
         self._aliases: Dict[str, str] = {}
         self._scope_funcs: Set[ast.AST] = set()
+
+    def begin_run(self, graph: CallGraph) -> None:
+        self._graph = graph
 
     # -- per-file prescan ----------------------------------------------------
     def begin_file(self, ctx: FileContext) -> None:
         self._active = "runtime" in ctx.segments[:-1]
         self._aliases = {}
         self._scope_funcs = set()
-        if not self._active:
+        if not self._active or self._graph is None:
             return
-        engine: List[Dict[str, ast.AST]] = []
-        helpers: List[Dict[str, ast.AST]] = []
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    self._aliases[a.asname or a.name.split(".")[0]] = a.name
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                for a in node.names:
-                    self._aliases[a.asname or a.name] = f"{node.module}.{a.name}"
-            elif isinstance(node, ast.ClassDef):
-                methods = {
-                    n.name: n
-                    for n in node.body
-                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-                }
-                (engine if "_tick" in methods else helpers).append(methods)
-        if not engine:
+        self._scope_funcs = tick_scope(
+            self._graph, ctx.rel, engine_markers=("_tick",), include_helpers=True
+        )
+        if not self._scope_funcs:
             self._active = False
             return
-        for methods in engine:
-            for name in self._reachable(methods):
-                self._scope_funcs.add(methods[name])
-        for methods in helpers:
-            self._scope_funcs.update(methods.values())
-
-    @staticmethod
-    def _reachable(methods: Dict[str, ast.AST]) -> Set[str]:
-        """Methods reachable from the tick roots via `self.method()` calls
-        (the same unambiguous local resolution NOS006/NOS010 use)."""
-        seen = {r for r in _ROOTS if r in methods}
-        queue = list(seen)
-        while queue:
-            body = methods[queue.pop()]
-            for node in ast.walk(body):
-                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
-                    continue
-                target = node.func
-                if (
-                    isinstance(target.value, ast.Name)
-                    and target.value.id == "self"
-                    and target.attr in methods
-                    and target.attr not in seen
-                ):
-                    seen.add(target.attr)
-                    queue.append(target.attr)
-        return seen
+        self._aliases = self._graph.modules[ctx.rel].aliases
 
     # -- visit ---------------------------------------------------------------
     def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:

@@ -20,6 +20,7 @@ fractional workload, and prints the resulting cluster state.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import sys
@@ -525,7 +526,10 @@ def _simulate_multihost(args) -> int:
 def cmd_lint(args) -> int:
     """Domain-aware static analysis (docs/static-analysis.md): wire-protocol
     literals, protocol round-trips, exception hygiene, lock discipline, JAX
-    trace-safety. Exit 0 iff every finding is covered by the baseline."""
+    trace-safety, and the interprocedural checkers (donation, replay purity,
+    telemetry schema). Incremental by default: per-file findings are reused
+    from `.nos-lint-cache.json` when content hashes match (`--no-cache` for
+    a guaranteed-cold run). Exit 0 iff every finding is baseline-covered."""
     from nos_tpu import analysis
 
     baseline = args.baseline
@@ -533,7 +537,13 @@ def cmd_lint(args) -> int:
         baseline = "lint-baseline.txt"
     engine = analysis.Engine(analysis.all_checkers(), root=args.root)
     select = [c.strip() for c in args.select.split(",")] if args.select else None
-    findings = engine.run(args.paths, select=select)
+    cache = None
+    if not args.no_cache:
+        cache_path = os.path.join(engine.root, analysis.CACHE_BASENAME)
+        cache = analysis.LintCache(cache_path, analysis.package_salt(select))
+    findings = engine.run(args.paths, select=select, cache=cache)
+    if cache is not None:
+        cache.write()
     if args.write_baseline:
         analysis.write_baseline(findings, args.write_baseline)
         print(f"wrote {len(findings)} entries to {args.write_baseline} "
@@ -543,6 +553,20 @@ def cmd_lint(args) -> int:
     if baseline and not args.no_baseline:
         entries = analysis.load_baseline(baseline)
         findings, suppressed, stale = analysis.apply_baseline(findings, entries)
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [
+                    {"path": f.path, "line": f.line, "code": f.code, "message": f.message}
+                    for f in findings
+                ],
+                "suppressed": len(suppressed),
+                "stale_baseline_entries": [e.render() for e in stale],
+                "stats": engine.stats.summary(),
+            },
+            indent=2,
+        ))
+        return 1 if findings else 0
     for f in findings:
         print(f.render())
     for e in stale:
@@ -550,7 +574,8 @@ def cmd_lint(args) -> int:
               file=sys.stderr)
     print(
         f"nos-tpu lint: {len(findings)} finding(s), "
-        f"{len(suppressed)} suppressed by baseline, {len(stale)} stale entr(y/ies)",
+        f"{len(suppressed)} suppressed by baseline, {len(stale)} stale entr(y/ies) "
+        f"[{engine.stats.summary()}]",
         file=sys.stderr,
     )
     return 1 if findings else 0
@@ -710,6 +735,17 @@ def main(argv=None) -> int:
     )
     p_lint.add_argument(
         "--root", default=None, help="path findings are reported relative to (default: cwd)"
+    )
+    p_lint.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format: human text (default) or a machine-readable JSON object",
+    )
+    p_lint.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="skip the incremental cache (.nos-lint-cache.json) — guaranteed cold run",
     )
 
     args = parser.parse_args(argv)

@@ -29,9 +29,149 @@ import logging
 import threading
 import time
 from collections import defaultdict, deque
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
 from nos_tpu import constants
+
+
+# ---------------------------------------------------------------------------
+# Metric schema registry
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class MetricSpec:
+    """One registered metric series. `name` ending in `*` declares a FAMILY
+    (a dynamic suffix, e.g. the per-tenant cost gauges built as
+    f"nos_tpu_tenant_cost_{field}"). `report_field` names the ServingReport
+    field the series snapshots into, when it has one — fleet-derived gauges
+    (computed by the monitor from report windows) carry None."""
+
+    name: str
+    kind: str  # "counter" | "gauge" | "histogram"
+    report_field: Optional[str] = None
+
+
+#: Every metric series the serving plane (runtime/ + serving/) may emit.
+#: This is the cross-artifact schema NOS022 enforces: an emitted name not
+#: listed here, a report_field that ServingReport doesn't carry (or merge
+#: doesn't handle), or a listed name missing from docs/telemetry.md is a
+#: lint finding. Adding a metric = emit it, list it here, document it.
+METRIC_SERIES: Tuple[MetricSpec, ...] = (
+    # -- engine counters (DecodeServer), snapshotting into ServingReport --
+    MetricSpec("nos_tpu_decode_steps", "counter", "steps_run"),
+    MetricSpec("nos_tpu_decode_macro_dispatches", "counter", "macro_dispatches"),
+    MetricSpec("nos_tpu_decode_spec_rounds", "counter", "spec_rounds"),
+    MetricSpec("nos_tpu_decode_spec_tokens_accepted", "counter", "spec_tokens_accepted"),
+    MetricSpec("nos_tpu_decode_prefill_dispatches", "counter", "prefill_dispatches"),
+    MetricSpec("nos_tpu_decode_prefill_tokens", "counter", "prefill_tokens"),
+    MetricSpec(
+        "nos_tpu_decode_ticks_with_prefill_and_macro",
+        "counter",
+        "ticks_with_prefill_and_macro",
+    ),
+    MetricSpec("nos_tpu_decode_prefix_lookups", "counter", "prefix_lookups"),
+    MetricSpec("nos_tpu_decode_prefix_hit_blocks", "counter", "prefix_hit_blocks"),
+    MetricSpec("nos_tpu_decode_prefix_hit_tokens", "counter", "prefix_hit_tokens"),
+    MetricSpec("nos_tpu_decode_prefix_evictions", "counter", "prefix_evictions"),
+    MetricSpec("nos_tpu_decode_prefix_cow_hits", "counter", "prefix_cow_hits"),
+    MetricSpec("nos_tpu_decode_prefix_cow_tokens", "counter", "prefix_cow_tokens"),
+    MetricSpec(
+        "nos_tpu_decode_output_blocks_registered",
+        "counter",
+        "output_blocks_registered",
+    ),
+    MetricSpec("nos_tpu_decode_preemptions", "counter", "preemptions"),
+    MetricSpec("nos_tpu_decode_borrowed_ticks", "counter", "borrowed_ticks"),
+    MetricSpec("nos_tpu_decode_recoveries", "counter", "recoveries"),
+    # report_field mirrors the ServingReport ATTRIBUTE, which happens to
+    # share its spelling with the cost-charge key; same exemption
+    # telemetry.py gets from the accounting-literal rule.
+    MetricSpec("nos_tpu_decode_replay_tokens", "counter", "replay_tokens"),  # nos-lint: ignore[NOS018]
+    MetricSpec("nos_tpu_decode_requests_poisoned", "counter", "requests_poisoned"),
+    MetricSpec("nos_tpu_decode_slots_restored", "counter", "slots_restored"),
+    MetricSpec("nos_tpu_decode_transient_retries", "counter", "transient_retries"),
+    MetricSpec("nos_tpu_decode_burst_dispatches", "counter", "burst_dispatches"),
+    MetricSpec("nos_tpu_decode_burst_windows", "counter", "burst_windows_run"),
+    MetricSpec("nos_tpu_decode_spills", "counter", "spills"),
+    MetricSpec("nos_tpu_decode_revives", "counter", "revives"),
+    MetricSpec("nos_tpu_decode_spill_drops", "counter", "spill_drops"),
+    MetricSpec("nos_tpu_decode_h2d_uploads", "counter", "h2d_uploads"),
+    MetricSpec("nos_tpu_decode_staging_syncs", "counter", "staging_syncs"),
+    MetricSpec("nos_tpu_decode_blocking_syncs", "counter", "blocking_syncs"),
+    MetricSpec("nos_tpu_decode_idle_ticks", "counter", "idle_ticks"),
+    # -- engine gauges (per-tick state), snapshotting into ServingReport --
+    MetricSpec("nos_tpu_decode_kv_blocks_free", "gauge", "kv_blocks_free"),
+    MetricSpec("nos_tpu_decode_kv_blocks_cached", "gauge", "kv_blocks_cached"),
+    MetricSpec("nos_tpu_decode_kv_blocks_shared", "gauge", "kv_blocks_shared"),
+    MetricSpec("nos_tpu_decode_kv_blocks_spilled", "gauge", "kv_blocks_spilled"),
+    MetricSpec("nos_tpu_decode_radix_nodes", "gauge", "radix_nodes"),
+    MetricSpec("nos_tpu_decode_spill_host_bytes", "gauge", "spill_host_bytes"),
+    MetricSpec("nos_tpu_decode_inflight_dispatches", "gauge", "inflight_dispatches"),
+    MetricSpec("nos_tpu_decode_pending_verifies", "gauge", "pending_verifies"),
+    MetricSpec("nos_tpu_decode_waiting_requests", "gauge", "waiting_requests"),
+    MetricSpec("nos_tpu_decode_tp_devices", "gauge", "tp_devices"),
+    # -- per-tick slot-split gauges (no snapshot field: instantaneous) --
+    MetricSpec("nos_tpu_decode_slots_drafting", "gauge"),
+    MetricSpec("nos_tpu_decode_slots_macro", "gauge"),
+    MetricSpec("nos_tpu_decode_slots_prefilling", "gauge"),
+    # -- tick-profiling histograms (tracing.py), accumulated seconds --
+    MetricSpec("nos_tpu_decode_tick_phase_seconds", "histogram"),
+    # report_field mirrors the ServingReport attribute (see replay_tokens).
+    MetricSpec("nos_tpu_decode_tick_seconds", "histogram", "tick_wall_s"),  # nos-lint: ignore[NOS018]
+    MetricSpec("nos_tpu_decode_tick_dispatch_seconds", "histogram", "tick_dispatch_s"),
+    MetricSpec(
+        "nos_tpu_decode_tick_host_overhead_seconds",
+        "histogram",
+        "tick_host_overhead_s",
+    ),
+    # -- fleet KV store traffic (per-engine counters vs the shared tier) --
+    MetricSpec("nos_tpu_fleet_kv_store_hits", "counter", "store_hits"),
+    MetricSpec("nos_tpu_fleet_kv_store_misses", "counter", "store_misses"),
+    MetricSpec("nos_tpu_fleet_kv_store_puts", "counter", "store_puts"),
+    MetricSpec("nos_tpu_fleet_kv_store_dedup_hits", "counter", "store_dedup_hits"),
+    MetricSpec("nos_tpu_fleet_kv_prewarm_tokens", "counter", "prewarm_tokens"),
+    MetricSpec(
+        "nos_tpu_fleet_kv_failover_revive_tokens",
+        "counter",
+        "failover_revive_tokens",
+    ),
+    MetricSpec("nos_tpu_fleet_kv_store_bytes", "gauge", "store_bytes"),
+    MetricSpec("nos_tpu_fleet_kv_store_entries", "gauge", "store_entries"),
+    # -- fleet failure domains (supervisor) --
+    MetricSpec("nos_tpu_fleet_replica_suspects", "counter", "replica_suspects"),
+    MetricSpec("nos_tpu_fleet_replica_deaths", "counter", "replica_deaths"),
+    MetricSpec("nos_tpu_fleet_failovers", "counter", "failovers"),
+    MetricSpec(
+        "nos_tpu_fleet_failover_replay_tokens", "counter", "failover_replay_tokens"
+    ),
+    MetricSpec("nos_tpu_fleet_futures_failed_over", "counter", "futures_failed_over"),
+    MetricSpec("nos_tpu_fleet_futures_errored", "counter", "futures_errored"),
+    MetricSpec("nos_tpu_fleet_failover_latency", "histogram"),
+    # -- fleet pressure plane (monitor-derived gauges; computed from
+    # report windows, so no single report_field backs them) --
+    MetricSpec("nos_tpu_fleet_replicas_active", "gauge"),
+    MetricSpec("nos_tpu_fleet_windows_sampled", "gauge"),
+    MetricSpec("nos_tpu_fleet_tok_s", "gauge"),
+    MetricSpec("nos_tpu_fleet_prefill_tok_s", "gauge"),
+    MetricSpec("nos_tpu_fleet_admissions_s", "gauge"),
+    MetricSpec("nos_tpu_fleet_queue_depth", "gauge"),
+    MetricSpec("nos_tpu_fleet_slots_active", "gauge"),
+    MetricSpec("nos_tpu_fleet_slots_free", "gauge"),
+    MetricSpec("nos_tpu_fleet_kv_blocks_free", "gauge"),
+    MetricSpec("nos_tpu_fleet_headroom", "gauge"),
+    MetricSpec("nos_tpu_fleet_replica_state", "gauge"),
+    MetricSpec("nos_tpu_fleet_tenant_state", "gauge"),
+    MetricSpec("nos_tpu_fleet_tenant_tok_s", "gauge"),
+    MetricSpec("nos_tpu_fleet_tenant_waiting", "gauge"),
+    MetricSpec("nos_tpu_fleet_tenant_ttft_p95_s", "gauge"),
+    MetricSpec("nos_tpu_fleet_tenant_slo_breached", "gauge"),
+    # -- utilization & cost accounting --
+    MetricSpec("nos_tpu_fleet_util_busy_chip_s", "gauge"),
+    MetricSpec("nos_tpu_fleet_util_waste_chip_s", "gauge"),
+    MetricSpec("nos_tpu_fleet_util_waste_fraction", "gauge"),
+    MetricSpec("nos_tpu_fleet_util_tok_s_per_chip_hour", "gauge"),
+    MetricSpec("nos_tpu_tenant_cost_*", "gauge"),
+)
 
 #: Histogram bucket upper bounds (seconds) for `observe`d durations —
 #: sub-millisecond through 10s, the range an engine tick phase or a plan

@@ -1151,8 +1151,8 @@ class DecodeServer:
         definition), and the bytes moved are the point."""
         k, v = self._extract_fn(self.cache, block)
         self._syncs.note()  # one counted blocking copy-out per block
-        k = np.asarray(k)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
-        v = np.asarray(v)  # nos-lint: ignore[NOS010] — spill copy-out, see docstring
+        k = np.asarray(k)
+        v = np.asarray(v)
         return (k, v), k.nbytes + v.nbytes
 
     def prewarm(self) -> "DecodeServer":

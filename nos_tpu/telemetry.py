@@ -305,12 +305,7 @@ class ServingReport:
                 elif f.name == "tick_phase_s":
                     for phase, s in val.items():
                         cur[phase] = cur.get(phase, 0.0) + float(s)
-                elif f.name in (
-                    "tick_wall_s",
-                    "tick_dispatch_s",
-                    "tick_host_overhead_s",
-                    "slot_seconds_total",
-                ):
+                elif f.name in MERGE_FLOAT_FIELDS:
                     setattr(merged, f.name, cur + float(val))
                 elif isinstance(cur, int):
                     setattr(merged, f.name, cur + int(val))
@@ -336,6 +331,18 @@ def percentile(samples, q: float) -> float:
     rank = max(0, min(len(values) - 1, round(q / 100.0 * (len(values) - 1))))
     return values[int(rank)]
 
+
+#: Float-typed ServingReport fields that fleet `merge` SUMS across
+#: replicas (accumulated seconds). Percentile fields are re-derived from
+#: pooled samples instead, and every other float is per-replica detail.
+#: NOS022 (telemetry-schema) introspects this: a float field a registry
+#: entry snapshots into must appear here or merge silently drops it.
+MERGE_FLOAT_FIELDS = (
+    "tick_wall_s",
+    "tick_dispatch_s",
+    "tick_host_overhead_s",
+    "slot_seconds_total",
+)
 
 #: ServingReport integer fields that are POINT-IN-TIME gauges, not
 #: monotonic counters: differencing two snapshots of these is meaningless

@@ -1,0 +1,58 @@
+"""NOS020 negative fixture — the same donated callables used under the
+sanctioned discipline: the donated variable is rebound from the call's
+result in the same statement (single target, tuple target, loop body),
+returned straight out of the frame, or never read again. Non-self handle
+attributes (``st.pos``) are deliberately untracked — the TickState
+pattern re-scatters results through the handle."""
+
+import jax
+
+
+def _step(params, cache):
+    return params, cache
+
+
+fill_fn = jax.jit(_step, donate_argnums=(1,))
+
+
+class Engine:
+    def __init__(self, params):
+        self.params = params
+        self.cache = None
+        self._step_fn = jax.jit(_step, donate_argnums=(1,))
+
+    def rebind_same_statement(self):
+        self.cache = self._step_fn(self.params, self.cache)
+        return self.cache
+
+    def rebind_tuple_target(self):
+        out, self.cache = self._step_fn(self.params, self.cache)
+        return out
+
+    def rebind_in_loop(self, cache):
+        for _ in range(4):
+            cache = fill_fn(self.params, cache)
+        return cache
+
+    def return_result(self, cache):
+        return fill_fn(self.params, cache)
+
+    def donate_then_done(self, cache):
+        out = fill_fn(self.params, cache)
+        return out  # the consumed name is never read again
+
+    def handle_attrs_untracked(self, st):
+        out = self._step_fn(self.params, st.cache)
+        return st.cache, out  # non-self attr: re-scattered via the handle
+
+    def trace_body_is_exempt(self, cache):
+        def inner(c):
+            out = fill_fn(self.params, c)
+            return c, out  # inside a trace body: trace-time, not host path
+
+        return inner
+
+    def rebound_before_reread(self, cache):
+        out = fill_fn(self.params, cache)
+        cache = out[1]  # fresh binding before any read
+        return cache

@@ -1,0 +1,41 @@
+"""NOS020 positive fixture — donated buffers read on the host path after
+the call consumed them. Every pattern here violates the composition
+contract (rebind the donated variable from the result, in the same
+statement): a read after a non-rebinding donated call, a loop that
+re-donates without ever rebinding, and an immediate
+``jax.jit(f, donate_argnums=...)(x)`` call followed by a read."""
+
+import jax
+
+
+def _step(params, cache):
+    return params, cache
+
+
+fill_fn = jax.jit(_step, donate_argnums=(1,))
+
+
+class Engine:
+    def __init__(self, params):
+        self.params = params
+        self.cache = None
+        self._step_fn = jax.jit(_step, donate_argnums=(1,))
+
+    def read_after_donate(self):
+        out = self._step_fn(self.params, self.cache)
+        return self.cache.shape, out  # NOS020: self.cache was consumed
+
+    def loop_without_rebind(self, cache):
+        for _ in range(4):
+            self._step_fn(self.params, cache)  # NOS020: re-donates on iter 2
+        return None
+
+    def local_read_after_donate(self, cache):
+        out = fill_fn(self.params, cache)
+        total = cache.sum()  # NOS020: cache was consumed by fill_fn
+        return out, total
+
+
+def immediate_jit_then_read(params, cache):
+    out = jax.jit(_step, donate_argnums=(1,))(params, cache)
+    return cache, out  # NOS020: cache was consumed at the immediate call

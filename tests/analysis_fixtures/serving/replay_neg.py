@@ -1,0 +1,42 @@
+"""NOS021 negative fixture — a pure replay/classification plane next to
+impure code that is NOT in the closure. Replay consumes recorded
+timestamps carried by the reports, explicit keyed jax.random is legal,
+and the live loop below may read clocks and probe replicas freely: it is
+not reachable from `replay`/`classify_*`, and closure precision is the
+point of the whole-tree call graph."""
+
+import time
+
+import jax
+
+
+def _window_rate(reports):
+    # Pure: derives the rate from RECORDED timestamps, never the clock.
+    if len(reports) < 2:
+        return 0.0
+    span = reports[-1]["recorded_at"] - reports[0]["recorded_at"]
+    return sum(r["tokens"] for r in reports) / span if span else 0.0
+
+
+def _keyed_noise(key):
+    return jax.random.uniform(key)  # keyed and explicit: deterministic
+
+
+class FleetMonitor:
+    def __init__(self, engines):
+        self._engines = engines
+
+    def replay(self, reports, key):
+        return _window_rate(reports), _keyed_noise(key)
+
+    def classify_replica(self, snapshot):
+        if snapshot["missed_probes"] > 3:
+            return "dead"
+        return "suspect" if snapshot["missed_probes"] else "alive"
+
+    def sample_live(self):
+        # Live sweep: clocks and probes are fine OUTSIDE the closure.
+        now = time.monotonic()
+        for engine in self._engines:
+            engine.probe()
+        return now

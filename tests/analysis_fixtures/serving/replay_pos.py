@@ -1,0 +1,38 @@
+"""NOS021 positive fixture — impurity inside the replay/classification
+closure. The roots (`replay`, `classify_*`) look innocent; the
+violations sit in helpers the call graph pulls into the closure: a wall
+clock read, a global-RNG draw, a datetime capture, and live-surface
+calls (replica probe, shared-registry gauge mutation)."""
+
+import random
+import time
+from datetime import datetime
+
+
+def _rebuild_window(reports):
+    started = time.time()  # NOS021: wall clock inside the closure
+    return [(started, r) for r in reports]
+
+
+def _jitter():
+    return random.random()  # NOS021: global RNG draw
+
+
+class FleetMonitor:
+    def __init__(self, engines, metrics):
+        self._engines = engines
+        self._metrics = metrics
+
+    def replay(self, reports):
+        window = _rebuild_window(reports)
+        return window, _jitter()
+
+    def classify_replica(self, snapshot):
+        stamp = datetime.now()  # NOS021: captures "now", not the snapshot
+        for engine in self._engines:
+            engine.probe()  # NOS021: live probe during classification
+        return stamp
+
+    def classify_pressure(self, snapshot):
+        self._metrics.set_gauge("nos_tpu_fleet_headroom", 1.0)  # NOS021
+        return snapshot

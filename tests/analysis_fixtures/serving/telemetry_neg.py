@@ -1,0 +1,12 @@
+"""NOS022 negative fixture — emits that agree with the (test-injected)
+registry: the registered literal, a dynamic name under the registered
+family, a non-metric string, and a metric name quoted in prose only.
+Quoting ``nos_tpu_fix_bogus_total`` here in the docstring is exempt —
+docstrings are documentation, not emit sites."""
+
+
+def publish(metrics, field):
+    metrics.inc("nos_tpu_fix_ok_total")  # registered exactly
+    metrics.set_gauge(f"nos_tpu_fix_fam_{field}", 1.0)  # registered family
+    metrics.observe("latency_seconds", 0.5)  # not a nos_tpu_ name: out of scope
+    return metrics

@@ -1,0 +1,11 @@
+"""NOS022 positive fixture — emit-site drift against the (test-injected)
+registry: a literal metric name the registry never heard of, and a
+dynamic f-string name whose leading fragment matches no registered
+family. The registry the gate tests inject knows exactly
+``nos_tpu_fix_ok_total`` and the ``nos_tpu_fix_fam_*`` family."""
+
+
+def publish(metrics, shard):
+    metrics.inc("nos_tpu_fix_bogus_total")  # unregistered name
+    metrics.set_gauge(f"nos_tpu_fix_unknown_{shard}", 1.0)  # no family match
+    return metrics
