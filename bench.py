@@ -1653,6 +1653,260 @@ def _shared_kv_fleet(
     }
 
 
+def _disagg_long_context(
+    np,
+    cfg,
+    params,
+    prompt_len: int = 32768,
+    prefill_budget: int = 1024,
+    n_short: int = 4,
+    short_prompt_len: int = 24,
+    short_max_new: int = 512,
+    long_max_new: int = 32,
+    n_long: int = 1,
+    block_size: int = 32,
+    steps_per_dispatch: int = 4,
+    temperatures=(0.0, 0.8),
+    timeout_s: float = 900.0,
+) -> dict:
+    """Phase-disaggregation A/B on long-context traffic (ISSUE 18
+    tentpole, docs/disaggregation.md) — the long-context scenario
+    family opener (32k at the default; the full bench also sweeps 4k
+    through this helper for the interference table, the CPU smoke runs
+    a scaled prompt). Identical traffic, two placements:
+
+      - COLOCATED: one unified engine; `n_short` decode streams in
+        steady state, then one `prompt_len`-token prompt arrives and
+        its prefill time-shares the forward pass with them.
+      - DISAGGREGATED: a prefill-role replica and a decode-role
+        replica over one FleetKVStore; the same shorts and the same
+        long prompt submit through the HandoffCoordinator — prefill
+        runs on the prefill replica at the same budget, the finished
+        slot hands off as a SlotCheckpoint whose KV rides the store,
+        and decode never shares a forward pass with the long prefill.
+
+    Gates ride counters + bit-exactness (the PR 12 noise lesson):
+    outputs identical colocated vs disaggregated (greedy AND
+    temperature — the handoff IS a checkpoint restore), handoff KV
+    REVIVED from the store not recomputed (`handoff_revived_tokens`),
+    and the decode tok/s the shorts sustain during the long prefill
+    window — the interference collapse this scenario exists to
+    measure — reported per arm with its chip_accounting waste
+    decomposition."""
+    import time as _time
+
+    from nos_tpu import constants as _c
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.serving import (
+        FleetKVStore,
+        HandoffCoordinator,
+        PrefixRouter,
+        ReplicaSet,
+        utilization_block,
+    )
+    from nos_tpu.telemetry import collect_serving
+    from nos_tpu.tracing import EngineTracing
+
+    srng = np.random.default_rng([prompt_len, n_short, short_max_new])
+    short_prompts = [
+        srng.integers(1, cfg.vocab, short_prompt_len).tolist()
+        for _ in range(n_short)
+    ]
+    # Distinct long prompts: the warm prompt is NOT one of the measured
+    # ones, so every measured prefill is genuine admission work even
+    # when the radix cache / store is already hot (a warm==measured
+    # prompt turns the drain into a cache hit and the window vanishes).
+    warm_long = srng.integers(1, cfg.vocab, prompt_len).tolist()
+    long_prompts = [
+        srng.integers(1, cfg.vocab, prompt_len).tolist() for _ in range(n_long)
+    ]
+    max_len = prompt_len + long_max_new + 2 * block_size
+    # Buckets: the shorts' shape, the chunk shape, and (for the
+    # budget-0 inline smoke) the whole-prompt shape.
+    buckets = tuple(
+        sorted(
+            {
+                max(8, 1 << (short_prompt_len - 1).bit_length()),
+                max(16, prefill_budget) if prefill_budget else 16,
+                1 << (prompt_len - 1).bit_length()
+                if prompt_len & (prompt_len - 1)
+                else prompt_len,
+            }
+        )
+    )
+
+    def make_engine(store=None, device=None):
+        # Replicas model separate hosts. Pinning each replica's weights
+        # to its own device (committed-data placement: every program
+        # follows the params) gives each replica its own execution
+        # stream — without it, both "replicas" serialize on one device
+        # queue, which is precisely the colocated condition the A/B
+        # exists to measure against. On a single-device runtime the pin
+        # is the identity and the arms degrade to stream-serialized.
+        import jax as _jax
+
+        eng_params = params if device is None else _jax.device_put(params, device)
+        return DecodeServer(
+            eng_params,
+            cfg,
+            n_slots=n_short + n_long,
+            max_len=max_len,
+            prompt_buckets=buckets,
+            steps_per_dispatch=steps_per_dispatch,
+            prefill_budget_tokens=prefill_budget,
+            block_size=block_size,
+            kv_store=store,
+            temperature=temperature,
+            seed=11,
+            tracing=EngineTracing(),
+        )
+
+    def wait(cond, t0, what):
+        while not cond():
+            if _time.perf_counter() - t0 > timeout_s:
+                raise RuntimeError(f"disagg_long_context: {what} timed out")
+            _time.sleep(0.002)
+
+    def measure_arm(submit, ttft_engine, decode_engine, warm_engines):
+        """One arm: shorts to steady state, `n_long` long prompts
+        mid-flight, the decode tokens the shorts produce during the
+        long-prefill window (first long submitted → last long's first
+        token). `submit` is the arm's ingress; TTFT samples land on
+        `ttft_engine` (the admitting/prefilling engine); decode-side
+        macro tokens are read from `decode_engine`. Back-to-back longs
+        exist to keep the window WIDE relative to the decode fold
+        period whatever the compile-cache state — a single warm drain
+        can finish inside one macro fold, which reads as zero decode
+        tokens on a genuinely free-running replica."""
+        for e in warm_engines:  # compile the short-shape programs:
+            e.generate(short_prompts[0], max_new=4, timeout=timeout_s)
+        # Warm the long shape on the ADMITTING engine (same warm count on
+        # it in both arms, so admission serials — and therefore sampled
+        # outputs — line up across arms). The measured window must hold
+        # no compiles: XLA compilation stalls every engine thread in the
+        # process, which would mask the interference signal.
+        ttft_engine.generate(warm_long, max_new=2, timeout=timeout_s)
+        warm_ttft = len(ttft_engine.ttft_s)
+        # Steady state keys on macro TOKENS, not dispatch counts — the
+        # fused burst path advances lanes without bumping macro_dispatches.
+        warm_tokens = int(decode_engine.macro_tokens_by_slot.sum())
+        t0 = _time.perf_counter()
+        shorts = [submit(p, short_max_new) for p in short_prompts]
+        wait(
+            lambda: len(ttft_engine.ttft_s) >= warm_ttft + n_short
+            and int(decode_engine.macro_tokens_by_slot.sum())
+            >= warm_tokens + 4 * steps_per_dispatch,
+            t0,
+            "short-stream steady state",
+        )
+        n_ttft = len(ttft_engine.ttft_s)
+        base_tokens = int(decode_engine.macro_tokens_by_slot.sum())
+        t_long = _time.perf_counter()
+        flongs = [submit(p, long_max_new) for p in long_prompts]
+        wait(
+            lambda: len(ttft_engine.ttft_s) >= n_ttft + n_long,
+            t_long,
+            "long prefill",
+        )
+        window = _time.perf_counter() - t_long
+        during = int(decode_engine.macro_tokens_by_slot.sum()) - base_tokens
+        outs = [f.result(timeout=timeout_s) for f in shorts]
+        outs.extend(f.result(timeout=timeout_s) for f in flongs)
+        return outs, {
+            "decode_tok_s_during_prefill": round(during / window, 1),
+            "decode_tokens_during_prefill": during,
+            "prefill_window_s": round(window, 3),
+            "ttft_long_s": round(ttft_engine.ttft_s[n_ttft], 3),
+        }
+
+    def colocated_arm():
+        server = make_engine().start()
+        try:
+            outs, stats = measure_arm(
+                lambda p, m: server.submit(p, max_new=m),
+                server,
+                server,
+                [server],
+            )
+            stats["chip_accounting"] = utilization_block(
+                [collect_serving(server)]
+            )
+        finally:
+            server.stop()
+        return outs, stats
+
+    def disagg_arm():
+        import jax as _jax
+
+        store = FleetKVStore(capacity_bytes=1 << 31)
+        devs = _jax.devices()
+        pre = make_engine(store, device=devs[0])
+        dec = make_engine(store, device=devs[1 % len(devs)])
+        rs = ReplicaSet(
+            [pre, dec],
+            start=True,
+            roles=[_c.REPLICA_ROLE_PREFILL, _c.REPLICA_ROLE_DECODE],
+        )
+        router = PrefixRouter(rs, kv_store=store)
+        coord = HandoffCoordinator(rs, router)
+        try:
+            outs, stats = measure_arm(
+                lambda p, m: coord.submit(p, max_new=m), pre, dec, [pre, dec]
+            )
+            rep = coord.report()
+            stats.update(
+                {
+                    "handoffs": coord.handoffs,
+                    "handoff_reroutes": coord.handoff_reroutes,
+                    "handoffs_errored": coord.handoffs_errored,
+                    "handoff_exports": pre.handoff_exports,
+                    "handoff_published_blocks": pre.handoff_published_blocks,
+                    "handoff_ingests": dec.handoff_ingests,
+                    "handoff_revived_tokens": dec.handoff_revived_tokens,
+                    "handoff_latency_p50_s": round(
+                        rep.handoff_latency_p50_s, 4
+                    ),
+                    "handoff_latency_p95_s": round(
+                        rep.handoff_latency_p95_s, 4
+                    ),
+                    "store_conserved": store.conserved(),
+                    "chip_accounting": utilization_block(
+                        [collect_serving(pre), collect_serving(dec)]
+                    ),
+                }
+            )
+        finally:
+            coord.detach()
+            rs.stop()
+        return outs, stats
+
+    arms = {}
+    for temperature in temperatures:
+        tkey = "greedy" if temperature == 0.0 else f"temp_{temperature}"
+        colo_outs, colo = colocated_arm()
+        dis_outs, dis = disagg_arm()
+        colo_rate = colo["decode_tok_s_during_prefill"]
+        arms[tkey] = {
+            "outputs_identical": colo_outs == dis_outs,
+            "colocated": colo,
+            "disaggregated": dis,
+            "decode_interference_ratio": (
+                round(dis["decode_tok_s_during_prefill"] / colo_rate, 2)
+                if colo_rate
+                else None  # colocated fully frozen: ratio unbounded
+            ),
+        }
+    return {
+        "prompt_len": prompt_len,
+        "prefill_budget_tokens": prefill_budget,
+        "n_short_streams": n_short,
+        "n_long_streams": n_long,
+        "short_max_new": short_max_new,
+        "long_max_new": long_max_new,
+        "arms": arms,
+    }
+
+
 def _decode_phase(jax, jnp) -> dict:
     """Driver-captured serving throughput (VERDICT r4 #3: the README's
     tok/s claims lived only in docs — now the artifact carries them).
@@ -1869,6 +2123,10 @@ def _decode_phase(jax, jnp) -> dict:
     # per tick, 1024 = four chunks per tick (the latency/throughput knob's
     # other end).
     def interference(budget):
+        from nos_tpu.serving import utilization_block
+        from nos_tpu.telemetry import collect_serving
+        from nos_tpu.tracing import EngineTracing
+
         srng = np.random.default_rng([4096, 7, budget])
         short_prompts = [
             srng.integers(1, cfg.vocab, 128).tolist() for _ in range(7)
@@ -1882,6 +2140,7 @@ def _decode_phase(jax, jnp) -> dict:
             prompt_buckets=(16, 32, 64, 128, 256),
             steps_per_dispatch=16,
             prefill_budget_tokens=budget,
+            tracing=EngineTracing(),
         ).start()
         try:
             # Warm BOTH shapes: the short streams' programs and the long
@@ -1925,6 +2184,10 @@ def _decode_phase(jax, jnp) -> dict:
                 "tok_s_7_streams_overall": round(7 * 512 / wall, 1),
                 "ticks_with_prefill_and_macro": server.ticks_with_prefill_and_macro,
                 "prefill_dispatches": server.prefill_dispatches,
+                # Waste decomposition per arm (ISSUE 18 satellite): where
+                # the chip-seconds went while the 4k prefill sheared the
+                # decode streams — pairs with the disaggregated arm below.
+                "chip_accounting": utilization_block([collect_serving(server)]),
             }
         finally:
             server.stop()
@@ -1933,6 +2196,40 @@ def _decode_phase(jax, jnp) -> dict:
         _retry(f"decode:interference_b{b}", lambda b=b: interference(b))
         for b in (0, 256, 1024)
     ]
+    # The disaggregation A/B at the interference scenario's shape: same
+    # 4k arrival over 7 short streams, colocated (one unified engine)
+    # vs phase-split (prefill replica + decode replica, KV handoff over
+    # the fleet store). Counter-primary: outputs bit-identical, handoff
+    # KV revived not recomputed, decode tok/s during the prefill window.
+    out["interference_4k_disagg"] = _retry(
+        "decode:interference_4k_disagg",
+        lambda: _disagg_long_context(
+            np,
+            cfg,
+            params,
+            prompt_len=4096,
+            prefill_budget=1024,
+            n_short=7,
+            short_prompt_len=128,
+            short_max_new=512,
+            long_max_new=16,
+            temperatures=(0.0,),
+        ),
+    )
+
+    # Long-context family opener (ISSUE 18): 32k prompt, both arms.
+    # Needs its own config — the serving cfg caps max_seq at 8192.
+    def disagg_long():
+        lcfg = GPTConfig(
+            vocab=32000, hidden=512, layers=8, heads=8, kv_heads=2,
+            max_seq=32896,
+        )
+        lparams = init_gpt(jax.random.PRNGKey(0), lcfg)
+        return _disagg_long_context(np, lcfg, lparams)
+
+    out["disagg_long_context"] = _retry(
+        "decode:disagg_long_context", disagg_long
+    )
 
     # Shared-prefix KV reuse (PR 5): 8 streams sharing a 512-token system
     # prompt with distinct 64-token suffixes, prefix cache off vs on.

@@ -210,12 +210,36 @@ REPLICA_HEALTH_STATES = (
     REPLICA_HEALTH_SUSPECT,
     REPLICA_HEALTH_DEAD,
 )
+# Replica ROLES (serving/disagg.py, docs/disaggregation.md) — a third
+# axis beside lifecycle and health: what PHASE of work placement should
+# send this replica. A `prefill` replica runs admission chunks at full
+# prefill budget and hands finished slots off; a `decode` replica
+# receives handoff checkpoints and streams tokens; `unified` (the
+# default, and the only role that existed before disaggregation) does
+# both. Roles constrain the router's phase-aware `select` — they are a
+# placement preference, NOT a capability limit: every engine can still
+# run both phases, which is what makes failover onto any survivor safe.
+REPLICA_ROLE_PREFILL = "prefill"
+REPLICA_ROLE_DECODE = "decode"
+REPLICA_ROLE_UNIFIED = "unified"
+REPLICA_ROLES = (
+    REPLICA_ROLE_PREFILL,
+    REPLICA_ROLE_DECODE,
+    REPLICA_ROLE_UNIFIED,
+)
+# Router placement phases (PrefixRouter.select(phase=...)): which phase
+# of a request is being placed. `None` (no phase) keeps the pre-disagg
+# behaviour — every admitting replica is a candidate.
+ROUTER_PHASE_PREFILL = "prefill"
+ROUTER_PHASE_DECODE = "decode"
+ROUTER_PHASES = (ROUTER_PHASE_PREFILL, ROUTER_PHASE_DECODE)
 # Replica snapshot keys (ReplicaHandle.snapshot() / fleet telemetry rows).
 REPLICA_KEY_ID = "replica_id"
 REPLICA_KEY_STATE = "state"
 REPLICA_KEY_HEALTH = "health"
 REPLICA_KEY_SHADOW_KEYS = "shadow_keys"
 REPLICA_KEY_ROUTED_REQUESTS = "routed_requests"
+REPLICA_KEY_ROLE = "role"
 # Engine load-probe keys (DecodeServer.probe() -> router scoring).
 PROBE_KEY_ACTIVE_SLOTS = "active_slots"
 PROBE_KEY_QUEUED_REQUESTS = "queued_requests"
@@ -290,6 +314,12 @@ FLEET_EV_SUSPECT = "fleet.suspect"          # health active -> suspect
 FLEET_EV_RECOVERED = "fleet.recovered"      # health suspect -> active
 FLEET_EV_DEATH = "fleet.death"              # health -> dead, failover fires
 FLEET_EV_FAILOVER = "fleet.failover"        # one stream re-homed/resolved
+# Phase-disaggregation handoff events (serving/disagg.py,
+# docs/disaggregation.md): one prefill-complete slot shipped from a
+# prefill-role replica to a decode-role replica over the fleet store.
+FLEET_EV_HANDOFF = "fleet.handoff"            # one handoff completed
+FLEET_EV_HANDOFF_REROUTE = "fleet.handoff_reroute"  # dst died mid-revive, retried
+FLEET_EV_HANDOFF_FAILED = "fleet.handoff_failed"    # no survivor; classified error
 FLEET_EVENTS = (
     FLEET_EV_WINDOW,
     FLEET_EV_FREEZE,
@@ -300,6 +330,9 @@ FLEET_EVENTS = (
     FLEET_EV_RECOVERED,
     FLEET_EV_DEATH,
     FLEET_EV_FAILOVER,
+    FLEET_EV_HANDOFF,
+    FLEET_EV_HANDOFF_REROUTE,
+    FLEET_EV_HANDOFF_FAILED,
 )
 # ---------------------------------------------------------------------------
 # Fleet utilization & cost-attribution plane (nos_tpu/serving/accounting.py,
@@ -420,6 +453,11 @@ TRACE_EV_DRAIN_MIGRATE = "req.drain_migrate"
 # its last checkpoint replayed onto a survivor — one trace id survives
 # replica death exactly as it survives device-lost.
 TRACE_EV_FAILOVER = "req.failover"
+# Phase-disaggregated handoff (serving/disagg.py): the request prefilled
+# on a prefill-role replica and its finished slot — KV published to the
+# fleet store — moved to a decode-role replica. One trace id spans both
+# replicas, exactly as it spans a failover.
+TRACE_EV_HANDOFF = "req.handoff"
 # Radix COW (PR 13): a diverging block's shared head copied into the
 # request's private page instead of recomputed.
 TRACE_EV_COW = "req.cow"
@@ -437,6 +475,7 @@ TRACE_EVENTS = (
     TRACE_EV_RESTORE,
     TRACE_EV_DRAIN_MIGRATE,
     TRACE_EV_FAILOVER,
+    TRACE_EV_HANDOFF,
     TRACE_EV_COW,
 )
 

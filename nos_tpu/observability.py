@@ -147,6 +147,25 @@ METRIC_SERIES: Tuple[MetricSpec, ...] = (
     MetricSpec("nos_tpu_fleet_futures_failed_over", "counter", "futures_failed_over"),
     MetricSpec("nos_tpu_fleet_futures_errored", "counter", "futures_errored"),
     MetricSpec("nos_tpu_fleet_failover_latency", "histogram"),
+    # -- phase-disaggregated handoff (serving/disagg.py + engine export/
+    # ingest counters; docs/disaggregation.md) --
+    MetricSpec("nos_tpu_fleet_handoff_exports", "counter", "handoff_exports"),
+    MetricSpec("nos_tpu_fleet_handoff_ingests", "counter", "handoff_ingests"),
+    MetricSpec(
+        "nos_tpu_fleet_handoff_published_blocks",
+        "counter",
+        "handoff_published_blocks",
+    ),
+    MetricSpec(
+        "nos_tpu_fleet_handoff_revived_tokens",
+        "counter",
+        "handoff_revived_tokens",
+    ),
+    MetricSpec("nos_tpu_fleet_handoffs", "counter", "handoffs"),
+    MetricSpec("nos_tpu_fleet_handoff_reroutes", "counter", "handoff_reroutes"),
+    MetricSpec("nos_tpu_fleet_handoffs_errored", "counter", "handoffs_errored"),
+    MetricSpec("nos_tpu_fleet_handoff_latency", "histogram"),
+    MetricSpec("nos_tpu_fleet_handoff_seconds", "histogram", "handoff_wall_s"),
     # -- fleet pressure plane (monitor-derived gauges; computed from
     # report windows, so no single report_field backs them) --
     MetricSpec("nos_tpu_fleet_replicas_active", "gauge"),

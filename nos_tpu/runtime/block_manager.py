@@ -645,6 +645,39 @@ class BlockManager:
                 break
         return published
 
+    def publish_slot_chain(self, idx: int) -> int:
+        """Targeted write-through publish of ONE slot's indexed prompt
+        chain — the handoff-export fast path (serving/disagg.py). The
+        per-tick `publish_to_tier` sweep would get these blocks to the
+        store eventually; a handoff needs them there NOW, before the
+        destination replica's admission stages its revives, or the
+        decode side recomputes exactly the prefill this slot just paid
+        for. Only blocks `note_progress` has indexed are published
+        (completely written by construction); chain metadata comes from
+        the slot's own key/token chain, so a tier-less tree or a pruned
+        node cannot hole the parent links. Keys already host-resident
+        are skipped (the store would dedup). Runs on the engine thread
+        like every spill copy-out. Returns the number of blocks put."""
+        if self._spill is None:
+            return 0
+        published = 0
+        keys = self._slot_keys[idx][: self._slot_indexed[idx]]
+        for b, key in enumerate(keys):
+            if key in self._spill:
+                continue
+            block = self._prefix_index.get(key)
+            if block is None:
+                # Lost the indexing race to a concurrent same-prefix
+                # slot whose copy was since evicted: this slot's private
+                # duplicate holds identical bytes (content addressing).
+                block = self._slot_blocks[idx][b]
+            payload, nbytes = self._spill_reader(block)
+            parent = keys[b - 1] if b > 0 else ""
+            tokens = self._slot_blocks_tokens[idx][b]
+            self._spill.put(key, payload, nbytes, parent=parent, tokens=tokens)
+            published += 1
+        return published
+
     def _alloc_one(self) -> int:
         """One block, cheapest casualty first: the plain free list, then
         a spilled block (its content already lives on host — reuse

@@ -198,6 +198,16 @@ class _Request:
     # (or by the router), preserved across restores/preemptions/migrations
     # so one request is one trace regardless of how many engines served it.
     trace_id: Optional[str] = None
+    # Phase-disaggregation markers (serving/disagg.py,
+    # docs/disaggregation.md). `handoff_export`: this engine is the
+    # request's PREFILL replica — at prefill-complete the slot is
+    # checkpointed, its prompt chain force-published to the shared
+    # store, and the checkpoint delivered to the handoff hook instead
+    # of decoding here. `handoff_ingest`: this request ARRIVED via a
+    # handoff — its staged revives count as handoff traffic, not
+    # failover traffic.
+    handoff_export: bool = False
+    handoff_ingest: bool = False
 
 
 @dataclass
@@ -269,6 +279,10 @@ class _Slot:
     # on its first post-prefill dispatch).
     trace_id: Optional[str] = None
     trace_decoding: bool = False
+    # Phase-disaggregation markers (see _Request): export at
+    # prefill-complete / arrived-via-handoff revive accounting.
+    handoff_export: bool = False
+    handoff_ingest: bool = False
 
 
 @dataclass
@@ -739,6 +753,17 @@ class DecodeServer:
         self.prewarm_tokens = 0
         self.failover_revive_tokens = 0
         self.store_published_blocks = 0
+        # Phase-disaggregation plane (serving/disagg.py): the export
+        # hook a HandoffCoordinator arms (fires on the engine thread at
+        # prefill-complete with the captured SlotCheckpoint), plus the
+        # per-engine counters telemetry mirrors — slots exported /
+        # checkpoints ingested / blocks force-published at export /
+        # prompt tokens the decode side revived from store payloads.
+        self._handoff_hook = None
+        self.handoff_exports = 0
+        self.handoff_ingests = 0
+        self.handoff_published_blocks = 0
+        self.handoff_revived_tokens = 0
         # Elastic tenant quotas (PR 7, runtime/quota.py): None = no quota
         # behavior. `_tick_tokens` accumulates one tick's decode tokens
         # per tenant for the policy's sliding window.
@@ -1218,13 +1243,23 @@ class DecodeServer:
         future: Optional[Future] = None,
         t_submit: Optional[float] = None,
         trace_id: Optional[str] = None,
+        handoff: bool = False,
     ) -> Future:
         """The general request-ingress hook: `submit()` plus the
         cross-replica form the drain/migrate controller
         (nos_tpu/serving/drain.py) uses — a migrated request keeps its
         ORIGINAL client Future and submit timestamp, so the client
         blocked in Future.result() never notices its work moved
-        engines. Thread-safe (the queue is the cross-thread boundary)."""
+        engines. Thread-safe (the queue is the cross-thread boundary).
+
+        `handoff=True` marks the request for phase-disaggregated export
+        (serving/disagg.py): this engine runs the PREFILL only — at the
+        final chunk the slot is checkpointed, its prompt chain
+        force-published to the shared store, and the checkpoint handed
+        to the armed handoff hook for decode placement elsewhere.
+        Requires a shared store and an armed hook; without both the
+        marker is inert and the request decodes here (unified
+        behavior)."""
         if self._closed.is_set():
             raise RuntimeError(
                 "DecodeServer is stopped (or draining): submit() after "
@@ -1252,12 +1287,16 @@ class DecodeServer:
                 t_submit if t_submit is not None else time.monotonic(),
                 tenant=tenant,
                 trace_id=trace_id,
+                handoff_export=handoff,
             )
         )
         return fut
 
     def transfer_in_checkpoint(
-        self, ck: SlotCheckpoint, t_restore: Optional[float] = None
+        self,
+        ck: SlotCheckpoint,
+        t_restore: Optional[float] = None,
+        handoff: bool = False,
     ) -> None:
         """Accept a SlotCheckpoint captured on ANOTHER replica
         (drain/migrate): enqueued as a restore-shaped request — replay =
@@ -1267,7 +1306,14 @@ class DecodeServer:
         provided it shares the source's params, config, and sampling
         seed (the ReplicaSet construction contract,
         docs/serving-cluster.md). The checkpoint's Future rides along:
-        the client resolves against THIS engine's completion."""
+        the client resolves against THIS engine's completion.
+
+        `handoff=True` marks a phase-disaggregation arrival (the decode
+        half of serving/disagg.py's handoff): the replay's staged store
+        revives count as `handoff_revived_tokens` — the counter witness
+        that the prefill replica's KV was SHIPPED through the fleet
+        store rather than recomputed here — instead of as failover
+        traffic."""
         if self._closed.is_set():
             raise RuntimeError(
                 "DecodeServer is stopped (or draining): cannot accept a "
@@ -1277,6 +1323,10 @@ class DecodeServer:
             return  # resolved at capture (eos/budget) — nothing to replay
         if ck.future is not None:
             self._note_accepted(ck.future)
+        if handoff:
+            self.handoff_ingests += 1
+            if self.metrics is not None:
+                self.metrics.inc("nos_tpu_fleet_handoff_ingests")
         self._queue.put(
             _Request(
                 prompt=list(ck.prompt),
@@ -1289,6 +1339,7 @@ class DecodeServer:
                 spec=dict(ck.spec) if ck.spec is not None else None,
                 tenant=ck.tenant,
                 trace_id=ck.trace_id,
+                handoff_ingest=handoff,
             )
         )
 
@@ -1341,6 +1392,14 @@ class DecodeServer:
             if len(self._accepted) > 64:
                 self._accepted = [f for f in self._accepted if not f.done()]
             self._accepted.append(fut)
+
+    def _drop_accepted(self, fut: Future) -> None:
+        """Ownership transfer (handoff export): the future now belongs
+        to another replica's completion, so this engine's drain loop
+        must stop counting it as work owed HERE — a source drain would
+        otherwise block on a stream the destination is serving."""
+        with self._accept_lock:
+            self._accepted = [f for f in self._accepted if f is not fut]
 
     def _has_outstanding(self) -> bool:
         """Any accepted request whose Future is still unresolved. Exact
@@ -1553,6 +1612,19 @@ class DecodeServer:
         already-built fleet. Same contract as the constructor param:
         the hook only READS the passive checkpoints."""
         self._checkpoint_hook = hook
+
+    def set_handoff_hook(self, hook) -> None:
+        """Arm (or, with None, disarm) the prefill-complete handoff
+        hook (serving/disagg.py). The hook fires ON THE ENGINE THREAD
+        with one argument — the freshly captured SlotCheckpoint, its
+        prompt chain already force-published to the shared store and
+        its slot already released — and OWNS the checkpoint from that
+        moment: this engine has dropped the future from its accepted
+        set, so the coordinator must place the checkpoint (or resolve
+        its future with a classified error) or the client hangs. A
+        raising hook is contained: the export already completed, so the
+        engine logs and keeps ticking."""
+        self._handoff_hook = hook
 
     def forsake(self) -> List[Future]:
         """Disown every outstanding Future WITHOUT resolving it: the
@@ -1871,6 +1943,8 @@ class DecodeServer:
                 slot.tenant = req.tenant
                 slot.trace_id = req.trace_id
                 slot.trace_decoding = False
+                slot.handoff_export = req.handoff_export
+                slot.handoff_ingest = req.handoff_ingest
                 slot.pending_prompt = full_prompt
                 # Prefix hits are already in the page table: the prefill
                 # cursor starts at the first MISS boundary, so the budget
@@ -2143,7 +2217,16 @@ class DecodeServer:
                 slot.phase = "prefilling"
             copies += 1
             used += cost
-            if slot.t_restore:
+            if slot.handoff_ingest:
+                # Handoff arrivals serving their replay from the
+                # prefill replica's published payloads — the shipped-
+                # not-recomputed witness the bench-smoke gate reads.
+                self.handoff_revived_tokens += cost
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "nos_tpu_fleet_handoff_revived_tokens", cost
+                    )
+            elif slot.t_restore:
                 # Failover/restore admissions that hit the tier serve
                 # their replay from host bytes instead of recompute —
                 # the fleet-level witness that a dead replica's cache
@@ -2467,6 +2550,7 @@ class DecodeServer:
             # instead of one RTT per slot.
             now = time.monotonic()
             ref = _TokRef(self._first_dev, self._syncs)
+            exports: List[int] = []
             for idx, _, _ in finals:
                 slot = self._slots[idx]
                 slot.phase = "decoding"
@@ -2491,6 +2575,17 @@ class DecodeServer:
                         pos=slot.pos,
                     )
                 self._finish_if_done(idx)
+                # Re-fetch: _finish_if_done replaces a completed slot's
+                # lane with a fresh _Slot (handoff_export False), so a
+                # request that finished AT its first token never exports.
+                if (
+                    self._slots[idx].handoff_export
+                    and self._slots[idx].active
+                    and self._handoff_hook is not None
+                ):
+                    exports.append(idx)
+            for idx in exports:
+                self._export_handoff(idx)
         self.prefill_dispatches += dispatches
         if self._recorder is not None:
             self._recorder.record(
@@ -2506,6 +2601,62 @@ class DecodeServer:
                 sum(len(piece) for _, _, piece in wave),
             )
         return dispatches
+
+    def _export_handoff(self, idx: int) -> None:
+        """Prefill-complete export (serving/disagg.py): checkpoint the
+        slot, force-publish its prompt chain into the shared store, and
+        deliver the checkpoint to the handoff hook — the decode phase
+        runs on whatever replica the coordinator picks.
+
+        Runs on the engine thread right after the slot's final chunk
+        (the one place the device copy-outs cannot race the donated
+        cache chain). Order matters: the checkpoint capture materializes
+        the first token (the destination replays it bit-identically —
+        serial and PRNG step ride the checkpoint, the standard
+        transfer_in_checkpoint exactness contract); the chain publish
+        happens BEFORE the release so every full prompt block is in the
+        store when the destination's admission stages its revives; the
+        future leaves this engine's accepted set because ownership
+        transfers with the checkpoint. A destination that finds a key
+        already retired degrades that block to recompute — identical
+        output, the usual store-miss price."""
+        slot = self._slots[idx]
+        ck = self._checkpoint_slot(idx)
+        if ck is None:
+            # eos / budget completed at capture: resolved here, nothing
+            # to hand off. The slot still needs its release.
+            self._release_slot(idx)
+            return
+        published = self._block_mgr.publish_slot_chain(idx)
+        self.handoff_exports += 1
+        self.handoff_published_blocks += published
+        if self.metrics is not None:
+            self.metrics.inc("nos_tpu_fleet_handoff_exports")
+            if published:
+                self.metrics.inc(
+                    "nos_tpu_fleet_handoff_published_blocks", published
+                )
+        if self._tracer is not None:
+            self._tracer.event(
+                slot.trace_id,
+                constants.TRACE_EV_HANDOFF,
+                slot=idx,
+                published_blocks=published,
+                generated=len(ck.generated),
+            )
+        if ck.future is not None:
+            self._drop_accepted(ck.future)
+        self._release_slot(idx)
+        try:
+            self._handoff_hook(ck)
+        except Exception as exc:
+            # The hook owns recovery (it holds the checkpoint and the
+            # future); a raise here must not take the engine loop down
+            # with it.
+            logger.exception(
+                "handoff hook raised (%s); engine continues",
+                classify_fault(exc),
+            )
 
     @staticmethod
     def _token_at(ref: _TokRef, lane: Optional[int], row: Optional[int]) -> int:

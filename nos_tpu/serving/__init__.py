@@ -36,6 +36,7 @@ from nos_tpu.serving.accounting import (  # noqa: F401
     fleet_utilization,
     utilization_block,
 )
+from nos_tpu.serving.disagg import HandoffCoordinator  # noqa: F401
 from nos_tpu.serving.drain import (  # noqa: F401
     DrainReport,
     drain_replica,

@@ -48,7 +48,30 @@ import threading
 from collections import OrderedDict
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 __all__ = ["FleetKVStore", "StoreTier"]
+
+
+def _readonly_view(payload: object) -> object:
+    """Zero-copy read-only view of a shared payload. ndarray leaves
+    become `writeable=False` VIEWS of the stored buffer — the consumer
+    slices/uploads them as before, and an accidental in-place write
+    raises instead of silently corrupting the one host copy every other
+    replica revives from. Tuples/lists (the engine's `(k, v)` stacks)
+    map recursively; anything else — the unit tests' immutable string
+    stand-ins — passes through unchanged. Copy-on-demand: a consumer
+    that truly needs a private mutable buffer copies it itself, paying
+    for the duplicate only when one is actually required."""
+    if isinstance(payload, np.ndarray):
+        view = payload.view()
+        view.flags.writeable = False
+        return view
+    if isinstance(payload, tuple):
+        return tuple(_readonly_view(p) for p in payload)
+    if isinstance(payload, list):
+        return [_readonly_view(p) for p in payload]
+    return payload
 
 # put() outcomes (StoreTier turns these into per-engine counters).
 PUT_STORED = "stored"
@@ -362,7 +385,15 @@ class StoreTier:
         """Revive read: consume this engine's staged pin (if any) and
         return the payload WITHOUT removing it from the store. The
         copy-in is synchronous in the caller, so the momentary
-        take-pin closes immediately after."""
+        take-pin closes immediately after.
+
+        The returned payload is a READ-ONLY zero-copy view
+        (`writeable=False` on ndarray leaves): the old eager
+        full-payload duplicate is gone — consumers slice/upload the
+        shared buffer directly and copy only on demand, while the view
+        flag keeps one replica's revive from ever mutating the host
+        copy the rest of the fleet hits. Dedup and pin accounting are
+        untouched by the change (pinned by the byte-balance tests)."""
         payload = self._fleet.take_pinned(key)
         self._drop_stage(key)
         if payload is None:
@@ -371,7 +402,7 @@ class StoreTier:
         self._fleet.unpin(key)  # the take-pin; copy-in is synchronous
         self.revives += 1
         self.store_hits += 1
-        return payload
+        return _readonly_view(payload)
 
     def discard(self, key: str) -> None:
         # Shared content stays (see class docstring); only release any

@@ -78,9 +78,26 @@ class ReplicaHandle:
     the handle itself takes no locks; PrefixRouter serializes mutation
     under its own lock."""
 
-    def __init__(self, replica_id: str, engine):
+    def __init__(
+        self,
+        replica_id: str,
+        engine,
+        role: str = constants.REPLICA_ROLE_UNIFIED,
+    ):
+        if role not in constants.REPLICA_ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r}; expected one of "
+                f"{constants.REPLICA_ROLES}"
+            )
         self.replica_id = replica_id
         self.engine = engine
+        #: Placement role (docs/disaggregation.md): which PHASE of work
+        #: the router sends here. `unified` (default) serves both
+        #: phases — the pre-disaggregation fleet byte-for-byte. A role
+        #: is a routing preference, not a capability limit: every
+        #: engine can run both phases, which is why failover may land a
+        #: decode stream on a prefill-role survivor.
+        self.role = role
         self.state = constants.REPLICA_STATE_ACTIVE
         #: Health axis (serving/supervisor.py, docs/robustness.md):
         #: what PROBING observed of the replica, beside the lifecycle
@@ -112,6 +129,15 @@ class ReplicaHandle:
             self.state == constants.REPLICA_STATE_ACTIVE
             and self.health == constants.REPLICA_HEALTH_ACTIVE
         )
+
+    def serves_phase(self, phase: Optional[str]) -> bool:
+        """Whether this replica's role accepts `phase` placements
+        (constants.ROUTER_PHASES; None = any role — the pre-disagg
+        select). Unified replicas serve every phase; specialized ones
+        serve their own."""
+        if phase is None or self.role == constants.REPLICA_ROLE_UNIFIED:
+            return True
+        return self.role == phase
 
     def probe(self) -> Dict[str, object]:
         """The engine's load snapshot (constants.PROBE_KEY_*)."""
@@ -189,6 +215,7 @@ class ReplicaHandle:
             constants.REPLICA_KEY_ID: self.replica_id,
             constants.REPLICA_KEY_STATE: self.state,
             constants.REPLICA_KEY_HEALTH: self.health,
+            constants.REPLICA_KEY_ROLE: self.role,
             constants.REPLICA_KEY_SHADOW_KEYS: len(self.shadow),
             constants.REPLICA_KEY_ROUTED_REQUESTS: self.routed_requests,
             **probe,
@@ -201,7 +228,12 @@ class ReplicaSet:
     prompt); `start=True` spins each engine's loop thread, `start=False`
     leaves them for deterministic manual ticking (tests)."""
 
-    def __init__(self, engines: Iterable, start: bool = False):
+    def __init__(
+        self,
+        engines: Iterable,
+        start: bool = False,
+        roles: Optional[Sequence[str]] = None,
+    ):
         engines = list(engines)
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
@@ -211,18 +243,33 @@ class ReplicaSet:
                 f"replicas must share one block_size (router keys and "
                 f"engine keys agree by construction), got {sorted(sizes)}"
             )
+        if roles is not None and len(list(roles)) != len(engines):
+            raise ValueError(
+                f"roles ({len(list(roles))}) must match engines "
+                f"({len(engines)}) one-to-one"
+            )
         self.block_size = engines[0].block_size
         self._next_ordinal = 0
         self.handles: List[ReplicaHandle] = []
-        for engine in engines:
-            self._add_handle(engine)
+        for i, engine in enumerate(engines):
+            self._add_handle(
+                engine,
+                role=(
+                    roles[i] if roles is not None
+                    else constants.REPLICA_ROLE_UNIFIED
+                ),
+            )
         if start:
             for h in self.handles:
                 h.engine.start()
 
-    def _add_handle(self, engine) -> ReplicaHandle:
+    def _add_handle(
+        self, engine, role: str = constants.REPLICA_ROLE_UNIFIED
+    ) -> ReplicaHandle:
         handle = ReplicaHandle(
-            f"{constants.REPLICA_ID_PREFIX}{self._next_ordinal}", engine
+            f"{constants.REPLICA_ID_PREFIX}{self._next_ordinal}",
+            engine,
+            role=role,
         )
         self._next_ordinal += 1
         self.handles.append(handle)
@@ -238,7 +285,13 @@ class ReplicaSet:
     def active_handles(self) -> List[ReplicaHandle]:
         return [h for h in self.handles if h.admitting]
 
-    def add(self, engine, start: bool = False, prewarm: bool = True) -> ReplicaHandle:
+    def add(
+        self,
+        engine,
+        start: bool = False,
+        prewarm: bool = True,
+        role: str = constants.REPLICA_ROLE_UNIFIED,
+    ) -> ReplicaHandle:
         """Register a new replica (the CREATE step of the move protocol:
         grow the fleet first, then drain the source into it).
 
@@ -255,7 +308,7 @@ class ReplicaSet:
                 f"new replica block_size {engine.block_size} != fleet "
                 f"block_size {self.block_size}"
             )
-        handle = self._add_handle(engine)
+        handle = self._add_handle(engine, role=role)
         pw = getattr(engine, "prewarm_from_store", None)
         if prewarm and pw is not None:
             try:

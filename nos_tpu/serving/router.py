@@ -156,15 +156,35 @@ class PrefixRouter:
         prompt: Sequence[int],
         tenant: Optional[str] = None,
         exclude=None,
+        phase: Optional[str] = None,
     ) -> ReplicaHandle:
         """Pick (and account) the destination replica for `prompt`
         without submitting — the placement half of `submit`, also used
         by the drain controller and the fleet supervisor to re-home
         extracted/failed-over work. `exclude` masks one handle or an
         iterable of handles (the draining source before its state
-        flips; the set of destinations a failover already saw fail)."""
+        flips; the set of destinations a failover already saw fail).
+
+        `phase` is the disaggregation axis (constants.ROUTER_PHASES,
+        docs/disaggregation.md): the SECOND routing decision. With
+        `phase="prefill"` only prefill/unified-role replicas are
+        candidates and the scoring prefers free prefill budget (the
+        backlog a new prompt would queue behind is double-weighted);
+        with `phase="decode"` only decode/unified replicas are
+        candidates under the existing device-then-store hit scoring (a
+        handoff's KV is in the shared store, so decode placement lands
+        where the radix shadow or store continuation says the bytes
+        already are). `phase=None` is the pre-disaggregation select,
+        byte-for-byte: every admitting replica, one scoring."""
+        if phase is not None and phase not in constants.ROUTER_PHASES:
+            raise ValueError(
+                f"unknown routing phase {phase!r}; "
+                f"expected one of {constants.ROUTER_PHASES} or None"
+            )
         with self._lock:
-            handle, keys, hit_tokens = self._select_locked(prompt, tenant, exclude)
+            handle, keys, hit_tokens = self._select_locked(
+                prompt, tenant, exclude, phase
+            )
             handle.note_routed(keys, prompt)
             self.routed_requests += 1
             self.predicted_hit_tokens += hit_tokens
@@ -183,28 +203,44 @@ class PrefixRouter:
             return frozenset({id(exclude)})
         return frozenset(id(h) for h in exclude)
 
-    def _candidates(self, exclude=None) -> List[ReplicaHandle]:
+    def _candidates(self, exclude=None, phase=None) -> List[ReplicaHandle]:
         excluded = self._excluded_set(exclude)
         active = [
             h
             for h in self.replica_set.handles
-            if h.admitting and id(h) not in excluded
+            if h.admitting
+            and id(h) not in excluded
+            and h.serves_phase(phase)
         ]
         if not active:
+            if phase is not None:
+                raise RuntimeError(
+                    f"no admitting {phase}-capable replica "
+                    f"({phase}/unified roles all draining/retired/"
+                    "unhealthy/excluded): cannot route"
+                )
             raise RuntimeError(
                 "no admitting replica (all draining/retired/unhealthy): "
                 "cannot route"
             )
         return active
 
-    @staticmethod
-    def _safe_load(handle: ReplicaHandle) -> Optional[float]:
+    def _safe_load(
+        self, handle: ReplicaHandle, phase: Optional[str] = None
+    ) -> Optional[float]:
         """A candidate's load score, or None when its probe raises —
         an unreachable replica must not take scoring down with it (the
         supervisor's health machine will demote it on its own probe
-        cadence; here it simply stops being a candidate)."""
+        cadence; here it simply stops being a candidate).
+
+        For `phase="prefill"` the prefill backlog is counted a second
+        time: a prefill placement queues behind exactly that backlog
+        before its own chunks run, so "free prefill budget" dominates
+        the penalty where decode placement weighs backlog only as
+        generic busyness. One probe either way — the phase changes the
+        arithmetic, not the read."""
         try:
-            return handle.load()
+            p = handle.probe()
         except Exception as exc:
             logger.warning(
                 "router: load probe of %s failed (%s); skipping candidate",
@@ -212,16 +248,26 @@ class PrefixRouter:
                 classify_fault(exc),
             )
             return None
+        backlog = p[constants.PROBE_KEY_PREFILL_BACKLOG]
+        load = (
+            p[constants.PROBE_KEY_ACTIVE_SLOTS]
+            + p[constants.PROBE_KEY_QUEUED_REQUESTS]
+            + backlog / max(1, self.block_size)
+        )
+        if phase == constants.ROUTER_PHASE_PREFILL:
+            load += backlog / max(1, self.block_size)
+        return load
 
     def _select_locked(
         self,
         prompt: Sequence[int],
         tenant: Optional[str],
         exclude,
+        phase: Optional[str] = None,
     ) -> Tuple[ReplicaHandle, List[str], int]:
         """Returns (handle, the prompt's cacheable chain keys, predicted
         hit tokens — deepest-tree-match). Caller holds the lock."""
-        active = self._candidates(exclude)
+        active = self._candidates(exclude, phase)
         # The same below-the-last-token cap admission applies (ONE
         # shared helper — router and engine can never disagree on it):
         # the final block is always recomputed privately, so it can
@@ -246,7 +292,7 @@ class PrefixRouter:
         store_run = 0
         scored = []
         for h in active:
-            load = self._safe_load(h)
+            load = self._safe_load(h, phase)
             if load is None:
                 continue  # unreachable probe: not a candidate this round
             hit = h.shadow_hit_tokens(prompt)

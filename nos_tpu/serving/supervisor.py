@@ -97,7 +97,21 @@ SITE_PROBE = "probe"
 SITE_SUBMIT = "submit"
 SITE_TRANSFER_IN = "transfer_in"
 SITE_DRAIN_EXTRACT = "drain_extract"
-REPLICA_SITES = (SITE_PROBE, SITE_SUBMIT, SITE_TRANSFER_IN, SITE_DRAIN_EXTRACT)
+# Phase-handoff boundaries (docs/disaggregation.md): the source-side
+# publish of a finished prefill's KV chain into the fleet store, and
+# the destination-side checkpoint revive. Each is a distinct failure
+# surface — source death mid-publish vs destination death mid-revive —
+# and the chaos suite injects at each independently.
+SITE_HANDOFF_PUBLISH = "handoff_publish"
+SITE_HANDOFF_REVIVE = "handoff_revive"
+REPLICA_SITES = (
+    SITE_PROBE,
+    SITE_SUBMIT,
+    SITE_TRANSFER_IN,
+    SITE_DRAIN_EXTRACT,
+    SITE_HANDOFF_PUBLISH,
+    SITE_HANDOFF_REVIVE,
+)
 
 #: Kinds a ReplicaFaultSpec may inject: a transient blip (the wrapper's
 #: backoff retries it) or hard unreachability (the wrapper escalates).
@@ -812,8 +826,17 @@ class FleetSupervisor:
         tried: List[ReplicaHandle] = [src]
         while True:
             try:
+                # A failed-over stream resumes DECODING (its prefill —
+                # original or replayed — runs wherever it lands), so the
+                # placement is a decode-phase decision: decode/unified
+                # roles only, device-then-store hit scoring. On an
+                # all-unified fleet this is byte-identical to the
+                # pre-disaggregation select.
                 dst = self.router.select(
-                    ck.replay_prompt(), tenant=ck.tenant, exclude=tried
+                    ck.replay_prompt(),
+                    tenant=ck.tenant,
+                    exclude=tried,
+                    phase=constants.ROUTER_PHASE_DECODE,
                 )
             except RuntimeError:
                 return None
@@ -875,6 +898,88 @@ class FleetSupervisor:
                 self.metrics.inc("nos_tpu_fleet_replica_deaths")
             self._event_locked(constants.FLEET_EV_DEATH, replica=replica_id, streak=0)
             return self._fail_over_locked(handle)
+
+    # -- stream tracking for out-of-band ingress ------------------------------
+    def track_stream(
+        self,
+        handle: ReplicaHandle,
+        prompt: Sequence[int],
+        max_new: int,
+        tenant: Optional[str],
+        future: Future,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        """Track a stream submitted to `handle` OUTSIDE supervisor
+        .submit (the disaggregation coordinator's prefill-phase
+        ingress, serving/disagg.py): the failover walk covers it from
+        admission — a replica dying with this stream pre-checkpoint
+        resolves it classified-with-request, never a hang."""
+        with self._lock:
+            self._streams.setdefault(handle.replica_id, {})[id(future)] = (
+                _TrackedStream(
+                    prompt=list(prompt),
+                    max_new=max_new,
+                    tenant=tenant,
+                    future=future,
+                    trace_id=trace_id,
+                )
+            )
+
+    def untrack_stream(self, replica_id: str, future: Future) -> None:
+        """Withdraw a stream from `replica_id`'s tracking tables — the
+        handoff coordinator owns it for the duration of the transfer
+        window, so a concurrent failover of the source must not ALSO
+        try to resolve it (the at-most-once ownership rule:
+        docs/disaggregation.md, failure matrix)."""
+        with self._lock:
+            key = id(future)
+            streams = self._streams.get(replica_id)
+            if streams:
+                streams.pop(key, None)
+            cks = self._checkpoints.get(replica_id)
+            if cks:
+                cks.pop(key, None)
+
+    def adopt_stream(
+        self,
+        dst: ReplicaHandle,
+        ck: SlotCheckpoint,
+        src: Optional[ReplicaHandle] = None,
+    ) -> None:
+        """Register a stream that arrived on `dst` OUTSIDE the
+        supervised submit path (a phase handoff — the coordinator in
+        serving/disagg.py placed the source's checkpoint here): tracked
+        under the destination exactly like a submit-time stream, so a
+        later `dst` death re-homes or classifies it through the same
+        failover walk. The checkpoint rides along as the stream's
+        newest capture — a death BEFORE dst's first burst-boundary
+        checkpoint still re-homes from the handoff image instead of
+        erroring as never-checkpointed. Passing `src` completes the
+        ownership transfer: the stream leaves the source's tables in
+        the same locked step it enters the destination's. A stream
+        already resolved (or detached from any client future) has
+        nothing to track."""
+        if ck.future is None:
+            return
+        with self._lock:
+            key = id(ck.future)
+            if src is not None:
+                streams = self._streams.get(src.replica_id)
+                if streams:
+                    streams.pop(key, None)
+                cks = self._checkpoints.get(src.replica_id)
+                if cks:
+                    cks.pop(key, None)
+            if ck.future.done():
+                return
+            self._streams.setdefault(dst.replica_id, {})[key] = _TrackedStream(
+                prompt=list(ck.prompt),
+                max_new=ck.max_new,
+                tenant=ck.tenant,
+                future=ck.future,
+                trace_id=ck.trace_id,
+            )
+            self._checkpoints.setdefault(dst.replica_id, {})[key] = ck
 
     # -- background cadence ---------------------------------------------------
     def start(self) -> "FleetSupervisor":

@@ -197,6 +197,35 @@ class ServingReport:
     futures_errored: int = 0
     failover_latency_p50_s: float = 0.0
     failover_latency_p95_s: float = 0.0
+    # Phase-disaggregated handoff plane (nos_tpu/serving/disagg.py,
+    # docs/disaggregation.md): prefill-complete slots EXPORTED by a
+    # prefill-role engine (checkpoint captured, chain published,
+    # slot released), checkpoints INGESTED by a decode-role engine
+    # through transfer_in_checkpoint, KV blocks force-published at the
+    # export point, prompt tokens the destination REVIVED from store
+    # payloads instead of recomputing (the "shipped, not replayed"
+    # witness — an export whose destination recomputed shows up as
+    # handoff_exports > 0 with revived tokens ~0), completed handoffs
+    # seen by the coordinator, destination re-routes after a mid-revive
+    # death, and handoffs resolved with a classified error (no
+    # survivor). Latency percentiles re-derive from pooled samples
+    # (export capture -> destination accepted), same contract as
+    # failover latency.
+    handoff_exports: int = 0
+    handoff_ingests: int = 0
+    handoff_published_blocks: int = 0
+    handoff_revived_tokens: int = 0
+    handoffs: int = 0
+    handoff_reroutes: int = 0
+    handoffs_errored: int = 0
+    handoff_latency_p50_s: float = 0.0
+    handoff_latency_p95_s: float = 0.0
+    # Total wall seconds spent inside handoffs (export capture ->
+    # destination accepted), summed across replicas by `merge` (a
+    # MERGE_FLOAT_FIELDS member): the in-transfer exposure window the
+    # failover machinery must cover, as an accumulated-seconds quantity
+    # beside the per-handoff percentiles above.
+    handoff_wall_s: float = 0.0
     # Decoupled-round shape: ticks that dispatched a verify AND a macro
     # window (neighbors kept the pipeline while a slot speculated), and
     # the per-slot split totals.
@@ -254,6 +283,7 @@ class ServingReport:
     queue_wait_samples: List[float] = field(default_factory=list)
     restore_latency_samples: List[float] = field(default_factory=list)
     failover_latency_samples: List[float] = field(default_factory=list)
+    handoff_latency_samples: List[float] = field(default_factory=list)
     # Tick-phase profiler (PR 9, nos_tpu/tracing.py, docs/tracing.md):
     # profiled engine ticks, total measured wall, the per-tick
     # host-overhead vs dispatch split (dispatch = wall inside jitted-call
@@ -314,6 +344,7 @@ class ServingReport:
             ("queue_wait", merged.queue_wait_samples),
             ("restore_latency", merged.restore_latency_samples),
             ("failover_latency", merged.failover_latency_samples),
+            ("handoff_latency", merged.handoff_latency_samples),
             ("host_overhead", merged.host_overhead_samples),
             ("dispatch", merged.dispatch_samples),
         ):
@@ -342,6 +373,7 @@ MERGE_FLOAT_FIELDS = (
     "tick_dispatch_s",
     "tick_host_overhead_s",
     "slot_seconds_total",
+    "handoff_wall_s",
 )
 
 #: ServingReport integer fields that are POINT-IN-TIME gauges, not
@@ -502,6 +534,14 @@ def collect_serving(server) -> ServingReport:
         ),
         futures_failed_over=int(getattr(server, "futures_failed_over", 0)),
         futures_errored=int(getattr(server, "futures_errored", 0)),
+        handoff_exports=int(getattr(server, "handoff_exports", 0)),
+        handoff_ingests=int(getattr(server, "handoff_ingests", 0)),
+        handoff_published_blocks=int(
+            getattr(server, "handoff_published_blocks", 0)
+        ),
+        handoff_revived_tokens=int(
+            getattr(server, "handoff_revived_tokens", 0)
+        ),
         failover_latency_p50_s=percentile(failover, 50),
         failover_latency_p95_s=percentile(failover, 95),
         failover_latency_samples=[float(v) for v in failover],

@@ -1,0 +1,467 @@
+"""Phase-disaggregated serving (ISSUE 18 tentpole): replica roles, the
+router's second (phase-aware) routing decision, and the prefill->decode
+KV handoff over the fleet store (`serving/disagg.py`).
+
+The exactness bar is inherited: the handoff IS a SlotCheckpoint
+transfer whose KV rides the FleetKVStore, so disaggregated must equal
+colocated BIT-IDENTICALLY — greedy AND temperature — by the same
+oracle that proves spill-revive, drain, and failover. The counters
+must WITNESS the mechanism: handoff tokens revive from the store
+(`handoff_revived_tokens`), they are not silently recomputed. The
+in-transfer window is chaos-covered at both new supervised sites:
+source death mid-publish and destination death mid-revive each finish
+bit-identically on a survivor or resolve with a classified error
+CARRYING the request — never a hang — with `conserved()` holding on
+every surviving engine and the store.
+
+Two substrates, the supervisor-test pattern: stub engines for the
+role/phase routing mechanics, real DecodeServer fleets (shared tiny
+serving model, manual ticking) for the handoff exactness oracles."""
+
+from concurrent.futures import Future
+
+import jax
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.runtime.faults import (
+    FAULT_REPLICA_UNREACHABLE,
+    ReplicaLostError,
+)
+from nos_tpu.serving import (
+    FleetKVStore,
+    FleetSupervisor,
+    HandoffCoordinator,
+    PrefixRouter,
+    ReplicaFaultInjector,
+    ReplicaFaultSpec,
+    ReplicaSet,
+)
+from nos_tpu.serving.supervisor import (
+    REPLICA_SITES,
+    SITE_HANDOFF_PUBLISH,
+    SITE_HANDOFF_REVIVE,
+)
+from nos_tpu.telemetry import ServingReport
+from tests.conftest import serving_test_config
+from tests.test_block_manager import check_invariants
+
+CFG = serving_test_config()
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="handoff bit-exactness crosses program shapes: needs the "
+    "deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+# ---------------------------------------------------------------------------
+# Stub substrate (roles + phase routing mechanics)
+# ---------------------------------------------------------------------------
+class StubEngine:
+    block_size = 8
+
+    def __init__(self, backlog=0):
+        self.backlog = backlog
+
+    def probe(self):
+        return {
+            constants.PROBE_KEY_ACTIVE_SLOTS: 0,
+            constants.PROBE_KEY_QUEUED_REQUESTS: 0,
+            constants.PROBE_KEY_PREFILL_BACKLOG: self.backlog,
+            constants.PROBE_KEY_DRAINING: False,
+            constants.PROBE_KEY_TP_DEVICES: 1,
+            constants.PROBE_KEY_SLOTS_TOTAL: 2,
+            constants.PROBE_KEY_KV_BLOCKS_TOTAL: 15,
+        }
+
+    def prefix_keys(self):
+        return frozenset()
+
+    def submit(self, prompt, max_new, tenant=None, trace_id=None):
+        return Future()
+
+    def stop(self, **kw):
+        pass
+
+
+def role_fleet(roles, backlogs=None):
+    engines = [
+        StubEngine(backlog=(backlogs[i] if backlogs else 0))
+        for i in range(len(roles))
+    ]
+    rs = ReplicaSet(engines, roles=roles)
+    return rs, PrefixRouter(rs)
+
+
+def test_replica_roles_validate_and_snapshot():
+    rs = ReplicaSet([StubEngine(), StubEngine()])
+    for h in rs.handles:
+        assert h.role == constants.REPLICA_ROLE_UNIFIED
+        assert h.serves_phase(None)
+        assert h.serves_phase(constants.ROUTER_PHASE_PREFILL)
+        assert h.serves_phase(constants.ROUTER_PHASE_DECODE)
+        assert h.snapshot()[constants.REPLICA_KEY_ROLE] == h.role
+    with pytest.raises(ValueError, match="role"):
+        ReplicaSet([StubEngine()], roles=["gpu"])
+    with pytest.raises(ValueError, match="roles"):
+        ReplicaSet([StubEngine()], roles=[constants.REPLICA_ROLE_PREFILL] * 2)
+    rs2, _ = role_fleet(
+        [constants.REPLICA_ROLE_PREFILL, constants.REPLICA_ROLE_DECODE]
+    )
+    pre, dec = rs2.handles
+    assert pre.serves_phase(constants.ROUTER_PHASE_PREFILL)
+    assert not pre.serves_phase(constants.ROUTER_PHASE_DECODE)
+    assert dec.serves_phase(constants.ROUTER_PHASE_DECODE)
+    assert not dec.serves_phase(constants.ROUTER_PHASE_PREFILL)
+    # None = the pre-disaggregation select: every role is a candidate.
+    assert pre.serves_phase(None) and dec.serves_phase(None)
+
+
+def test_router_phase_filters_candidates():
+    rs, router = role_fleet(
+        [
+            constants.REPLICA_ROLE_PREFILL,
+            constants.REPLICA_ROLE_DECODE,
+            constants.REPLICA_ROLE_UNIFIED,
+        ]
+    )
+    pre, dec, uni = rs.handles
+    prompt = list(range(1, 17))
+    for _ in range(4):
+        assert router.select(prompt, phase=constants.ROUTER_PHASE_PREFILL) in (
+            pre,
+            uni,
+        )
+        assert router.select(prompt, phase=constants.ROUTER_PHASE_DECODE) in (
+            dec,
+            uni,
+        )
+    # Unknown phase is a caller bug, loudly.
+    with pytest.raises(ValueError, match="phase"):
+        router.select(prompt, phase="verify")
+    # Excluding every phase-capable replica is the phase-shaped
+    # no-candidate error, naming the phase.
+    with pytest.raises(RuntimeError, match="prefill-capable"):
+        router.select(
+            prompt, exclude=[pre, uni], phase=constants.ROUTER_PHASE_PREFILL
+        )
+    # phase=None still sees the whole fleet.
+    assert router.select(prompt) in (pre, dec, uni)
+
+
+def test_router_prefill_phase_prefers_free_prefill_budget():
+    """The second decision's scoring half: two prefill-capable
+    replicas, one buried under a 4k-token admission backlog — the
+    prefill placement must land on the free one (the backlog is
+    double-weighted for phase="prefill"), while the decode placement
+    over the same pair is backlog-blind enough to keep alternating."""
+    rs, router = role_fleet(
+        [constants.REPLICA_ROLE_PREFILL, constants.REPLICA_ROLE_PREFILL],
+        backlogs=[4096, 0],
+    )
+    buried, free = rs.handles
+    prompt = list(range(1, 17))
+    for _ in range(4):
+        assert (
+            router.select(prompt, phase=constants.ROUTER_PHASE_PREFILL)
+            is free
+        )
+
+
+def test_handoff_sites_registered():
+    assert SITE_HANDOFF_PUBLISH in REPLICA_SITES
+    assert SITE_HANDOFF_REVIVE in REPLICA_SITES
+    # Injectable like any other site.
+    ReplicaFaultSpec(
+        "replica-0",
+        SITE_HANDOFF_PUBLISH,
+        1,
+        kind=FAULT_REPLICA_UNREACHABLE,
+        persistent=True,
+    )
+
+
+def test_handoff_report_merges_pooled():
+    """Coordinator counters pool per the merge contract: counts sum,
+    `handoff_wall_s` sums (MERGE_FLOAT_FIELDS), and the latency
+    percentiles RE-DERIVE from pooled samples — not from either
+    side's pre-computed percentile."""
+    a = ServingReport(
+        replicas=0,
+        handoffs=2,
+        handoff_reroutes=1,
+        handoff_wall_s=0.5,
+        handoff_latency_p95_s=1.0,
+        handoff_latency_samples=[1.0, 1.0],
+    )
+    b = ServingReport(
+        replicas=1,
+        handoffs=1,
+        handoffs_errored=1,
+        handoff_wall_s=0.25,
+        handoff_latency_p95_s=9.0,
+        handoff_latency_samples=[9.0],
+    )
+    m = ServingReport.merge([a, b])
+    assert m.handoffs == 3 and m.handoff_reroutes == 1
+    assert m.handoffs_errored == 1
+    assert m.handoff_wall_s == pytest.approx(0.75)
+    assert sorted(m.handoff_latency_samples) == [1.0, 1.0, 9.0]
+    assert m.handoff_latency_p95_s == pytest.approx(9.0)
+    assert m.handoff_latency_p50_s == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Real-engine substrate
+# ---------------------------------------------------------------------------
+def make_engine(params, store=None, **kw):
+    defaults = dict(
+        n_slots=2, max_len=64, prompt_buckets=(8, 16), block_size=8,
+        total_blocks=1 + 8, seed=11,
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, kv_store=store, **defaults)
+
+
+PROMPTS = [
+    [4, 9, 2, 33, 7, 1, 8, 5, 12, 13, 14, 15, 16, 17, 18, 19],
+    [40, 41, 42, 43, 44, 45, 46, 47],
+    [9, 8, 7, 6, 5, 4, 3, 2, 1, 96, 95, 94, 93, 92, 91, 90],
+]
+MAX_NEW = 8
+
+
+def drive(rs, pred, downed=(), sup=None, n=2000):
+    """Deterministic manual ticking: a downed replica simply stops
+    being ticked — what host death looks like from the survivors."""
+    for _ in range(n):
+        for h in rs.handles:
+            if (
+                h.state == constants.REPLICA_STATE_ACTIVE
+                and h.replica_id not in downed
+                and h.engine._thread is None
+            ):
+                h.engine._tick()
+        if sup is not None:
+            sup.probe()
+        if pred():
+            return True
+    return False
+
+
+_SOLO_REF_CACHE = {}
+
+
+def solo_reference(params, temperature):
+    """THE colocated oracle. All disagg traffic prefill-places onto the
+    single prefill replica in submission order, so its admission
+    serials match a solo engine's — greedy AND temperature compare
+    bit-for-bit against this one reference (cached per temperature:
+    it is deterministic, recomputation buys nothing)."""
+    if temperature in _SOLO_REF_CACHE:
+        return _SOLO_REF_CACHE[temperature]
+    eng = make_engine(params, temperature=temperature)
+    futs = [eng.submit(p, max_new=MAX_NEW) for p in PROMPTS]
+    for _ in range(3000):
+        if all(f.done() for f in futs):
+            break
+        eng._tick()
+    outs = [f.result(1) for f in futs]
+    eng.stop()
+    _SOLO_REF_CACHE[temperature] = outs
+    return outs
+
+
+def disagg_fleet(params, temperature, faults=()):
+    store = FleetKVStore(capacity_bytes=1 << 22)
+    engines = [
+        make_engine(params, store=store, temperature=temperature)
+        for _ in range(3)
+    ]
+    roles = [
+        constants.REPLICA_ROLE_PREFILL,
+        constants.REPLICA_ROLE_DECODE,
+        constants.REPLICA_ROLE_DECODE,
+    ]
+    rs = ReplicaSet(engines, roles=roles)
+    router = PrefixRouter(rs, kv_store=store)
+    inj = ReplicaFaultInjector(schedule=list(faults))
+    sup = FleetSupervisor(
+        rs, router, suspect_after=2, dead_after=3,
+        fault_injector=inj, sleep=lambda s: None,
+    )
+    coord = HandoffCoordinator(rs, router, supervisor=sup)
+    return store, rs, router, inj, sup, coord
+
+
+def surviving_conserved(rs, store):
+    assert store.conserved()
+    for h in rs.handles:
+        if h.state == constants.REPLICA_STATE_ACTIVE:
+            assert h.engine._block_mgr.conserved()
+            check_invariants(h.engine._block_mgr)
+
+
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_disaggregated_equals_colocated_bit_identical(params, temperature):
+    """THE disaggregation oracle: prefill on one replica, decode on
+    another, KV shipped through the fleet store — outputs equal the
+    colocated run bit-for-bit, and the counters witness that the
+    handoff tokens were REVIVED from the store, not recomputed."""
+    want = solo_reference(params, temperature)
+    store, rs, router, inj, sup, coord = disagg_fleet(params, temperature)
+    futs = [coord.submit(p, max_new=MAX_NEW) for p in PROMPTS]
+    assert drive(rs, lambda: all(f.done() for f in futs), sup=sup)
+    got = [f.result(1) for f in futs]
+    assert got == want  # bit-identical, phases disaggregated
+    pre = rs.handles[0].engine
+    decs = [h.engine for h in rs.handles[1:]]
+    assert coord.handoffs == len(PROMPTS)
+    assert coord.handoffs_errored == 0
+    assert pre.handoff_exports == len(PROMPTS)
+    assert pre.handoff_published_blocks > 0
+    assert sum(e.handoff_ingests for e in decs) == len(PROMPTS)
+    # The witness: decode-side prompt KV arrived by store revive.
+    assert sum(e.handoff_revived_tokens for e in decs) > 0
+    # The prefill replica never decoded a handed-off stream: its decode
+    # traffic is exactly the first token each capture materializes.
+    assert all(
+        ev["event"] == constants.FLEET_EV_HANDOFF for ev in coord.events
+    )
+    rep = coord.report()
+    assert rep.handoffs == len(PROMPTS)
+    assert len(rep.handoff_latency_samples) == len(PROMPTS)
+    assert rep.handoff_wall_s > 0
+    surviving_conserved(rs, store)
+    rs.stop()
+
+
+@cpu_only
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+@pytest.mark.parametrize(
+    "site,victim",
+    [
+        (SITE_HANDOFF_PUBLISH, "replica-0"),
+        (SITE_HANDOFF_REVIVE, "replica-1"),
+    ],
+)
+def test_handoff_in_transfer_death(params, temperature, site, victim):
+    """The in-transfer window, both halves: the source dying mid-publish
+    and the destination dying mid-revive. Every stream either finishes
+    BIT-IDENTICALLY on a survivor or resolves with a classified
+    ReplicaLostError carrying the request — never a hang — and
+    conservation holds on every surviving engine and the store."""
+    want = solo_reference(params, temperature)
+    store, rs, router, inj, sup, coord = disagg_fleet(
+        params,
+        temperature,
+        faults=[
+            ReplicaFaultSpec(
+                victim, site, 1,
+                kind=FAULT_REPLICA_UNREACHABLE, persistent=True,
+            )
+        ],
+    )
+    futs = [coord.submit(p, max_new=MAX_NEW) for p in PROMPTS]
+    downed = set()
+
+    def pred():
+        downed.update(inj.downed)  # a fired persistent spec = host death
+        return all(f.done() for f in futs)
+
+    assert drive(rs, pred, downed=downed, sup=sup)
+    n_match = n_classified = 0
+    for f, w in zip(futs, want):
+        try:
+            assert f.result(1) == w  # bit-identical through the death
+            n_match += 1
+        except ReplicaLostError as exc:
+            # Classified AND carrying the request for resubmit.
+            assert exc.prompt is not None and exc.max_new == MAX_NEW
+            n_classified += 1
+    assert n_match + n_classified == len(PROMPTS)
+    if site == SITE_HANDOFF_PUBLISH:
+        # The checkpoint in the coordinator's hand survives the source:
+        # at least the handed-off stream finishes on a survivor.
+        assert n_match >= 1
+        assert rs.get(victim).state == constants.REPLICA_STATE_RETIRED
+    else:
+        # Destination death is absorbed by reroute: nothing errors.
+        assert n_classified == 0 and n_match == len(PROMPTS)
+        assert coord.handoff_reroutes >= 1
+        assert any(
+            ev["event"] == constants.FLEET_EV_HANDOFF_REROUTE
+            for ev in coord.events
+        )
+    surviving_conserved(rs, store)
+    rs.stop()
+
+
+@cpu_only
+def test_handoff_no_decode_survivor_resolves_classified(params):
+    """Exhaustion terminus: every decode-capable replica is down, so
+    the handoff resolves the stream with a classified error carrying
+    the request — the failure matrix's never-hang guarantee."""
+    store = FleetKVStore(capacity_bytes=1 << 22)
+    engines = [make_engine(params, store=store) for _ in range(2)]
+    rs = ReplicaSet(
+        engines,
+        roles=[constants.REPLICA_ROLE_PREFILL, constants.REPLICA_ROLE_DECODE],
+    )
+    router = PrefixRouter(rs, kv_store=store)
+    inj = ReplicaFaultInjector(
+        schedule=[
+            ReplicaFaultSpec(
+                "replica-1", SITE_HANDOFF_REVIVE, 1,
+                kind=FAULT_REPLICA_UNREACHABLE, persistent=True,
+            )
+        ]
+    )
+    sup = FleetSupervisor(
+        rs, router, suspect_after=2, dead_after=3,
+        fault_injector=inj, sleep=lambda s: None,
+    )
+    coord = HandoffCoordinator(rs, router, supervisor=sup)
+    fut = coord.submit(PROMPTS[0], max_new=MAX_NEW)
+    downed = set()
+
+    def pred():
+        downed.update(inj.downed)
+        return fut.done()
+
+    assert drive(rs, pred, downed=downed, sup=sup)
+    with pytest.raises(ReplicaLostError) as ei:
+        fut.result(1)
+    assert ei.value.prompt == PROMPTS[0]
+    assert coord.handoffs_errored == 1
+    assert any(
+        ev["event"] == constants.FLEET_EV_HANDOFF_FAILED
+        for ev in coord.events
+    )
+    assert store.conserved()
+    rs.stop()
+
+
+@cpu_only
+def test_unified_fleet_handoff_marker_inert_without_coordinator(params):
+    """The opt-in law: a handoff-marked request on an engine with no
+    armed hook decodes in place (unified behavior) — the marker alone
+    changes nothing."""
+    eng = make_engine(params)
+    fut = eng.transfer_in_request(PROMPTS[0], max_new=MAX_NEW, handoff=True)
+    for _ in range(2000):
+        if fut.done():
+            break
+        eng._tick()
+    want = solo_reference(params, 0.0)[0]
+    assert fut.result(1) == want
+    assert eng.handoff_exports == 0
+    eng.stop()

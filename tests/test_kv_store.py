@@ -18,6 +18,7 @@ import random
 import threading
 
 import jax
+import numpy as np
 import pytest
 
 from nos_tpu import constants
@@ -248,6 +249,43 @@ def test_store_tier_take_reads_without_removing():
     t1.put("big", "pb", 1 << 11)
     assert t1.drops == 1
     assert t1.conserved() and t2.conserved()
+
+
+def test_store_tier_take_returns_readonly_views():
+    """Satellite: `take` must NOT eagerly copy the payload — it returns
+    read-only numpy views (zero-copy; the engine's device put is the
+    one real copy) — and the view discipline must leave the byte
+    balance and dedup/pin accounting exactly as before."""
+    store = FleetKVStore(capacity_bytes=1 << 12)
+    t1, t2 = StoreTier(store), StoreTier(store)
+    k = np.arange(32, dtype=np.float32).reshape(2, 16)
+    v = -np.arange(32, dtype=np.float32).reshape(2, 16)
+    t1.put("kv", (k, v), k.nbytes + v.nbytes, parent="", tokens=(1, 2))
+    got = t1.take("kv")
+    gk, gv = got
+    # Zero-copy: same buffer, not a materialized duplicate.
+    assert np.shares_memory(gk, k) and np.shares_memory(gv, v)
+    assert np.array_equal(gk, k) and np.array_equal(gv, v)
+    # Read-only: a consumer that wants bytes to scribble on must copy
+    # ON DEMAND — writing through the view would corrupt the shared
+    # resident payload for every other replica.
+    with pytest.raises(ValueError, match="read-only"):
+        gk[0, 0] = 99.0
+    own = gk.copy()
+    own[0, 0] = 99.0  # copy-on-demand: the copy is writable
+    assert store.get("kv")[0][0, 0] == 0.0  # resident payload untouched
+    # Accounting unchanged by the view discipline: one entry, its full
+    # byte charge, no residual pins, dedup still dedups.
+    assert store.entries == 1 and store.host_bytes == k.nbytes + v.nbytes
+    assert store.pinned_entries == 0
+    t2.put("kv", (k, v), k.nbytes + v.nbytes)
+    assert t2.store_dedup_hits == 1 and store.entries == 1
+    assert np.shares_memory(t2.take("kv")[0], k)  # same buffer for all readers
+    assert t1.revives == 1 and t1.store_hits == 1
+    assert t1.conserved() and t2.conserved() and store.conserved()
+    # Non-array payloads (unit tests, duck stand-ins) pass through.
+    t1.put("s", "plain", 8)
+    assert t1.take("s") == "plain"
 
 
 def test_store_tier_stage_discard_reset_release_only_own_pins():

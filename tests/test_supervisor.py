@@ -44,6 +44,8 @@ from nos_tpu.serving import (
 from nos_tpu.serving.supervisor import (
     REPLICA_SITES,
     SITE_DRAIN_EXTRACT,
+    SITE_HANDOFF_PUBLISH,
+    SITE_HANDOFF_REVIVE,
     SITE_PROBE,
     SITE_SUBMIT,
     SITE_TRANSFER_IN,
@@ -144,6 +146,8 @@ def test_replica_fault_spec_validation():
         SITE_SUBMIT,
         SITE_TRANSFER_IN,
         SITE_DRAIN_EXTRACT,
+        SITE_HANDOFF_PUBLISH,
+        SITE_HANDOFF_REVIVE,
     }
 
 
