@@ -621,6 +621,51 @@ def main() -> int:
         check_chip_block(
             f"multi_turn_chat[{tkey}].tree", tree.get("chip_accounting")
         )
+        # ISSUE 19: the spec-armed tree arm rides the greedy temperature
+        # (speculation is greedy-exact). The gate is exactness only —
+        # multi-turn generation is fresh content, so draft volume here is
+        # reported, not gated (templated_output gates the source A/B).
+        if tkey == "greedy":
+            if not arm.get("tree_spec_outputs_identical"):
+                failures.append(
+                    "multi_turn_chat[greedy]: spec-armed tree arm outputs "
+                    "differ from the spec-off tree arm"
+                )
+
+    # -- ISSUE 19: templated-output draft-source A/B -----------------------
+    spec = bench._templated_output(np, cfg, params)
+    spec_payload = json.dumps(spec, sort_keys=True)
+    spec_parsed = json.loads(spec_payload)
+    print(spec_payload)
+
+    if not spec_parsed["outputs_identical"]:
+        failures.append(
+            "templated_output: outputs differ across spec_off/history_only/"
+            "tree_fed arms (speculation broke greedy exactness)"
+        )
+    hist_rate = spec_parsed["arms"]["history_only"]["accepted_per_dispatch"]
+    tree_rate = spec_parsed["arms"]["tree_fed"]["accepted_per_dispatch"]
+    # Counter-primary ordering gate (PR 12 noise lesson — no wall-clock
+    # ratios): the repetitive boilerplate keeps history drafting
+    # profitable (> 1 accepted token per verify dispatch), and round 2's
+    # tree-stored continuation must beat self-lookup strictly.
+    if not hist_rate > 1.0:
+        failures.append(
+            "templated_output: history-only accepted/dispatch "
+            f"{hist_rate} not > 1.0 (prompt-lookup drafting unprofitable "
+            "on repetitive boilerplate)"
+        )
+    if not tree_rate > hist_rate:
+        failures.append(
+            "templated_output: tree-fed accepted/dispatch "
+            f"{tree_rate} not > history-only {hist_rate} (the stored "
+            "continuation did not out-draft self-lookup)"
+        )
+    if not spec_parsed["arms"]["tree_fed"]["spec_tree_rounds"]:
+        failures.append(
+            "templated_output: tree-fed arm never drafted from the tree "
+            "(the radix continuation probe never fired)"
+        )
 
     # -- ISSUE 18: phase disaggregation (colocated vs prefill/decode) ------
     # Needs its own config: the long prompt exceeds the serving cfg's
@@ -788,6 +833,14 @@ def main() -> int:
             f"{arm['tree']['ttft_p95_turn2_s']}s"
             for tkey, arm in chat_parsed["arms"].items()
         )
+        + "; templated output: accepted/dispatch "
+        f"{spec_parsed['arms']['history_only']['accepted_per_dispatch']} "
+        "history -> "
+        f"{spec_parsed['arms']['tree_fed']['accepted_per_dispatch']} "
+        "tree-fed (tok/s "
+        f"{spec_parsed['arms']['spec_off']['tok_s']} off / "
+        f"{spec_parsed['arms']['history_only']['tok_s']} history / "
+        f"{spec_parsed['arms']['tree_fed']['tok_s']} tree)"
         + "; disagg: "
         + ", ".join(
             f"{tkey} decode-during-prefill "

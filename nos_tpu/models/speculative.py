@@ -42,9 +42,24 @@ from nos_tpu.models.decode import init_paged_cache, paged_prefill_chunk
 from nos_tpu.models.gpt import GPTConfig
 
 
+#: Draft-source names (docs/speculation.md): the slot's own generated
+#: history (prompt-lookup) vs the radix tree's stored continuation.
+#: Module-level so the engine, telemetry, and tests never drift on the
+#: spelling.
+SOURCE_HISTORY = "history"
+SOURCE_TREE = "tree"
+
+#: source -> (rate attr, denied_until attr) on AdaptiveSpec. An unknown
+#: source is a KeyError — a programming error, not a runtime state.
+_SOURCE_ATTRS = {
+    SOURCE_HISTORY: ("rate", "denied_until"),
+    SOURCE_TREE: ("tree_rate", "tree_denied_until"),
+}
+
+
 @dataclass
 class AdaptiveSpec:
-    """Per-slot adaptive speculation controller (DecodeServer).
+    """Per-slot, PER-SOURCE adaptive speculation controller (DecodeServer).
 
     Speculation pays only when drafts get accepted: a verify window of W
     rows costs one dispatch whether 1 or W tokens come back, and a slot
@@ -61,56 +76,101 @@ class AdaptiveSpec:
         re-enters with fresh optimism afterwards (repetition is bursty:
         a stream that stopped repeating may start again).
 
+    The engine drafts from two sources — the radix tree's stored
+    continuation (SOURCE_TREE) and the slot's own prompt-lookup index
+    (SOURCE_HISTORY) — whose acceptance behavior is independent: traffic
+    can diverge from cached history while still repeating itself, or
+    vice versa. Each source therefore carries its OWN EWMA and cooldown,
+    and the controller demotes them independently; `observe`/`allowed`/
+    `cap` take a `source` argument defaulting to SOURCE_HISTORY (the
+    pre-tree call sites keep their exact semantics).
+
     Everything here is a pure function of the slot's OWN acceptance
     history, so adaptive windows never break the engine's determinism: a
     request's draft schedule does not depend on its co-tenants."""
 
     alpha: float = 0.5  # EWMA weight of the newest round
-    demote_below: float = 0.2  # EWMA floor; crossing it demotes the slot
+    demote_below: float = 0.2  # EWMA floor; crossing it demotes the source
     cooldown: int = 32  # generated tokens drafting stays denied after demotion
-    rate: float = 1.0  # optimistic start: first draft gets the full window
-    denied_until: int = 0  # drafting allowed once `generated` reaches this
+    rate: float = 1.0  # history source: optimistic start (full first window)
+    denied_until: int = 0  # history source: drafting allowed at this count
+    tree_rate: float = 1.0  # tree source EWMA (same dynamics, own state)
+    tree_denied_until: int = 0  # tree source cooldown threshold
 
-    def observe(self, drafted: int, accepted: int, generated: int) -> bool:
+    def observe(
+        self, drafted: int, accepted: int, generated: int,
+        source: str = SOURCE_HISTORY,
+    ) -> bool:
         """Fold one resolved verify round (`drafted` draft tokens sent,
-        `accepted` of them kept; `generated` = the slot's tokens so far).
-        Returns True when this round demoted the slot."""
+        `accepted` of them kept; `generated` = the slot's tokens so far)
+        into `source`'s EWMA. Returns True when this round demoted the
+        source."""
         if drafted <= 0:
             return False
-        self.rate += self.alpha * (accepted / drafted - self.rate)
-        if self.rate < self.demote_below:
-            self.denied_until = generated + self.cooldown
-            self.rate = 1.0  # fresh optimism when the cooldown expires
+        r_attr, d_attr = _SOURCE_ATTRS[source]
+        rate = getattr(self, r_attr)
+        rate += self.alpha * (accepted / drafted - rate)
+        if rate < self.demote_below:
+            setattr(self, d_attr, generated + self.cooldown)
+            setattr(self, r_attr, 1.0)  # fresh optimism after the cooldown
             return True
+        setattr(self, r_attr, rate)
         return False
 
-    def allowed(self, generated: int) -> bool:
-        return generated >= self.denied_until
+    def allowed(self, generated: int, source: str = SOURCE_HISTORY) -> bool:
+        _, d_attr = _SOURCE_ATTRS[source]
+        return generated >= getattr(self, d_attr)
 
-    def cap(self, k: int) -> int:
+    def cap(self, k: int, source: str = SOURCE_HISTORY) -> int:
         """Effective draft window: full `k` at rate 1.0, shrinking with the
-        EWMA, never below 1 (a 1-draft probe is how the rate recovers)."""
-        return max(1, min(k, int(round(k * self.rate))))
+        source's EWMA, never below 1 (a 1-draft probe is how the rate
+        recovers)."""
+        r_attr, _ = _SOURCE_ATTRS[source]
+        return max(1, min(k, int(round(k * getattr(self, r_attr)))))
+
+    def denial_margin(self, generated: int, sources: Sequence[str]) -> int:
+        """Tokens of guaranteed no-draft headroom: how many tokens this
+        slot can generate before the FIRST of `sources` leaves demotion
+        cooldown. 0 when any listed source is already allowed. The fused-
+        burst gate (DecodeServer._burst_plan) uses this to prove a burst
+        span cannot skip a draft probe: while every available source of
+        every slot is in cooldown, no draft is possible by construction."""
+        margin: Optional[int] = None
+        for source in sources:
+            _, d_attr = _SOURCE_ATTRS[source]
+            m = getattr(self, d_attr) - generated
+            margin = m if margin is None else min(margin, m)
+        return max(0, margin) if margin is not None else 0
 
     def snapshot(self, generated: int) -> Dict[str, float]:
         """Host-serializable controller state for a slot checkpoint
         (runtime/checkpoint.py). `denied_until` is stored RELATIVE to the
         slot's current generated count: a restored slot's count restarts
         at zero (the replayed tokens become prompt), so the absolute
-        threshold would silently extend or truncate the cooldown."""
+        threshold would silently extend or truncate the cooldown. The
+        shape stays a FLAT str->float dict — SlotCheckpoint shallow-copies
+        it with `dict(...)`, so nesting would alias mutable state across
+        checkpoint and live controller."""
         return {
             "rate": self.rate,
             "denied_for": max(0, self.denied_until - generated),
+            "tree_rate": self.tree_rate,
+            "tree_denied_for": max(0, self.tree_denied_until - generated),
         }
 
     @classmethod
     def restore(cls, snap: Dict[str, float]) -> "AdaptiveSpec":
         """Rebuild the controller from `snapshot()` output: same learned
-        acceptance EWMA, cooldown re-anchored at the restored slot's fresh
-        generated count."""
+        per-source acceptance EWMAs, cooldowns re-anchored at the restored
+        slot's fresh generated count. Pre-tree snapshots (no tree_* keys —
+        PR 6/14 checkpoints written before this PR) restore the tree
+        source to its fresh-optimism defaults, the same tolerated-absent
+        convention as SlotCheckpoint's trace_id."""
         spec = cls()
         spec.rate = float(snap.get("rate", 1.0))
         spec.denied_until = int(snap.get("denied_for", 0))
+        spec.tree_rate = float(snap.get("tree_rate", 1.0))
+        spec.tree_denied_until = int(snap.get("tree_denied_for", 0))
         return spec
 
 
@@ -144,20 +204,36 @@ class _LookupIndex:
     (inserted on the next extend), so a lookup never matches the suffix
     occurrence itself — bit-for-bit the semantics of the reference scan,
     without the per-round O(len(history)) walk that would otherwise
-    compete with the dispatch round trip on long contexts."""
+    compete with the dispatch round trip on long contexts.
 
-    def __init__(self, history: List[int], ngram: int):
+    The map is BOUNDED at `max_entries` distinct ngrams: each insertion
+    re-seats its key at the back of the dict (recency = latest stream
+    occurrence), and overflow evicts the front — the ngram whose last
+    occurrence is oldest. A long non-repeating stream therefore holds
+    per-slot index memory at O(max_entries) instead of O(generated), and
+    `extend` stays amortized O(new tokens) (one ordered-dict re-seat and
+    at most one eviction per token). Losing an evicted ngram only costs
+    a missed draft — a hint, never correctness — and the default cap
+    sits far above any window the acceptance EWMA keeps profitable."""
+
+    def __init__(self, history: List[int], ngram: int, max_entries: int = 4096):
         self.history = history  # shared alias; extend() appends to it
         self.ngram = ngram
+        self.max_entries = max_entries
         self.index: Dict[tuple, int] = {}
         self._indexed_through = 0  # ngrams ending strictly before this idx
         self._catch_up(len(history) - 1)
 
     def _catch_up(self, end_exclusive: int) -> None:
         """Insert every ngram ending at positions [..end_exclusive)."""
-        h, g = self.history, self.ngram
+        h, g, idx = self.history, self.ngram, self.index
         for j in range(max(self._indexed_through, g - 1), end_exclusive):
-            self.index[tuple(h[j - g + 1 : j + 1])] = j - g + 1
+            key = tuple(h[j - g + 1 : j + 1])
+            if key in idx:
+                del idx[key]  # re-seat at the back: recency order
+            idx[key] = j - g + 1
+            if len(idx) > self.max_entries:
+                del idx[next(iter(idx))]  # evict the least-recent ngram
         self._indexed_through = max(self._indexed_through, end_exclusive)
 
     def extend(self, tokens: Sequence[int]) -> None:

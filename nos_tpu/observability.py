@@ -62,6 +62,39 @@ METRIC_SERIES: Tuple[MetricSpec, ...] = (
     MetricSpec("nos_tpu_decode_macro_dispatches", "counter", "macro_dispatches"),
     MetricSpec("nos_tpu_decode_spec_rounds", "counter", "spec_rounds"),
     MetricSpec("nos_tpu_decode_spec_tokens_accepted", "counter", "spec_tokens_accepted"),
+    # Per-draft-source speculation series (docs/speculation.md): verify
+    # windows, accepted tokens, and demotions split by which source
+    # drafted the window — the radix tree's stored continuation vs the
+    # slot's own prompt-lookup history. Sources partition the totals
+    # (tree + history accepted == spec_tokens_accepted).
+    MetricSpec(
+        "nos_tpu_decode_draft_source_tree_rounds", "counter", "spec_tree_rounds"
+    ),
+    MetricSpec(
+        "nos_tpu_decode_draft_source_history_rounds",
+        "counter",
+        "spec_history_rounds",
+    ),
+    MetricSpec(
+        "nos_tpu_decode_draft_source_tree_accepted",
+        "counter",
+        "spec_tree_tokens_accepted",
+    ),
+    MetricSpec(
+        "nos_tpu_decode_draft_source_history_accepted",
+        "counter",
+        "spec_history_tokens_accepted",
+    ),
+    MetricSpec(
+        "nos_tpu_decode_draft_source_tree_demotions",
+        "counter",
+        "spec_tree_demotions",
+    ),
+    MetricSpec(
+        "nos_tpu_decode_draft_source_history_demotions",
+        "counter",
+        "spec_history_demotions",
+    ),
     MetricSpec("nos_tpu_decode_prefill_dispatches", "counter", "prefill_dispatches"),
     MetricSpec("nos_tpu_decode_prefill_tokens", "counter", "prefill_tokens"),
     MetricSpec(

@@ -326,6 +326,26 @@ class BlockManager:
                 host += 1
         return dev, host
 
+    def has_tree(self) -> bool:
+        """Whether the radix tree is armed — the engine's source-
+        availability test for cache-fed drafting (a flat-chain manager
+        has no continuation structure to probe)."""
+        return self._tree is not None
+
+    def draft_continuation(self, tokens: Sequence[int], k: int) -> List[int]:
+        """READ-ONLY draft probe: up to `k` tokens the radix tree stores
+        past the deepest node matching `tokens` — the cache-fed draft
+        source of docs/speculation.md. Empty in flat-chain mode.
+
+        Same no-touch contract as `peek_prefix`: no refcount bump, no
+        LRU reorder, no revive staging, no payload read. Continuation
+        nodes must be device-resident (`_on_device` is a plain dict
+        membership test); a spilled node ends the draft rather than
+        pulling tier traffic onto the speculation path."""
+        if self._tree is None or k <= 0:
+            return []
+        return self._tree.continuation(tokens, self.block_size, self._on_device, k)
+
     def _on_device(self, key: str) -> bool:
         return key in self._prefix_index
 

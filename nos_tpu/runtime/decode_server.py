@@ -110,7 +110,13 @@ from nos_tpu.parallel.sharding import (
     shard_map_compat,
     shard_params,
 )
-from nos_tpu.models.speculative import AdaptiveSpec, _LookupIndex, accept_prefix
+from nos_tpu.models.speculative import (
+    SOURCE_HISTORY,
+    SOURCE_TREE,
+    AdaptiveSpec,
+    _LookupIndex,
+    accept_prefix,
+)
 from nos_tpu.runtime.block_manager import BlockManager
 from nos_tpu.runtime.checkpoint import SlotCheckpoint
 from nos_tpu.runtime.faults import (
@@ -292,6 +298,27 @@ class _PendingVerify:
 
     preds: _TokRef  # [n_slots, spec_k+1] int32, on device until resolved
     windows: Dict[int, list]  # drafting slot idx -> its dispatched window
+    # drafting slot idx -> which source produced its draft (SOURCE_TREE /
+    # SOURCE_HISTORY) — acceptance must credit, and demote, the source
+    # that actually drafted the window.
+    sources: Dict[int, str]
+
+
+#: Draft-source -> its telemetry series (rounds, accepted tokens,
+#: demotions), spelled as LITERALS so the NOS022 schema lint can check
+#: each name against observability.METRIC_SERIES.
+_DRAFT_SOURCE_METRICS = {
+    SOURCE_TREE: (
+        "nos_tpu_decode_draft_source_tree_rounds",
+        "nos_tpu_decode_draft_source_tree_accepted",
+        "nos_tpu_decode_draft_source_tree_demotions",
+    ),
+    SOURCE_HISTORY: (
+        "nos_tpu_decode_draft_source_history_rounds",
+        "nos_tpu_decode_draft_source_history_accepted",
+        "nos_tpu_decode_draft_source_history_demotions",
+    ),
+}
 
 
 class DecodeServer:
@@ -313,6 +340,7 @@ class DecodeServer:
         spec_k: int = 0,
         spec_ngram: int = 3,
         spec_sync: bool = False,
+        spec_tree_drafts: bool = True,
         prefill_budget_tokens: Optional[int] = None,
         prefix_cache: bool = True,
         radix_cache: bool = True,
@@ -372,7 +400,11 @@ class DecodeServer:
         recovery/migration semantics see the per-tick engine they were
         built against (checkpoints reconstruct at burst boundaries from
         the same refs as ever). Speculative engines (spec_k > 0) keep
-        per-tick scheduling: the draft probe is host-side by nature.
+        per-tick scheduling — the draft probe is host-side by nature —
+        with ONE exception: while every active slot's controller holds
+        every available draft source in demotion cooldown, no draft is
+        possible by construction and bursts resume, capped to end at
+        the earliest cooldown expiry (see _burst_plan).
 
         `block_size`/`total_blocks` size the paged KV pool. The default pool
         (n_slots x ceil(max_len/block_size) + scratch) matches the dense
@@ -384,31 +416,39 @@ class DecodeServer:
 
         `spec_k` > 0 enables SPECULATIVE decoding inside the continuous
         batch (greedy only — acceptance is exact-match, so temperature must
-        be 0): each slot keeps a host-side prompt-lookup index
-        (models/speculative.py), and every tick PARTITIONS the active
-        slots into a drafting set and a macro set. Slots whose lookup
-        found a draft verify it through one `paged_verify_window`
-        dispatch (active mask covers ONLY them; up to spec_k+1 tokens per
-        slot per round); every other active slot runs the normal K-step
-        macro program in the SAME tick — both programs device-ordered on
-        the shared donated cache over disjoint slot sets, so a repetitive
-        stream speculates while its neighbors keep the full pipeline.
-        The verify read is OFF the critical path: predictions stay on
-        device as a _TokRef and acceptance resolves on a later tick while
-        macro dispatches continue, blocking only when the drafting slots
-        are the engine's sole possible progress. Each slot also carries an
-        AdaptiveSpec controller (acceptance-rate EWMA): the draft window
-        shrinks as acceptance decays and the slot is DEMOTED back to the
-        macro path (cooldown, then re-probe) when drafts stop paying, so
-        a stream that stops repeating stops taxing itself. Outputs remain
-        bit-identical to spec_k=0 greedy decoding (same argmax chain,
-        modulo exact logit ties — see models/speculative.py module
-        docstring). Draft detection needs the host to SEE generated
-        tokens, so spec mode clamps the pipeline depth like eos does;
-        `spec_sync=True` additionally syncs histories (blocking) before
-        every drafts probe — deterministic speculation scheduling, the
-        right choice when dispatch latency is negligible (a locally
-        attached chip) or draft reactivity beats pipelining.
+        be 0): each slot drafts from TWO sources (docs/speculation.md) —
+        the radix tree's stored continuation past the slot's
+        prompt+generated suffix (`spec_tree_drafts`, a read-only
+        no-LRU-touch probe of the cache: what an earlier request
+        generated after this exact prefix IS a draft, for zero extra
+        FLOPs) with the slot's host-side prompt-lookup index
+        (models/speculative.py) as the fallback — and every tick
+        PARTITIONS the active slots into a drafting set and a macro set.
+        Slots whose probe found a draft verify it through one
+        `paged_verify_window` dispatch (active mask covers ONLY them; up
+        to spec_k+1 tokens per slot per round); every other active slot
+        runs the normal K-step macro program in the SAME tick — both
+        programs device-ordered on the shared donated cache over
+        disjoint slot sets, so a repetitive stream speculates while its
+        neighbors keep the full pipeline. The verify read is OFF the
+        critical path: predictions stay on device as a _TokRef and
+        acceptance resolves on a later tick while macro dispatches
+        continue, blocking only when the drafting slots are the engine's
+        sole possible progress. Each slot also carries an AdaptiveSpec
+        controller with a PER-SOURCE acceptance-rate EWMA: the draft
+        window shrinks as the drafting source's acceptance decays and
+        that source is DEMOTED (cooldown, then re-probe) when its drafts
+        stop paying — a slot whose traffic diverges from cached history
+        loses tree drafting but keeps self-lookup, and vice versa.
+        Outputs remain bit-identical to spec_k=0 greedy decoding
+        regardless of source (same argmax chain, modulo exact logit ties
+        — see models/speculative.py module docstring). Draft detection
+        needs the host to SEE generated tokens, so spec mode clamps the
+        pipeline depth like eos does; `spec_sync=True` additionally
+        syncs histories (blocking) before every drafts probe —
+        deterministic speculation scheduling, the right choice when
+        dispatch latency is negligible (a locally attached chip) or
+        draft reactivity beats pipelining.
 
         NEIGHBOR PENALTY, FIXED (ADVICE r5 -> decoupled verify): verify
         rounds used to be BATCH-wide — while any slot held a draft, every
@@ -815,6 +855,18 @@ class DecodeServer:
         self.spec_rounds = 0
         self.spec_tokens_accepted = 0
         self.spec_demotions = 0
+        # Per-draft-source accounting (docs/speculation.md): verify
+        # windows drafted, tokens accepted, and demotions by which source
+        # produced the draft — the radix tree's stored continuation vs
+        # the slot's own prompt-lookup history. Sources partition the
+        # totals: tree+history rounds = verify windows dispatched, and
+        # tree+history accepted = spec_tokens_accepted.
+        self.spec_tree_rounds = 0
+        self.spec_history_rounds = 0
+        self.spec_tree_tokens_accepted = 0
+        self.spec_history_tokens_accepted = 0
+        self.spec_tree_demotions = 0
+        self.spec_history_demotions = 0
         self.macro_dispatches = 0
         # Ticks that dispatched BOTH a verify round and a macro window —
         # the direct witness that a speculating slot did not stall its
@@ -890,6 +942,9 @@ class DecodeServer:
         self.spec_k = max(0, int(spec_k))
         self.spec_ngram = int(spec_ngram)
         self.spec_sync = bool(spec_sync)
+        # Cache-fed drafting rides the radix tree; False keeps the
+        # history-only drafting of PR 3 (the bench A/B arm).
+        self.spec_tree_drafts = bool(spec_tree_drafts)
         if self.spec_k > 0 and self.temperature > 0.0:
             raise ValueError(
                 "speculative decoding (spec_k > 0) is greedy-exact: "
@@ -2805,37 +2860,70 @@ class DecodeServer:
             slot.lookup.extend(new)  # appends to slot.history (shared alias)
         return len(slot.history) - len(slot.prompt) == len(slot.refs)
 
+    def _spec_sources(self) -> List[str]:
+        """Draft sources available on THIS engine, probe order first:
+        the radix tree's stored continuation (when the tree is armed and
+        `spec_tree_drafts` wants it), then the slot's own prompt-lookup
+        history — always available, always last (the fallback)."""
+        if self.spec_tree_drafts and self._block_mgr.has_tree():
+            return [SOURCE_TREE, SOURCE_HISTORY]
+        return [SOURCE_HISTORY]
+
     def _spec_drafts(self) -> dict:
-        """Non-blocking draft probe: {slot idx -> draft tokens} for slots
-        whose history is fully synced and whose lookup finds a repetition.
+        """Non-blocking draft probe: {slot idx -> (draft tokens, source)}
+        for slots whose history is fully synced and whose draft sources
+        find a continuation. Two sources, probed in order
+        (docs/speculation.md): the RADIX TREE's stored continuation past
+        the deepest node matching the slot's prompt+generated history —
+        what some earlier request (or this conversation's prior turn)
+        generated after this exact prefix, a read-only no-LRU-touch probe
+        (BlockManager.draft_continuation) — then the slot's own
+        `_LookupIndex` prompt-lookup when the tree has nothing. Either
+        way the draft flows through the SAME verify window, so exactness
+        never depends on which source spoke.
+
         Skips slots with a verify already in flight (they are waiting on
         that outcome) and slots whose AdaptiveSpec controller currently
-        denies drafting, so the (optionally blocking, spec_sync) history
-        pass touches exactly the slots that could draft this tick — never
-        the whole batch. Lag-tolerant by design: refs still in flight just
-        delay a draft by a tick, so non-repetitive traffic never leaves
-        the pipelined macro path."""
+        denies EVERY available source (sources demote independently: a
+        slot whose traffic diverged from cached history keeps drafting
+        from its own repetitions, and vice versa), so the (optionally
+        blocking, spec_sync) history pass touches exactly the slots that
+        could draft this tick — never the whole batch. Lag-tolerant by
+        design: refs still in flight just delay a draft by a tick, so
+        non-repetitive traffic never leaves the pipelined macro path."""
         drafts = {}
+        sources = self._spec_sources()
         for idx, slot in enumerate(self._slots):
             if not slot.active or slot.phase != "decoding":
                 continue  # prefilling slots are masked out of drafting too
             if slot.verifying or slot.remaining <= 1:
                 continue
-            if slot.adapt is not None and not slot.adapt.allowed(len(slot.refs)):
+            if slot.adapt is not None and not any(
+                slot.adapt.allowed(len(slot.refs), s) for s in sources
+            ):
                 continue
             if not self._sync_spec_history(idx, blocking=self.spec_sync):
                 continue
             # Cap: the round may emit at most `remaining` tokens, and the
             # window's last row must stay inside the slot's block
             # allocation (positions 0..prompt+max_new-2), hence -1. The
-            # adaptive controller shrinks the window further as the slot's
-            # acceptance EWMA decays.
-            cap = min(self.spec_k, slot.remaining - 1)
-            if slot.adapt is not None:
-                cap = min(cap, slot.adapt.cap(self.spec_k))
-            d = slot.lookup.draft(cap)
-            if d:
-                drafts[idx] = d
+            # adaptive controller shrinks the window further as the
+            # drafting source's acceptance EWMA decays.
+            base = min(self.spec_k, slot.remaining - 1)
+            for source in sources:
+                if slot.adapt is not None:
+                    if not slot.adapt.allowed(len(slot.refs), source):
+                        continue
+                    cap = min(base, slot.adapt.cap(self.spec_k, source))
+                else:
+                    cap = base
+                if source == SOURCE_TREE:
+                    d = self._block_mgr.draft_continuation(slot.history, cap)
+                else:
+                    d = slot.lookup.draft(cap)
+                if d:
+                    drafts[idx] = (d, source)
+                    break
         return drafts
 
     def _dispatch_verify(self, drafts: dict) -> None:
@@ -2854,15 +2942,25 @@ class DecodeServer:
         lengths = np.zeros((self.n_slots,), dtype=np.int32)
         active = np.zeros((self.n_slots,), dtype=bool)
         windows: Dict[int, list] = {}
-        for idx, draft in drafts.items():
+        sources: Dict[int, str] = {}
+        for idx, (draft, source) in drafts.items():
             slot = self._slots[idx]
             window = [slot.history[-1]] + draft[: max(0, slot.remaining - 1)]
             windows[idx] = window
+            sources[idx] = source
             tokens[idx, : len(window)] = window
             lengths[idx] = len(window)
             active[idx] = True
             slot.verifying = True
             self.spec_rounds_by_slot[idx] += 1
+            # Per-source round accounting: one "round" per drafting slot
+            # per dispatch (the window the source actually filled).
+            if source == SOURCE_TREE:
+                self.spec_tree_rounds += 1
+            else:
+                self.spec_history_rounds += 1
+            if self.metrics is not None:
+                self.metrics.inc(_DRAFT_SOURCE_METRICS[source][0])
             if self._tracer is not None and not slot.trace_decoding:
                 slot.trace_decoding = True
                 self._tracer.event(
@@ -2894,7 +2992,7 @@ class DecodeServer:
             self.metrics.inc("nos_tpu_decode_steps")
             self.metrics.inc("nos_tpu_decode_spec_rounds")
         self._pending_verifies.append(
-            _PendingVerify(_TokRef(preds_dev, self._syncs), windows)
+            _PendingVerify(_TokRef(preds_dev, self._syncs), windows, sources)
         )
 
     def _resolve_verifies(self, block: bool) -> None:
@@ -2944,6 +3042,11 @@ class DecodeServer:
             slot.remaining -= len(accepted)
             slot.lookup.extend(accepted)
             self.spec_tokens_accepted += len(accepted)
+            source = entry.sources.get(idx, SOURCE_HISTORY)
+            if source == SOURCE_TREE:
+                self.spec_tree_tokens_accepted += len(accepted)
+            else:
+                self.spec_history_tokens_accepted += len(accepted)
             if accepted:
                 tname = slot.tenant or ""
                 self.tokens_by_tenant[tname] = (
@@ -2962,11 +3065,24 @@ class DecodeServer:
                 self.metrics.inc(
                     "nos_tpu_decode_spec_tokens_accepted", len(accepted)
                 )
+                self.metrics.inc(
+                    _DRAFT_SOURCE_METRICS[source][1], len(accepted)
+                )
             if slot.adapt is not None and len(window) > 1:
+                # The acceptance outcome feeds — and can demote — exactly
+                # the source that drafted this window; the other source's
+                # EWMA is untouched (independent per-source controllers).
                 if slot.adapt.observe(
-                    len(window) - 1, len(accepted) - 1, len(slot.refs)
+                    len(window) - 1, len(accepted) - 1, len(slot.refs),
+                    source,
                 ):
                     self.spec_demotions += 1
+                    if source == SOURCE_TREE:
+                        self.spec_tree_demotions += 1
+                    else:
+                        self.spec_history_demotions += 1
+                    if self.metrics is not None:
+                        self.metrics.inc(_DRAFT_SOURCE_METRICS[source][2])
             scatter_rows.append(idx)
             scatter_vals.append(accepted[-1])
             if self.eos_id is not None and self.eos_id in accepted:
@@ -3557,8 +3673,17 @@ class DecodeServer:
         the per-tick engine the PR 6-8 recovery semantics were built
         against. The window count is capped at the work actually left
         (ceil(max remaining / K)), so lanes never coast through whole
-        trailing windows."""
-        if self.burst_windows <= 1 or self.spec_k > 0:
+        trailing windows.
+
+        A spec-armed engine (spec_k > 0) normally stays per-tick — the
+        draft probe is host-side by nature — EXCEPT while every active
+        slot's controller has EVERY available draft source in demotion
+        cooldown: no draft is possible by construction, so the macro
+        windows may fuse. The span is additionally capped so the burst
+        ends no later than the earliest cooldown expiry across slots
+        and sources (`AdaptiveSpec.denial_margin`): the first tick a
+        source could re-probe still sees the per-tick engine."""
+        if self.burst_windows <= 1:
             return 0
         if n_prefill or n_drafting or self._pending_verifies:
             return 0
@@ -3576,6 +3701,17 @@ class DecodeServer:
         if max_rem <= 0:
             return 0
         n = min(self.burst_windows, -(-max_rem // K))
+        if self.spec_k > 0:
+            sources = self._spec_sources()
+            margin = None
+            for s in active:
+                if s.adapt is None:
+                    return 0  # a drafting-eligible slot without a controller
+                m = s.adapt.denial_margin(len(s.refs), sources)
+                margin = m if margin is None else min(margin, m)
+            if not margin:
+                return 0  # some slot could draft right now: stay per-tick
+            n = min(n, margin // K)
         return n if n >= 2 else 0
 
     def _make_burst(self, n_windows: int):

@@ -244,6 +244,74 @@ class RadixTree:
                 cow = (best_key, best_len, best_dev)
         return dev_keys, host_keys, cow
 
+    def continuation(
+        self,
+        tokens: Sequence[int],
+        block_size: int,
+        dev: Callable[[str], bool],
+        k: int,
+    ) -> List[int]:
+        """Draft probe for cache-fed speculation (docs/speculation.md):
+        walk the tree to the deepest node matching `tokens` (a slot's
+        prompt + generated history) and return up to `k` tokens of the
+        continuation stored PAST that frontier — what some earlier
+        request generated or prefilled after this exact prefix. The
+        caller verifies the draft through the normal acceptance path, so
+        a stale or diverged continuation costs a rejected window, never
+        a wrong token.
+
+        Read-only with `peek_prefix`'s no-touch contract: no refcount,
+        no LRU recency, no structural mutation, and NO payload read —
+        the probe consumes only the token labels the tree already holds
+        on host. Continuation nodes must be DEVICE-resident: a spilled
+        or store-resident continuation ends the draft rather than
+        staging a revive (speculation must never cause tier traffic; a
+        draft is a hint, not a mapping). The matched PREFIX, by
+        contrast, is walked structurally without residency checks — its
+        tokens equal the query by construction and contribute nothing
+        to the draft.
+
+        Where several children continue the frontier (mid-block: same
+        `r`-token head; block-aligned: any child), the FIRST qualifying
+        child in edge-insertion order wins — deterministic for a
+        deterministic op order, the same argument as `match`'s COW
+        tiebreak."""
+        if k <= 0:
+            return []
+        node = self._root
+        n_full = len(tokens) // block_size
+        for b in range(n_full):
+            child = node._edges.get(
+                tuple(tokens[b * block_size : (b + 1) * block_size])
+            )
+            if child is None:
+                return []
+            node = child
+        out: List[int] = []
+        r = len(tokens) - n_full * block_size
+        if r:
+            tail = tuple(tokens[n_full * block_size :])
+            nxt = None
+            for child in node._edges.values():
+                if child.tokens[:r] == tail and dev(child.key):
+                    nxt = child
+                    break
+            if nxt is None:
+                return []
+            out.extend(nxt.tokens[r:])
+            node = nxt
+        while len(out) < k:
+            nxt = None
+            for child in node._edges.values():
+                if dev(child.key):
+                    nxt = child
+                    break
+            if nxt is None:
+                break
+            out.extend(nxt.tokens)
+            node = nxt
+        return out[:k]
+
     # -- mutation (the only sanctioned sites — NOS017) ------------------------
     def ensure_path(
         self, block_tokens: Sequence[Tuple[int, ...]], keys: Sequence[str]

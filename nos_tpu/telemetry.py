@@ -94,6 +94,17 @@ class ServingReport:
     spec_rounds: int = 0
     spec_tokens_accepted: int = 0
     spec_demotions: int = 0
+    # Per-draft-source speculation split (docs/speculation.md): verify
+    # windows, accepted tokens, and demotions by which source drafted —
+    # the radix tree's stored continuation vs the slot's prompt-lookup
+    # history. Sources partition the totals (tree + history accepted ==
+    # spec_tokens_accepted).
+    spec_tree_rounds: int = 0
+    spec_history_rounds: int = 0
+    spec_tree_tokens_accepted: int = 0
+    spec_history_tokens_accepted: int = 0
+    spec_tree_demotions: int = 0
+    spec_history_demotions: int = 0
     # Budgeted-prefill shape: bounded chunk dispatches per tick, and the
     # ticks where prefill and a macro window landed together (the
     # prompt-axis analogue of both_dispatch_ticks).
@@ -478,6 +489,18 @@ def collect_serving(server) -> ServingReport:
         spec_rounds=int(getattr(server, "spec_rounds", 0)),
         spec_tokens_accepted=int(getattr(server, "spec_tokens_accepted", 0)),
         spec_demotions=int(getattr(server, "spec_demotions", 0)),
+        spec_tree_rounds=int(getattr(server, "spec_tree_rounds", 0)),
+        spec_history_rounds=int(getattr(server, "spec_history_rounds", 0)),
+        spec_tree_tokens_accepted=int(
+            getattr(server, "spec_tree_tokens_accepted", 0)
+        ),
+        spec_history_tokens_accepted=int(
+            getattr(server, "spec_history_tokens_accepted", 0)
+        ),
+        spec_tree_demotions=int(getattr(server, "spec_tree_demotions", 0)),
+        spec_history_demotions=int(
+            getattr(server, "spec_history_demotions", 0)
+        ),
         both_dispatch_ticks=int(getattr(server, "both_dispatch_ticks", 0)),
         burst_dispatches=int(getattr(server, "burst_dispatches", 0)),
         tp_devices=int(getattr(server, "tp", 1)),

@@ -460,7 +460,12 @@ def test_adaptive_spec_snapshot_restore_rebases_cooldown():
     spec.rate = 0.4
     spec.denied_until = 37
     snap = spec.snapshot(generated=30)
-    assert snap == {"rate": 0.4, "denied_for": 7}
+    assert snap == {
+        "rate": 0.4,
+        "denied_for": 7,
+        "tree_rate": 1.0,
+        "tree_denied_for": 0,
+    }
     back = AdaptiveSpec.restore(snap)
     assert back.rate == 0.4
     assert not back.allowed(6) and back.allowed(7)

@@ -1,0 +1,471 @@
+"""Radix-fed speculative drafting (ISSUE 19, docs/speculation.md): the
+cache as a free draft model.
+
+The tentpole wires a second draft source into the decoupled speculation
+path — the radix tree's stored continuation past the slot's
+prompt+generated suffix — verified through the unchanged
+`paged_verify_window` program, so the house bar is unchanged too: spec-on
+must be BIT-IDENTICAL to spec-off greedy decoding no matter which source
+drafted, across every composition corner the tree adds (COW-shared
+nodes, multi-turn re-admission, spilled continuations, device-lost
+restore, seeded chaos). The probe itself carries `peek_prefix`'s
+no-touch contract: no refcounts, no LRU, no revive staging — pinned at
+the tree, manager, and engine layers below.
+
+float32 model everywhere outputs are compared: spec-vs-nonspec crosses
+differently-shaped programs (verify window vs macro step), where a tiny
+random bf16 model's exact logit ties would test tie-breaking luck
+(tests/test_decode_server.py SPEC_CFG reasoning)."""
+
+import jax
+import pytest
+
+from nos_tpu.models.speculative import SOURCE_HISTORY, SOURCE_TREE, AdaptiveSpec
+from nos_tpu.runtime.block_manager import BlockManager
+from nos_tpu.runtime.decode_server import DecodeServer
+from nos_tpu.runtime.radix_tree import RadixTree, prompt_chain_keys
+from tests.conftest import serving_test_config
+
+CFG = serving_test_config()
+
+cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="cross-program greedy equality needs the deterministic CPU backend",
+)
+
+
+@pytest.fixture(scope="module")
+def params(serving_params):
+    return serving_params
+
+
+def mk(params, **kw):
+    defaults = dict(
+        n_slots=2, max_len=64, prompt_buckets=(8, 16), block_size=8, seed=11
+    )
+    defaults.update(kw)
+    return DecodeServer(params, CFG, **defaults)
+
+
+def run_seq(server, reqs):
+    """Serve `reqs` ([(prompt, max_new)]) strictly in order — FIFO keeps
+    serials (and the spec_sync draft schedule) identical across arms."""
+    outs = []
+    server.start()
+    try:
+        for p, n in reqs:
+            outs.append(server.generate(p, max_new=n, timeout=300))
+    finally:
+        server.stop()
+    return outs
+
+
+# -- the tree probe (unit) -----------------------------------------------------
+BS = 4
+PATH = [((i * 13) % 89) + 1 for i in range(16)]  # 4 full blocks
+
+
+def grown_tree():
+    tree = RadixTree()
+    tree.insert_path(PATH, BS, 4)
+    return tree, prompt_chain_keys(PATH, BS)
+
+
+def test_continuation_block_aligned_and_midblock():
+    tree, _keys = grown_tree()
+    dev = lambda _k: True  # noqa: E731
+    # Block-aligned frontier: the stored suffix comes back, capped at k.
+    assert tree.continuation(PATH[:8], BS, dev, 8) == PATH[8:16]
+    assert tree.continuation(PATH[:8], BS, dev, 3) == PATH[8:11]
+    # Mid-block frontier: the matched child's tail, then its descendants.
+    assert tree.continuation(PATH[:6], BS, dev, 8) == PATH[6:14]
+    assert tree.continuation(PATH[:15], BS, dev, 8) == PATH[15:16]
+    # Diverged tail / unknown prefix / exhausted path: no draft.
+    assert tree.continuation(PATH[:5] + [96], BS, dev, 8) == []
+    assert tree.continuation([96, 95, 94, 93], BS, dev, 8) == []
+    assert tree.continuation(PATH, BS, dev, 8) == []
+    assert tree.continuation(PATH[:8], BS, dev, 0) == []
+
+
+def test_continuation_matched_prefix_is_structural_but_draft_is_device_only():
+    """The walked prefix needs no residency (its tokens equal the query by
+    construction) — but every node CONTRIBUTING tokens must be on device:
+    a spilled/store-resident continuation ends the draft instead of
+    implying tier traffic (the never-stage-a-revive half of satellite 3)."""
+    tree, keys = grown_tree()
+    # Only block 2 resident: probing past blocks 0-1 (non-resident,
+    # structural) still serves block 2's tokens, then stops at block 3.
+    dev = lambda k: k == keys[2]  # noqa: E731
+    assert tree.continuation(PATH[:8], BS, dev, 8) == PATH[8:12]
+    # Nothing resident at the frontier: no draft at all — mid-block
+    # matches demand a device-resident child too.
+    none = lambda _k: False  # noqa: E731
+    assert tree.continuation(PATH[:8], BS, none, 8) == []
+    assert tree.continuation(PATH[:6], BS, none, 8) == []
+
+
+def test_continuation_probe_never_mutates():
+    """peek_prefix's no-touch contract, tree level: structure, refcounts,
+    and edge order are bit-identical after any probe mix."""
+    tree, keys = grown_tree()
+    tree.ref(keys[1])  # a mapped page table, so refcounts are non-trivial
+    before = {
+        k: (tree.node_ref(k), tuple(tree.children_keys(k))) for k in keys
+    }
+    dev = lambda k: k in (keys[0], keys[2])  # noqa: E731
+    for prefix in (PATH[:4], PATH[:6], PATH[:8], PATH, [96] * 4):
+        tree.continuation(prefix, BS, dev, 8)
+    after = {
+        k: (tree.node_ref(k), tuple(tree.children_keys(k))) for k in keys
+    }
+    assert before == after
+    assert len(tree) == 4
+
+
+def test_manager_draft_continuation_devices_only_and_flat_mode_empty():
+    """BlockManager wrapper: device-index-gated drafts, no state change,
+    and flat-chain managers (no tree) report no source at all."""
+    mgr = BlockManager(10, BS, 2, radix=True)
+    assert mgr.has_tree()
+    mgr._tree.insert_path(PATH, BS, 4)
+    for key in prompt_chain_keys(PATH, BS)[:3]:
+        mgr._prefix_index[key] = 99  # device-resident; block 3 is not
+    index_before = dict(mgr._prefix_index)
+    assert mgr.draft_continuation(PATH[:8], 8) == PATH[8:12]
+    assert mgr.draft_continuation(PATH[:8], 2) == PATH[8:10]
+    assert mgr.draft_continuation([96] * 4, 8) == []
+    # Pure read: the probe staged nothing and touched no index entry.
+    assert dict(mgr._prefix_index) == index_before
+    assert len(mgr._tree) == 4
+
+    flat = BlockManager(10, BS, 2, radix=False)
+    assert not flat.has_tree()
+    assert flat.draft_continuation(PATH[:8], 8) == []
+
+
+# -- the per-source controller (unit) ------------------------------------------
+def test_adaptive_spec_sources_demote_independently():
+    a = AdaptiveSpec()
+    # Tree drafts keep missing -> tree demotes; history is untouched.
+    demoted = False
+    for g in range(6):
+        demoted = demoted or a.observe(4, 0, g, SOURCE_TREE)
+    assert demoted
+    assert not a.allowed(6, SOURCE_TREE)
+    assert a.allowed(6, SOURCE_HISTORY)
+    assert a.rate == 1.0  # history EWMA never observed a round
+    # Each source's cap tracks its own EWMA.
+    a2 = AdaptiveSpec()
+    a2.observe(4, 0, 0, SOURCE_TREE)
+    assert a2.cap(8, SOURCE_TREE) == 4
+    assert a2.cap(8, SOURCE_HISTORY) == 8
+    # Default-source calls are the pre-tree API, history semantics.
+    a3 = AdaptiveSpec()
+    assert a3.observe(2, 0, 0) is False or True  # callable without source
+    assert a3.cap(8) == a3.cap(8, SOURCE_HISTORY)
+
+
+def test_adaptive_spec_denial_margin():
+    a = AdaptiveSpec()
+    # Nothing denied: zero margin (a draft is possible right now).
+    assert a.denial_margin(0, [SOURCE_TREE, SOURCE_HISTORY]) == 0
+    a.tree_denied_until = 40
+    a.denied_until = 24
+    # Both denied: the margin is the EARLIEST expiry.
+    assert a.denial_margin(10, [SOURCE_TREE, SOURCE_HISTORY]) == 14
+    assert a.denial_margin(10, [SOURCE_TREE]) == 30
+    # One source already allowed: no margin.
+    assert a.denial_margin(30, [SOURCE_TREE, SOURCE_HISTORY]) == 0
+
+
+def test_adaptive_spec_snapshot_roundtrip_and_legacy_shape():
+    from nos_tpu.runtime.checkpoint import SlotCheckpoint
+
+    a = AdaptiveSpec()
+    a.rate, a.denied_until = 0.6, 50
+    a.tree_rate, a.tree_denied_until = 0.35, 70
+    snap = a.snapshot(generated=44)
+    # Flat str->float dict — the shape SlotCheckpoint shallow-copies.
+    assert all(isinstance(v, (int, float)) for v in snap.values())
+    ckpt = SlotCheckpoint(
+        prompt=[1, 2], generated=[3], max_new=4, serial=1, spec=snap
+    )
+    restored = AdaptiveSpec.restore(
+        SlotCheckpoint.from_dict(ckpt.to_dict()).spec
+    )
+    assert restored.rate == pytest.approx(0.6)
+    assert restored.tree_rate == pytest.approx(0.35)
+    # Cooldowns re-anchor at the restored slot's fresh generated count.
+    assert restored.denied_until == 6
+    assert restored.tree_denied_until == 26
+    # Pre-tree snapshots (PR 6/14 checkpoints) restore tree state to the
+    # fresh-optimism defaults — tolerated-absent, like trace_id.
+    legacy = AdaptiveSpec.restore({"rate": 0.5, "denied_for": 2})
+    assert legacy.rate == 0.5 and legacy.denied_until == 2
+    assert legacy.tree_rate == 1.0 and legacy.tree_denied_until == 0
+
+
+# -- engine oracles: the composition corners -----------------------------------
+DONOR = [((i * 5) % 91) + 1 for i in range(24)]  # 3 full blocks at bs=8
+DIV = DONOR[:12] + [((i * 7) % 91) + 2 for i in range(12)]  # diverges mid-block
+
+
+def spec_kw(**kw):
+    base = dict(spec_k=6, spec_sync=True)
+    base.update(kw)
+    return base
+
+
+@cpu_only
+def test_regeneration_tree_drafts_bit_identical_and_engaged(params):
+    """THE tentpole oracle: a regenerated request's continuation already
+    sits in the tree (round 1 registered its generated blocks), so round
+    2 drafts from the cache — and the output is bit-identical to the
+    spec-off engine on the same traffic."""
+    reqs = [(DONOR, 16), (DONOR, 16)]
+    base = run_seq(mk(params), reqs)
+    spec_srv = mk(params, **spec_kw())
+    spec = run_seq(spec_srv, reqs)
+    assert spec == base
+    assert spec[0] == spec[1]  # greedy regeneration is deterministic
+    # The tree source actually fired and its drafts were accepted.
+    assert spec_srv.spec_tree_rounds > 0
+    assert spec_srv.spec_tree_tokens_accepted > 0
+    # Source counters partition the totals.
+    assert (
+        spec_srv.spec_tree_rounds + spec_srv.spec_history_rounds
+        >= spec_srv.spec_rounds
+    )
+    assert (
+        spec_srv.spec_tree_tokens_accepted
+        + spec_srv.spec_history_tokens_accepted
+        == spec_srv.spec_tokens_accepted
+    )
+
+
+@cpu_only
+def test_history_only_engine_never_probes_tree(params):
+    """The `spec_tree_drafts=False` A/B arm: same exactness, zero tree
+    rounds — the bench's history-only arm measures what it claims."""
+    reqs = [(DONOR, 16), (DONOR, 16)]
+    base = run_seq(mk(params), reqs)
+    srv = mk(params, **spec_kw(spec_tree_drafts=False))
+    assert run_seq(srv, reqs) == base
+    assert srv.spec_tree_rounds == 0
+    assert srv.spec_tree_tokens_accepted == 0
+
+
+@cpu_only
+def test_tree_draft_from_cow_shared_node_bit_identical(params):
+    """Composition corner 1: the regenerated path runs THROUGH blocks a
+    COW-diverging neighbor shares (refcounted by both page tables) — the
+    probe reads shared nodes without perturbing them."""
+    reqs = [(DONOR, 10), (DIV, 10), (DIV, 10)]
+    base = run_seq(mk(params), reqs)
+    spec_srv = mk(params, **spec_kw())
+    assert run_seq(spec_srv, reqs) == base
+    assert spec_srv.prefix_cow_hits >= 1  # the corner actually exists
+    assert spec_srv.spec_tree_rounds > 0  # and the tree drafted through it
+    assert spec_srv._block_mgr.conserved()
+
+
+@cpu_only
+def test_tree_draft_across_multi_turn_readmission_boundary(params):
+    """Composition corner 2: a regenerated TURN-2 history crosses the
+    re-admission boundary (prompt blocks + registered output blocks +
+    turn-2 suffix) — the probe walks the grown path bit-exactly."""
+    turn1 = DONOR[:20]
+    probe = mk(params)
+    out1 = run_seq(probe, [(turn1, 12)])[0]
+    turn2 = turn1 + out1 + [33, 44, 55]
+    # Identical traffic for both arms, turn 2 regenerated.
+    reqs = [(turn1, 12), (turn2, 8), (turn2, 8)]
+    base = run_seq(mk(params), reqs)
+    spec_srv = mk(params, **spec_kw())
+    spec = run_seq(spec_srv, reqs)
+    assert spec == base
+    assert spec_srv.output_blocks_registered > 0
+    assert spec_srv.spec_tree_rounds > 0
+
+
+@cpu_only
+def test_spilled_continuation_degrades_without_revive(params):
+    """Composition corner 3: a continuation evicted to the spill tier is
+    NOT a draft source — the probe returns nothing for the spilled path
+    (no revive staged, no payload read) and the engine degrades to
+    history/no-draft, outputs bit-identical throughout."""
+    donor = DONOR + [77, 78, 79, 80]
+    filler = [((i * 11) % 91) + 3 for i in range(28)]
+    reqs = [(donor, 4), (filler, 4), (donor, 4)]
+    small = dict(total_blocks=1 + 6, n_slots=1)
+    base = run_seq(mk(params, **small), reqs)
+    spec_srv = mk(params, **spec_kw(**small))
+    assert run_seq(spec_srv, reqs) == base
+    assert spec_srv.spills > 0, "the pool pressure never spilled the path"
+    # Direct probe against a spilled suffix: the filler's blocks are
+    # host-resident now (the donor run evicted them); the probe must
+    # yield nothing for them and must not stage a revive or touch tiers.
+    mgr = spec_srv._block_mgr
+    keys = prompt_chain_keys(filler, 8)
+    spilled = [k for k in keys if mgr._on_host(k) and not mgr._on_device(k)]
+    if spilled:
+        revives_before = spec_srv.revives
+        first_spilled = keys.index(spilled[0])
+        assert (
+            mgr.draft_continuation(filler[: first_spilled * 8], 8) == []
+        ), "a spilled continuation must end the draft, not revive"
+        assert spec_srv.revives == revives_before
+        assert mgr.conserved()
+
+
+@cpu_only
+def test_spec_state_survives_device_lost_restore_bit_identical(params):
+    """Composition corner 4 (PR 6): device-lost mid-verify with tree
+    drafting armed — every stream restores and completes bit-identical,
+    and the AdaptiveSpec snapshot (both sources' state) rides the
+    checkpoint (the restore path feeds `AdaptiveSpec.restore`). The
+    repetitive third request keeps history drafting past the tree round,
+    so verify-dispatch occurrence 2 (the faulted one) is guaranteed."""
+    from nos_tpu.runtime.faults import (
+        FAULT_DEVICE_LOST,
+        FaultInjector,
+        FaultSpec,
+    )
+
+    rep = [3, 1, 4, 1, 5, 9, 2, 6] * 5
+    reqs = [(DONOR, 16), (DONOR, 16), (rep, 24)]
+
+    def run(injector):
+        srv = mk(params, **spec_kw(fault_injector=injector, max_len=128))
+        return run_seq(srv, reqs), srv
+
+    base, _ = run(None)
+    got, srv = run(
+        FaultInjector([FaultSpec("dispatch_verify", 2, FAULT_DEVICE_LOST)])
+    )
+    assert got == base
+    assert srv.recoveries == 1
+    assert srv.slots_restored >= 1
+
+
+@cpu_only
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6])
+def test_chaos_bit_identical_spec_armed(params, seed):
+    """ISSUE 19 acceptance: the 7-seed chaos gate passes SPEC-ARMED with
+    tree drafting on — every non-poisoned request bit-identical to its
+    fault-free spec-armed run, poison classified, pool conserved."""
+    from nos_tpu.runtime.faults import FAULT_POISON, FaultInjector, classify_fault
+    from tests.test_block_manager import check_invariants
+
+    prompts = [DONOR, DIV, [7, 7, 2, 9] * 4, list(range(20, 36))]
+    news = [10, 8, 12, 6]
+
+    def run(injector):
+        srv = mk(
+            params,
+            **spec_kw(
+                n_slots=4,
+                max_len=128,
+                fault_injector=injector,
+                transient_backoff_s=0.001,
+            ),
+        )
+        futs = [srv.submit(p, max_new=n) for p, n in zip(prompts, news)]
+        srv.start()
+        outcomes = []
+        try:
+            for f in futs:
+                try:
+                    outcomes.append(("ok", f.result(timeout=300)))
+                except Exception as e:  # noqa: BLE001 — the outcome under test
+                    outcomes.append(("err", e))
+        finally:
+            srv.stop()
+        return outcomes, srv
+
+    base, _ = run(None)
+    assert all(kind == "ok" for kind, _ in base)
+    injector = FaultInjector.seeded(seed, n_faults=3, max_occurrence=8)
+    outcomes, srv = run(injector)
+    for i, (kind, value) in enumerate(outcomes):
+        if kind == "ok":
+            assert value == base[i][1], f"stream {i} diverged under seed {seed}"
+        else:
+            assert classify_fault(value) == FAULT_POISON, (i, value)
+    assert srv.fail_all_recoveries == 0
+    assert srv._block_mgr.conserved()
+    check_invariants(srv._block_mgr)
+
+
+# -- satellite 6: bursts resume under full demotion ----------------------------
+@cpu_only
+def test_bursts_resume_while_all_sources_in_cooldown(params, monkeypatch):
+    """A spec-armed engine used to disable fused bursts outright. While
+    EVERY active slot's controller holds every available source in
+    demotion cooldown, no draft is possible by construction — the macro
+    windows must fuse again (burst_dispatches > 0), outputs unchanged.
+    The draft source is stubbed to a constant the model essentially never
+    produces, so demotion is immediate; radix_cache=False keeps history
+    the only available source (the tree never arms on a flat manager)."""
+    from nos_tpu.models.speculative import _LookupIndex
+    from nos_tpu.runtime import decode_server as ds
+
+    class _RejectingLookup(_LookupIndex):
+        def draft(self, k):
+            return [96] * k if k > 0 else []
+
+    monkeypatch.setattr(ds, "_LookupIndex", _RejectingLookup)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6] * 3
+
+    def run(**kw):
+        srv = mk(
+            params,
+            n_slots=1,
+            max_len=128,
+            radix_cache=False,
+            burst_windows=4,
+            steps_per_dispatch=4,
+            **kw,
+        )
+        return run_seq(srv, [(prompt, 64)]), srv
+
+    base, base_srv = run()
+    assert base_srv.burst_dispatches > 0  # spec-off engine bursts freely
+    got, srv = run(**spec_kw())
+    assert got == base
+    assert srv.spec_demotions >= 1, "the rejecting drafts never demoted"
+    assert srv.burst_dispatches > 0, (
+        "spec-armed engine never burst during full demotion cooldown"
+    )
+    # Drafting actually ran (and failed) before the cooldown freed bursts:
+    # the exactness assertion above therefore covers the handoff ticks.
+    assert srv.spec_rounds > 0
+
+
+# -- telemetry plumbing --------------------------------------------------------
+@cpu_only
+def test_draft_source_counters_flow_to_report_registry_and_merge(params):
+    from nos_tpu.observability import Metrics
+    from nos_tpu.telemetry import ServingReport, collect_serving
+
+    registry = Metrics()
+    srv = mk(params, **spec_kw(metrics=registry))
+    run_seq(srv, [(DONOR, 16), (DONOR, 16)])
+    rep = collect_serving(srv)
+    assert rep.spec_tree_rounds == srv.spec_tree_rounds > 0
+    assert rep.spec_history_rounds == srv.spec_history_rounds
+    assert (
+        rep.spec_tree_tokens_accepted == srv.spec_tree_tokens_accepted > 0
+    )
+    assert registry.get("nos_tpu_decode_draft_source_tree_rounds") == float(
+        srv.spec_tree_rounds
+    )
+    assert registry.get(
+        "nos_tpu_decode_draft_source_tree_accepted"
+    ) == float(srv.spec_tree_tokens_accepted)
+    # Fleet merge int-sums the per-source counters like any engine counter.
+    merged = ServingReport.merge([rep, ServingReport(spec_tree_rounds=3)])
+    assert merged.spec_tree_rounds == rep.spec_tree_rounds + 3
+    assert (
+        merged.spec_history_tokens_accepted == rep.spec_history_tokens_accepted
+    )

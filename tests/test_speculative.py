@@ -66,6 +66,32 @@ def test_lookup_draft_capped_at_k():
     assert find_prompt_lookup_draft(history, ngram=3, k=2) == [4, 5]
 
 
+def test_index_bounded_on_long_stream():
+    """A 10k-token stream must hold the map at `max_entries`, evict in
+    recency order (stale firsts leave, recent re-seats stay), and keep
+    drafting from the survivors — per-slot memory is O(max_entries), not
+    O(generated), so marathon decodes can't grow the index unboundedly."""
+    from nos_tpu.models.speculative import _LookupIndex
+
+    rng = np.random.default_rng(7)
+    history: list = []
+    idx = _LookupIndex(history, ngram=3, max_entries=256)
+    for _ in range(100):
+        idx.extend([int(x) for x in rng.integers(0, 50, size=100)])
+        assert len(idx.index) <= 256
+    assert len(history) == 10_000
+    assert len(idx.index) == 256  # saturated, not merely bounded
+    # Survivors are the RECENT ngrams: every still-indexed start position
+    # must be re-derivable from the live map (self-consistency), and a
+    # suffix drafted through the bounded map matches the reference scan
+    # whenever the reference's match survived eviction.
+    for key, start in list(idx.index.items())[:32]:
+        assert tuple(history[start : start + 3]) == key
+    tail = [9001 % 50, 17, 23]  # a fresh trigram, then repeat it
+    idx.extend(tail + [int(x) for x in rng.integers(0, 50, size=10)] + tail)
+    assert idx.draft(4) == find_prompt_lookup_draft(history, 3, 4)
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_incremental_index_matches_reference_scan(seed):
     """The O(ngram) incremental index must reproduce the reference scan's
