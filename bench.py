@@ -1004,6 +1004,153 @@ def _templated_output(
     }
 
 
+def _quantized_kv(
+    np,
+    cfg,
+    params,
+    n_streams: int = 4,
+    gen_tokens: int = 16,
+    block_size: int = 8,
+    max_len: int = 64,
+) -> dict:
+    """Int8 quantized-KV A/B (ISSUE 20, docs/quantized-kv.md): the KV
+    byte economy measured end to end on IDENTICAL traffic, three arms —
+    `default` (no kv_dtype argument: the pre-PR construction), `fp16`
+    (explicit native), `int8` (quantized pool). Every arm runs a
+    deliberately undersized device pool over a fleet-store cold tier
+    (StoreTier), so the whole off-device byte path lands on one gauge:
+    spill evictions, idle publishes, and the PR 18 handoff wire format
+    (handoff rides the fleet store) are all the same payloads.
+
+    Gates (evaluated in hack/bench_smoke.py, counter/byte primary per
+    the PR 12 noise lesson; tok/s reported, never gated):
+
+      - `default` == `fp16` outputs BIT-IDENTICAL (the witness that
+        the quantization plumbing left the native path untouched);
+      - pool blocks per HBM byte >= 1.9x the fp16 arm's (the capacity
+        win — on the f32 CPU pool the measured ratio is ~3.9x; a bf16
+        device pool gives ~2x, hence the 1.9 floor);
+      - cold-tier (spill+store+handoff) bytes <= 0.55x the fp16 arm's
+        (the byte-path win; per-block payload width ratio alongside);
+      - the bounded-divergence oracle (teacher-forced, pure-model)
+        within its pinned tolerances, plus the blunter free-running
+        stream agreement reported for context.
+
+    The cost tier rides along: each arm charges its CostLedger, and the
+    artifact quotes WHICH field accumulated (`kv_block_ticks` vs
+    `kv_block_ticks_int8`) with the tick volume — the billing half of
+    the per-tenant quality knob."""
+    import time
+
+    from nos_tpu import constants
+    from nos_tpu.runtime.decode_server import DecodeServer
+    from nos_tpu.runtime.divergence import measure_divergence
+    from nos_tpu.serving.accounting import CostLedger
+    from nos_tpu.serving.kv_store import FleetKVStore
+
+    srng = np.random.default_rng([2026, 20, n_streams])
+    prompts = [
+        srng.integers(1, cfg.vocab, 6 + 3 * i).tolist()
+        for i in range(n_streams)
+    ]
+    total_blocks = 1 + 6  # undersized: forces spill/store traffic
+
+    def run_arm(kv_dtype):
+        store = FleetKVStore(capacity_bytes=1 << 20)
+        ledger = CostLedger()
+        kw = {} if kv_dtype is None else {"kv_dtype": kv_dtype}
+        server = DecodeServer(
+            params, cfg, n_slots=2, max_len=max_len, prompt_buckets=(8, 16),
+            block_size=block_size, total_blocks=total_blocks, seed=11,
+            kv_store=store, cost_ledger=ledger,
+            **kw,
+        )
+        # Manual deterministic driving (no engine thread): the
+        # spill/store byte counters must be schedule-exact so the
+        # cross-arm byte ratios compare pools, not tick timing.
+        futs = [
+            server.submit(p, max_new=gen_tokens, tenant="bench")
+            for p in prompts
+        ]
+        t0 = time.perf_counter()
+        try:
+            for _ in range(20000):
+                if all(f.done() for f in futs):
+                    break
+                server._tick()
+            outs = [f.result(timeout=5) for f in futs]
+            elapsed = time.perf_counter() - t0
+            for _ in range(8):  # publish drain into the store
+                server._tick()
+        finally:
+            server.stop()
+        tier = server.spill_tier
+        totals = ledger.tenant_totals().get("bench", {})
+        cost_field = (
+            constants.COST_KV_BLOCK_TICKS_INT8
+            if kv_dtype == constants.KV_DTYPE_INT8
+            else constants.COST_KV_BLOCK_TICKS
+        )
+        return outs, {
+            "tok_s": round(n_streams * gen_tokens / elapsed, 1),
+            "kv_pool_bytes": int(server.kv_pool_bytes),
+            "pool_blocks_per_mib": round(
+                total_blocks / (server.kv_pool_bytes / (1 << 20)), 1
+            ),
+            "bytes_per_block": int(server._bytes_per_block),
+            "spills": int(tier.spills),
+            "store_puts": int(server.store_puts),
+            "store_dedup_hits": int(tier.store_dedup_hits),
+            # With kv_store attached the engine's cold tier IS the
+            # fleet store (StoreTier): evictions, publishes, and PR 18
+            # handoff payloads all land here — one gauge prices the
+            # whole off-device byte path.
+            "cold_tier_bytes": int(store.host_bytes),
+            "payload_rejected": int(server.kv_quant_payload_rejected),
+            "cost_field": cost_field,
+            "kv_block_ticks": int(totals.get(cost_field, 0)),
+        }
+
+    default_out, default = run_arm(None)
+    fp16_out, fp16 = run_arm(constants.KV_DTYPE_NATIVE)
+    int8_out, int8 = run_arm(constants.KV_DTYPE_INT8)
+
+    # The bounded-divergence oracle (pure-model, teacher-forced): the
+    # tier's quality price, measured against its pinned tolerances.
+    from nos_tpu.runtime.divergence import compare_output_streams
+
+    reports = [
+        measure_divergence(params, cfg, p, steps=12, block_size=block_size)
+        for p in prompts[:2]
+    ]
+    flat_f = [t for o in fp16_out for t in o]
+    flat_q = [t for o in int8_out for t in o]
+    return {
+        "n_streams": n_streams,
+        "gen_tokens": gen_tokens,
+        "default_fp16_identical": default_out == fp16_out,
+        "pool_bytes_ratio": round(fp16["kv_pool_bytes"] / int8["kv_pool_bytes"], 3),
+        "byte_path_ratio": round(
+            int8["cold_tier_bytes"] / max(1, fp16["cold_tier_bytes"]), 3
+        ),
+        "block_payload_ratio": round(
+            int8["bytes_per_block"] / fp16["bytes_per_block"], 3
+        ),
+        "divergence": {
+            "tokens_compared": sum(r.tokens_compared for r in reports),
+            "max_abs_logit_delta": round(
+                max(r.max_abs_logit_delta for r in reports), 5
+            ),
+            "top1_agreement": round(
+                min(r.top1_agreement for r in reports), 4
+            ),
+            "within_pinned_bounds": all(r.within() for r in reports),
+        },
+        "stream_agreement": round(compare_output_streams(flat_f, flat_q), 4),
+        "arms": {"default": default, "fp16": fp16, "int8": int8},
+    }
+
+
 def _fleet_pressure(
     np,
     cfg,
@@ -2753,6 +2900,15 @@ def _decode_phase(jax, jnp) -> dict:
             phrase_tokens=16, prompt_tokens=96, gen_tokens=192,
             spec_k=8, block_size=32, max_len=512,
         ),
+    )
+    # Int8 quantized-KV A/B (ISSUE 20, docs/quantized-kv.md): default /
+    # explicit-fp16 / int8 arms on identical traffic — fp16 arm
+    # bit-identical to default, pool blocks per HBM byte >= 1.9x,
+    # cold-tier (spill+store+handoff) bytes <= 0.55x, and the
+    # teacher-forced divergence oracle within its pinned bounds.
+    out["quantized_kv"] = _retry(
+        "decode:quantized_kv",
+        lambda: _quantized_kv(np, cfg, params),
     )
     return out
 

@@ -162,6 +162,26 @@ CPU-sized prompt):
     `chip_accounting` blocks (the waste decomposition the
     disaggregation trade rides on).
 
+ISSUE 20 adds the `quantized_kv` A/B (default vs explicit-fp16 vs int8
+KV pool on identical traffic over a fleet-store cold tier,
+docs/quantized-kv.md) with its own gates, all counter/byte primary
+(tok/s reported, never gated):
+
+  - the explicit `kv_dtype="fp16"` arm's outputs BIT-IDENTICAL to the
+    no-argument default's (the witness that quantization left the
+    native path untouched);
+  - fp16/int8 pool byte ratio >= 1.9 (pool blocks per HBM byte at
+    least ~doubles; measured ~3.9x on the f32 CPU pool, ~2x on a bf16
+    device pool — hence the floor);
+  - int8 cold-tier bytes (spill evictions + store publishes + PR 18
+    handoff payloads — one gauge, the cold tier IS the fleet store)
+    <= 0.55x the fp16 arm's;
+  - the teacher-forced bounded-divergence oracle within its pinned
+    tolerances (runtime/divergence.py), zero dtype-tag payload
+    rejections on the single-dtype fleet, and the cost ledger charging
+    `kv_block_ticks_int8` vs `kv_block_ticks` per arm (the two-tier
+    billing half of the per-tenant quality knob).
+
 Exit 0 and print the artifacts on success; exit 1 with the failed gate
 otherwise.
 """
@@ -667,6 +687,54 @@ def main() -> int:
             "(the radix continuation probe never fired)"
         )
 
+    # -- ISSUE 20: int8 quantized paged KV A/B -----------------------------
+    qkv = bench._quantized_kv(np, cfg, params)
+    qkv_payload = json.dumps(qkv, sort_keys=True)
+    qkv_parsed = json.loads(qkv_payload)
+    print(qkv_payload)
+
+    if not qkv_parsed["default_fp16_identical"]:
+        failures.append(
+            "quantized_kv: explicit kv_dtype='fp16' outputs differ from the "
+            "no-argument default (the quantization plumbing disturbed the "
+            "native path)"
+        )
+    if qkv_parsed["pool_bytes_ratio"] < 1.9:
+        failures.append(
+            "quantized_kv: fp16/int8 pool byte ratio "
+            f"{qkv_parsed['pool_bytes_ratio']} < 1.9 (pool blocks per HBM "
+            "byte did not ~double)"
+        )
+    if qkv_parsed["byte_path_ratio"] > 0.55:
+        failures.append(
+            "quantized_kv: int8 cold-tier (spill+store+handoff) bytes at "
+            f"{qkv_parsed['byte_path_ratio']}x the fp16 arm's (> 0.55 — the "
+            "off-device byte path did not shrink with the pool)"
+        )
+    if not qkv_parsed["divergence"]["within_pinned_bounds"]:
+        failures.append(
+            "quantized_kv: teacher-forced divergence oracle outside its "
+            f"pinned bounds (max |dlogit| "
+            f"{qkv_parsed['divergence']['max_abs_logit_delta']}, top-1 "
+            f"agreement {qkv_parsed['divergence']['top1_agreement']})"
+        )
+    for arm_key, arm in qkv_parsed["arms"].items():
+        if arm["payload_rejected"]:
+            failures.append(
+                f"quantized_kv[{arm_key}]: {arm['payload_rejected']} "
+                "payload(s) rejected on a single-dtype fleet (the dtype "
+                "tag or chain-key salt leaked across tiers)"
+            )
+    if (
+        qkv_parsed["arms"]["int8"]["cost_field"] != "kv_block_ticks_int8"
+        or qkv_parsed["arms"]["fp16"]["cost_field"] != "kv_block_ticks"
+    ):
+        failures.append(
+            "quantized_kv: the cost ledger charged the wrong tier field "
+            f"(fp16 -> {qkv_parsed['arms']['fp16']['cost_field']}, int8 -> "
+            f"{qkv_parsed['arms']['int8']['cost_field']})"
+        )
+
     # -- ISSUE 18: phase disaggregation (colocated vs prefill/decode) ------
     # Needs its own config: the long prompt exceeds the serving cfg's
     # 128-token max_seq. 4096 x 4 back-to-back longs keeps the measured
@@ -841,6 +909,12 @@ def main() -> int:
         f"{spec_parsed['arms']['spec_off']['tok_s']} off / "
         f"{spec_parsed['arms']['history_only']['tok_s']} history / "
         f"{spec_parsed['arms']['tree_fed']['tok_s']} tree)"
+        + "; quantized kv: pool "
+        f"{qkv_parsed['pool_bytes_ratio']}x smaller, cold-tier bytes "
+        f"{qkv_parsed['byte_path_ratio']}x, fp16 bit-identical "
+        f"{qkv_parsed['default_fp16_identical']}, max |dlogit| "
+        f"{qkv_parsed['divergence']['max_abs_logit_delta']} (top-1 "
+        f"{qkv_parsed['divergence']['top1_agreement']})"
         + "; disagg: "
         + ", ".join(
             f"{tkey} decode-during-prefill "

@@ -18,6 +18,7 @@ def all_checkers() -> List[Checker]:
     from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
     from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+    from nos_tpu.analysis.checkers.quant_discipline import QuantDisciplineChecker
     from nos_tpu.analysis.checkers.radix_discipline import RadixDisciplineChecker
     from nos_tpu.analysis.checkers.replay_purity import ReplayPurityChecker
     from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
@@ -47,4 +48,5 @@ def all_checkers() -> List[Checker]:
         DonationDisciplineChecker(),
         ReplayPurityChecker(),
         TelemetrySchemaChecker(),
+        QuantDisciplineChecker(),
     ]

@@ -1,0 +1,161 @@
+"""NOS024 — quantized-KV state touched outside the ops/ write funnel.
+
+The int8 KV tier (docs/quantized-kv.md) keeps ONE module honest about
+its format: nos_tpu/ops/quantized_kv.py owns every write to the
+per-block scale arrays (the scatter funnel's offset-0 reset +
+scatter-max + requant dance) and every dequantization multiply (the
+attention ops inline the same arithmetic next door in
+ops/paged_attention.py). That is the NOS011/NOS019 single-mutator
+discipline applied to a NUMERIC format instead of host bookkeeping —
+and it matters for the same reason: a stray
+``cache["0"]["k_scale"].at[b].set(s)`` in engine code silently breaks
+the monotone-scale/requant-idempotence invariants, and no conservation
+counter can see it; only output quality decays.
+
+Two rules, enforced across runtime/, serving/ and models/ (ops/ is the
+funnel and is exempt):
+
+  A. WRITES to quantization state — assignment/deletion/augmented
+     assignment whose target resolves through subscripts to a
+     ``"k_scale"``/``"v_scale"`` key or a ``_kv_scales`` attribute, AND
+     functional ``.at[...].set/add/max/min/...`` chains rooted at the
+     same state (jax's "mutation" spelling). Reads stay legal
+     everywhere: the model's attend closures hand scales to the
+     attention ops, telemetry sizes the pool, tests inspect freely.
+     Dict LITERALS carrying scale keys are reads-with-structure, not
+     writes — the model rebuilds its per-layer cache dict from funnel
+     outputs, which is exactly the sanctioned flow.
+
+  B. CALLS to dequantization — any call whose name mentions
+     ``dequant``. Dequantization outside ops/ means pool bytes were
+     materialized as floats on the host path, which both breaks the
+     single-format-authority rule and silently forfeits the bandwidth
+     win the tier exists for.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from nos_tpu.analysis.core import Checker, FileContext, Report
+
+_SCALE_KEYS = frozenset({"k_scale", "v_scale"})
+_SCALE_ATTRS = frozenset({"_kv_scales"})
+
+#: jax functional-update methods: `root.at[i].set(x)` et al. — writes in
+#: jax's spelling even though the AST shows a pure call.
+_AT_METHODS = frozenset(
+    {"set", "add", "subtract", "multiply", "divide", "max", "min", "power"}
+)
+
+
+def _quant_root(node: ast.AST):
+    """The protected quant-state name an expression chain is rooted at,
+    if any: unwraps subscripts/attributes so ``cache["0"]["k_scale"]``,
+    ``lc["v_scale"][b]`` and ``engine._kv_scales`` all resolve."""
+    while True:
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and sl.value in _SCALE_KEYS:
+                return str(sl.value)
+            node = node.value
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _SCALE_ATTRS:
+                return node.attr
+            node = node.value
+        else:
+            return None
+
+
+class QuantDisciplineChecker(Checker):
+    name = "quant-discipline"
+    codes = ("NOS024",)
+    description = (
+        "quantized-KV scale state written, or dequantization called, "
+        "outside the ops/ write funnel"
+    )
+
+    def __init__(self) -> None:
+        self._scope = False
+
+    def begin_file(self, ctx: FileContext) -> None:
+        dirs = ctx.segments[:-1]
+        self._scope = (
+            "runtime" in dirs or "serving" in dirs or "models" in dirs
+        ) and "ops" not in dirs
+
+    def _flag(
+        self, ctx: FileContext, node: ast.AST, what: str, report: Report
+    ) -> None:
+        report.add(
+            ctx.rel,
+            node.lineno,
+            "NOS024",
+            f"{what} outside nos_tpu/ops/; the int8 KV format (per-block "
+            "scale reset/scatter-max/requant and the dequant multiply) "
+            "has ONE authority — route it through ops/quantized_kv.py / "
+            "ops/paged_attention.py so the bounded-divergence oracle's "
+            "assumptions keep holding (docs/quantized-kv.md)",
+        )
+
+    def visit(self, ctx: FileContext, node: ast.AST, report: Report) -> None:
+        if not self._scope:
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for target in targets:
+                parts = (
+                    target.elts
+                    if isinstance(target, (ast.Tuple, ast.List))
+                    else [target]
+                )
+                for part in parts:
+                    root = _quant_root(part)
+                    if root is not None:
+                        self._flag(
+                            ctx,
+                            node,
+                            f"quantized-KV scale state `{root}` assigned",
+                            report,
+                        )
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                root = _quant_root(target)
+                if root is not None:
+                    self._flag(
+                        ctx,
+                        node,
+                        f"quantized-KV scale state `{root}` deleted",
+                        report,
+                    )
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            fn = node.func
+            if "dequant" in fn.attr.lower():
+                self._flag(
+                    ctx, node, f"dequantization call `.{fn.attr}()`", report
+                )
+                return
+            # `root.at[i].set(x)`: Call(Attribute set, Subscript,
+            # Attribute at, <root>) — jax's write spelling.
+            if (
+                fn.attr in _AT_METHODS
+                and isinstance(fn.value, ast.Subscript)
+                and isinstance(fn.value.value, ast.Attribute)
+                and fn.value.value.attr == "at"
+            ):
+                root = _quant_root(fn.value.value.value)
+                if root is not None:
+                    self._flag(
+                        ctx,
+                        node,
+                        f"quantized-KV scale state `{root}` written via "
+                        f".at[...].{fn.attr}()",
+                        report,
+                    )
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if "dequant" in node.func.id.lower():
+                self._flag(
+                    ctx, node, f"dequantization call `{node.func.id}()`", report
+                )

@@ -393,6 +393,11 @@ COST_DECODE_TOKENS = "decode_tokens"            # generated tokens
 COST_PREFILL_CHARGED = "prefill_tokens_charged"  # prompt tokens computed
 COST_PREFILL_CACHED = "prefill_tokens_cached"    # prompt tokens served from cache
 COST_KV_BLOCK_TICKS = "kv_block_ticks"          # pool-block x tick products
+# Quantized pool residency bills under its own field (docs/quantized-kv.md):
+# an int8 block-tick holds roughly half the HBM of a native one, so the
+# ledger prices the two tiers separately instead of flattening them into
+# one number the operator cannot decompose.
+COST_KV_BLOCK_TICKS_INT8 = "kv_block_ticks_int8"
 COST_SPILL_BYTES = "spill_bytes"                # spill/revive bytes moved
 COST_REPLAY_TOKENS = "replay_tokens"            # recovery/failover replay
 COST_FIELDS = (
@@ -402,9 +407,20 @@ COST_FIELDS = (
     COST_PREFILL_CHARGED,
     COST_PREFILL_CACHED,
     COST_KV_BLOCK_TICKS,
+    COST_KV_BLOCK_TICKS_INT8,
     COST_SPILL_BYTES,
     COST_REPLAY_TOKENS,
 )
+
+# Paged-KV pool storage dtypes (docs/quantized-kv.md). "fp16" names the
+# NATIVE tier — the pool stores cfg.jdtype exactly as before PR 20,
+# bit-for-bit (the name reads "full-precision sixteen-ish", not a cast:
+# an f32 config stays f32). "int8" stores one signed byte per element
+# plus one f32 amax-scale per (block, layer, k|v) — per-block, never
+# per-shard, so payloads stay tp-width-agnostic.
+KV_DTYPE_NATIVE = "fp16"
+KV_DTYPE_INT8 = "int8"
+KV_DTYPES = (KV_DTYPE_NATIVE, KV_DTYPE_INT8)
 # Receipt status vocabulary (the req.finish/failure terminus).
 RECEIPT_STATUS_OK = "ok"
 RECEIPT_STATUS_FAILED = "failed"

@@ -245,6 +245,7 @@ def init_paged_cache(
     block_size: int,
     mesh=None,
     tp_axis: str = "tp",
+    kv_dtype: Optional[str] = None,
 ) -> Dict:
     """A shared pool of fixed-size KV blocks [total_blocks, n_kv, block,
     head_dim] per layer. Sequences own disjoint block lists via a page
@@ -263,9 +264,24 @@ def init_paged_cache(
     positions >= its prefill cursor at admission — which the BlockManager
     places past every shared block — so shared blocks are read-only for
     every program of every tick; all writes (tail prefill chunks, decode
-    steps, verify windows) land in pages exactly one table row maps."""
+    steps, verify windows) land in pages exactly one table row maps.
+
+    `kv_dtype` (constants.KV_DTYPES, docs/quantized-kv.md): None or
+    "fp16" allocates the native pool exactly as before — bit-for-bit.
+    "int8" stores K/V as int8 and adds per-layer `k_scale`/`v_scale`
+    leaves [total_blocks] f32 — one amax scale per (block, layer, k|v),
+    REPLICATED under tp (scales are per-block, never per-shard, which is
+    what keeps spill payloads tp-width-agnostic)."""
+    from nos_tpu import constants
+
+    if kv_dtype is not None and kv_dtype not in constants.KV_DTYPES:
+        raise ValueError(
+            f"unknown kv_dtype {kv_dtype!r}; expected one of "
+            f"{constants.KV_DTYPES}"
+        )
+    quant = kv_dtype == constants.KV_DTYPE_INT8
     shape = (total_blocks, cfg.n_kv, block_size, cfg.head_dim)
-    sharding = None
+    sharding = scale_sharding = None
     if mesh is not None and tp_axis in mesh.shape and mesh.shape[tp_axis] > 1:
         # Tensor-parallel pool partition (docs/sharded-decode.md): each
         # device holds the n_kv/tp head-slices of EVERY block, so block
@@ -277,13 +293,28 @@ def init_paged_cache(
         sharding = NamedSharding(
             mesh, PartitionSpec(None, tp_axis, None, None)
         )
+        scale_sharding = NamedSharding(mesh, PartitionSpec(None))
 
     def _zeros():
-        z = jnp.zeros(shape, cfg.jdtype)
+        z = jnp.zeros(shape, jnp.int8 if quant else cfg.jdtype)
         return z if sharding is None else jax.device_put(z, sharding)
 
+    def _scales():
+        z = jnp.zeros((total_blocks,), jnp.float32)
+        return z if scale_sharding is None else jax.device_put(z, scale_sharding)
+
+    if not quant:
+        return {
+            str(i): {"k": _zeros(), "v": _zeros()}
+            for i in range(cfg.layers)
+        }
     return {
-        str(i): {"k": _zeros(), "v": _zeros()}
+        str(i): {
+            "k": _zeros(),
+            "v": _zeros(),
+            "k_scale": _scales(),
+            "v_scale": _scales(),
+        }
         for i in range(cfg.layers)
     }
 
@@ -306,10 +337,17 @@ def paged_decode_step(
     shard_map: the pool shard holds n_kv/tp head-slices of every block,
     the scatter/attention stay entirely local to the device's heads,
     and only the block-boundary gathers (`_block_core`) and the
-    embedding/head hooks touch the tp axis — all exact collectives."""
+    embedding/head hooks touch the tp axis — all exact collectives.
+
+    A quantized pool (`"k_scale" in lc` — init_paged_cache kv_dtype=
+    "int8") routes the write through the ops/quantized_kv.py funnel and
+    hands the scales to the attention op, which dequantizes inside the
+    read; the native pool takes the byte-identical pre-PR-20 path."""
     from nos_tpu.ops.paged_attention import paged_decode_attention
+    from nos_tpu.ops.quantized_kv import scatter_tokens
 
     mcfg = cfg if tp is None else tp.lcfg
+    axis_name = None if tp is None else tp.axis
     x = _embed(params, token[:, None], tp)
     positions = pos[:, None].astype(jnp.int32)
     page_idx = pos // block_size
@@ -322,11 +360,28 @@ def paged_decode_step(
         def attend(q, k_new, v_new, lc=lc, i=i):
             page = jnp.take_along_axis(table, page_idx[:, None], axis=1)[:, 0]
             page = jnp.where(mask, page, 0)  # inactive lanes hit scratch
+            limit = (pos + 1).astype(jnp.int32)
+            if "k_scale" in lc:
+                ck, ks = scatter_tokens(
+                    lc["k"], lc["k_scale"], page, off, k_new[:, :, 0, :],
+                    axis_name=axis_name,
+                )
+                cv, vs = scatter_tokens(
+                    lc["v"], lc["v_scale"], page, off, v_new[:, :, 0, :],
+                    axis_name=axis_name,
+                )
+                new_cache[str(i)] = {
+                    "k": ck, "v": cv, "k_scale": ks, "v_scale": vs
+                }
+                return paged_decode_attention(
+                    q[:, :, 0, :], ck, cv, table, limit,
+                    k_scale=ks, v_scale=vs,
+                )[:, :, None, :]
             ck = lc["k"].at[page, :, off, :].set(k_new[:, :, 0, :])
             cv = lc["v"].at[page, :, off, :].set(v_new[:, :, 0, :])
             new_cache[str(i)] = {"k": ck, "v": cv}
             return paged_decode_attention(
-                q[:, :, 0, :], ck, cv, table, (pos + 1).astype(jnp.int32)
+                q[:, :, 0, :], ck, cv, table, limit
             )[:, :, None, :]
 
         x = _block_core(x, p, mcfg, positions, attend, tp=tp)
@@ -355,8 +410,10 @@ def paged_prefill_chunk(
     chunk attends over the already-written prefix (exact causal masking
     within the chunk via _attend_cache). `tp`: see `paged_decode_step`."""
     from nos_tpu.ops.paged_attention import paged_window_attention
+    from nos_tpu.ops.quantized_kv import scatter_tokens
 
     mcfg = cfg if tp is None else tp.lcfg
+    axis_name = None if tp is None else tp.axis
     _, c = tokens.shape
     positions = start + jnp.arange(c, dtype=jnp.int32)
     valid = jnp.arange(c) < length
@@ -377,6 +434,22 @@ def paged_prefill_chunk(
         lc = pcache[str(i)]
 
         def attend(q, k_new, v_new, lc=lc, i=i):
+            if "k_scale" in lc:
+                ck, ks = scatter_tokens(
+                    lc["k"], lc["k_scale"], pages, offs,
+                    k_new[0].transpose(1, 0, 2), axis_name=axis_name,
+                )
+                cv, vs = scatter_tokens(
+                    lc["v"], lc["v_scale"], pages, offs,
+                    v_new[0].transpose(1, 0, 2), axis_name=axis_name,
+                )
+                new_cache[str(i)] = {
+                    "k": ck, "v": cv, "k_scale": ks, "v_scale": vs
+                }
+                return paged_window_attention(
+                    q, ck, cv, table, w_pos, w_len, w_mask,
+                    k_scale=ks, v_scale=vs,
+                )
             ck = lc["k"].at[pages, :, offs, :].set(k_new[0].transpose(1, 0, 2))
             cv = lc["v"].at[pages, :, offs, :].set(v_new[0].transpose(1, 0, 2))
             new_cache[str(i)] = {"k": ck, "v": cv}
@@ -411,8 +484,10 @@ def _paged_window_core(
     pages, attending causally over the confirmed prefix plus the window.
     Returns (pre-final-norm activations [B, W, h], new pool)."""
     from nos_tpu.ops.paged_attention import paged_window_attention
+    from nos_tpu.ops.quantized_kv import scatter_tokens
 
     mcfg = cfg if tp is None else tp.lcfg
+    axis_name = None if tp is None else tp.axis
     b, w = tokens.shape
     positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
     valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]
@@ -437,6 +512,27 @@ def _paged_window_core(
         lc = pcache[str(i)]
 
         def attend(q, k_new, v_new, lc=lc, i=i):
+            if "k_scale" in lc:
+                nkv, hd = k_new.shape[1], k_new.shape[3]
+                ck, ks = scatter_tokens(
+                    lc["k"], lc["k_scale"],
+                    pages.reshape(-1), offs.reshape(-1),
+                    k_new.transpose(0, 2, 1, 3).reshape(b * w, nkv, hd),
+                    axis_name=axis_name,
+                )
+                cv, vs = scatter_tokens(
+                    lc["v"], lc["v_scale"],
+                    pages.reshape(-1), offs.reshape(-1),
+                    v_new.transpose(0, 2, 1, 3).reshape(b * w, nkv, hd),
+                    axis_name=axis_name,
+                )
+                new_cache[str(i)] = {
+                    "k": ck, "v": cv, "k_scale": ks, "v_scale": vs
+                }
+                return paged_window_attention(
+                    q, ck, cv, table, pos, lengths, mask,
+                    k_scale=ks, v_scale=vs,
+                )
             ck = lc["k"].at[pages, :, offs, :].set(k_new.transpose(0, 2, 1, 3))
             cv = lc["v"].at[pages, :, offs, :].set(v_new.transpose(0, 2, 1, 3))
             new_cache[str(i)] = {"k": ck, "v": cv}
@@ -579,7 +675,14 @@ def generate(
     def pick(logits, key):
         if temperature > 0.0:
             return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        # Lowest-index tie-break, NOT jnp.argmax: argmax's tie behavior is
+        # not stable across fused programs, and the DecodeServer's greedy
+        # sampler resolves exact logit ties toward the lowest token id —
+        # this dense-reference path must agree with it token for token.
+        vocab = logits.shape[-1]
+        top = jnp.max(logits, axis=-1, keepdims=True)
+        idx = jnp.arange(vocab, dtype=jnp.int32)
+        return jnp.min(jnp.where(logits == top, idx, vocab), axis=-1)
 
     keys = jax.random.split(rng, steps)
     first = pick(logits, keys[0]).astype(jnp.int32)

@@ -139,6 +139,14 @@ METRIC_SERIES: Tuple[MetricSpec, ...] = (
     MetricSpec("nos_tpu_decode_kv_blocks_spilled", "gauge", "kv_blocks_spilled"),
     MetricSpec("nos_tpu_decode_radix_nodes", "gauge", "radix_nodes"),
     MetricSpec("nos_tpu_decode_spill_host_bytes", "gauge", "spill_host_bytes"),
+    # -- quantized-KV tier (docs/quantized-kv.md) --
+    MetricSpec("nos_tpu_decode_kv_quant_enabled", "gauge", "kv_quant_enabled"),
+    MetricSpec("nos_tpu_decode_kv_quant_pool_bytes", "gauge", "kv_pool_bytes"),
+    MetricSpec(
+        "nos_tpu_decode_kv_quant_payload_rejected",
+        "counter",
+        "kv_quant_payload_rejected",
+    ),
     MetricSpec("nos_tpu_decode_inflight_dispatches", "gauge", "inflight_dispatches"),
     MetricSpec("nos_tpu_decode_pending_verifies", "gauge", "pending_verifies"),
     MetricSpec("nos_tpu_decode_waiting_requests", "gauge", "waiting_requests"),

@@ -31,20 +31,36 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _reference(q, pool_k, pool_v, table, limit):
+def _gather_pool(pool, table, scale, out_dtype):
+    """Materialize `pool[table]` -> [B, nkv, P*bs, hd]. With a per-block
+    `scale` [T] the pool is int8 and the gather DEQUANTIZES in place
+    (ops/quantized_kv.py format: row * scale[block]), cast to
+    `out_dtype` so downstream math sees exactly the native path's dtypes
+    with perturbed values — the whole int8 read path in one multiply."""
+    g = pool[table]  # [B, P, nkv, bs, hd]
+    b, p, nkv, bs, hd = g.shape
+    if scale is not None:
+        s = scale[table]  # [B, P]
+        g = (g.astype(jnp.float32) * s[:, :, None, None, None]).astype(out_dtype)
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, nkv, p * bs, hd)
+
+
+def _reference(q, pool_k, pool_v, table, limit, k_scale=None, v_scale=None):
     """The gather formulation: q [B,nh,hd]; pool [T,nkv,bs,hd]; table [B,P]
-    int32; limit [B] -> [B,nh,hd]."""
+    int32; limit [B] -> [B,nh,hd]. `k_scale`/`v_scale` [T]: the int8 pool's
+    per-block scales (None = native pool, byte-identical gather)."""
     from nos_tpu.ops.decode_attention import _reference as dense_reference
 
-    def gather(pool):
-        g = pool[table]  # [B, P, nkv, bs, hd]
-        b, p, nkv, bs, hd = g.shape
-        return g.transpose(0, 2, 1, 3, 4).reshape(b, nkv, p * bs, hd)
+    return dense_reference(
+        q,
+        _gather_pool(pool_k, table, k_scale, q.dtype),
+        _gather_pool(pool_v, table, v_scale, q.dtype),
+        limit,
+    )
 
-    return dense_reference(q, gather(pool_k), gather(pool_v), limit)
 
-
-def _pallas(q, pool_k, pool_v, table, limit, interpret: bool = False):
+def _pallas(q, pool_k, pool_v, table, limit, k_scale=None, v_scale=None,
+            interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -57,9 +73,17 @@ def _pallas(q, pool_k, pool_v, table, limit, interpret: bool = False):
     if rep_p != rep:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_p - rep), (0, 0)))
     scale = hd ** -0.5
+    # int8 pool: the per-block scales ride as [T, 1] VMEM operands whose
+    # block index map follows the SAME prefetched table lookup as the
+    # pools — dequantization is one scalar multiply per streamed block,
+    # inside the kernel, so the HBM read stays one byte per element.
+    quant = k_scale is not None
 
-    def kernel(table_ref, limit_ref, q_ref, k_ref, v_ref, o_ref,
-               m_ref, l_ref, acc_ref):
+    def kernel(table_ref, limit_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
         i = pl.program_id(0)
         p = pl.program_id(2)
 
@@ -72,6 +96,10 @@ def _pallas(q, pool_k, pool_v, table, limit, interpret: bool = False):
         lim = limit_ref[i]
         qf = q_ref[0, 0].astype(jnp.float32)          # [rep_p, hd]
         kf = k_ref[0, 0].astype(jnp.float32)          # [bs, hd]
+        vf = v_ref[0, 0].astype(jnp.float32)          # [bs, hd]
+        if quant:
+            kf = kf * ks_ref[0, 0]
+            vf = vf * vs_ref[0, 0]
         s = jax.lax.dot_general(
             qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                      # [rep_p, bs]
@@ -90,7 +118,7 @@ def _pallas(q, pool_k, pool_v, table, limit, interpret: bool = False):
         l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
         l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            e, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            e, vf, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -103,16 +131,28 @@ def _pallas(q, pool_k, pool_v, table, limit, interpret: bool = False):
                 acc_ref[...] / jnp.maximum(l_fin, 1e-30)
             ).astype(o_ref.dtype)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, rep_p, hd), lambda i, g, p, tr, lr: (i, g, 0, 0)),
+        # THE point of the kernel: the page id comes straight from the
+        # prefetched table — Mosaic streams only the owned blocks.
+        pl.BlockSpec((1, 1, bs, hd), lambda i, g, p, tr, lr: (tr[i, p], g, 0, 0)),
+        pl.BlockSpec((1, 1, bs, hd), lambda i, g, p, tr, lr: (tr[i, p], g, 0, 0)),
+    ]
+    operands = [table.astype(jnp.int32), limit.astype(jnp.int32), qg,
+                pool_k, pool_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1), lambda i, g, p, tr, lr: (tr[i, p], 0)),
+            pl.BlockSpec((1, 1), lambda i, g, p, tr, lr: (tr[i, p], 0)),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32).reshape(t, 1),
+            v_scale.astype(jnp.float32).reshape(t, 1),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # (table, limit) ride in SMEM
         grid=(b, nkv, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, rep_p, hd), lambda i, g, p, tr, lr: (i, g, 0, 0)),
-            # THE point of the kernel: the page id comes straight from the
-            # prefetched table — Mosaic streams only the owned blocks.
-            pl.BlockSpec((1, 1, bs, hd), lambda i, g, p, tr, lr: (tr[i, p], g, 0, 0)),
-            pl.BlockSpec((1, 1, bs, hd), lambda i, g, p, tr, lr: (tr[i, p], g, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, rep_p, hd), lambda i, g, p, tr, lr: (i, g, 0, 0)
         ),
@@ -127,7 +167,7 @@ def _pallas(q, pool_k, pool_v, table, limit, interpret: bool = False):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, nkv, rep_p, hd), q.dtype),
         interpret=interpret,
-    )(table.astype(jnp.int32), limit.astype(jnp.int32), qg, pool_k, pool_v)
+    )(*operands)
     return out[:, :, :rep, :].reshape(b, nh, hd)
 
 
@@ -151,7 +191,7 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 
 
 def _pallas_sharded(q, pool_k, pool_v, table, limit, mesh, tp_axis,
-                    interpret: bool = False):
+                    k_scale=None, v_scale=None, interpret: bool = False):
     """The single-token kernel on a tensor-parallel mesh: the pool is
     head-sharded ([T, nkv@tp, bs, hd]) and q head-sharded to match, so
     each device runs the UNCHANGED kernel over its own n_kv/tp groups
@@ -159,77 +199,94 @@ def _pallas_sharded(q, pool_k, pool_v, table, limit, mesh, tp_axis,
     limits ride in replicated. Per-(sequence, group) math is independent
     (the online softmax never crosses heads), so the shard_map'd kernel
     is bit-identical to the unsharded one per head: no collective runs
-    inside or after the kernel."""
+    inside or after the kernel. int8 scales replicate like the table —
+    they are per-BLOCK, not per-shard (docs/quantized-kv.md), so every
+    device dequantizes its head-slice with the same scalar."""
     from jax.sharding import PartitionSpec as P
 
+    args = [q, pool_k, pool_v, table, limit]
+    in_specs = [
+        P(None, tp_axis, None),
+        P(None, tp_axis, None, None),
+        P(None, tp_axis, None, None),
+        P(None, None),
+        P(None),
+    ]
+    if k_scale is not None:
+        args += [k_scale, v_scale]
+        in_specs += [P(None), P(None)]
     return _shard_map(
         functools.partial(_pallas, interpret=interpret),
         mesh,
-        in_specs=(
-            P(None, tp_axis, None),
-            P(None, tp_axis, None, None),
-            P(None, tp_axis, None, None),
-            P(None, None),
-            P(None),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, tp_axis, None),
-    )(q, pool_k, pool_v, table, limit)
+    )(*args)
 
 
 def _window_pallas_sharded(q, pool_k, pool_v, table, pos, lengths, mask,
-                           mesh, tp_axis, interpret: bool = False):
+                           mesh, tp_axis, k_scale=None, v_scale=None,
+                           interpret: bool = False):
     """`_window_pallas` on a tensor-parallel mesh — same argument as
     `_pallas_sharded`: q [B, nh@tp, W, hd] and the pools [T, nkv@tp, bs,
-    hd] shard on heads, the scalar-prefetch operands replicate, and each
-    device's kernel instance computes its heads' windows exactly as the
-    single-device kernel would."""
+    hd] shard on heads, the scalar-prefetch operands (and the per-block
+    int8 scales) replicate, and each device's kernel instance computes
+    its heads' windows exactly as the single-device kernel would."""
     from jax.sharding import PartitionSpec as P
 
+    args = [q, pool_k, pool_v, table, pos, lengths, mask]
+    in_specs = [
+        P(None, tp_axis, None, None),
+        P(None, tp_axis, None, None),
+        P(None, tp_axis, None, None),
+        P(None, None),
+        P(None),
+        P(None),
+        P(None),
+    ]
+    if k_scale is not None:
+        args += [k_scale, v_scale]
+        in_specs += [P(None), P(None)]
     return _shard_map(
         functools.partial(_window_pallas, interpret=interpret),
         mesh,
-        in_specs=(
-            P(None, tp_axis, None, None),
-            P(None, tp_axis, None, None),
-            P(None, tp_axis, None, None),
-            P(None, None),
-            P(None),
-            P(None),
-            P(None),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, tp_axis, None, None),
-    )(q, pool_k, pool_v, table, pos, lengths, mask)
+    )(*args)
 
 
 # -- windowed-query variant (PR 10) ------------------------------------------
-def _window_reference(q, pool_k, pool_v, table, pos, lengths, mask):
+def _window_reference(q, pool_k, pool_v, table, pos, lengths, mask,
+                      k_scale=None, v_scale=None):
     """The gather formulation of the windowed read: q [B,nh,W,hd]; pool
     [T,nkv,bs,hd]; table [B,P]; pos/lengths [B]; mask [B] bool ->
     [B,nh,W,hd]. Deliberately the EXACT ops `_paged_window_core` used
     before the kernel existed (gather + models.decode._attend_cache), so
     the reference backend's numerics are bit-identical to the pre-kernel
-    engine — every greedy exactness oracle carries over unchanged."""
+    engine — every greedy exactness oracle carries over unchanged. With
+    `k_scale`/`v_scale` the pool is int8 and the gather dequantizes
+    per block (`_gather_pool`); the attention math is otherwise the
+    native path's, fed perturbed values."""
     from nos_tpu.models.decode import _attend_cache
 
     b, nh, w, hd = q.shape
     nkv = pool_k.shape[1]
-
-    def gather(pool):
-        g = pool[table]  # [B, P, nkv, bs, hd]
-        bb, p, kk, bs, dd = g.shape
-        return g.transpose(0, 2, 1, 3, 4).reshape(bb, kk, p * bs, dd)
-
     positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
     valid = (jnp.arange(w)[None, :] < lengths[:, None]) & mask[:, None]
     # Invalid rows attend the scratch page's first position only (an
     # all-masked score row would softmax to NaN) — same guard as the
     # window core always applied.
     limit = jnp.where(valid, positions + 1, 1)  # [B, W]
-    return _attend_cache(q, gather(pool_k), gather(pool_v), nh // nkv, limit)
+    return _attend_cache(
+        q,
+        _gather_pool(pool_k, table, k_scale, q.dtype),
+        _gather_pool(pool_v, table, v_scale, q.dtype),
+        nh // nkv,
+        limit,
+    )
 
 
 def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
-                   interpret: bool = False):
+                   k_scale=None, v_scale=None, interpret: bool = False):
     """In-kernel paged gather for W query tokens per sequence: the page
     table, window base positions, and lengths ride as SCALAR-PREFETCH
     operands; the K/V BlockSpec index maps read `(table[b, p], g, 0, 0)`
@@ -257,9 +314,16 @@ def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
     if rows_p != rows:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_p - rows), (0, 0)))
     scale = hd ** -0.5
+    # int8 pool: per-block scales as [T, 1] VMEM operands indexed by the
+    # same prefetched table lookup (see `_pallas`).
+    quant = k_scale is not None
 
     def kernel(table_ref, pos_ref, len_ref, mask_ref, q_ref, k_ref, v_ref,
-               o_ref, m_ref, l_ref, acc_ref):
+               *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
         i = pl.program_id(0)
         p = pl.program_id(2)
 
@@ -271,6 +335,10 @@ def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
 
         qf = q_ref[0, 0].astype(jnp.float32)          # [rows_p, hd]
         kf = k_ref[0, 0].astype(jnp.float32)          # [bs, hd]
+        vf = v_ref[0, 0].astype(jnp.float32)          # [bs, hd]
+        if quant:
+            kf = kf * ks_ref[0, 0]
+            vf = vf * vs_ref[0, 0]
         s = jax.lax.dot_general(
             qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                      # [rows_p, bs]
@@ -291,7 +359,7 @@ def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
         l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
         l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            e, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            e, vf, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -304,20 +372,39 @@ def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
                 acc_ref[...] / jnp.maximum(l_fin, 1e-30)
             ).astype(o_ref.dtype)
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, rows_p, hd), lambda i, g, p, tr, pr, lr, mr: (i, g, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, bs, hd), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], g, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, bs, hd), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], g, 0, 0)
+        ),
+    ]
+    operands = [
+        table.astype(jnp.int32),
+        pos.astype(jnp.int32),
+        lengths.astype(jnp.int32),
+        mask.astype(jnp.int32),
+        qg,
+        pool_k,
+        pool_v,
+    ]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], 0)),
+            pl.BlockSpec((1, 1), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], 0)),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32).reshape(t, 1),
+            v_scale.astype(jnp.float32).reshape(t, 1),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,  # (table, pos, lengths, mask) ride in SMEM
         grid=(b, nkv, n_pages),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, rows_p, hd), lambda i, g, p, tr, pr, lr, mr: (i, g, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bs, hd), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], g, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bs, hd), lambda i, g, p, tr, pr, lr, mr: (tr[i, p], g, 0, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, rows_p, hd), lambda i, g, p, tr, pr, lr, mr: (i, g, 0, 0)
         ),
@@ -332,20 +419,13 @@ def _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, nkv, rows_p, hd), q.dtype),
         interpret=interpret,
-    )(
-        table.astype(jnp.int32),
-        pos.astype(jnp.int32),
-        lengths.astype(jnp.int32),
-        mask.astype(jnp.int32),
-        qg,
-        pool_k,
-        pool_v,
-    )
+    )(*operands)
     return out[:, :, :rows, :].reshape(b, nkv, rep, w, hd).reshape(b, nh, w, hd)
 
 
 def paged_window_attention(q, pool_k, pool_v, table, pos, lengths, mask,
-                           mesh=None, tp_axis: str = "tp"):
+                           mesh=None, tp_axis: str = "tp",
+                           k_scale=None, v_scale=None):
     """Windowed-query attention over a block-paged KV pool: q [B,nh,W,hd]
     (W window tokens per sequence, already written into the pool by the
     caller), table [B,P] page ids, pos [B] window base positions,
@@ -362,27 +442,42 @@ def paged_window_attention(q, pool_k, pool_v, table, pos, lengths, mask,
     consumes its n_kv/tp slice of every pool block with the table
     replicated in SMEM, per-head bit-identical to the unsharded kernel.
     The gather reference needs no wrapping: its einsums batch over the
-    sharded head dim and GSPMD keeps them local."""
+    sharded head dim and GSPMD keeps them local.
+
+    `k_scale`/`v_scale` [T] f32 (both or neither): the pools are int8
+    (ops/quantized_kv.py) and the read path dequantizes per block —
+    inside the kernel on TPU (one byte per element off HBM), inside the
+    gather in the reference. None = native pools, byte-identical to the
+    pre-quantization op."""
     if _use_pallas():
         if _tp_width(mesh, tp_axis) > 1:
             return _window_pallas_sharded(
-                q, pool_k, pool_v, table, pos, lengths, mask, mesh, tp_axis
+                q, pool_k, pool_v, table, pos, lengths, mask, mesh, tp_axis,
+                k_scale=k_scale, v_scale=v_scale,
             )
-        return _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask)
-    return _window_reference(q, pool_k, pool_v, table, pos, lengths, mask)
+        return _window_pallas(q, pool_k, pool_v, table, pos, lengths, mask,
+                              k_scale=k_scale, v_scale=v_scale)
+    return _window_reference(q, pool_k, pool_v, table, pos, lengths, mask,
+                             k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_decode_attention(q, pool_k, pool_v, table, limit,
-                           mesh=None, tp_axis: str = "tp"):
+                           mesh=None, tp_axis: str = "tp",
+                           k_scale=None, v_scale=None):
     """Single-token attention over a block-paged KV pool: q [B,nh,hd],
     pool [total_blocks,nkv,block,hd], table [B,P] (page ids per sequence,
     rows beyond a sequence's allocation point at the scratch page), limit
     [B] attention bounds. Pallas scalar-prefetch kernel on TPU (no
     materialized gather); XLA gather reference elsewhere. `mesh`/
     `tp_axis`: see `paged_window_attention` — the kernel shard_maps over
-    heads, the reference shards through GSPMD propagation."""
+    heads, the reference shards through GSPMD propagation. `k_scale`/
+    `v_scale`: int8-pool per-block dequantization scales (see
+    `paged_window_attention`); None = the native path, byte-identical."""
     if _use_pallas():
         if _tp_width(mesh, tp_axis) > 1:
-            return _pallas_sharded(q, pool_k, pool_v, table, limit, mesh, tp_axis)
-        return _pallas(q, pool_k, pool_v, table, limit)
-    return _reference(q, pool_k, pool_v, table, limit)
+            return _pallas_sharded(q, pool_k, pool_v, table, limit, mesh,
+                                   tp_axis, k_scale=k_scale, v_scale=v_scale)
+        return _pallas(q, pool_k, pool_v, table, limit,
+                       k_scale=k_scale, v_scale=v_scale)
+    return _reference(q, pool_k, pool_v, table, limit,
+                      k_scale=k_scale, v_scale=v_scale)

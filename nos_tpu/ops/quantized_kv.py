@@ -1,0 +1,173 @@
+"""Int8 per-block quantization for the paged KV pool (docs/quantized-kv.md).
+
+The pool's byte economy is HBM-bound end to end: pool capacity, radix
+residency, spill traffic, fleet-store footprint, handoff bytes. Storing
+K/V as int8 with one f32 amax-scale per (block, layer, k|v) roughly
+halves every one of those paths at a bounded, measured quality cost
+(runtime/divergence.py prices it; docs/benchmark.md quotes it).
+
+This module is the ONE write funnel and the ONE dequantization site for
+quantized pool state — the NOS024 checker (analysis/checkers/
+quant_discipline.py) rejects scale-array writes or dequant calls
+anywhere else, exactly like NOS011/NOS019 guard their single-mutator
+disciplines. Everything here is jit-compatible pure array math; the
+engine wraps these helpers in its own jit/shard_map plumbing.
+
+Format invariants the funnel maintains:
+
+  - `scale[b]` is the CURRENT quantization step of block b: stored int8
+    row `q` decodes as `q * scale[b]` (scale 0.0 = never written, decodes
+    as zeros through the `safe` guard).
+  - Scales are per-BLOCK, per-layer, per-(k|v) — never per-shard, so a
+    spilled payload revives at any tp width (the PR 11 property).
+  - A write at block offset 0 RESETS the block's scale before folding the
+    new rows' amax in: offset 0 is, by the pool's sequential write
+    discipline, always the first write of a block's new occupancy, and
+    without the reset a freed block would inherit its previous occupant's
+    (possibly huge) scale forever — a quality ratchet, not an error you
+    could see in conservation counters.
+  - Within an occupancy the scale is monotone non-decreasing, and growth
+    REQUANTIZES the block's existing rows under the new scale. When the
+    scale does not change, requantization is exactly idempotent:
+    round(q * s / s) == q in float32 for |q| <= 127 — which is why the
+    scatter-max runs on the scale array directly (an amax*127/127 round
+    trip would break that exactness).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+#: int8 code range. +-127 (not -128): symmetric, so dequantization is a
+#: single multiply and negation round-trips exactly.
+QMAX = 127.0
+
+
+def safe_scale(scale):
+    """Scale with the never-written guard: 0.0 (a zeroed block) divides
+    and multiplies as 1.0, so untouched blocks stay exactly zero through
+    a quantize/dequantize round trip."""
+    return jnp.where(scale > 0.0, scale, 1.0)
+
+
+def quantize_rows(vals, scale):
+    """Quantize `vals` [..., ] under per-row `scale` (broadcast against
+    vals' leading axis). Returns int8 codes."""
+    q = jnp.round(vals.astype(jnp.float32) / safe_scale(scale))
+    return jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+
+
+def dequantize(q, scale):
+    """Decode int8 codes under `scale` (broadcastable) to float32 —
+    the module's one dequantization primitive; the paged-attention
+    reference and kernel inline the same multiply."""
+    return q.astype(jnp.float32) * scale
+
+
+def scatter_tokens(pool_q, scale, pages, offs, vals, axis_name=None):
+    """The pool write funnel: scatter token rows into the int8 pool.
+
+    pool_q [T, nkv, bs, hd] int8; scale [T] f32; pages/offs [N] int32;
+    vals [N, nkv, hd] (any float dtype). Returns (new_pool_q, new_scale).
+
+    `axis_name`: the tensor-parallel mesh axis when this runs inside a
+    shard_map over head-sharded pool shards — the row amax is pmax'd
+    across it so every device derives the same per-BLOCK scale from its
+    local heads (scales are replicated, never per-shard; without the
+    pmax each shard would ratchet its own copy and the replication
+    invariant would silently break).
+
+    Three steps, all scatter-deterministic (min/max scatters commute;
+    value scatters only ever carry duplicate-identical rows):
+
+      1. scale maintenance — reset pages written at offset 0 (fresh
+         occupancy), then scatter-MAX the new rows' amax/127 in;
+      2. requantize the touched blocks' existing rows from the old scale
+         to the new one (exactly idempotent when the scale held);
+      3. quantize the new rows under the final scale and scatter them.
+
+    Rows aimed at the scratch page (page 0, masked-off lanes) pollute
+    only scratch state, which nothing ever attends unmasked — same
+    contract as the native scatter sites.
+    """
+    vals_f = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(vals_f), axis=(1, 2))  # [N]
+    if axis_name is not None:
+        import jax
+
+        amax = jax.lax.pmax(amax, axis_name)
+    fresh = offs == 0
+    s_old = scale[pages]  # [N] — pre-update, for the requant ratio
+    scale = scale.at[pages].min(jnp.where(fresh, 0.0, jnp.inf))
+    scale = scale.at[pages].max(amax / QMAX)
+    s_new = scale[pages]  # [N] — post-update, duplicates agree
+    # Requantize existing content of every touched block. ratio == 1.0
+    # exactly when the scale held, so steady-state writes do not perturb
+    # neighbors; a fresh page's "existing content" is the previous
+    # occupant's garbage, overwritten before anything attends it.
+    ratio = safe_scale(s_old) / safe_scale(s_new)  # [N]
+    old_rows = pool_q[pages].astype(jnp.float32)  # [N, nkv, bs, hd]
+    requant = jnp.clip(
+        jnp.round(old_rows * ratio[:, None, None, None]), -QMAX, QMAX
+    ).astype(jnp.int8)
+    pool_q = pool_q.at[pages].set(requant)
+    new_rows = quantize_rows(vals_f, s_new[:, None, None])  # [N, nkv, hd]
+    pool_q = pool_q.at[pages, :, offs, :].set(new_rows)
+    return pool_q, scale
+
+
+# -- whole-block movement (spill copy-out, revive copy-in, COW) ---------------
+# The engine jits these under its own tp sharding specs; keeping the
+# scale-array writes here (not in decode_server.py) is what makes the
+# NOS024 "scale writes only in ops/" discipline honest.
+
+def extract_block(cache: Dict, block, layers: int) -> Tuple:
+    """Copy-out of one block's quantized K/V + scales across layers:
+    (k_q [L,nkv,bs,hd] int8, v_q int8, k_scale [L] f32, v_scale [L] f32).
+    The stacked layout mirrors the native extract, so payloads keep the
+    tp-width-agnostic full-KV-head shape."""
+    k = jnp.stack([cache[str(i)]["k"][block] for i in range(layers)])
+    v = jnp.stack([cache[str(i)]["v"][block] for i in range(layers)])
+    ks = jnp.stack([cache[str(i)]["k_scale"][block] for i in range(layers)])
+    vs = jnp.stack([cache[str(i)]["v_scale"][block] for i in range(layers)])
+    return k, v, ks, vs
+
+
+def revive_block(cache: Dict, k, v, ks, vs, block) -> Dict:
+    """Copy-in of one extracted block: verbatim int8 bytes + their
+    scales, so spill -> revive is bit-exact within the int8 tier (the
+    bounded-divergence budget is spent at quantize time, never on tier
+    movement)."""
+    out = {}
+    for i in range(k.shape[0]):
+        lc = cache[str(i)]
+        out[str(i)] = {
+            "k": lc["k"].at[block].set(k[i]),
+            "v": lc["v"].at[block].set(v[i]),
+            "k_scale": lc["k_scale"].at[block].set(ks[i]),
+            "v_scale": lc["v_scale"].at[block].set(vs[i]),
+        }
+    return out
+
+
+def cow_copy_block(cache: Dict, src, dst, length, block_size: int) -> Dict:
+    """Copy-on-write head copy, quantized: the first `length` token rows
+    of `src` move to `dst` VERBATIM (int8 codes + the source's scale —
+    no requantization, so a COW costs zero quality), the garbage tail
+    masked to zero codes. The destination's subsequent tail writes grow
+    the scale through `scatter_tokens` like any mid-block append."""
+    mask = (jnp.arange(block_size) < length)[None, :, None]
+    zero = jnp.zeros((), jnp.int8)
+    out = {}
+    for key in cache:
+        lc = cache[key]
+        k, v = lc["k"], lc["v"]
+        out[key] = {
+            "k": k.at[dst].set(jnp.where(mask, k[src], zero)),
+            "v": v.at[dst].set(jnp.where(mask, v[src], zero)),
+            "k_scale": lc["k_scale"].at[dst].set(lc["k_scale"][src]),
+            "v_scale": lc["v_scale"].at[dst].set(lc["v_scale"][src]),
+        }
+    return out

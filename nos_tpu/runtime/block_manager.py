@@ -118,12 +118,18 @@ class BlockManager:
         n_slots: int,
         fault_injector=None,
         radix: bool = False,
+        key_salt: str = "",
     ):
         if total_blocks < 2:
             raise ValueError("total_blocks must be >= 2 (scratch + 1)")
         self.total_blocks = int(total_blocks)
         self.block_size = int(block_size)
         self.n_slots = int(n_slots)
+        #: chain-key root salt (runtime/radix_tree.prompt_chain_keys):
+        #: a quantized-pool engine salts its key space with the payload
+        #: dtype so fp16 and int8 bytes can never alias in a shared
+        #: store (docs/quantized-kv.md).
+        self.key_salt = str(key_salt)
         # Deterministic chaos harness (runtime/faults.py FaultInjector):
         # the `block_admit` site fires at admission ENTRY, before any pool
         # mutation, so an injected fault can never leave half-taken state
@@ -155,7 +161,9 @@ class BlockManager:
         # COW source block per slot (an extra refcount not backed by a
         # page table, held until `cow_done`/release so eviction cannot
         # reuse the source before the copy dispatches).
-        self._tree: Optional[RadixTree] = RadixTree() if radix else None
+        self._tree: Optional[RadixTree] = (
+            RadixTree(key_salt=self.key_salt) if radix else None
+        )
         self._slot_blocks_tokens: List[List[Tuple[int, ...]]] = [
             [] for _ in range(self.n_slots)
         ]
@@ -283,7 +291,7 @@ class BlockManager:
 
     def prompt_keys(self, prompt: Sequence[int]) -> List[str]:
         """Chain keys for every block FULLY covered by the prompt."""
-        return prompt_chain_keys(prompt, self.block_size)
+        return prompt_chain_keys(prompt, self.block_size, self.key_salt)
 
     def device_resident(self, key: str) -> bool:
         """Whether a chain key is already indexed on device — the
@@ -310,7 +318,7 @@ class BlockManager:
             )
             return len(dev_keys), len(host_keys)
         cap = cacheable_block_cap(len(prompt), self.block_size)
-        keys = prompt_chain_keys(prompt, self.block_size)[:cap]
+        keys = prompt_chain_keys(prompt, self.block_size, self.key_salt)[:cap]
         dev = 0
         for key in keys:
             if key not in self._prefix_index:
@@ -792,7 +800,7 @@ class BlockManager:
         existing = len(self._slot_keys[idx])
         if n_full <= existing or n_full > len(self._slot_blocks[idx]):
             return
-        keys = prompt_chain_keys(seq, bs)[:n_full]
+        keys = prompt_chain_keys(seq, bs, self.key_salt)[:n_full]
         blocks_tokens = [tuple(seq[b * bs : (b + 1) * bs]) for b in range(n_full)]
         for b in range(existing, n_full):
             block = self._slot_blocks[idx][b]

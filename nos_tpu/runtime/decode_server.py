@@ -357,6 +357,7 @@ class DecodeServer:
         transient_backoff_s: float = 0.02,
         checkpoint_hook=None,
         cost_ledger=None,
+        kv_dtype: str = constants.KV_DTYPE_NATIVE,
     ):
         """`temperature` 0 = greedy (bit-identical to solo decoding); > 0 =
         softmax sampling with a deterministic per-slot, per-step PRNG stream
@@ -711,9 +712,22 @@ class DecodeServer:
         )
         if self.total_blocks < 2:
             raise ValueError("total_blocks must be >= 2 (scratch + 1)")
+        # Quantized-KV tier (docs/quantized-kv.md): "fp16"/native keeps
+        # today's pool BIT-FOR-BIT; "int8" stores K/V as int8 codes with
+        # per-block f32 scales — ~half the bytes on the pool and on
+        # every spill/store/handoff path, verified by the bounded-
+        # divergence oracle (runtime/divergence.py) instead of the
+        # bit-exact house oracles.
+        if kv_dtype not in constants.KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {constants.KV_DTYPES}: {kv_dtype!r}"
+            )
+        self.kv_dtype = kv_dtype
+        self._kv_quant = kv_dtype == constants.KV_DTYPE_INT8
         self.cache = init_paged_cache(
             cfg, self.total_blocks, self.block_size,
             mesh=self._mesh, tp_axis=tp_axis,
+            kv_dtype=kv_dtype if self._kv_quant else None,
         )
         # Host->device staging discipline (runtime/staging.py, NOS015):
         # every tick-path upload funnels through the counted HostStage;
@@ -747,6 +761,11 @@ class DecodeServer:
         self._block_mgr = BlockManager(
             self.total_blocks, self.block_size, n_slots,
             fault_injector=fault_injector, radix=self.radix_cache,
+            # Quantized pools salt the chain-key space with the payload
+            # dtype: int8 and fp16 replicas sharing one FleetKVStore can
+            # never alias each other's bytes (docs/quantized-kv.md). The
+            # native pool keeps the unsalted pre-PR-20 keys bit-for-bit.
+            key_salt=(self.kv_dtype + ":") if self._kv_quant else "",
         )
         if self._recorder is not None:
             self._block_mgr.attach_recorder(self._recorder)
@@ -760,14 +779,23 @@ class DecodeServer:
         # spill/revive byte unit; 0 with the tier disabled).
         self._bytes_per_block = 0
         if kv_store is not None or spill_blocks > 0:
-            bytes_per_block = (
-                cfg.layers
-                * 2
-                * cfg.n_kv
-                * self.block_size
-                * cfg.head_dim
-                * np.dtype(cfg.jdtype).itemsize
-            )
+            if self._kv_quant:
+                # int8 codes (1 byte/elem) + one f32 scale per (layer,
+                # k|v) — exactly the nbytes of the tagged payload
+                # _extract_block ships, so byte gauges stay honest for
+                # variable-dtype tiers.
+                bytes_per_block = cfg.layers * 2 * (
+                    cfg.n_kv * self.block_size * cfg.head_dim + 4
+                )
+            else:
+                bytes_per_block = (
+                    cfg.layers
+                    * 2
+                    * cfg.n_kv
+                    * self.block_size
+                    * cfg.head_dim
+                    * np.dtype(cfg.jdtype).itemsize
+                )
             self._bytes_per_block = int(bytes_per_block)
             if kv_store is not None:
                 # Fleet-scope shared cold tier (serving/kv_store.py):
@@ -823,6 +851,10 @@ class DecodeServer:
         self.slot_seconds_total = 0.0
         self.kv_block_ticks = 0
         self.cost_receipts = 0
+        # Quantized-KV tier counters (docs/quantized-kv.md): payloads
+        # whose wire dtype mismatched this engine's pool (rejected ->
+        # recomputed, never attended).
+        self.kv_quant_payload_rejected = 0
         # Delta-mirror shadow for monotonic counters owned by the tier /
         # manager / policy (published into the metrics registry per tick).
         self._metric_shadow: Dict[str, int] = {}
@@ -1007,7 +1039,19 @@ class DecodeServer:
 
             _R = _P()
             _KV = _P(None, tp_axis, None, None)
-            _CS = {str(i): {"k": _KV, "v": _KV} for i in range(cfg.layers)}
+            # Per-block scales are REPLICATED (per-block, never per-
+            # shard: the tp-width-agnostic payload property) — P(None),
+            # matching the pmax in the ops/quantized_kv.py funnel.
+            if self._kv_quant:
+                _SC = _P(None)
+                _CS = {
+                    str(i): {
+                        "k": _KV, "v": _KV, "k_scale": _SC, "v_scale": _SC
+                    }
+                    for i in range(cfg.layers)
+                }
+            else:
+                _CS = {str(i): {"k": _KV, "v": _KV} for i in range(cfg.layers)}
             _PS = self._param_specs
         else:
             _R = _KV = _CS = _PS = None
@@ -1171,18 +1215,29 @@ class DecodeServer:
         # program serves every block id.
         L = cfg.layers
 
-        def _extract(cache, block):
-            k = jnp.stack([cache[str(i)]["k"][block] for i in range(L)])
-            v = jnp.stack([cache[str(i)]["v"][block] for i in range(L)])
-            return k, v
+        if self._kv_quant:
+            # Quantized whole-block movement lives in ops/quantized_kv.py
+            # (the NOS024 funnel); the engine only jits/shards it.
+            from nos_tpu.ops import quantized_kv as qkv
 
-        def _revive(cache, k, v, block):
-            for i in range(L):
-                cache[str(i)] = {
-                    "k": cache[str(i)]["k"].at[block].set(k[i]),
-                    "v": cache[str(i)]["v"].at[block].set(v[i]),
-                }
-            return cache
+            def _extract(cache, block):
+                return qkv.extract_block(cache, block, L)
+
+            def _revive(cache, k, v, ks, vs, block):
+                return qkv.revive_block(cache, k, v, ks, vs, block)
+        else:
+            def _extract(cache, block):
+                k = jnp.stack([cache[str(i)]["k"][block] for i in range(L)])
+                v = jnp.stack([cache[str(i)]["v"][block] for i in range(L)])
+                return k, v
+
+            def _revive(cache, k, v, block):
+                for i in range(L):
+                    cache[str(i)] = {
+                        "k": cache[str(i)]["k"].at[block].set(k[i]),
+                        "v": cache[str(i)]["v"].at[block].set(v[i]),
+                    }
+                return cache
 
         # Spill copy-outs GATHER the head shards into one full-width
         # payload (out spec on the KV-head axis, np.asarray assembles),
@@ -1190,13 +1245,27 @@ class DecodeServer:
         # payloads, and everything built on them (preemption, tiered
         # revive, cross-replica transfer), are identical bytes at any
         # tp: replicas of different widths interoperate by construction.
-        self._extract_fn = jax.jit(
-            _tp_shard(_extract, (_CS, _R), (_KV, _KV))
-        )
-        self._revive_fn = jax.jit(
-            _tp_shard(_revive, (_CS, _KV, _KV, _R), _CS),
-            donate_argnums=(0,),
-        )
+        # Quantized payloads keep the property — codes full-KV-head,
+        # scales per-block/replicated — plus an explicit dtype tag at
+        # the host layer (_extract_block) so an fp16 replica can never
+        # silently revive int8 bytes.
+        if self._kv_quant:
+            _SCO = None if self._mesh is None else _P(None)
+            self._extract_fn = jax.jit(
+                _tp_shard(_extract, (_CS, _R), (_KV, _KV, _SCO, _SCO))
+            )
+            self._revive_fn = jax.jit(
+                _tp_shard(_revive, (_CS, _KV, _KV, _SCO, _SCO, _R), _CS),
+                donate_argnums=(0,),
+            )
+        else:
+            self._extract_fn = jax.jit(
+                _tp_shard(_extract, (_CS, _R), (_KV, _KV))
+            )
+            self._revive_fn = jax.jit(
+                _tp_shard(_revive, (_CS, _KV, _KV, _R), _CS),
+                donate_argnums=(0,),
+            )
 
         # Radix-tree COW copy (PR 13): the first `length` positions of a
         # SHARED source block copied into a PRIVATE destination block,
@@ -1207,16 +1276,22 @@ class DecodeServer:
         # width (each device copies its own KV-head slice); `src`/`dst`/
         # `length` are traced scalars — one compiled program serves
         # every (source, destination, length) triple.
-        def _cow_copy(cache, src, dst, length):
-            mask = (jnp.arange(bs) < length)[None, :, None]
-            for i in range(L):
-                k = cache[str(i)]["k"]
-                v = cache[str(i)]["v"]
-                cache[str(i)] = {
-                    "k": k.at[dst].set(jnp.where(mask, k[src], k[dst])),
-                    "v": v.at[dst].set(jnp.where(mask, v[src], v[dst])),
-                }
-            return cache
+        if self._kv_quant:
+            def _cow_copy(cache, src, dst, length):
+                from nos_tpu.ops import quantized_kv as qkv
+
+                return qkv.cow_copy_block(cache, src, dst, length, bs)
+        else:
+            def _cow_copy(cache, src, dst, length):
+                mask = (jnp.arange(bs) < length)[None, :, None]
+                for i in range(L):
+                    k = cache[str(i)]["k"]
+                    v = cache[str(i)]["v"]
+                    cache[str(i)] = {
+                        "k": k.at[dst].set(jnp.where(mask, k[src], k[dst])),
+                        "v": v.at[dst].set(jnp.where(mask, v[src], v[dst])),
+                    }
+                return cache
 
         self._cow_fn = jax.jit(
             _tp_shard(_cow_copy, (_CS, _R, _R, _R), _CS),
@@ -1228,12 +1303,77 @@ class DecodeServer:
         (payload, nbytes). The reads below are DELIBERATE synchronous
         device->host transfers — spilling IS the copy-out, it happens
         only under allocation pressure or preemption (slow paths by
-        definition), and the bytes moved are the point."""
+        definition), and the bytes moved are the point.
+
+        Payload formats (the tier/store/handoff wire contract):
+          native  (k, v)                       — 2-tuple, pre-PR-20 bytes
+          int8    ("int8", k_q, v_q, ks, vs)   — explicit dtype tag first,
+                  so a native replica reviving a shared-store chain can
+                  REJECT a quantized payload (counted, then recomputed
+                  through normal prefill) instead of silently attending
+                  int8 codes as floats. nbytes includes the scales."""
+        if self._kv_quant:
+            k, v, ks, vs = self._extract_fn(self.cache, block)
+            self._syncs.note()  # one counted blocking copy-out per block
+            k = np.asarray(k)
+            v = np.asarray(v)
+            ks = np.asarray(ks)
+            vs = np.asarray(vs)
+            nbytes = k.nbytes + v.nbytes + ks.nbytes + vs.nbytes
+            return (constants.KV_DTYPE_INT8, k, v, ks, vs), nbytes
         k, v = self._extract_fn(self.cache, block)
         self._syncs.note()  # one counted blocking copy-out per block
         k = np.asarray(k)
         v = np.asarray(v)
         return (k, v), k.nbytes + v.nbytes
+
+    def _payload_matches(self, payload) -> bool:
+        """Does a tier payload's wire format match THIS engine's pool
+        dtype? Native engines take (k, v) 2-tuples; int8 engines take
+        ("int8", k, v, ks, vs) tagged 5-tuples. Chain keys are salted
+        per dtype (BlockManager key_salt), so a mismatch should be
+        impossible through the normal store path — this check is the
+        defense in depth that turns an impossible-in-theory collision
+        into a counted rejection + recompute instead of attending
+        garbage bytes."""
+        if not isinstance(payload, (tuple, list)):
+            return False
+        if self._kv_quant:
+            return len(payload) == 5 and payload[0] == constants.KV_DTYPE_INT8
+        return len(payload) == 2 and not isinstance(payload[0], str)
+
+    def _dispatch_revive(self, payload, block) -> bool:
+        """Copy one tier payload into device `block` through the jitted
+        revive program, dispatching on the wire format. Returns False
+        (counted in `kv_quant_payload_rejected`) on a dtype-mismatched
+        payload — every caller then downgrades that range to recompute,
+        bit-identical output paid in forward passes."""
+        if not self._payload_matches(payload):
+            self.kv_quant_payload_rejected += 1
+            if self.metrics is not None:
+                self.metrics.inc("nos_tpu_decode_kv_quant_payload_rejected")
+            return False
+        if self._kv_quant:
+            _, kx, vx, ksx, vsx = payload
+            with self._prof.dispatch():
+                self.cache = self._revive_fn(
+                    self.cache,
+                    self._stage.to_device(kx),
+                    self._stage.to_device(vx),
+                    self._stage.to_device(ksx),
+                    self._stage.to_device(vsx),
+                    block,
+                )
+            return True
+        kx, vx = payload
+        with self._prof.dispatch():
+            self.cache = self._revive_fn(
+                self.cache,
+                self._stage.to_device(kx),
+                self._stage.to_device(vx),
+                block,
+            )
+        return True
 
     def prewarm(self) -> "DecodeServer":
         """Compile every PREFILL program shape — mid-chunk, batched
@@ -1320,6 +1460,20 @@ class DecodeServer:
                 "DecodeServer is stopped (or draining): submit() after "
                 "stop() would strand the request; route it elsewhere"
             )
+        # Tenant KV-quality pin (TenantShare.kv_dtype): a request whose
+        # tenant is pinned to a different pool dtype is REJECTED at
+        # ingress — a guaranteed-fp16 tenant must never be silently
+        # served from a quantized pool. Static config check, so it
+        # raises synchronously instead of failing the Future later.
+        if self._quota is not None and tenant:
+            pin = getattr(self._quota.share_of(tenant), "kv_dtype", None)
+            if pin is not None and pin != self.kv_dtype:
+                raise ValueError(
+                    f"tenant {tenant!r} is pinned to kv_dtype={pin!r} but "
+                    f"this engine's pool is {self.kv_dtype!r}: route the "
+                    "request to a matching replica (serving/router.py "
+                    "filters candidates by the pin)"
+                )
         fut: Future = future if future is not None else Future()
         if max_new <= 0:
             fut.set_result([])
@@ -2244,14 +2398,14 @@ class DecodeServer:
                 )
                 slot.pending_revives = []
                 break
-            kx, vx = payload
-            with self._prof.dispatch():
-                self.cache = self._revive_fn(
-                    self.cache,
-                    self._stage.to_device(kx),
-                    self._stage.to_device(vx),
-                    block,
+            if not self._dispatch_revive(payload, block):
+                # Wire-dtype mismatch (counted): same downgrade as a
+                # dropped payload — the rest of the run recomputes.
+                self.spill_tier.unstage(
+                    [k for _, _, k in slot.pending_revives[1:]]
                 )
+                slot.pending_revives = []
+                break
             self._tick_state.mark_dirty()
             if self._tracer is not None:
                 self._tracer.event(
@@ -2340,14 +2494,9 @@ class DecodeServer:
             if payload is None:
                 slot.pending_cow = None
                 return 0, 0  # dropped under host pressure: recompute
-            kx, vx = payload
-            with self._prof.dispatch():
-                self.cache = self._revive_fn(
-                    self.cache,
-                    self._stage.to_device(kx),
-                    self._stage.to_device(vx),
-                    dst,
-                )
+            if not self._dispatch_revive(payload, dst):
+                slot.pending_cow = None
+                return 0, 0  # wire-dtype mismatch (counted): recompute
         slot.pending_cow = None
         slot.prefill_cursor = offset + n
         slot.pos = slot.prefill_cursor
@@ -2468,20 +2617,23 @@ class DecodeServer:
                 # Retired despite the stage pin (reset) — skip.
                 self._pending_prewarm.popleft()
                 continue
+            if not self._payload_matches(payload):
+                # Wire-dtype mismatch (counted): never admit a block for
+                # bytes this pool cannot attend.
+                self.kv_quant_payload_rejected += 1
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "nos_tpu_decode_kv_quant_payload_rejected"
+                    )
+                self._pending_prewarm.popleft()
+                continue
             block = self._block_mgr.admit_prewarm_block(
                 key, chain_tokens, chain_keys, reserve_free=reserve
             )
             if block is None:
                 self._pending_prewarm.popleft()
                 continue
-            kx, vx = payload
-            with self._prof.dispatch():
-                self.cache = self._revive_fn(
-                    self.cache,
-                    self._stage.to_device(kx),
-                    self._stage.to_device(vx),
-                    block,
-                )
+            self._dispatch_revive(payload, block)
             self._pending_prewarm.popleft()
             self._tick_state.mark_dirty()
             self.prewarm_tokens += cost
@@ -3623,8 +3775,17 @@ class DecodeServer:
         each active slot's tenant is charged `blocks held x weight`
         KV-block-ticks (`weight` = the fused windows of a burst tick,
         else 1, so burst-on and burst-off bill the same holding time).
-        Host-side reads only; runs solely while a ledger is armed."""
+        A quantized pool bills the SEPARATE `kv_block_ticks_int8` field:
+        an int8 block holds ~half the HBM bytes of a native one, so the
+        two tiers must be priceable differently on the same receipt
+        surface (docs/quantized-kv.md). Host-side reads only; runs
+        solely while a ledger is armed."""
         w = max(1, int(weight))
+        field = (
+            constants.COST_KV_BLOCK_TICKS_INT8
+            if self._kv_quant
+            else constants.COST_KV_BLOCK_TICKS
+        )
         for idx, slot in enumerate(self._slots):
             if not slot.active:
                 continue
@@ -3632,7 +3793,7 @@ class DecodeServer:
             if held:
                 self.kv_block_ticks += held
                 self._cost.charge(
-                    slot.trace_id, slot.tenant or "", kv_block_ticks=held
+                    slot.trace_id, slot.tenant or "", **{field: held}
                 )
 
     def _sync_tick_state(self, for_table_only: bool = False) -> None:
@@ -4033,6 +4194,24 @@ class DecodeServer:
     def spill_host_bytes(self) -> int:
         return self.spill_tier.host_bytes if self.spill_tier is not None else 0
 
+    # -- quantized-KV tier gauges (docs/quantized-kv.md) ----------------------
+    @property
+    def kv_quant_enabled(self) -> int:
+        """1 when the pool stores int8 codes + per-block scales."""
+        return int(self._kv_quant)
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        """Actual HBM bytes of the paged KV pool, scale arrays included
+        — metadata arithmetic only, no device sync. The capacity win is
+        `total_blocks / kv_pool_bytes` vs a native pool of the same
+        shape (the bench-smoke >= 1.9x blocks-per-HBM-byte gate)."""
+        total = 0
+        for lc in self.cache.values():
+            for leaf in lc.values():
+                total += int(leaf.nbytes)
+        return total
+
     # -- fleet KV store counters (serving/kv_store.py StoreTier; all
     # zero when the engine runs a private SpillTier, so the same report
     # fields serve both wirings). NOTE for fleet merges: store_bytes /
@@ -4160,6 +4339,8 @@ class DecodeServer:
         m.set_gauge("nos_tpu_decode_kv_blocks_spilled", pool["spilled"])
         m.set_gauge("nos_tpu_decode_spill_host_bytes", self.spill_host_bytes)
         m.set_gauge("nos_tpu_decode_radix_nodes", self.radix_nodes)
+        m.set_gauge("nos_tpu_decode_kv_quant_enabled", self.kv_quant_enabled)
+        m.set_gauge("nos_tpu_decode_kv_quant_pool_bytes", self.kv_pool_bytes)
         if self._store_shared:
             m.set_gauge("nos_tpu_fleet_kv_store_bytes", self.store_bytes)
             m.set_gauge("nos_tpu_fleet_kv_store_entries", self.store_entries)

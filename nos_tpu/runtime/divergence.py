@@ -1,0 +1,180 @@
+"""Bounded-divergence oracle for the int8 quantized KV tier.
+
+Everything else in this repo is verified by bit-exactness: replay
+determinism, dense-vs-paged parity, spill/revive round trips. Int8 KV
+deliberately breaks that house style — quantization error is the price
+of doubling pool capacity — so it needs a DIFFERENT kind of oracle: not
+"identical", but "divergence measured, bounded, and pinned".
+
+The oracle runs the pure model programs (paged_prefill_chunk +
+paged_decode_step) on two caches over identical traffic, TEACHER-FORCED:
+the native arm's greedy tokens (engine tie-break: lowest index) are fed
+to BOTH arms, so the quantized arm's logits are compared at the same
+sequence position against the same history — per-token deltas stay
+comparable instead of compounding through divergent sampling paths.
+It reports:
+
+  - max/mean per-token max-abs logit delta (quantized vs native arm);
+  - greedy top-1 agreement rate (would free-running greedy decode have
+    picked the same token?);
+  - per-position deltas, so a regression shows WHERE divergence grows.
+
+The pinned tolerances below were measured on the tier-1 model shapes
+(tiny GPT, f32 master weights) with ~4x headroom over observed values
+(observed max delta ~0.1, agreement 1.0 across seeds); tests and the
+bench-smoke gate assert against them. If a kernel change moves the
+measurement, re-pin CONSCIOUSLY — with the new measurement quoted in
+docs/quantized-kv.md — never by loosening to make a test pass.
+
+Acceptance-rate coupling: when the quantized cache feeds the PR 19
+radix-draft tree, quantization error can only change accept/reject
+decisions through these same logits, so the bench A/B compares the two
+arms' acceptance counters directly (`spec_accept_rate_delta` in the
+`quantized_kv` bench scenario) rather than re-deriving them here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+#: Pinned per-token max-abs logit delta bound for the tier-1 oracle
+#: shapes. Measured ~0.09-0.12 across seeds; pinned with headroom.
+MAX_ABS_LOGIT_DELTA = 0.5
+
+#: Pinned greedy top-1 agreement floor. Measured 1.0 on tier-1 shapes
+#: (tiny vocab, well-separated logits); pinned below to tolerate an
+#: occasional near-tie flip on adversarial seeds.
+MIN_TOP1_AGREEMENT = 0.98
+
+
+@dataclass(frozen=True)
+class DivergenceReport:
+    """Result of one oracle run: quantized arm vs native arm over
+    identical teacher-forced traffic."""
+
+    tokens_compared: int
+    max_abs_logit_delta: float
+    mean_abs_logit_delta: float
+    top1_agreement: float
+    #: per-token max-abs delta, in generation order (prefill last-token
+    #: logits first, then each decode step) — for localizing growth.
+    per_token_delta: List[float] = field(default_factory=list)
+
+    def within(
+        self,
+        max_delta: float = MAX_ABS_LOGIT_DELTA,
+        min_agreement: float = MIN_TOP1_AGREEMENT,
+    ) -> bool:
+        """True when this run sits inside the pinned bounds."""
+        return (
+            self.max_abs_logit_delta <= max_delta
+            and self.top1_agreement >= min_agreement
+        )
+
+    def summary(self) -> str:
+        return (
+            f"divergence: n={self.tokens_compared} "
+            f"max|dlogit|={self.max_abs_logit_delta:.4f} "
+            f"mean|dlogit|={self.mean_abs_logit_delta:.4f} "
+            f"top1_agree={self.top1_agreement:.4f}"
+        )
+
+
+def _greedy_pick(logits):
+    """The engine's greedy rule: highest logit, LOWEST index on exact
+    ties (matches DecodeServer._greedy and models.decode.generate)."""
+    import jax.numpy as jnp
+
+    vocab = logits.shape[-1]
+    top = jnp.max(logits, axis=-1, keepdims=True)
+    idx = jnp.arange(vocab, dtype=jnp.int32)
+    return jnp.min(jnp.where(logits == top, idx, vocab), axis=-1)
+
+
+def measure_divergence(
+    params,
+    cfg,
+    prompt: Sequence[int],
+    steps: int,
+    block_size: int = 8,
+    total_blocks: Optional[int] = None,
+    quant_dtype: str = "int8",
+) -> DivergenceReport:
+    """Run one prompt through a native-pool arm and a `quant_dtype`-pool
+    arm, teacher-forcing the native arm's greedy tokens into both, and
+    compare logits token by token. Pure-model: no engine, no scheduler —
+    this isolates quantization error from batching/dispatch effects."""
+    import jax.numpy as jnp
+
+    from nos_tpu.models import decode as D
+
+    prompt = list(int(t) for t in prompt)
+    n = len(prompt)
+    if total_blocks is None:
+        total_blocks = 2 + (n + steps + block_size - 1) // block_size
+    pages = [i + 1 for i in range((n + steps + block_size - 1) // block_size)]
+    width = max(len(pages), 1)
+    table = jnp.zeros((1, width), jnp.int32).at[0, : len(pages)].set(
+        jnp.asarray(pages, jnp.int32)
+    )
+    toks = jnp.asarray(prompt, jnp.int32)[None, :]
+
+    def prefill(kv_dtype):
+        cache = D.init_paged_cache(
+            cfg, total_blocks=total_blocks, block_size=block_size,
+            kv_dtype=kv_dtype,
+        )
+        logits, cache = D.paged_prefill_chunk(
+            params, toks, cfg, cache, table[0], 0, n, block_size
+        )
+        return logits[n - 1][None, :], cache  # [1, vocab]
+
+    lg_n, cache_n = prefill(None)
+    lg_q, cache_q = prefill(quant_dtype)
+
+    deltas: List[float] = []
+    agree = 0
+    total = 0
+    mass = 0.0
+    mask = jnp.ones((1,), bool)
+    pos = jnp.asarray([n], jnp.int32)
+    for step in range(steps + 1):
+        delta = jnp.max(jnp.abs(lg_n - lg_q))
+        deltas.append(float(delta))
+        mass += float(jnp.mean(jnp.abs(lg_n - lg_q)))
+        pick_n = _greedy_pick(lg_n)
+        pick_q = _greedy_pick(lg_q)
+        agree += int(pick_n[0] == pick_q[0])
+        total += 1
+        if step == steps:
+            break
+        # Teacher-force the NATIVE pick into both arms.
+        tok = pick_n.astype(jnp.int32)
+        lg_n, cache_n = D.paged_decode_step(
+            params, tok, cfg, cache_n, table, pos, mask, block_size
+        )
+        lg_q, cache_q = D.paged_decode_step(
+            params, tok, cfg, cache_q, table, pos, mask, block_size
+        )
+        pos = pos + 1
+
+    return DivergenceReport(
+        tokens_compared=total,
+        max_abs_logit_delta=max(deltas) if deltas else 0.0,
+        mean_abs_logit_delta=(mass / total) if total else 0.0,
+        top1_agreement=(agree / total) if total else 1.0,
+        per_token_delta=deltas,
+    )
+
+
+def compare_output_streams(native: Sequence[int], quant: Sequence[int]) -> float:
+    """Positionwise token agreement between two FREE-RUNNING output
+    streams (engine-level A/B, where arms sample their own tokens).
+    Divergence compounds after the first disagreement, so this is a
+    blunter signal than the teacher-forced oracle — the bench scenario
+    reports both."""
+    if not native or len(native) != len(quant):
+        return 0.0
+    hits = sum(1 for a, b in zip(native, quant) if int(a) == int(b))
+    return hits / len(native)

@@ -60,10 +60,19 @@ class TenantShare:
     room. `max_share` is the CEILING (`max`): admission stops feeding
     the tenant once its share reaches it. `max_share >= 1.0` means "may
     borrow everything" — a sole tenant's share is 1.0 by definition, so
-    only sub-1.0 ceilings ever throttle."""
+    only sub-1.0 ceilings ever throttle.
+
+    `kv_dtype` (optional) PINS the tenant to a KV-pool quality tier
+    (docs/quantized-kv.md): "fp16" keeps a guaranteed tenant on exact
+    native pools in a mixed fleet, "int8" opts a cost-tier tenant into
+    the cheaper quantized pools. None (default) = no preference, any
+    pool serves. Engines REJECT a submit whose pin contradicts their
+    pool at admission time, and the prefix router filters candidate
+    replicas by the pin — the knob routes, it never silently degrades."""
 
     min_share: float = 0.0
     max_share: float = 1.0
+    kv_dtype: Optional[str] = None
 
     def __post_init__(self):
         if not (0.0 <= self.min_share <= self.max_share):
@@ -71,6 +80,14 @@ class TenantShare:
                 f"need 0 <= min_share <= max_share, got "
                 f"min={self.min_share} max={self.max_share}"
             )
+        if self.kv_dtype is not None:
+            from nos_tpu import constants
+
+            if self.kv_dtype not in constants.KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype must be None or one of {constants.KV_DTYPES}: "
+                    f"{self.kv_dtype!r}"
+                )
 
 
 class QuotaPolicy:

@@ -78,13 +78,23 @@ def chain_key(parent: str, tokens: Sequence[int]) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
-def prompt_chain_keys(prompt: Sequence[int], block_size: int) -> List[str]:
+def prompt_chain_keys(
+    prompt: Sequence[int], block_size: int, salt: str = ""
+) -> List[str]:
     """Chain keys for every block FULLY covered by `prompt`, in prefix
     order. Module-level so the cluster router (nos_tpu/serving/router.py)
     computes the SAME keys engines index under — router keys and engine
-    keys agree by construction, never by convention."""
+    keys agree by construction, never by convention.
+
+    `salt` seeds the chain's root parent, giving the key space an extra
+    dimension: an int8-pool engine salts with its payload dtype
+    (docs/quantized-kv.md), so its keys can NEVER collide with an fp16
+    replica's in a shared FleetKVStore — a native pool cannot even look
+    up quantized bytes, let alone revive them. The router keeps the
+    unsalted space; against a salted engine its prefix scores read 0,
+    which only costs routing affinity, never correctness."""
     keys: List[str] = []
-    parent = ""
+    parent = salt
     for b in range(len(prompt) // block_size):
         parent = chain_key(parent, prompt[b * block_size : (b + 1) * block_size])
         keys.append(parent)
@@ -136,9 +146,13 @@ class RadixTree:
     predicates over chain keys (the BlockManager passes its index and
     spill tier; the router shadow passes its believed-resident set)."""
 
-    def __init__(self) -> None:
+    def __init__(self, key_salt: str = "") -> None:
         self._root = RadixNode("", (), None)
         self._nodes = {}  # key -> RadixNode
+        #: chain-key root salt (see `prompt_chain_keys`): every key this
+        #: tree derives itself is salted identically, so a tree never
+        #: mixes key spaces.
+        self.key_salt = key_salt
 
     # -- queries -------------------------------------------------------------
     def __len__(self) -> int:
@@ -344,7 +358,10 @@ class RadixTree:
             tuple(prompt[b * block_size : (b + 1) * block_size])
             for b in range(n_blocks)
         ]
-        self.ensure_path(blocks, prompt_chain_keys(prompt, block_size)[:n_blocks])
+        self.ensure_path(
+            blocks,
+            prompt_chain_keys(prompt, block_size, self.key_salt)[:n_blocks],
+        )
 
     def ref(self, key: str) -> None:
         """A page table mapped the node's indexed block (admission hit,

@@ -75,6 +75,7 @@ class PrefixRouter:
         sticky_tenants: bool = True,
         tracer=None,
         kv_store=None,
+        quota=None,
     ):
         """`load_penalty_tokens` prices one unit of replica load (an
         active slot / queued request) in prefix-hit tokens; default =
@@ -97,7 +98,15 @@ class PrefixRouter:
         device-resident hit, mirroring the engine-side cost order.
         Membership probes only (peek-must-not-perturb: no recency
         touch, no pins), so scoring never changes what the store
-        retires next."""
+        retires next.
+
+        `quota` (optional, duck-typed to runtime/quota.py QuotaPolicy —
+        share the instance the replicas use) arms TENANT KV-QUALITY
+        routing (docs/quantized-kv.md): a tenant whose TenantShare pins
+        `kv_dtype` only ever routes to replicas whose pool matches the
+        pin — the router-side half of the engine's ingress rejection,
+        so a guaranteed-fp16 tenant simply never sees an int8 replica
+        as a candidate. Tenants without a pin score every replica."""
         if policy not in constants.ROUTER_POLICIES:
             raise ValueError(
                 f"unknown router policy {policy!r}; "
@@ -114,6 +123,7 @@ class PrefixRouter:
         self.sticky_tenants = bool(sticky_tenants)
         self.tracer = tracer
         self.kv_store = kv_store
+        self.quota = quota
         self._lock = threading.Lock()
         self._rr = 0
         self._sticky: Dict[str, str] = {}  # tenant -> replica_id
@@ -268,6 +278,27 @@ class PrefixRouter:
         """Returns (handle, the prompt's cacheable chain keys, predicted
         hit tokens — deepest-tree-match). Caller holds the lock."""
         active = self._candidates(exclude, phase)
+        # Tenant KV-quality pin (TenantShare.kv_dtype): candidates whose
+        # pool dtype contradicts the pin are not candidates at all —
+        # the engine-side ingress check would reject them anyway; the
+        # router just never sends the request there.
+        pin = None
+        if tenant and self.quota is not None:
+            pin = getattr(self.quota.share_of(tenant), "kv_dtype", None)
+        if pin is not None:
+            matched = [
+                h
+                for h in active
+                if getattr(h.engine, "kv_dtype", constants.KV_DTYPE_NATIVE)
+                == pin
+            ]
+            if not matched:
+                raise RuntimeError(
+                    f"no admitting replica with kv_dtype={pin!r} for "
+                    f"tenant {tenant!r} (pin via TenantShare.kv_dtype): "
+                    "cannot route"
+                )
+            active = matched
         # The same below-the-last-token cap admission applies (ONE
         # shared helper — router and engine can never disagree on it):
         # the final block is always recomputed privately, so it can

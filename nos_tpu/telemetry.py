@@ -147,6 +147,15 @@ class ServingReport:
     kv_blocks_spilled: int = 0
     preemptions: int = 0
     borrowed_ticks: int = 0
+    # Quantized-KV tier (PR 20, docs/quantized-kv.md): whether the pool
+    # stores int8 codes (gauge 0/1; a fleet merge's sum counts quantized
+    # replicas), the pool's actual HBM bytes including scale arrays
+    # (gauge; merge sums fleet HBM), and tier payloads rejected for a
+    # wire-dtype mismatch (counter; nonzero means a mis-wired fleet —
+    # dtype-salted chain keys make it unreachable through the store).
+    kv_quant_enabled: int = 0
+    kv_pool_bytes: int = 0
+    kv_quant_payload_rejected: int = 0
     # Fleet KV store (PR 16, serving/kv_store.py, docs/kv-store.md):
     # per-engine traffic against the SHARED content-addressed cold tier
     # — revive reads served / staged revives the store had retired /
@@ -400,6 +409,8 @@ REPORT_GAUGE_FIELDS = frozenset(
         "kv_blocks_spilled",
         "radix_nodes",
         "spill_host_bytes",
+        "kv_quant_enabled",
+        "kv_pool_bytes",
         "store_bytes",
         "store_entries",
         "inflight_dispatches",
@@ -528,6 +539,11 @@ def collect_serving(server) -> ServingReport:
         revives=int(getattr(server, "revives", 0)),
         spill_drops=int(getattr(server, "spill_drops", 0)),
         spill_host_bytes=int(getattr(server, "spill_host_bytes", 0)),
+        kv_quant_enabled=int(getattr(server, "kv_quant_enabled", 0)),
+        kv_pool_bytes=int(getattr(server, "kv_pool_bytes", 0)),
+        kv_quant_payload_rejected=int(
+            getattr(server, "kv_quant_payload_rejected", 0)
+        ),
         store_hits=int(getattr(server, "store_hits", 0)),
         store_misses=int(getattr(server, "store_misses", 0)),
         store_puts=int(getattr(server, "store_puts", 0)),

@@ -1,0 +1,36 @@
+"""NOS024 negatives: reading scale leaves, rebuilding the per-layer cache
+dict from funnel OUTPUTS (a dict literal, not a write into quant state),
+functional writes on non-scale leaves, similarly-named keys, and
+quantize-direction helpers are all sanctioned. The model's attend closure
+does exactly this: call the ops/ funnel, receive new arrays, re-wrap.
+"""
+
+
+def attend(lc, pages, offs, vals, scatter_tokens, paged_decode_attention, q, table, limit):
+    # The sanctioned flow: the ops/ funnel returns new pool + scale
+    # arrays; the caller re-wraps them in a dict LITERAL.
+    ck, ks = scatter_tokens(lc["k"], lc["k_scale"], pages, offs, vals)
+    cv, vs = scatter_tokens(lc["v"], lc["v_scale"], pages, offs, vals)
+    out = paged_decode_attention(
+        q, ck, cv, table, limit, k_scale=ks, v_scale=vs
+    )
+    return out, {"k": ck, "v": cv, "k_scale": ks, "v_scale": vs}
+
+
+def pool_bytes(cache):
+    return sum(
+        lc["k_scale"].nbytes + lc["v_scale"].nbytes for lc in cache.values()
+    )
+
+
+def non_scale_write(lc, block, rows):
+    lc["k"] = lc["k"].at[block].set(rows)  # pool codes, not scale state
+
+
+def metadata(meta, scales):
+    meta["k_scale_layout"] = "per-block"  # similarly-named key, not a leaf
+    return meta
+
+
+def compress(quantize_rows, rows, scale):
+    return quantize_rows(rows, scale)  # quantize direction: ops-bound input

@@ -1,0 +1,31 @@
+"""NOS024 positives: quantized-KV scale state written, or dequantization
+called, outside the ops/ funnel.
+
+Expected findings (8): a direct subscript assignment to a `"k_scale"`
+leaf, an elementwise assignment through a `"v_scale"` leaf, an engine
+attribute `_kv_scales` assignment, two jax functional writes
+(`.at[...].set` / `.at[...].max`) rooted at scale leaves, a `del` of a
+scale leaf, and two dequantization calls (free function + method). Reads
+stay legal — see quant_neg.py.
+"""
+
+
+def patch_scales(cache, block, scales):
+    cache["0"]["k_scale"] = scales
+    cache["0"]["v_scale"][block] = 1.0
+    ks = cache["0"]["k_scale"].at[block].set(0.0)
+    vs = cache["1"]["v_scale"].at[block].max(2.0)
+    del cache["0"]["k_scale"]
+    return ks, vs
+
+
+def hydrate(pool_q, scale, dequantize):
+    return dequantize(pool_q, scale)
+
+
+class Engine:
+    def __init__(self, scales):
+        self._kv_scales = scales
+
+    def _revive(self, tier, block):
+        return tier.dequantize_block(block)

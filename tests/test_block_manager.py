@@ -95,7 +95,11 @@ def check_tree_invariants(mgr, owners, pins):
         )
         # The chain key recomputed over the node's path must equal the
         # stored key — index and tree agree by content, not convention.
+        # Root children chain from the tree's dtype salt (ISSUE 20), the
+        # root node itself keeps the "" sentinel key the walk tests on.
         parent_key = node.parent.key if node.parent is not None else ""
+        if node.parent is tree._root:
+            parent_key = tree.key_salt
         assert chain_key(parent_key, node.tokens) == key
         # Reachability: the parent edge points back at this node.
         assert node.parent._edges.get(node.tokens) is node
@@ -648,9 +652,15 @@ def test_radix_reset_keeps_host_paths_prunes_device_nodes():
 
 
 # -- the randomized invariant satellite ---------------------------------------
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
 @pytest.mark.parametrize("radix", [False, True])
-def test_randomized_interleaving_preserves_invariants(radix):
-    """ISSUE 5 satellite, extended by ISSUE 6, ISSUE 7, and ISSUE 13:
+def test_randomized_interleaving_preserves_invariants(radix, kv_dtype):
+    """ISSUE 5 satellite, extended by ISSUE 6, ISSUE 7, ISSUE 13, and
+    ISSUE 20 (the `kv_dtype` axis: the int8 arm salts chain keys with
+    the pool dtype and spills TAGGED payloads of VARIABLE width —
+    quantized codes + scales make per-block bytes shape-dependent, so
+    the host tier's byte-balance law must hold for any width mix, not
+    one constant):
     after ANY admit/prefill/decode/finish/evict interleaving — with
     FAULT-INJECTED admissions, recovery-shaped reset/restore cycles,
     SPILL/REVIVE/PREEMPT ops, and (radix arm) TREE ops woven into the
@@ -680,11 +690,21 @@ def test_randomized_interleaving_preserves_invariants(radix):
     injector = FaultInjector(
         [FaultSpec("block_admit", rng.randint(1, 40), "poison")]
     )
-    mgr = BlockManager(1 + 10, BS, 4, fault_injector=injector, radix=radix)
-    # Small host tier (6 x 16-byte fake payloads): capacity drops fire
-    # alongside spills and revives.
+    mgr = BlockManager(
+        1 + 10, BS, 4, fault_injector=injector, radix=radix,
+        key_salt=(kv_dtype + ":") if kv_dtype else "",
+    )
+    # Small host tier (~6 payloads): capacity drops fire alongside
+    # spills and revives. The native arm spills constant 16-byte
+    # payloads; the int8 arm spills dtype-tagged payloads whose width
+    # varies per block (codes + scales).
     tier = SpillTier(capacity_bytes=6 * 16)
-    mgr.attach_spill(tier, lambda block: (f"kv-of-{block}", 16))
+    if kv_dtype:
+        mgr.attach_spill(
+            tier, lambda block: ((kv_dtype, f"kv-of-{block}"), 10 + block % 7)
+        )
+    else:
+        mgr.attach_spill(tier, lambda block: (f"kv-of-{block}", 16))
     live = {}  # slot -> (prompt, cursor, max_new)
     finished = []  # (prompt, registered output) pool for multi-turn ops
     injected = 0

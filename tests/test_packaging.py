@@ -237,7 +237,12 @@ class TestBuildArtifacts:
     def test_images_install_declared_dependencies(self):
         """The images rely on `pip install .` pulling what the binaries
         import at startup (yaml for configs/kubeconfigs, numpy)."""
-        import tomllib
+        # stdlib tomllib landed in Python 3.11; on 3.10 interpreters the
+        # import (not the assertion) is what fails, so skip honestly
+        # instead of reporting a dependency regression that isn't one.
+        tomllib = pytest.importorskip(
+            "tomllib", reason="stdlib tomllib requires Python >= 3.11"
+        )
 
         with open(REPO / "pyproject.toml", "rb") as f:
             project = tomllib.load(f)["project"]

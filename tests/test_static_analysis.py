@@ -23,6 +23,7 @@ from nos_tpu.analysis.checkers.fault_discipline import FaultDisciplineChecker
 from nos_tpu.analysis.checkers.host_sync import HostSyncChecker
 from nos_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
 from nos_tpu.analysis.checkers.protocol_roundtrip import ProtocolRoundTripChecker
+from nos_tpu.analysis.checkers.quant_discipline import QuantDisciplineChecker
 from nos_tpu.analysis.checkers.radix_discipline import RadixDisciplineChecker
 from nos_tpu.analysis.checkers.spill_discipline import SpillDisciplineChecker
 from nos_tpu.analysis.checkers.device_placement import DevicePlacementChecker
@@ -1573,3 +1574,78 @@ def test_cli_lint_json_format(tmp_path, capsys):
     assert payload["findings"][0]["code"] == "NOS001"
     assert payload["findings"][0]["path"] == "mod.py"
     assert "stats" in payload
+
+
+# -- NOS024 quantized-KV write-funnel discipline ------------------------------
+def test_quant_discipline_positives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "quant_pos.py"),
+        [QuantDisciplineChecker()],
+    )
+    assert codes_of(findings) == ["NOS024"]
+    # Subscript assign to k_scale, elementwise assign through v_scale,
+    # the engine's _kv_scales attribute assign, the two .at[...] writes,
+    # the del, and both dequantization calls — NOT any read.
+    assert len(findings) == 8
+    msgs = " | ".join(f.message for f in findings)
+    assert "k_scale" in msgs
+    assert "v_scale" in msgs
+    assert "_kv_scales" in msgs
+    assert "dequantize" in msgs
+
+
+def test_quant_discipline_negatives():
+    findings = run_checkers(
+        os.path.join(FIXTURES, "runtime", "quant_neg.py"),
+        [QuantDisciplineChecker()],
+    )
+    assert findings == []
+
+
+def test_quant_discipline_scopes(tmp_path):
+    # The rule binds runtime/, serving/ and models/; ops/ is the funnel
+    # itself and stays exempt, as does anything outside those trees.
+    body = (
+        "def hack(lc, b, s):\n"
+        "    lc['k_scale'] = lc['k_scale'].at[b].set(s)\n"
+    )
+    f = tmp_path / "elsewhere.py"
+    f.write_text(body)
+    assert run_checkers(str(f), [QuantDisciplineChecker()]) == []
+    g = tmp_path / "ops" / "quantized_kv_like.py"
+    g.parent.mkdir()
+    g.write_text(body)
+    assert run_checkers(str(g), [QuantDisciplineChecker()]) == []
+    k = tmp_path / "models" / "decode_like.py"
+    k.parent.mkdir()
+    k.write_text(body)
+    # One finding per rule hit: the subscript assign AND the .at write.
+    found = run_checkers(str(k), [QuantDisciplineChecker()])
+    assert codes_of(found) == ["NOS024"] and len(found) == 2
+    m = tmp_path / "runtime" / "engine_like.py"
+    m.parent.mkdir()
+    m.write_text("def hydrate(tier, b):\n    return tier.dequantize_block(b)\n")
+    assert codes_of(run_checkers(str(m), [QuantDisciplineChecker()])) == [
+        "NOS024"
+    ]
+
+
+def test_quant_discipline_real_surface_is_clean():
+    # The tentpole's enforcement, checked directly: the model's quant
+    # attend closures, the engine's extract/revive/COW wrappers and the
+    # divergence oracle all route scale writes and dequantization
+    # through ops/quantized_kv.py + ops/paged_attention.py.
+    for rel in (
+        os.path.join("models", "decode.py"),
+        os.path.join("runtime", "decode_server.py"),
+        os.path.join("runtime", "divergence.py"),
+        os.path.join("runtime", "block_manager.py"),
+        os.path.join("runtime", "spill.py"),
+        os.path.join("serving", "kv_store.py"),
+        os.path.join("serving", "replica.py"),
+        os.path.join("serving", "router.py"),
+    ):
+        findings = run_checkers(
+            os.path.join(TREE, rel), [QuantDisciplineChecker()]
+        )
+        assert findings == [], rel
